@@ -1,0 +1,17 @@
+"""spark-rapids-tpu: a TPU-native accelerator with the capabilities of the
+RAPIDS Accelerator for Apache Spark (reference: NVIDIA spark-rapids), built
+on JAX/XLA/Pallas over Arrow-layout HBM batches instead of cuDF/CUDA.
+
+Enable 64-bit mode up front: SQL engines are bigint/double-centric and Spark
+semantics require true int64/float64 — jax defaults to 32-bit otherwise.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from . import types  # noqa: E402,F401
+from .batch import ColumnarBatch, DeviceColumn, Field, Schema  # noqa: E402,F401
+from .config import RapidsTpuConf  # noqa: E402,F401
+
+__version__ = "26.08.0"

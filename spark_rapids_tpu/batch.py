@@ -1,0 +1,322 @@
+"""Columnar data plane: device columns and batches.
+
+TPU-native analogue of the reference's column bridge
+(reference: sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java —
+Spark ColumnVector over cudf columns) re-designed for XLA:
+
+- A ``DeviceColumn`` is a fixed-capacity JAX array plus a validity mask. The
+  capacity is **static** (bucketed to powers of two) so that every operator
+  compiles once per bucket instead of once per row count — cudf kernels accept
+  any shape, XLA wants static shapes; this bucketed-padding scheme is the
+  central architectural divergence called out in SURVEY.md §7.
+- ``num_rows`` is a traced scalar: rows in ``[num_rows, capacity)`` are padding
+  and always invalid. Filters clear validity instead of compacting, so a whole
+  scan→project→filter→aggregate stage fuses into one XLA computation with no
+  host round-trips; compaction happens only at exchange boundaries.
+- Strings are fixed-width padded UTF-8 byte matrices ``uint8[cap, max_len]``
+  with a separate length vector (rectangular data for the VPU; see types.py).
+
+Host interchange is Arrow (pyarrow) — the same interchange layer the
+reference uses between the JVM and Python workers (GpuArrowEvalPythonExec).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+import flax.struct
+
+from . import types as T
+from .types import SqlType, TypeKind
+
+MIN_CAPACITY = 128  # one TPU lane row
+
+
+class StringOverflowError(ValueError):
+    """A string exceeded its column's device max_len byte budget."""
+
+
+def bucket_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
+    """Round a row count up to the compile-cache bucket (next power of two)."""
+    if n <= minimum:
+        return minimum
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: SqlType
+    nullable: bool = True
+
+
+@dataclass(frozen=True)
+class Schema:
+    fields: Tuple[Field, ...]
+
+    def __init__(self, fields: Sequence[Field]):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i):
+        return self.fields[i]
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(f"column {name!r} not in schema {self.names}")
+
+    def field(self, name: str) -> Field:
+        return self.fields[self.index_of(name)]
+
+    def __str__(self):
+        inner = ", ".join(f"{f.name}: {f.dtype}" for f in self.fields)
+        return f"Schema({inner})"
+
+
+@flax.struct.dataclass
+class DeviceColumn:
+    """One column resident in HBM: payload + validity (+ lengths for strings)."""
+
+    data: jax.Array                 # [cap] or [cap, max_len] uint8 for strings
+    validity: jax.Array             # bool[cap]; False beyond num_rows
+    lengths: Optional[jax.Array] = None   # int32[cap], strings only
+    dtype: SqlType = flax.struct.field(pytree_node=False, default=T.INT32)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def with_validity(self, validity: jax.Array) -> "DeviceColumn":
+        return self.replace(validity=validity)
+
+    def size_bytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize + self.validity.size
+        if self.lengths is not None:
+            n += self.lengths.size * 4
+        return n
+
+
+@flax.struct.dataclass
+class ColumnarBatch:
+    """A batch of columns with a traced row count and static capacity."""
+
+    columns: Tuple[DeviceColumn, ...]
+    num_rows: jax.Array  # int32 scalar
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> DeviceColumn:
+        return self.columns[i]
+
+    def row_mask(self) -> jax.Array:
+        """bool[cap] — True for live (within num_rows) positions."""
+        cap = self.capacity
+        return jnp.arange(cap, dtype=jnp.int32) < self.num_rows
+
+    def size_bytes(self) -> int:
+        return sum(c.size_bytes() for c in self.columns)
+
+
+# ---------------------------------------------------------------------------
+# Construction helpers
+# ---------------------------------------------------------------------------
+
+def make_column(values: np.ndarray, validity: np.ndarray, dtype: SqlType,
+                capacity: int, lengths: Optional[np.ndarray] = None) -> DeviceColumn:
+    """Pad host arrays to capacity and move to device.
+
+    For strings, pass the exact byte ``lengths``; deriving them from the
+    zero-padded matrix would drop trailing NUL bytes.
+    """
+    n = values.shape[0]
+    if n > capacity:
+        raise ValueError(f"{n} rows exceed capacity {capacity}")
+    if dtype.kind is TypeKind.STRING:
+        ml = dtype.max_len
+        padded = np.zeros((capacity, ml), dtype=np.uint8)
+        padded[:n] = values
+        plen = np.zeros(capacity, dtype=np.int32)
+        plen[:n] = values_lengths(values) if lengths is None else lengths
+        val = np.zeros(capacity, dtype=bool)
+        val[:n] = validity
+        return DeviceColumn(jnp.asarray(padded), jnp.asarray(val),
+                            jnp.asarray(plen), dtype)
+    padded = np.zeros(capacity, dtype=T.numpy_dtype(dtype))
+    padded[:n] = values
+    val = np.zeros(capacity, dtype=bool)
+    val[:n] = validity
+    return DeviceColumn(jnp.asarray(padded), jnp.asarray(val), None, dtype)
+
+
+def values_lengths(byte_matrix: np.ndarray) -> np.ndarray:
+    """Recover string byte lengths from a zero-padded byte matrix."""
+    nz = byte_matrix != 0
+    return (byte_matrix.shape[1] - np.argmax(nz[:, ::-1], axis=1)) * nz.any(axis=1)
+
+
+def _strings_to_matrix(arr: pa.Array, max_len: int,
+                       truncate: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode an arrow string array into (byte_matrix, lengths).
+
+    Raises on strings longer than ``max_len`` unless ``truncate`` — silent
+    truncation is data corruption; the planner re-buckets max_len or falls
+    back to CPU instead (config.STRING_MAX_BYTES).
+    """
+    n = len(arr)
+    out = np.zeros((n, max_len), dtype=np.uint8)
+    lengths = np.zeros(n, dtype=np.int32)
+    py = arr.to_pylist()
+    for i, s in enumerate(py):
+        if s is None:
+            continue
+        b = s.encode("utf-8")
+        if len(b) > max_len:
+            if not truncate:
+                raise StringOverflowError(
+                    f"string of {len(b)} bytes exceeds device max_len "
+                    f"{max_len}; re-bucket the column or fall back to CPU")
+            b = b[:max_len]
+            while b and (b[-1] & 0xC0) == 0x80:  # don't split a codepoint
+                b = b[:-1]
+        out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lengths[i] = len(b)
+    return out, lengths
+
+
+def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
+                      truncate_strings: bool = False) -> DeviceColumn:
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    n = len(arr)
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+    else:
+        validity = np.ones(n, dtype=bool)
+
+    if dtype.kind is TypeKind.STRING:
+        mat, lengths = _strings_to_matrix(arr, dtype.max_len, truncate_strings)
+        padded = np.zeros((capacity, dtype.max_len), dtype=np.uint8)
+        padded[:n] = mat
+        plen = np.zeros(capacity, dtype=np.int32)
+        plen[:n] = lengths
+        val = np.zeros(capacity, dtype=bool)
+        val[:n] = validity
+        return DeviceColumn(jnp.asarray(padded), jnp.asarray(val),
+                            jnp.asarray(plen), dtype)
+
+    if dtype.kind is TypeKind.DECIMAL:
+        # store unscaled int64 (DECIMAL64)
+        np_vals = np.array([int(v.scaleb(dtype.scale)) if v is not None else 0
+                            for v in arr.to_pylist()], dtype=np.int64)
+    elif dtype.kind is TypeKind.TIMESTAMP:
+        np_vals = np.zeros(n, dtype=np.int64)
+        tmp = arr.cast(pa.timestamp("us")).to_numpy(zero_copy_only=False)
+        np_vals[validity] = tmp[validity].astype("datetime64[us]").astype(np.int64)
+    elif dtype.kind is TypeKind.DATE:
+        np_vals = np.zeros(n, dtype=np.int32)
+        tmp = arr.to_numpy(zero_copy_only=False)
+        good = validity
+        np_vals[good] = np.asarray(tmp[good], dtype="datetime64[D]").astype(np.int32)
+    else:
+        # Null slots become 0 in the payload (validity carries nullness);
+        # keeps integer dtypes intact and avoids NaN poisoning reductions.
+        filled = arr.fill_null(False) if dtype.kind is TypeKind.BOOLEAN \
+            else arr.fill_null(0) if arr.null_count else arr
+        np_vals = np.asarray(filled.to_numpy(zero_copy_only=False),
+                             dtype=T.numpy_dtype(dtype))
+
+    return make_column(np_vals, validity, dtype, capacity)
+
+
+def schema_from_arrow(schema: pa.Schema, string_max_len: int = 64) -> Schema:
+    return Schema([Field(f.name, T.from_arrow(f.type, string_max_len), f.nullable)
+                   for f in schema])
+
+
+def from_arrow(table: pa.Table, capacity: Optional[int] = None,
+               schema: Optional[Schema] = None,
+               string_max_len: int = 64,
+               truncate_strings: bool = False) -> Tuple[ColumnarBatch, Schema]:
+    """Build a device batch from an Arrow table (the scan H2D boundary)."""
+    if schema is None:
+        schema = schema_from_arrow(table.schema, string_max_len)
+    n = table.num_rows
+    cap = capacity or bucket_capacity(n)
+    cols = [column_from_arrow(table.column(i), f.dtype, cap, truncate_strings)
+            for i, f in enumerate(schema)]
+    return ColumnarBatch(tuple(cols), jnp.asarray(n, jnp.int32)), schema
+
+
+def empty_batch(schema: Schema, capacity: int = MIN_CAPACITY) -> ColumnarBatch:
+    cols = []
+    for f in schema:
+        if f.dtype.kind is TypeKind.STRING:
+            data = jnp.zeros((capacity, f.dtype.max_len), jnp.uint8)
+            lengths = jnp.zeros(capacity, jnp.int32)
+        else:
+            data = jnp.zeros(capacity, f.dtype.storage_dtype)
+            lengths = None
+        cols.append(DeviceColumn(data, jnp.zeros(capacity, bool), lengths, f.dtype))
+    return ColumnarBatch(tuple(cols), jnp.asarray(0, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Device -> host (the C2R / collect boundary)
+# ---------------------------------------------------------------------------
+
+def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
+    n = int(batch.num_rows)
+    arrays = []
+    for col, f in zip(batch.columns, schema):
+        validity = np.asarray(col.validity[:n])
+        if f.dtype.kind is TypeKind.STRING:
+            mat = np.asarray(col.data[:n])
+            lens = np.asarray(col.lengths[:n])
+            vals = [bytes(mat[i, : lens[i]]).decode("utf-8", "replace")
+                    if validity[i] else None for i in range(n)]
+            arrays.append(pa.array(vals, type=pa.string()))
+            continue
+        data = np.asarray(col.data[:n])
+        if f.dtype.kind is TypeKind.DECIMAL:
+            import decimal as pydec
+            vals = [pydec.Decimal(int(v)).scaleb(-f.dtype.scale)
+                    if ok else None for v, ok in zip(data, validity)]
+            arrays.append(pa.array(vals, type=T.to_arrow(f.dtype)))
+            continue
+        if f.dtype.kind is TypeKind.TIMESTAMP:
+            arrays.append(pa.array(data.astype("datetime64[us]"),
+                                   type=T.to_arrow(f.dtype),
+                                   mask=~validity))
+            continue
+        if f.dtype.kind is TypeKind.DATE:
+            arrays.append(pa.array(data.astype("datetime64[D]"),
+                                   type=T.to_arrow(f.dtype), mask=~validity))
+            continue
+        arrays.append(pa.array(data, type=T.to_arrow(f.dtype), mask=~validity))
+    return pa.table(arrays, names=schema.names)
+
+
+def to_pandas(batch: ColumnarBatch, schema: Schema):
+    return to_arrow(batch, schema).to_pandas()

@@ -1,0 +1,264 @@
+"""Self-documenting configuration registry.
+
+TPU-native analogue of the reference's `RapidsConf` builder DSL
+(reference: sql-plugin/.../RapidsConf.scala:119-308 — 168 typed
+`spark.rapids.*` entries with generated docs). Entries here use the
+`spark.rapids.tpu.*` namespace; `generate_docs()` renders the table the same
+way `RapidsConf.help` generates docs/configs.md in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+
+
+@dataclass
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    conv: Callable[[str], Any]
+    startup_only: bool = False
+    internal: bool = False
+
+    def get(self, conf: "RapidsTpuConf") -> Any:
+        return conf.get(self.key)
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    if entry.key in _REGISTRY:
+        raise ValueError(f"duplicate conf {entry.key}")
+    _REGISTRY[entry.key] = entry
+    return entry
+
+
+class ConfBuilder:
+    def __init__(self, key: str):
+        self.key = key
+        self._doc = ""
+        self._startup = False
+        self._internal = False
+
+    def doc(self, d: str) -> "ConfBuilder":
+        self._doc = " ".join(d.split())
+        return self
+
+    def startup_only(self) -> "ConfBuilder":
+        self._startup = True
+        return self
+
+    def internal(self) -> "ConfBuilder":
+        self._internal = True
+        return self
+
+    def _make(self, default, conv):
+        return _register(ConfEntry(self.key, default, self._doc, conv,
+                                   self._startup, self._internal))
+
+    def boolean(self, default: bool) -> ConfEntry:
+        return self._make(default, lambda s: str(s).strip().lower() in ("true", "1"))
+
+    def integer(self, default: int) -> ConfEntry:
+        return self._make(default, int)
+
+    def floating(self, default: float) -> ConfEntry:
+        return self._make(default, float)
+
+    def bytes_(self, default: int) -> ConfEntry:
+        return self._make(default, parse_bytes)
+
+    def text(self, default: str) -> ConfEntry:
+        return self._make(default, str)
+
+
+def conf(key: str) -> ConfBuilder:
+    return ConfBuilder(key)
+
+
+def parse_bytes(s) -> int:
+    if isinstance(s, (int, float)):
+        return int(s)
+    s = str(s).strip().lower()
+    units = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40, "b": 1}
+    for suffix in ("kb", "mb", "gb", "tb", "k", "m", "g", "t", "b"):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * units[suffix[0]])
+    return int(float(s))
+
+
+# ---------------------------------------------------------------------------
+# Entries. Grouped like the reference's RapidsConf sections.
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf("spark.rapids.tpu.sql.enabled").doc(
+    "Master switch: when false every operator stays on CPU (differential-test "
+    "oracle mode; reference: spark.rapids.sql.enabled).").boolean(True)
+
+EXPLAIN = conf("spark.rapids.tpu.sql.explain").doc(
+    "NONE, ALL, or NOT_ON_TPU: log why parts of a query were not placed on the "
+    "TPU (reference: spark.rapids.sql.explain).").text("NONE")
+
+MODE = conf("spark.rapids.tpu.sql.mode").doc(
+    "executeontpu or explainonly: explainonly plans as if a TPU were present "
+    "but executes on CPU (reference: spark.rapids.sql.mode=explainonly).").text(
+    "executeontpu")
+
+INCOMPATIBLE_OPS = conf("spark.rapids.tpu.sql.incompatibleOps.enabled").doc(
+    "Enable operators whose results differ from Spark in corner cases (float "
+    "aggregation order, XLA float rounding vs CUDA; reference: "
+    "spark.rapids.sql.incompatibleOps.enabled).").boolean(False)
+
+ANSI_ENABLED = conf("spark.rapids.tpu.sql.ansi.enabled").doc(
+    "ANSI SQL mode: overflow and invalid casts raise instead of null/wrap."
+).boolean(False)
+
+BATCH_SIZE_BYTES = conf("spark.rapids.tpu.sql.batchSizeBytes").doc(
+    "Target device batch size; operator output batches are coalesced up to "
+    "this size (reference: spark.rapids.sql.batchSizeBytes=1GiB).").bytes_(
+    512 << 20)
+
+BATCH_ROW_CAPACITY = conf("spark.rapids.tpu.sql.batchRowCapacity").doc(
+    "Maximum rows per device batch. Row counts are padded to bucketed "
+    "capacities (powers of two) so XLA recompiles are bounded — the TPU "
+    "answer to cudf's fully dynamic shapes.").integer(1 << 20)
+
+CONCURRENT_TPU_TASKS = conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
+    "Admission-control semaphore: number of tasks that may hold device "
+    "memory concurrently per executor (reference: "
+    "spark.rapids.sql.concurrentGpuTasks=2).").integer(2)
+
+HBM_POOL_FRACTION = conf("spark.rapids.tpu.memory.hbm.poolFraction").doc(
+    "Fraction of HBM reserved for the framework's budget allocator "
+    "(reference: spark.rapids.memory.gpu.allocFraction).").startup_only().floating(0.85)
+
+HBM_RESERVE = conf("spark.rapids.tpu.memory.hbm.reserve").doc(
+    "Bytes of HBM held back for XLA scratch/fusion temporaries (reference: "
+    "spark.rapids.memory.gpu.reserve).").startup_only().bytes_(2 << 30)
+
+HOST_SPILL_LIMIT = conf("spark.rapids.tpu.memory.host.spillStorageSize").doc(
+    "Bytes of host memory for spilled device buffers before overflowing to "
+    "disk (reference: spark.rapids.memory.host.spillStorageSize).").bytes_(4 << 30)
+
+SPILL_DIR = conf("spark.rapids.tpu.memory.spillDir").doc(
+    "Directory for disk-tier spill files.").text("/tmp/rapids_tpu_spill")
+
+METRICS_LEVEL = conf("spark.rapids.tpu.sql.metrics.level").doc(
+    "ESSENTIAL, MODERATE or DEBUG metric collection (reference: "
+    "spark.rapids.sql.metrics.level).").text("MODERATE")
+
+STRING_MAX_BYTES = conf("spark.rapids.tpu.sql.stringMaxBytes").doc(
+    "Default maximum encoded byte length for device string columns. Strings "
+    "are fixed-width padded byte matrices on TPU; longer inputs fall back to "
+    "CPU or are re-bucketed.").integer(64)
+
+MULTITHREADED_READ_THREADS = conf(
+    "spark.rapids.tpu.sql.multiThreadedRead.numThreads").doc(
+    "Thread-pool size for the multithreaded multi-file reader (reference: "
+    "spark.rapids.sql.multiThreadedRead.numThreads).").integer(8)
+
+READER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
+    "PERFILE, COALESCING, MULTITHREADED or AUTO (reference: "
+    "spark.rapids.sql.format.parquet.reader.type).").text("AUTO")
+
+SHUFFLE_MODE = conf("spark.rapids.tpu.shuffle.mode").doc(
+    "Shuffle manager mode: DEFAULT (serialized host batches), MULTITHREADED "
+    "(thread-pooled writers/readers) or ICI (device-resident, collective "
+    "data plane; reference: rapids-shuffle.md three modes).").text("DEFAULT")
+
+SHUFFLE_PARTITIONS = conf("spark.rapids.tpu.shuffle.partitions").doc(
+    "Default number of shuffle partitions (spark.sql.shuffle.partitions "
+    "analogue).").integer(8)
+
+SHUFFLE_COMPRESSION = conf("spark.rapids.tpu.shuffle.compression.codec").doc(
+    "Codec for serialized shuffle/spill batches: none, lz4 or zstd "
+    "(reference: nvcomp TableCompressionCodec).").text("lz4")
+
+OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.oomDumpDir").doc(
+    "If set, dump the buffer-catalog state here when an allocation cannot be "
+    "satisfied even after spilling (reference: "
+    "spark.rapids.memory.gpu.oomDumpDir).").text("")
+
+TEST_RETAG = conf("spark.rapids.tpu.sql.test.allowedNonTpu").doc(
+    "Comma-separated exec names allowed to stay on CPU during tests "
+    "(reference: the integration harness's allow_non_gpu marker).").internal().text("")
+
+UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
+    "Translate Python UDF bytecode into expression trees so UDF bodies "
+    "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."
+).boolean(False)
+
+
+class RapidsTpuConf:
+    """Typed view over a plain dict of settings, with registry defaults."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+        for k in self._settings:
+            if k not in _REGISTRY and not k.startswith("spark.rapids.tpu.sql.exec.") \
+                    and not k.startswith("spark.rapids.tpu.sql.expression."):
+                raise KeyError(f"unknown config {k}")
+
+    def get(self, key: str) -> Any:
+        entry = _REGISTRY.get(key)
+        if key in self._settings:
+            raw = self._settings[key]
+            return entry.conv(raw) if entry and isinstance(raw, str) else raw
+        if entry is None:
+            raise KeyError(key)
+        return entry.default
+
+    def set(self, key: str, value: Any) -> "RapidsTpuConf":
+        s = dict(self._settings)
+        s[key] = value
+        return RapidsTpuConf(s)
+
+    # convenience typed accessors used throughout the engine
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED.key)
+
+    @property
+    def batch_row_capacity(self) -> int:
+        return self.get(BATCH_ROW_CAPACITY.key)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES.key)
+
+    @property
+    def ansi(self) -> bool:
+        return self.get(ANSI_ENABLED.key)
+
+    @property
+    def incompatible_ops(self) -> bool:
+        return self.get(INCOMPATIBLE_OPS.key)
+
+    def is_op_enabled(self, op_key: str, default: bool = True) -> bool:
+        """Per-op enable flags auto-created by rule registration (reference:
+        spark.rapids.sql.exec.* / spark.rapids.sql.expression.*)."""
+        return bool(self._settings.get(op_key, default))
+
+
+def generate_docs() -> str:
+    """Render configs.md the way RapidsConf.help does in the reference."""
+    lines = [
+        "# spark-rapids-tpu Configuration",
+        "",
+        "Generated by `spark_rapids_tpu.config.generate_docs()` — do not edit.",
+        "",
+        "| name | default | description |",
+        "|---|---|---|",
+    ]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal:
+            continue
+        lines.append(f"| {e.key} | {e.default} | {e.doc} |")
+    return "\n".join(lines) + "\n"
+
+
+def registry() -> Dict[str, ConfEntry]:
+    return dict(_REGISTRY)

@@ -1,0 +1,26 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the reference's test strategy of exercising distributed machinery
+without a cluster (SURVEY.md §4.2 — UCX shuffle tested against mocked peers):
+sharding/exchange paths run on a virtual 8-device CPU mesh; only bench.py
+touches the real TPU.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

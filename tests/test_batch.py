@@ -1,0 +1,105 @@
+"""Round-trip and invariants for the columnar data plane."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import (
+    Field, Schema, bucket_capacity, empty_batch, from_arrow, to_arrow,
+)
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 128
+    assert bucket_capacity(128) == 128
+    assert bucket_capacity(129) == 256
+    assert bucket_capacity(1000) == 1024
+    assert bucket_capacity(1 << 20) == 1 << 20
+
+
+def _roundtrip(table: pa.Table, **kw) -> pa.Table:
+    batch, schema = from_arrow(table, **kw)
+    assert batch.capacity >= table.num_rows
+    assert int(batch.num_rows) == table.num_rows
+    # padding rows must be invalid
+    for col in batch.columns:
+        assert not np.asarray(col.validity[table.num_rows:]).any()
+    return to_arrow(batch, schema)
+
+
+def test_roundtrip_numeric_with_nulls():
+    table = pa.table({
+        "i32": pa.array([1, None, -3, 2**31 - 1], type=pa.int32()),
+        "i64": pa.array([None, -(2**62), 7, 0], type=pa.int64()),
+        "f64": pa.array([1.5, float("nan"), None, -0.0], type=pa.float64()),
+        "b": pa.array([True, None, False, True], type=pa.bool_()),
+    })
+    out = _roundtrip(table)
+    assert out.column("i32").to_pylist() == [1, None, -3, 2**31 - 1]
+    assert out.column("i64").to_pylist() == [None, -(2**62), 7, 0]
+    got = out.column("f64").to_pylist()
+    assert got[0] == 1.5 and np.isnan(got[1]) and got[2] is None
+    assert out.column("b").to_pylist() == [True, None, False, True]
+
+
+def test_roundtrip_strings():
+    table = pa.table({"s": pa.array(["hello", None, "", "héllo", "x" * 10])})
+    out = _roundtrip(table)
+    assert out.column("s").to_pylist() == ["hello", None, "", "héllo", "x" * 10]
+
+
+def test_roundtrip_date_timestamp():
+    import datetime as dt
+    table = pa.table({
+        "d": pa.array([dt.date(2020, 1, 1), None, dt.date(1969, 12, 31)]),
+        "ts": pa.array([dt.datetime(2023, 5, 1, 12, 30, 0, 123456), None,
+                        dt.datetime(1960, 1, 1)], type=pa.timestamp("us")),
+    })
+    out = _roundtrip(table)
+    assert out.column("d").to_pylist() == [dt.date(2020, 1, 1), None,
+                                           dt.date(1969, 12, 31)]
+    got = out.column("ts").to_pylist()
+    assert got[1] is None
+    assert got[0].replace(tzinfo=None) == dt.datetime(2023, 5, 1, 12, 30, 0, 123456)
+
+
+def test_roundtrip_decimal():
+    import decimal as d
+    table = pa.table({
+        "dec": pa.array([d.Decimal("123.45"), None, d.Decimal("-0.01")],
+                        type=pa.decimal128(9, 2))})
+    out = _roundtrip(table)
+    assert out.column("dec").to_pylist() == [d.Decimal("123.45"), None,
+                                             d.Decimal("-0.01")]
+
+
+def test_empty_batch():
+    schema = Schema([Field("a", T.INT64), Field("s", T.string(8))])
+    b = empty_batch(schema)
+    assert int(b.num_rows) == 0
+    out = to_arrow(b, schema)
+    assert out.num_rows == 0
+
+
+def test_typesig_gating():
+    sig = T.numeric
+    assert sig.supports(T.INT32) is None
+    assert sig.supports(T.STRING) is not None
+    assert sig.supports(T.decimal(38, 2)) is not None  # >18 digits unsupported
+
+
+def test_batch_is_pytree():
+    import jax
+    table = pa.table({"a": pa.array([1, 2, 3], type=pa.int64())})
+    batch, _ = from_arrow(table)
+    leaves = jax.tree_util.tree_leaves(batch)
+    assert len(leaves) == 3  # data, validity, num_rows
+
+    @jax.jit
+    def bump(b):
+        col = b.columns[0]
+        return b.replace(columns=(col.replace(data=col.data + 1),))
+
+    out = bump(batch)
+    assert np.asarray(out.columns[0].data[:3]).tolist() == [2, 3, 4]
