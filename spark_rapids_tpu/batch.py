@@ -181,28 +181,55 @@ def _strings_to_matrix(arr: pa.Array, max_len: int,
                        truncate: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Encode an arrow string array into (byte_matrix, lengths).
 
-    Raises on strings longer than ``max_len`` unless ``truncate`` — silent
-    truncation is data corruption; the planner re-buckets max_len or falls
-    back to CPU instead (config.STRING_MAX_BYTES).
+    Vectorized over the arrow offsets/data buffers (no per-row Python on the
+    scan hot path). Raises on strings longer than ``max_len`` unless
+    ``truncate`` — silent truncation is data corruption; the planner
+    re-buckets max_len or falls back to CPU instead (config.STRING_MAX_BYTES).
     """
     n = len(arr)
-    out = np.zeros((n, max_len), dtype=np.uint8)
-    lengths = np.zeros(n, dtype=np.int32)
-    py = arr.to_pylist()
-    for i, s in enumerate(py):
-        if s is None:
-            continue
-        b = s.encode("utf-8")
-        if len(b) > max_len:
-            if not truncate:
-                raise StringOverflowError(
-                    f"string of {len(b)} bytes exceeds device max_len "
-                    f"{max_len}; re-bucket the column or fall back to CPU")
-            b = b[:max_len]
-            while b and (b[-1] & 0xC0) == 0x80:  # don't split a codepoint
-                b = b[:-1]
-        out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
-        lengths[i] = len(b)
+    if n == 0:
+        return np.zeros((0, max_len), np.uint8), np.zeros(0, np.int32)
+    if arr.type == pa.large_string():
+        arr = arr.cast(pa.string())
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], dtype=np.int32, count=n + 1,
+                            offset=arr.offset * 4).astype(np.int64)
+    data = (np.frombuffer(bufs[2], dtype=np.uint8)
+            if bufs[2] is not None else np.zeros(0, np.uint8))
+    lengths = np.diff(offsets).astype(np.int32)
+    if arr.null_count:
+        valid = np.asarray(arr.is_valid())
+        lengths = np.where(valid, lengths, 0)
+    over = lengths > max_len
+    if over.any():
+        if not truncate:
+            raise StringOverflowError(
+                f"string of {int(lengths.max())} bytes exceeds device "
+                f"max_len {max_len}; re-bucket the column or fall back to CPU")
+        lengths = np.minimum(lengths, max_len)
+    col_idx = np.arange(max_len, dtype=np.int64)[None, :]
+    mask = col_idx < lengths[:, None]
+    if data.size:
+        gather = np.minimum(offsets[:-1, None] + col_idx, data.size - 1)
+        out = np.where(mask, data[gather], 0).astype(np.uint8)
+    else:
+        out = np.zeros((n, max_len), np.uint8)
+    if over.any():
+        # repair rows whose truncation split a multi-byte codepoint: find the
+        # start of the trailing char; drop it only if its sequence is cut
+        for i in np.nonzero(over)[0]:
+            row = out[i]
+            ln = int(lengths[i])
+            p = ln - 1
+            while p >= 0 and (row[p] & 0xC0) == 0x80:
+                p -= 1
+            if p >= 0:
+                lead = int(row[p])
+                char_len = 1 if lead < 0x80 else \
+                    2 if lead < 0xE0 else 3 if lead < 0xF0 else 4
+                if p + char_len > ln:  # incomplete sequence — drop it
+                    out[i, p:] = 0
+                    lengths[i] = p
     return out, lengths
 
 
@@ -217,16 +244,13 @@ def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
 
     if dtype.kind is TypeKind.STRING:
         mat, lengths = _strings_to_matrix(arr, dtype.max_len, truncate_strings)
-        padded = np.zeros((capacity, dtype.max_len), dtype=np.uint8)
-        padded[:n] = mat
-        plen = np.zeros(capacity, dtype=np.int32)
-        plen[:n] = lengths
-        val = np.zeros(capacity, dtype=bool)
-        val[:n] = validity
-        return DeviceColumn(jnp.asarray(padded), jnp.asarray(val),
-                            jnp.asarray(plen), dtype)
+        return make_column(mat, validity, dtype, capacity, lengths)
 
     if dtype.kind is TypeKind.DECIMAL:
+        if dtype.precision > 18:
+            raise TypeError(
+                f"decimal({dtype.precision},{dtype.scale}) exceeds DECIMAL64 "
+                f"device storage; the planner must fall back to CPU")
         # store unscaled int64 (DECIMAL64)
         np_vals = np.array([int(v.scaleb(dtype.scale)) if v is not None else 0
                             for v in arr.to_pylist()], dtype=np.int64)
@@ -293,10 +317,18 @@ def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
         validity = np.asarray(col.validity[:n])
         if f.dtype.kind is TypeKind.STRING:
             mat = np.asarray(col.data[:n])
-            lens = np.asarray(col.lengths[:n])
-            vals = [bytes(mat[i, : lens[i]]).decode("utf-8", "replace")
-                    if validity[i] else None for i in range(n)]
-            arrays.append(pa.array(vals, type=pa.string()))
+            lens = np.where(validity, np.asarray(col.lengths[:n]), 0)
+            # vectorized: row-major masked bytes ARE the arrow data buffer
+            mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
+            flat = np.ascontiguousarray(mat)[mask]
+            offsets = np.zeros(n + 1, np.int32)
+            np.cumsum(lens, out=offsets[1:])
+            sa = pa.StringArray.from_buffers(
+                n, pa.py_buffer(offsets.tobytes()),
+                pa.py_buffer(flat.tobytes()),
+                pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+                if not validity.all() else None)
+            arrays.append(sa)
             continue
         data = np.asarray(col.data[:n])
         if f.dtype.kind is TypeKind.DECIMAL:

@@ -239,7 +239,10 @@ class RapidsTpuConf:
     def is_op_enabled(self, op_key: str, default: bool = True) -> bool:
         """Per-op enable flags auto-created by rule registration (reference:
         spark.rapids.sql.exec.* / spark.rapids.sql.expression.*)."""
-        return bool(self._settings.get(op_key, default))
+        v = self._settings.get(op_key, default)
+        if isinstance(v, str):
+            return v.strip().lower() in ("true", "1")
+        return bool(v)
 
 
 def generate_docs() -> str:

@@ -1,0 +1,21 @@
+"""Expression library — the analogue of the reference's ~205 expression rules
+(reference: GpuOverrides.scala:831-3500). Built out in dependency order per
+SURVEY.md §7: arithmetic → cast → math → comparisons → conditionals →
+strings → datetime; each module documents its Spark-semantics contract.
+"""
+
+from .base import (Alias, BoundReference, EvalContext, Expression, Literal,
+                   UnresolvedColumn, col, lit)
+from .arithmetic import (Abs, Add, BitwiseNot, BitwiseOp, Divide,
+                         IntegralDivide, Multiply, Pmod, Remainder, Subtract,
+                         UnaryMinus)
+from .boolean import And, Or
+from .cast import Cast, cast_supported
+from .comparison import (EqualNullSafe, EqualTo, GreaterThan,
+                         GreaterThanOrEqual, In, IsNaN, IsNotNull, IsNull,
+                         LessThan, LessThanOrEqual, Not)
+from .conditional import CaseWhen, Coalesce, If, LeastGreatest
+from .hashing import Murmur3Hash, murmur3_batch, partition_ids
+from .math import Atan2, FloorCeil, Pow, Round, Signum, UnaryMath
+
+__all__ = [n for n in dir() if not n.startswith("_")]

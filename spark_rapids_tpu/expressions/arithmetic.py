@@ -1,0 +1,259 @@
+"""Arithmetic expressions with Spark semantics.
+
+Reference parity: sql-plugin/.../sql/rapids/arithmetic.scala (GpuAdd,
+GpuSubtract, GpuMultiply, GpuDivide, GpuIntegralDivide, GpuRemainder,
+GpuPmod, GpuUnaryMinus, GpuAbs). Non-ANSI mode: integer overflow wraps
+(Java two's-complement — XLA integer ops match), division by zero yields
+null. ANSI mode raises are handled at the engine boundary via overflow
+flags (round 1: non-ANSI only; the planner tags ANSI for fallback).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..types import SqlType, TypeKind
+from .base import (DeviceColumn, EvalContext, Expression, and_validity,
+                   numeric_column)
+
+
+@dataclass(frozen=True, eq=False)
+class BinaryArithmetic(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return type(self)(c[0], c[1])
+
+    @property
+    def dtype(self) -> SqlType:
+        return T.common_numeric_type(self.left.dtype, self.right.dtype)
+
+    def _operands(self, batch, ctx):
+        lc = self.left.eval(batch, ctx)
+        rc = self.right.eval(batch, ctx)
+        out = self.dtype
+        ld = lc.data.astype(out.storage_dtype)
+        rd = rc.data.astype(out.storage_dtype)
+        return ld, rd, and_validity([lc, rc]), out
+
+    def __repr__(self):
+        return f"({self.left!r} {self.SYMBOL} {self.right!r})"
+
+
+class Add(BinaryArithmetic):
+    SYMBOL = "+"
+
+    def eval(self, batch, ctx=EvalContext()):
+        l, r, v, d = self._operands(batch, ctx)
+        return numeric_column(l + r, v, d)
+
+
+class Subtract(BinaryArithmetic):
+    SYMBOL = "-"
+
+    def eval(self, batch, ctx=EvalContext()):
+        l, r, v, d = self._operands(batch, ctx)
+        return numeric_column(l - r, v, d)
+
+
+class Multiply(BinaryArithmetic):
+    SYMBOL = "*"
+
+    @property
+    def dtype(self):
+        d = T.common_numeric_type(self.left.dtype, self.right.dtype)
+        if d.kind is TypeKind.DECIMAL:
+            ld, rd = self.left.dtype, self.right.dtype
+            return T.decimal(min(ld.precision + rd.precision + 1, 38),
+                             ld.scale + rd.scale)
+        return d
+
+    def eval(self, batch, ctx=EvalContext()):
+        lc = self.left.eval(batch, ctx)
+        rc = self.right.eval(batch, ctx)
+        d = self.dtype
+        l = lc.data.astype(d.storage_dtype)
+        r = rc.data.astype(d.storage_dtype)
+        return numeric_column(l * r, and_validity([lc, rc]), d)
+
+
+class Divide(BinaryArithmetic):
+    """Spark `/`: true division, result is DOUBLE (decimal deferred);
+    x/0 -> null in non-ANSI mode."""
+
+    SYMBOL = "/"
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        lc = self.left.eval(batch, ctx)
+        rc = self.right.eval(batch, ctx)
+        l = lc.data.astype(jnp.float64)
+        r = rc.data.astype(jnp.float64)
+        valid = and_validity([lc, rc]) & (r != 0.0)
+        safe_r = jnp.where(r == 0.0, 1.0, r)
+        return numeric_column(l / safe_r, valid, T.FLOAT64)
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark `div`: integral division returning LONG; x div 0 -> null.
+    Java semantics: truncation toward zero."""
+
+    SYMBOL = "div"
+
+    @property
+    def dtype(self):
+        return T.INT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        lc = self.left.eval(batch, ctx)
+        rc = self.right.eval(batch, ctx)
+        l = lc.data.astype(jnp.int64)
+        r = rc.data.astype(jnp.int64)
+        valid = and_validity([lc, rc]) & (r != 0)
+        safe_r = jnp.where(r == 0, 1, r)
+        q = jnp.sign(l) * jnp.sign(safe_r) * (jnp.abs(l) // jnp.abs(safe_r))
+        return numeric_column(q, valid, T.INT64)
+
+
+class Remainder(BinaryArithmetic):
+    """Spark `%`: sign follows the dividend (Java %), x%0 -> null."""
+
+    SYMBOL = "%"
+
+    def eval(self, batch, ctx=EvalContext()):
+        l, r, v, d = self._operands(batch, ctx)
+        if d.is_fractional:
+            valid = v & (r != 0.0)
+            safe_r = jnp.where(r == 0.0, 1.0, r)
+            rem = jnp.fmod(l, safe_r)  # fmod: sign of dividend, like Java %
+        else:
+            valid = v & (r != 0)
+            safe_r = jnp.where(r == 0, 1, r)
+            rem = jnp.sign(l) * (jnp.abs(l) % jnp.abs(safe_r))
+        return numeric_column(rem, valid, d)
+
+
+class Pmod(BinaryArithmetic):
+    """Spark pmod: non-negative modulus (reference: GpuPmod)."""
+
+    SYMBOL = "pmod"
+
+    def eval(self, batch, ctx=EvalContext()):
+        l, r, v, d = self._operands(batch, ctx)
+        if d.is_fractional:
+            valid = v & (r != 0.0)
+            safe_r = jnp.where(r == 0.0, 1.0, r)
+        else:
+            valid = v & (r != 0)
+            safe_r = jnp.where(r == 0, 1, r)
+        m = jnp.mod(l, safe_r)  # python-style mod: sign of divisor
+        m = jnp.where(m < 0, m + jnp.abs(safe_r), m)
+        return numeric_column(m, valid, d)
+
+
+@dataclass(frozen=True, eq=False)
+class UnaryMinus(Expression):
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return UnaryMinus(c[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        return numeric_column(-c.data, c.validity, self.dtype)
+
+    def __repr__(self):
+        return f"(- {self.child!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class Abs(Expression):
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Abs(c[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        return numeric_column(jnp.abs(c.data), c.validity, self.dtype)
+
+    def __repr__(self):
+        return f"abs({self.child!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class BitwiseOp(Expression):
+    left: Expression
+    right: Expression
+    op: str = "and"  # and|or|xor
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return BitwiseOp(c[0], c[1], self.op)
+
+    @property
+    def dtype(self):
+        return T.common_numeric_type(self.left.dtype, self.right.dtype)
+
+    def eval(self, batch, ctx=EvalContext()):
+        lc = self.left.eval(batch, ctx)
+        rc = self.right.eval(batch, ctx)
+        d = self.dtype
+        l = lc.data.astype(d.storage_dtype)
+        r = rc.data.astype(d.storage_dtype)
+        fn = {"and": jnp.bitwise_and, "or": jnp.bitwise_or,
+              "xor": jnp.bitwise_xor}[self.op]
+        return numeric_column(fn(l, r), and_validity([lc, rc]), d)
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class BitwiseNot(Expression):
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return BitwiseNot(c[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        return numeric_column(jnp.bitwise_not(c.data), c.validity, self.dtype)

@@ -1,0 +1,379 @@
+"""Expression evaluation model.
+
+TPU-native analogue of the reference's `GpuExpression.columnarEval(batch)`
+(reference: sql-plugin/.../GpuExpressions.scala:113,146 — returns a
+GpuColumnVector or GpuScalar). Here every bound expression's ``eval(batch)``
+returns a ``DeviceColumn`` built from jnp ops, so an entire projection/filter/
+aggregation stage traces into ONE XLA computation — there is no per-kernel
+dispatch boundary like the reference's per-op JNI calls; XLA fuses the tree.
+
+Null semantics: validity masks propagate explicitly. The default combinator
+is AND-of-child-validities (Spark's null-intolerant expressions); special
+forms (boolean 3VL, coalesce, null-safe equality) override.
+
+Two-phase resolution like Catalyst: the user builds an unresolved tree with
+``col("name")``; ``bind(expr, schema)`` resolves references to ordinals and
+computes output types bottom-up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, ClassVar, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..batch import ColumnarBatch, DeviceColumn, Schema
+from ..types import SqlType, TypeKind
+
+
+@dataclass(frozen=True)
+class EvalContext:
+    """Static evaluation flags (participates in jit cache keys via closure)."""
+
+    ansi: bool = False
+
+
+@dataclass(frozen=True)
+class Expression:
+    """Base class. Subclasses are frozen dataclasses; trees are immutable."""
+
+    # registry of expression class -> pretty name, used by planner docs
+    _registry: ClassVar[Dict[str, type]] = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        Expression._registry[cls.__name__] = cls
+
+    # ---- tree ----
+    @property
+    def children(self) -> Tuple["Expression", ...]:
+        return ()
+
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- resolution ----
+    @property
+    def resolved(self) -> bool:
+        return all(c.resolved for c in self.children)
+
+    def bind(self, schema: Schema) -> "Expression":
+        return self.with_children([c.bind(schema) for c in self.children]) \
+            if self.children else self
+
+    # ---- typing (bound trees only) ----
+    @property
+    def dtype(self) -> SqlType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children) if self.children else True
+
+    # ---- evaluation (bound trees only; called inside jit tracing) ----
+    def eval(self, batch: ColumnarBatch, ctx: EvalContext = EvalContext()
+             ) -> DeviceColumn:
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- sugar: operator overloads build unresolved trees ----
+    def _bin(self, other, cls):
+        return cls(self, lit_if_needed(other))
+
+    def __add__(self, other):
+        from .arithmetic import Add
+        return self._bin(other, Add)
+
+    def __radd__(self, other):
+        from .arithmetic import Add
+        return Add(lit_if_needed(other), self)
+
+    def __sub__(self, other):
+        from .arithmetic import Subtract
+        return self._bin(other, Subtract)
+
+    def __rsub__(self, other):
+        from .arithmetic import Subtract
+        return Subtract(lit_if_needed(other), self)
+
+    def __mul__(self, other):
+        from .arithmetic import Multiply
+        return self._bin(other, Multiply)
+
+    def __rmul__(self, other):
+        from .arithmetic import Multiply
+        return Multiply(lit_if_needed(other), self)
+
+    def __truediv__(self, other):
+        from .arithmetic import Divide
+        return self._bin(other, Divide)
+
+    def __mod__(self, other):
+        from .arithmetic import Remainder
+        return self._bin(other, Remainder)
+
+    def __neg__(self):
+        from .arithmetic import UnaryMinus
+        return UnaryMinus(self)
+
+    def __eq__(self, other):  # type: ignore[override]
+        from .comparison import EqualTo
+        return self._bin(other, EqualTo)
+
+    def __ne__(self, other):  # type: ignore[override]
+        from .comparison import Not, EqualTo
+        return Not(self._bin(other, EqualTo))
+
+    def __lt__(self, other):
+        from .comparison import LessThan
+        return self._bin(other, LessThan)
+
+    def __le__(self, other):
+        from .comparison import LessThanOrEqual
+        return self._bin(other, LessThanOrEqual)
+
+    def __gt__(self, other):
+        from .comparison import GreaterThan
+        return self._bin(other, GreaterThan)
+
+    def __ge__(self, other):
+        from .comparison import GreaterThanOrEqual
+        return self._bin(other, GreaterThanOrEqual)
+
+    def __and__(self, other):
+        from .boolean import And
+        return self._bin(other, And)
+
+    def __or__(self, other):
+        from .boolean import Or
+        return self._bin(other, Or)
+
+    def __invert__(self):
+        from .comparison import Not
+        return Not(self)
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+    # named helpers
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def cast(self, to: SqlType) -> "Expression":
+        from .cast import Cast
+        return Cast(self, to)
+
+    def is_null(self):
+        from .comparison import IsNull
+        return IsNull(self)
+
+    def is_not_null(self):
+        from .comparison import IsNotNull
+        return IsNotNull(self)
+
+    def astuple(self):
+        return tuple(getattr(self, f.name) for f in
+                     self.__dataclass_fields__.values())  # type: ignore
+
+
+def lit_if_needed(v: Any) -> Expression:
+    return v if isinstance(v, Expression) else Literal.of(v)
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True, eq=False)
+class UnresolvedColumn(Expression):
+    name: str
+
+    @property
+    def resolved(self):
+        return False
+
+    def bind(self, schema: Schema) -> "BoundReference":
+        i = schema.index_of(self.name)
+        f = schema[i]
+        return BoundReference(i, f.dtype, f.nullable, f.name)
+
+    def __repr__(self):
+        return f"col({self.name!r})"
+
+
+def col(name: str) -> UnresolvedColumn:
+    return UnresolvedColumn(name)
+
+
+@dataclass(frozen=True, eq=False)
+class BoundReference(Expression):
+    """Resolved input-column reference (reference: GpuBoundReference)."""
+
+    ordinal: int
+    _dtype: SqlType
+    _nullable: bool = True
+    name: str = ""
+
+    @property
+    def resolved(self):
+        return True
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        return batch.columns[self.ordinal]
+
+    def __repr__(self):
+        return f"input[{self.ordinal}, {self._dtype}]"
+
+
+@dataclass(frozen=True, eq=False)
+class Literal(Expression):
+    """A scalar constant (reference: GpuScalar / literals.scala).
+
+    Evaluates to a broadcast column; XLA folds the broadcast into consumers.
+    """
+
+    value: Any
+    _dtype: SqlType
+
+    @staticmethod
+    def of(v: Any, dtype: Optional[SqlType] = None) -> "Literal":
+        if dtype is None:
+            dtype = _infer_literal_type(v)
+        return Literal(v, dtype)
+
+    @property
+    def resolved(self):
+        return True
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def eval(self, batch, ctx=EvalContext()):
+        cap = batch.capacity
+        d = self._dtype
+        if self.value is None:
+            if d.kind is TypeKind.STRING:
+                return DeviceColumn(jnp.zeros((cap, d.max_len), jnp.uint8),
+                                    jnp.zeros(cap, bool),
+                                    jnp.zeros(cap, jnp.int32), d)
+            return DeviceColumn(jnp.zeros(cap, d.storage_dtype),
+                                jnp.zeros(cap, bool), None, d)
+        if d.kind is TypeKind.STRING:
+            b = str(self.value).encode("utf-8")
+            if len(b) > d.max_len:
+                from ..batch import StringOverflowError
+                raise StringOverflowError(f"literal longer than {d.max_len}")
+            row = np.zeros(d.max_len, np.uint8)
+            row[: len(b)] = np.frombuffer(b, np.uint8)
+            data = jnp.broadcast_to(jnp.asarray(row), (cap, d.max_len))
+            return DeviceColumn(data, batch.row_mask(),
+                                jnp.full(cap, len(b), jnp.int32), d)
+        v = self.value
+        if d.kind is TypeKind.DECIMAL:
+            import decimal as pydec
+            v = int(pydec.Decimal(str(v)).scaleb(d.scale))
+        data = jnp.full(cap, v, d.storage_dtype)
+        return DeviceColumn(data, batch.row_mask(), None, d)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def _infer_literal_type(v: Any) -> SqlType:
+    import datetime as dt
+    if v is None:
+        return T.NULL
+    if isinstance(v, bool):
+        return T.BOOLEAN
+    if isinstance(v, int):
+        return T.INT32 if -(2**31) <= v < 2**31 else T.INT64
+    if isinstance(v, float):
+        return T.FLOAT64
+    if isinstance(v, str):
+        return T.string(max(8, len(v.encode("utf-8"))))
+    if isinstance(v, dt.datetime):
+        return T.TIMESTAMP
+    if isinstance(v, dt.date):
+        return T.DATE
+    raise TypeError(f"cannot infer literal type for {v!r}")
+
+
+def lit(v: Any, dtype: Optional[SqlType] = None) -> Literal:
+    return Literal.of(v, dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class Alias(Expression):
+    child: Expression
+    name: str
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Alias(c[0], self.name)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        return self.child.eval(batch, ctx)
+
+    def __repr__(self):
+        return f"{self.child!r} AS {self.name}"
+
+
+# ---------------------------------------------------------------------------
+# Shared helpers for subclasses
+# ---------------------------------------------------------------------------
+
+def and_validity(cols: Sequence[DeviceColumn]) -> jax.Array:
+    v = cols[0].validity
+    for c in cols[1:]:
+        v = v & c.validity
+    return v
+
+
+def numeric_column(data: jax.Array, validity: jax.Array,
+                   dtype: SqlType) -> DeviceColumn:
+    # Zero out invalid payload slots: keeps padding deterministic and stops
+    # NaN/garbage leaking through reductions.
+    zero = jnp.zeros((), data.dtype)
+    return DeviceColumn(jnp.where(validity, data, zero), validity, None, dtype)
+
+
+def string_equal(a: DeviceColumn, b: DeviceColumn) -> jax.Array:
+    same_bytes = jnp.all(a.data == b.data, axis=1)
+    return same_bytes & (a.lengths == b.lengths)
+
+
+def string_compare_lt(a: DeviceColumn, b: DeviceColumn) -> jax.Array:
+    """UTF-8 byte-wise lexicographic a < b over padded matrices."""
+    diff = a.data != b.data
+    any_diff = jnp.any(diff, axis=1)
+    first = jnp.argmax(diff, axis=1)
+    ab = jnp.take_along_axis(a.data, first[:, None], axis=1)[:, 0]
+    bb = jnp.take_along_axis(b.data, first[:, None], axis=1)[:, 0]
+    return jnp.where(any_diff, ab < bb, a.lengths < b.lengths)

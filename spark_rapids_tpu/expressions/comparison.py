@@ -1,0 +1,219 @@
+"""Comparison and null-test expressions (reference: predicates.scala,
+nullExpressions.scala — GpuEqualTo, GpuLessThan, GpuIsNull, GpuEqualNullSafe,
+GpuIn, GpuNot)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import DeviceColumn
+from ..types import TypeKind
+from .base import (EvalContext, Expression, and_validity, lit_if_needed,
+                   string_compare_lt, string_equal)
+
+
+def _bool_col(data, validity):
+    return DeviceColumn(data & validity, validity, None, T.BOOLEAN)
+
+
+def _compare_data(lc: DeviceColumn, rc: DeviceColumn, op: str):
+    """Raw comparison payload ignoring validity."""
+    if lc.dtype.kind is TypeKind.STRING:
+        eq = string_equal(lc, rc)
+        if op == "eq":
+            return eq
+        lt = string_compare_lt(lc, rc)
+        return {"lt": lt, "le": lt | eq, "gt": ~(lt | eq), "ge": ~lt}[op]
+    # promote to a common dtype for mixed-width comparisons
+    if lc.data.dtype != rc.data.dtype:
+        d = jnp.promote_types(lc.data.dtype, rc.data.dtype)
+        l, r = lc.data.astype(d), rc.data.astype(d)
+    else:
+        l, r = lc.data, rc.data
+    return {"eq": l == r, "lt": l < r, "le": l <= r,
+            "gt": l > r, "ge": l >= r}[op]
+
+
+@dataclass(frozen=True, eq=False)
+class BinaryComparison(Expression):
+    left: Expression
+    right: Expression
+    OP = "eq"
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return type(self)(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval(self, batch, ctx=EvalContext()):
+        lc = self.left.eval(batch, ctx)
+        rc = self.right.eval(batch, ctx)
+        return _bool_col(_compare_data(lc, rc, self.OP), and_validity([lc, rc]))
+
+    def __repr__(self):
+        return f"({self.left!r} {self.OP} {self.right!r})"
+
+
+class EqualTo(BinaryComparison):
+    OP = "eq"
+
+
+class LessThan(BinaryComparison):
+    OP = "lt"
+
+
+class LessThanOrEqual(BinaryComparison):
+    OP = "le"
+
+
+class GreaterThan(BinaryComparison):
+    OP = "gt"
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    OP = "ge"
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=>: null <=> null is true; never returns null."""
+
+    OP = "eq"
+
+    def eval(self, batch, ctx=EvalContext()):
+        lc = self.left.eval(batch, ctx)
+        rc = self.right.eval(batch, ctx)
+        eq = _compare_data(lc, rc, "eq")
+        both_valid = lc.validity & rc.validity
+        both_null = ~lc.validity & ~rc.validity
+        data = (both_valid & eq) | both_null
+        return DeviceColumn(data & batch.row_mask(), batch.row_mask(),
+                            None, T.BOOLEAN)
+
+
+@dataclass(frozen=True, eq=False)
+class Not(Expression):
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Not(c[0])
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        return _bool_col(~c.data, c.validity)
+
+    def __repr__(self):
+        return f"NOT {self.child!r}"
+
+
+@dataclass(frozen=True, eq=False)
+class IsNull(Expression):
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return IsNull(c[0])
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        mask = batch.row_mask()
+        return DeviceColumn(~c.validity & mask, mask, None, T.BOOLEAN)
+
+    def __repr__(self):
+        return f"isnull({self.child!r})"
+
+
+class IsNotNull(IsNull):
+    def with_children(self, c):
+        return IsNotNull(c[0])
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        mask = batch.row_mask()
+        return DeviceColumn(c.validity & mask, mask, None, T.BOOLEAN)
+
+    def __repr__(self):
+        return f"isnotnull({self.child!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class IsNaN(Expression):
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return IsNaN(c[0])
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        return _bool_col(jnp.isnan(c.data), c.validity)
+
+
+@dataclass(frozen=True, eq=False)
+class In(Expression):
+    """value IN (literals...). Spark 3VL: null if value is null, or if no
+    match and the list contains a null."""
+
+    child: Expression
+    values: Tuple = ()
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return In(c[0], self.values)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .base import Literal
+        c = self.child.eval(batch, ctx)
+        non_null = [v for v in self.values if v is not None]
+        has_null_item = len(non_null) != len(self.values)
+        found = jnp.zeros(batch.capacity, bool)
+        for v in non_null:
+            litc = Literal.of(v, self.child.dtype).eval(batch, ctx)
+            found = found | _compare_data(c, litc, "eq")
+        validity = c.validity & found if has_null_item else c.validity
+        return _bool_col(found, validity)
+
+    def __repr__(self):
+        return f"{self.child!r} IN {self.values!r}"

@@ -1,0 +1,259 @@
+"""Spark-compatible Murmur3 x86_32 hashing, vectorized in jnp.
+
+Reference parity: sql-plugin/.../HashFunctions.scala (GpuMurmur3Hash) and the
+JNI murmur3 in spark-rapids-jni — Spark's Murmur3Hash expression (seed 42)
+drives HashPartitioning, so shuffle placement is only compatible if this is
+bit-exact with org.apache.spark.unsafe.hash.Murmur3_x86_32:
+
+- int/short/byte/boolean/date -> hashInt(v)
+- long/timestamp             -> hashLong(v)
+- float  -> hashInt(floatToIntBits(v))  with -0.0 normalized to 0.0
+- double -> hashLong(doubleToLongBits(v)) with -0.0 normalized
+- string -> Spark's hashUnsafeBytes variant: 4-byte little-endian words,
+  then each TAIL BYTE fully mixed (Spark diverges from standard murmur3 here)
+- multiple columns fold left: hash = hash(col_i, seed=hash_so_far), start 42
+- null values leave the running hash unchanged
+
+All arithmetic in uint32 with explicit wraparound.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import ColumnarBatch, DeviceColumn
+from ..types import TypeKind
+from .base import EvalContext, Expression
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+_M = jnp.uint32(5)
+_N = jnp.uint32(0xE6546B64)
+
+DEFAULT_SEED = 42
+
+
+def _rotl(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ _mix_k1(k1)
+    h1 = _rotl(h1, 13)
+    return h1 * _M + _N
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.uint32(length) if isinstance(length, int) else h1 ^ length
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> 16)
+
+
+def hash_int(v, seed):
+    """Murmur3_x86_32.hashInt over an int32 array."""
+    k = v.astype(jnp.int32).view(jnp.uint32) if hasattr(v, "view") else v
+    h1 = _mix_h1(seed, k)
+    return _fmix(h1, 4)
+
+
+def _split_words_64(v) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(low, high) uint32 words of an int64 array, without 64-bit bitcasts.
+
+    The TPU backend emulates 64-bit types and its X64 rewrite has no
+    implementation for 64-bit bitcast-convert, so decompose arithmetically.
+    """
+    v = v.astype(jnp.int64)
+    low = (v & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    high = ((v >> 32) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    return low, high
+
+
+def _exp2i(e) -> jnp.ndarray:
+    """Exact 2.0**e for integer arrays with |e| <= 512, by bit decomposition
+    (all multiplies by exact power-of-two constants; no transcendentals)."""
+    neg = e < 0
+    a = jnp.abs(e).astype(jnp.int32)
+    f = jnp.ones(e.shape, jnp.float64)
+    for k in range(10):  # bits up to 2^9 = 512
+        c = jnp.float64(2.0 ** (1 << k))
+        f = f * jnp.where((a >> k) & 1 == 1, c, jnp.float64(1.0))
+    return jnp.where(neg, 1.0 / f, f)
+
+
+def _double_bits_words(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """IEEE-754 bits of f64 as (low, high) uint32 words, computed purely
+    arithmetically — the TPU backend has no 64-bit bitcast and its
+    frexp/signbit lower to one. Matches Java Double.doubleToLongBits (NaN
+    canonicalized to 0x7FF8000000000000) except that -0.0's sign is dropped;
+    callers normalize -0.0 -> 0.0 first (Spark's hash does the same).
+    """
+    x = x.astype(jnp.float64)
+    sign = x < 0
+    ax = jnp.abs(x)
+    # Stage the value into [2^-120, 2^120] with exact power-of-two multiplies
+    # before ANY comparison/log2: the TPU backend emulates f64 as float32
+    # pairs, so comparisons and transcendentals misbehave outside the f32
+    # range (isinf/log2 of 1e200 are wrong there). All thresholds below are
+    # f32-representable.
+    e_adj = jnp.zeros(x.shape, jnp.int32)
+    m0 = ax
+    for _ in range(9):
+        big = m0 > 2.0 ** 120
+        m0 = jnp.where(big, m0 * 2.0 ** -120, m0)
+        e_adj = e_adj + big.astype(jnp.int32) * 120
+    for _ in range(9):
+        small = (m0 < 2.0 ** -120) & (m0 > 0.0)
+        m0 = jnp.where(small, m0 * 2.0 ** 120, m0)
+        e_adj = e_adj - small.astype(jnp.int32) * 120
+    is_inf = m0 > 2.0 ** 124  # only +/-inf survives staging above 2^120
+    is_nan = x != x
+    # exponent estimate via log2 on the staged value, then exact rescale
+    safe_m0 = jnp.where((m0 > 0.0) & ~is_inf & ~is_nan, m0, 1.0)
+    e = jnp.floor(jnp.log2(safe_m0)).astype(jnp.int32) + e_adj
+    # For |x| < 2^-1021 (subnormals plus the lowest normal binade) the IEEE
+    # bit pattern is EXACTLY |x| * 2^1074 — sidestep the boundary entirely.
+    candidate_low = e <= -1018  # wide margin over log2's +/-1 error
+    bits_low = (jnp.where(candidate_low, ax, 0.0)
+                * (2.0 ** 537) * (2.0 ** 537)).astype(jnp.int64)
+    use_low = candidate_low & (bits_low < (jnp.int64(1) << 53))
+    normal = (ax > 0.0) & ~is_inf & ~is_nan & ~use_low
+    e = jnp.clip(e, -1021, 1023)
+    e1 = e // 2
+    m = jnp.where(normal, ax, 1.0) * _exp2i(-e1) * _exp2i(-(e - e1))
+    for _ in range(2):  # fix log2 rounding at power-of-two boundaries
+        too_big = m >= 2.0
+        m = jnp.where(too_big, m * 0.5, m)
+        e = e + too_big
+        too_small = m < 1.0
+        m = jnp.where(too_small, m * 2.0, m)
+        e = e - too_small
+    biased = jnp.where(normal, (e + 1023).astype(jnp.int64), jnp.int64(0))
+    mant = jnp.where(normal,
+                     ((m - 1.0) * (2.0 ** 52)).astype(jnp.int64),
+                     jnp.int64(0))
+    body = jnp.where(use_low, bits_low, (biased << 52) | mant)
+    body = jnp.where(is_inf, jnp.int64(2047) << 52, body)
+    body = jnp.where(is_nan, (jnp.int64(2047) << 52) | (jnp.int64(1) << 51),
+                     body)
+    sign_bit = jnp.where(is_nan, jnp.int64(0), sign.astype(jnp.int64))
+    bits = (sign_bit << 63) | body
+    return _split_words_64(bits)
+
+
+def hash_long(v, seed):
+    """Murmur3_x86_32.hashLong: low word then high word."""
+    low, high = _split_words_64(v)
+    h1 = _mix_h1(seed, low)
+    h1 = _mix_h1(h1, high)
+    return _fmix(h1, 8)
+
+
+def _hash_string(col: DeviceColumn, seed):
+    """Spark hashUnsafeBytes over padded byte matrices + lengths."""
+    data = col.data  # uint8[n, max_len]
+    lengths = col.lengths
+    n, max_len = data.shape
+    h1 = jnp.broadcast_to(seed, (n,)).astype(jnp.uint32)
+    # 4-byte aligned words, little-endian
+    n_words = max_len // 4
+    signed = data.view(jnp.int8)  # tail bytes are SIGNED in Spark
+    for w in range(n_words):
+        b0 = data[:, 4 * w].astype(jnp.uint32)
+        b1 = data[:, 4 * w + 1].astype(jnp.uint32)
+        b2 = data[:, 4 * w + 2].astype(jnp.uint32)
+        b3 = data[:, 4 * w + 3].astype(jnp.uint32)
+        word = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+        mixed = _mix_h1(h1, word)
+        h1 = jnp.where(lengths >= (w + 1) * 4, mixed, h1)
+    # tail bytes, each fully mixed as a signed-byte int (Spark variant)
+    for i in range(max_len):
+        byte = signed[:, i].astype(jnp.int32).view(jnp.uint32)
+        mixed = _mix_h1(h1, byte)
+        in_tail = (i >= (lengths // 4) * 4) & (i < lengths)
+        h1 = jnp.where(in_tail, mixed, h1)
+    return _fmix(h1, lengths.astype(jnp.uint32))
+
+
+def hash_column(col: DeviceColumn, seed) -> jnp.ndarray:
+    """Hash one column with the running per-row seed; nulls pass seed through."""
+    k = col.dtype.kind
+    seed = jnp.broadcast_to(seed, col.validity.shape).astype(jnp.uint32)
+    if k is TypeKind.STRING:
+        h = _hash_string(col, seed)
+    elif k in (TypeKind.INT64, TypeKind.TIMESTAMP):
+        h = hash_long(col.data, seed)
+    elif k is TypeKind.FLOAT64:
+        x = jnp.where(col.data == 0.0, 0.0, col.data)  # -0.0 -> 0.0
+        low, high = _double_bits_words(x)
+        h = _fmix(_mix_h1(_mix_h1(seed, low), high), 8)
+    elif k is TypeKind.FLOAT32:
+        import jax
+        x = jnp.where(col.data == 0.0, jnp.float32(0.0), col.data)
+        h = hash_int(jax.lax.bitcast_convert_type(x, jnp.uint32), seed)
+    elif k is TypeKind.BOOLEAN:
+        h = hash_int(col.data.astype(jnp.int32), seed)
+    elif k is TypeKind.DECIMAL:
+        # Spark hashes small decimals as their unscaled long
+        h = hash_long(col.data, seed)
+    else:  # int8/16/32, date
+        h = hash_int(col.data.astype(jnp.int32), seed)
+    return jnp.where(col.validity, h, seed)
+
+
+def murmur3_batch(cols: Sequence[DeviceColumn],
+                  seed: int = DEFAULT_SEED) -> jnp.ndarray:
+    """Row hash across columns (Spark Murmur3Hash expression), as int32."""
+    n = cols[0].validity.shape[0]
+    h = jnp.full((n,), seed, jnp.uint32)
+    for c in cols:
+        h = hash_column(c, h)
+    return h.view(jnp.int32)
+
+
+@dataclass(frozen=True, eq=False)
+class Murmur3Hash(Expression):
+    exprs: Tuple[Expression, ...]
+    seed: int = DEFAULT_SEED
+
+    @property
+    def children(self):
+        return self.exprs
+
+    def with_children(self, c):
+        return Murmur3Hash(tuple(c), self.seed)
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, batch: ColumnarBatch, ctx=EvalContext()):
+        cols = [e.eval(batch, ctx) for e in self.exprs]
+        h = murmur3_batch(cols, self.seed)
+        return DeviceColumn(h, batch.row_mask(), None, T.INT32)
+
+    def __repr__(self):
+        return f"murmur3({', '.join(map(repr, self.exprs))})"
+
+
+def partition_ids(cols: Sequence[DeviceColumn], num_partitions: int) -> jnp.ndarray:
+    """Spark HashPartitioning: pmod(murmur3(row), n)."""
+    h = murmur3_batch(cols)
+    m = h % jnp.int32(num_partitions)
+    return jnp.where(m < 0, m + num_partitions, m)

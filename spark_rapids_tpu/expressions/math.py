@@ -1,0 +1,203 @@
+"""Math expressions with Spark semantics (reference: mathExpressions.scala).
+
+Notable Spark quirks reproduced here:
+- log/ln/log10/log2 return NULL for non-positive input (not NaN).
+- sqrt of negative returns NaN (not null).
+- round() is HALF_UP (Java BigDecimal), not banker's rounding — jnp.round
+  is half-even so we implement half-up directly; bround() IS half-even.
+- floor/ceil of double return LONG.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, ClassVar, Dict
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..types import SqlType, TypeKind
+from .base import DeviceColumn, EvalContext, Expression, and_validity, \
+    numeric_column
+
+
+@dataclass(frozen=True, eq=False)
+class UnaryMath(Expression):
+    """Double-valued unary math function, selected by name."""
+
+    child: Expression
+    fn: str = "sqrt"
+
+    _FNS: ClassVar[Dict[str, Callable]] = {
+        "sqrt": jnp.sqrt, "exp": jnp.exp, "expm1": jnp.expm1,
+        "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+        "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+        "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+        "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+        "cbrt": jnp.cbrt, "rint": jnp.round,
+        "degrees": jnp.degrees, "radians": jnp.radians,
+    }
+    # functions where non-positive input yields NULL (Spark behavior)
+    _NULL_ON_NONPOS: ClassVar[Dict[str, Callable]] = {
+        "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+        "log1p": jnp.log1p,
+    }
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return UnaryMath(c[0], self.fn)
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        x = c.data.astype(jnp.float64)
+        if self.fn in self._NULL_ON_NONPOS:
+            lim = -1.0 if self.fn == "log1p" else 0.0
+            ok = x > lim
+            y = self._NULL_ON_NONPOS[self.fn](jnp.where(ok, x, 1.0))
+            return numeric_column(y, c.validity & ok, T.FLOAT64)
+        return numeric_column(self._FNS[self.fn](x), c.validity, T.FLOAT64)
+
+    def __repr__(self):
+        return f"{self.fn}({self.child!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class Pow(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return Pow(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        l = self.left.eval(batch, ctx)
+        r = self.right.eval(batch, ctx)
+        y = jnp.power(l.data.astype(jnp.float64), r.data.astype(jnp.float64))
+        return numeric_column(y, and_validity([l, r]), T.FLOAT64)
+
+
+@dataclass(frozen=True, eq=False)
+class Atan2(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return Atan2(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        l = self.left.eval(batch, ctx)
+        r = self.right.eval(batch, ctx)
+        y = jnp.arctan2(l.data.astype(jnp.float64), r.data.astype(jnp.float64))
+        return numeric_column(y, and_validity([l, r]), T.FLOAT64)
+
+
+@dataclass(frozen=True, eq=False)
+class FloorCeil(Expression):
+    child: Expression
+    is_ceil: bool = False
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return FloorCeil(c[0], self.is_ceil)
+
+    @property
+    def dtype(self):
+        d = self.child.dtype
+        return d if d.is_integral else T.INT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        if self.child.dtype.is_integral:
+            return c
+        f = jnp.ceil if self.is_ceil else jnp.floor
+        y = f(c.data.astype(jnp.float64))
+        valid = c.validity & jnp.isfinite(c.data)
+        return numeric_column(y.astype(jnp.int64), valid, T.INT64)
+
+    def __repr__(self):
+        return f"{'ceil' if self.is_ceil else 'floor'}({self.child!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class Round(Expression):
+    """round(x, scale): HALF_UP; bround: HALF_EVEN (reference: GpuBRound/GpuRound)."""
+
+    child: Expression
+    scale: int = 0
+    half_even: bool = False
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Round(c[0], self.scale, self.half_even)
+
+    @property
+    def dtype(self):
+        d = self.child.dtype
+        if d.kind is TypeKind.DECIMAL:
+            return T.decimal(d.precision, min(d.scale, max(self.scale, 0)))
+        return d
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        d = self.child.dtype
+        if d.is_integral and self.scale >= 0:
+            return c
+        x = c.data.astype(jnp.float64)
+        p = 10.0 ** self.scale
+        if self.half_even:
+            y = jnp.round(x * p) / p
+        else:
+            y = jnp.sign(x) * jnp.floor(jnp.abs(x) * p + 0.5) / p
+        if d.is_integral:
+            return numeric_column(y.astype(d.storage_dtype), c.validity, d)
+        return numeric_column(y.astype(c.data.dtype), c.validity, d)
+
+
+@dataclass(frozen=True, eq=False)
+class Signum(Expression):
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Signum(c[0])
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        return numeric_column(jnp.sign(c.data.astype(jnp.float64)),
+                              c.validity, T.FLOAT64)

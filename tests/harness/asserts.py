@@ -1,0 +1,81 @@
+"""Differential assertions: TPU engine result vs a CPU oracle.
+
+Port of the reference's assert framework semantics
+(reference: integration_tests/src/main/python/asserts.py:441,542 —
+assert_gpu_and_cpu_are_equal_collect; floats compared approximately, rows
+canonicalized). The oracle side here is pandas/pyarrow compute — the same
+role CPU Spark plays for the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+
+def _canon(v: Any) -> Any:
+    if isinstance(v, float):
+        if math.isnan(v):
+            return ("nan",)
+        return v
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return v
+
+
+def _row_key(row):
+    # total order over heterogeneous values incl. None, for ignore_order
+    return tuple((v is None, str(type(v)), str(_canon(v))) for v in row)
+
+
+def rows_of(table: pa.Table) -> List[tuple]:
+    cols = [c.to_pylist() for c in table.columns]
+    return [tuple(c[i] for c in cols) for i in range(table.num_rows)]
+
+
+def assert_rows_equal(actual: Sequence[tuple], expected: Sequence[tuple],
+                      ignore_order: bool = False, approx_float: bool = True,
+                      rel_tol: float = 1e-6):
+    assert len(actual) == len(expected), \
+        f"row count {len(actual)} != {len(expected)}\n" \
+        f"actual[:5]={list(actual)[:5]}\nexpected[:5]={list(expected)[:5]}"
+    a, e = list(actual), list(expected)
+    if ignore_order:
+        a = sorted(a, key=_row_key)
+        e = sorted(e, key=_row_key)
+    for i, (ra, re_) in enumerate(zip(a, e)):
+        assert len(ra) == len(re_), f"row {i}: width {len(ra)} != {len(re_)}"
+        for j, (va, ve) in enumerate(zip(ra, re_)):
+            _assert_value(va, ve, f"row {i} col {j}", approx_float, rel_tol)
+
+
+def _assert_value(va, ve, where, approx_float, rel_tol):
+    if ve is None or va is None:
+        assert va is None and ve is None, f"{where}: {va!r} != {ve!r}"
+        return
+    if isinstance(ve, float) or isinstance(va, float):
+        va_f, ve_f = float(va), float(ve)
+        if math.isnan(ve_f):
+            assert math.isnan(va_f), f"{where}: {va!r} != NaN"
+            return
+        if math.isinf(ve_f):
+            assert va_f == ve_f, f"{where}: {va!r} != {ve!r}"
+            return
+        if approx_float:
+            assert math.isclose(va_f, ve_f, rel_tol=rel_tol, abs_tol=1e-9), \
+                f"{where}: {va!r} !~ {ve!r}"
+        else:
+            assert va_f == ve_f, f"{where}: {va!r} != {ve!r}"
+        return
+    assert va == ve, f"{where}: {va!r} != {ve!r}"
+
+
+def assert_tables_equal(actual: pa.Table, expected: pa.Table,
+                        ignore_order: bool = False, approx_float: bool = True):
+    assert actual.num_columns == expected.num_columns, \
+        f"{actual.column_names} vs {expected.column_names}"
+    assert_rows_equal(rows_of(actual), rows_of(expected),
+                      ignore_order=ignore_order, approx_float=approx_float)

@@ -1,0 +1,187 @@
+"""Composable, seeded data generators for differential tests.
+
+Port of the reference's integration-test generator semantics
+(reference: integration_tests/src/main/python/data_gen.py:36-680 —
+IntegerGen, FloatGen with NaN toggles, StringGen, null injection with
+special values). Generators produce pyarrow arrays; `gen_table` is the
+analogue of gen_df.
+"""
+
+from __future__ import annotations
+
+import string as _string
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.types import SqlType, TypeKind
+
+
+@dataclass(frozen=True)
+class DataGen:
+    sql_type: SqlType
+    nullable: bool = True
+    null_prob: float = 0.1
+    special_vals: Tuple = ()
+    special_prob: float = 0.05
+
+    def gen_values(self, rng: np.random.Generator, n: int) -> List[Any]:
+        raise NotImplementedError
+
+    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+        vals = self.gen_values(rng, n)
+        if self.special_vals:
+            pick = rng.random(n) < self.special_prob
+            idx = rng.integers(0, len(self.special_vals), n)
+            vals = [self.special_vals[idx[i]] if pick[i] else v
+                    for i, v in enumerate(vals)]
+        if self.nullable:
+            nulls = rng.random(n) < self.null_prob
+            vals = [None if nulls[i] else v for i, v in enumerate(vals)]
+        return pa.array(vals, type=T.to_arrow(self.sql_type))
+
+
+@dataclass(frozen=True)
+class IntegerGen(DataGen):
+    sql_type: SqlType = T.INT32
+    min_val: Optional[int] = None
+    max_val: Optional[int] = None
+
+    def gen_values(self, rng, n):
+        bits = {TypeKind.INT8: 8, TypeKind.INT16: 16,
+                TypeKind.INT32: 32, TypeKind.INT64: 64}[self.sql_type.kind]
+        lo = self.min_val if self.min_val is not None else -(2 ** (bits - 1))
+        hi = self.max_val if self.max_val is not None else 2 ** (bits - 1) - 1
+        vals = rng.integers(lo, hi, n, dtype=np.int64, endpoint=True)
+        out = [int(v) for v in vals]
+        if self.min_val is None and self.special_vals == ():
+            # boundary values, like the reference's special cases
+            for sp in (lo, hi, 0):
+                if n > 3:
+                    out[int(rng.integers(0, n))] = sp
+        return out
+
+
+@dataclass(frozen=True)
+class LongGen(IntegerGen):
+    sql_type: SqlType = T.INT64
+
+
+@dataclass(frozen=True)
+class ByteGen(IntegerGen):
+    sql_type: SqlType = T.INT8
+
+
+@dataclass(frozen=True)
+class ShortGen(IntegerGen):
+    sql_type: SqlType = T.INT16
+
+
+@dataclass(frozen=True)
+class FloatGen(DataGen):
+    sql_type: SqlType = T.FLOAT64
+    no_nans: bool = False
+
+    def gen_values(self, rng, n):
+        vals = (rng.standard_normal(n) * rng.choice(
+            [1.0, 1e3, 1e-3, 1e10], n)).tolist()
+        if not self.no_nans and n > 4:
+            for sp in (float("nan"), float("inf"), float("-inf"), -0.0):
+                vals[int(rng.integers(0, n))] = sp
+        if self.sql_type.kind is TypeKind.FLOAT32:
+            vals = [float(np.float32(v)) for v in vals]
+        return vals
+
+
+@dataclass(frozen=True)
+class DoubleGen(FloatGen):
+    sql_type: SqlType = T.FLOAT64
+
+
+@dataclass(frozen=True)
+class BooleanGen(DataGen):
+    sql_type: SqlType = T.BOOLEAN
+
+    def gen_values(self, rng, n):
+        return [bool(v) for v in rng.integers(0, 2, n)]
+
+
+@dataclass(frozen=True)
+class StringGen(DataGen):
+    sql_type: SqlType = T.string(32)
+    min_len: int = 0
+    max_len: int = 20
+    charset: str = _string.ascii_letters + _string.digits + " _-"
+
+    def gen_values(self, rng, n):
+        out = []
+        chars = list(self.charset)
+        for _ in range(n):
+            k = int(rng.integers(self.min_len, self.max_len + 1))
+            out.append("".join(rng.choice(chars, k)))
+        if n > 2:
+            out[int(rng.integers(0, n))] = ""  # empty-string special
+        return out
+
+
+@dataclass(frozen=True)
+class DateGen(DataGen):
+    sql_type: SqlType = T.DATE
+
+    def gen_values(self, rng, n):
+        import datetime as dt
+        days = rng.integers(-25000, 25000, n)
+        return [dt.date(1970, 1, 1) + dt.timedelta(days=int(d)) for d in days]
+
+
+@dataclass(frozen=True)
+class TimestampGen(DataGen):
+    sql_type: SqlType = T.TIMESTAMP
+
+    def gen_values(self, rng, n):
+        import datetime as dt
+        us = rng.integers(-2**52, 2**52, n)
+        epoch = dt.datetime(1970, 1, 1)
+        return [epoch + dt.timedelta(microseconds=int(u)) for u in us]
+
+
+@dataclass(frozen=True)
+class DecimalGen(DataGen):
+    sql_type: SqlType = T.decimal(10, 2)
+
+    def gen_values(self, rng, n):
+        import decimal as d
+        p, s = self.sql_type.precision, self.sql_type.scale
+        unscaled_max = 10 ** p - 1
+        vals = rng.integers(-unscaled_max, unscaled_max, n, endpoint=True)
+        return [d.Decimal(int(v)).scaleb(-s) for v in vals]
+
+
+# Standard generator sets, mirroring the reference's numeric_gens etc.
+def integral_gens():
+    return [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+
+
+def numeric_gens(no_nans: bool = False):
+    return integral_gens() + [
+        FloatGen(sql_type=T.FLOAT32, no_nans=no_nans),
+        DoubleGen(no_nans=no_nans)]
+
+
+def all_basic_gens():
+    return numeric_gens() + [BooleanGen(), StringGen(), DateGen(),
+                             TimestampGen()]
+
+
+def gen_table(gens: Sequence[Tuple[str, DataGen]], n: int = 2048,
+              seed: int = 0) -> pa.Table:
+    """Build a pyarrow table from named generators (analogue of gen_df)."""
+    rng = np.random.default_rng(seed)
+    cols, names = [], []
+    for name, g in gens:
+        cols.append(g.generate(rng, n))
+        names.append(name)
+    return pa.table(cols, names=names)

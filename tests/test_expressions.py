@@ -1,0 +1,235 @@
+"""Differential tests for the expression layer (numpy/pandas oracle)."""
+
+import math
+
+import jax
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import from_arrow, to_arrow, Schema, Field
+from spark_rapids_tpu.expressions import (
+    Abs, And, CaseWhen, Cast, Coalesce, EqualNullSafe, FloorCeil, If, In,
+    IntegralDivide, IsNull, LeastGreatest, Murmur3Hash, Not, Or, Pmod, Pow,
+    Remainder, Round, UnaryMath, col, lit,
+)
+from harness.data_gen import gen_table, IntegerGen, LongGen, DoubleGen, \
+    StringGen, BooleanGen
+from harness.murmur3_oracle import spark_hash_row
+
+
+def eval_expr(table: pa.Table, expr, out_name="out"):
+    """Bind+jit-evaluate one expression over a table; return pylist."""
+    batch, schema = from_arrow(table)
+    bound = expr.bind(schema)
+
+    @jax.jit
+    def run(b):
+        c = bound.eval(b)
+        from spark_rapids_tpu.batch import ColumnarBatch
+        return ColumnarBatch((c,), b.num_rows)
+
+    out = run(batch)
+    out_schema = Schema([Field(out_name, bound.dtype)])
+    return to_arrow(out, out_schema).column(0).to_pylist()
+
+
+def test_add_mixed_width_nulls():
+    t = pa.table({
+        "a": pa.array([1, None, 3, 2**31 - 1], type=pa.int32()),
+        "b": pa.array([10, 20, None, 1], type=pa.int64()),
+    })
+    got = eval_expr(t, col("a") + col("b"))
+    assert got == [11, None, None, 2**31]
+
+
+def test_int_overflow_wraps():
+    t = pa.table({"a": pa.array([2**62, -5], type=pa.int64())})
+    got = eval_expr(t, col("a") * lit(4))
+    # Java two's-complement wrap: 2^62 * 4 == 2^64 == 0 in int64
+    assert got == [0, -20]
+
+
+def test_divide_by_zero_is_null():
+    t = pa.table({"a": pa.array([10.0, 5.0, None]),
+                  "b": pa.array([2.0, 0.0, 1.0])})
+    got = eval_expr(t, col("a") / col("b"))
+    assert got == [5.0, None, None]
+    t2 = pa.table({"a": pa.array([7, 7], type=pa.int32()),
+                   "b": pa.array([2, 0], type=pa.int32())})
+    assert eval_expr(t2, col("a") / col("b")) == [3.5, None]
+    assert eval_expr(t2, IntegralDivide(col("a"), col("b"))) == [3, None]
+
+
+def test_remainder_sign_follows_dividend():
+    t = pa.table({"a": pa.array([7, -7, 7, -7], type=pa.int32()),
+                  "b": pa.array([3, 3, -3, -3], type=pa.int32())})
+    assert eval_expr(t, Remainder(col("a"), col("b"))) == [1, -1, 1, -1]
+    assert eval_expr(t, Pmod(col("a"), col("b"))) == [1, 2, 1, 2]
+
+
+def test_integral_divide_truncates_toward_zero():
+    t = pa.table({"a": pa.array([-7], type=pa.int64()),
+                  "b": pa.array([2], type=pa.int64())})
+    assert eval_expr(t, IntegralDivide(col("a"), col("b"))) == [-3]
+
+
+def test_three_valued_logic():
+    tv = [True, True, True, False, False, False, None, None, None]
+    ov = [True, False, None, True, False, None, True, False, None]
+    t = pa.table({"a": pa.array(tv), "b": pa.array(ov)})
+    assert eval_expr(t, And(col("a"), col("b"))) == \
+        [True, False, None, False, False, False, None, False, None]
+    assert eval_expr(t, Or(col("a"), col("b"))) == \
+        [True, True, True, True, False, None, True, None, None]
+    assert eval_expr(t, Not(col("a"))) == \
+        [False, False, False, True, True, True, None, None, None]
+
+
+def test_comparisons_and_null_safe_eq():
+    t = pa.table({"a": pa.array([1, None, 3, None], type=pa.int32()),
+                  "b": pa.array([1, 2, None, None], type=pa.int32())})
+    assert eval_expr(t, col("a") == col("b")) == [True, None, None, None]
+    assert eval_expr(t, EqualNullSafe(col("a"), col("b"))) == \
+        [True, False, False, True]
+    assert eval_expr(t, IsNull(col("a"))) == [False, True, False, True]
+
+
+def test_string_compare():
+    t = pa.table({"a": pa.array(["apple", "b", None, "", "abc"]),
+                  "b": pa.array(["apricot", "b", "x", "a", "ab"])})
+    assert eval_expr(t, col("a") < col("b")) == [True, False, None, True, False]
+    assert eval_expr(t, col("a") == col("b")) == \
+        [False, True, None, False, False]
+
+
+def test_in_with_null_semantics():
+    t = pa.table({"a": pa.array([1, 2, None], type=pa.int32())})
+    assert eval_expr(t, In(col("a"), (1, 3))) == [True, False, None]
+    # null in list: no-match becomes null
+    assert eval_expr(t, In(col("a"), (1, None))) == [True, None, None]
+
+
+def test_conditionals():
+    t = pa.table({"a": pa.array([1, 5, None], type=pa.int32())})
+    e = If(col("a") > lit(2), lit(100), lit(-100))
+    assert eval_expr(t, e) == [-100, 100, -100]  # null pred -> else
+    e2 = CaseWhen(((col("a") > lit(4), lit(1)),
+                   (col("a") > lit(0), lit(2))), None)
+    assert eval_expr(t, e2) == [2, 1, None]
+    e3 = Coalesce((col("a"), lit(0)))
+    assert eval_expr(t, e3) == [1, 5, 0]
+
+
+def test_least_greatest_skip_nulls():
+    t = pa.table({"a": pa.array([1, None, None], type=pa.int32()),
+                  "b": pa.array([5, 7, None], type=pa.int32())})
+    assert eval_expr(t, LeastGreatest((col("a"), col("b")))) == [1, 7, None]
+    assert eval_expr(t, LeastGreatest((col("a"), col("b")),
+                                      greatest=True)) == [5, 7, None]
+
+
+def test_cast_float_to_int_java_semantics():
+    t = pa.table({"a": pa.array([1.9, -1.9, float("nan"), 1e20, -1e20, None])})
+    got = eval_expr(t, Cast(col("a"), T.INT32))
+    assert got == [1, -1, 0, 2**31 - 1, -(2**31), None]
+    got64 = eval_expr(t, Cast(col("a"), T.INT64))
+    assert got64 == [1, -1, 0, 2**63 - 1, -(2**63), None]
+
+
+def test_cast_int_narrowing_wraps():
+    t = pa.table({"a": pa.array([300, -300], type=pa.int32())})
+    assert eval_expr(t, Cast(col("a"), T.INT8)) == [44, -44]
+
+
+def test_cast_bool_numeric():
+    t = pa.table({"a": pa.array([0, 3, None], type=pa.int32())})
+    assert eval_expr(t, Cast(col("a"), T.BOOLEAN)) == [False, True, None]
+    t2 = pa.table({"b": pa.array([True, False])})
+    assert eval_expr(t2, Cast(col("b"), T.INT64)) == [1, 0]
+
+
+def test_cast_timestamp_date():
+    import datetime as dt
+    t = pa.table({"ts": pa.array([dt.datetime(2020, 5, 1, 23, 59),
+                                  dt.datetime(1969, 12, 31, 23, 0)],
+                                 type=pa.timestamp("us"))})
+    got = eval_expr(t, Cast(col("ts"), T.DATE))
+    assert got == [dt.date(2020, 5, 1), dt.date(1969, 12, 31)]
+
+
+def test_math_log_null_on_nonpositive():
+    t = pa.table({"a": pa.array([math.e, 0.0, -1.0, None])})
+    got = eval_expr(t, UnaryMath(col("a"), "log"))
+    assert got[0] == pytest.approx(1.0)
+    assert got[1:] == [None, None, None]
+
+
+def test_sqrt_negative_is_nan():
+    t = pa.table({"a": pa.array([4.0, -4.0])})
+    got = eval_expr(t, UnaryMath(col("a"), "sqrt"))
+    assert got[0] == 2.0 and math.isnan(got[1])
+
+
+def test_round_half_up_vs_bround():
+    t = pa.table({"a": pa.array([2.5, 3.5, -2.5, 1.25])})
+    assert eval_expr(t, Round(col("a"), 0)) == [3.0, 4.0, -3.0, 1.0]
+    # bround = HALF_EVEN
+    assert eval_expr(t, Round(col("a"), 0, half_even=True)) == \
+        [2.0, 4.0, -2.0, 1.0]
+    assert eval_expr(t, Round(col("a"), 1)) == [2.5, 3.5, -2.5, 1.3]
+
+
+def test_floor_ceil_return_long():
+    t = pa.table({"a": pa.array([1.5, -1.5, None])})
+    e = FloorCeil(col("a"))
+    b, s = from_arrow(t)
+    assert e.bind(s).dtype == T.INT64
+    assert eval_expr(t, e) == [1, -2, None]
+    assert eval_expr(t, FloorCeil(col("a"), is_ceil=True)) == [2, -1, None]
+
+
+def test_abs_pow():
+    t = pa.table({"a": pa.array([-3, 3, None], type=pa.int32())})
+    assert eval_expr(t, Abs(col("a"))) == [3, 3, None]
+    t2 = pa.table({"a": pa.array([2.0, 3.0]), "b": pa.array([10.0, 2.0])})
+    assert eval_expr(t2, Pow(col("a"), col("b"))) == [1024.0, 9.0]
+
+
+# ---------------- murmur3 parity vs scalar Java oracle ----------------
+
+def test_murmur3_ints_vs_oracle():
+    t = gen_table([("a", IntegerGen()), ("b", LongGen())], n=256, seed=7)
+    got = eval_expr(t, Murmur3Hash((col("a"), col("b"))))
+    a, b = t.column("a").to_pylist(), t.column("b").to_pylist()
+    exp = [spark_hash_row((a[i], b[i]), ("int", "long")) for i in range(256)]
+    assert got == exp
+
+
+def test_murmur3_floats_bools_vs_oracle():
+    t = gen_table([("f", DoubleGen()), ("g", BooleanGen())], n=200, seed=3)
+    got = eval_expr(t, Murmur3Hash((col("f"), col("g"))))
+    f, g = t.column("f").to_pylist(), t.column("g").to_pylist()
+    exp = [spark_hash_row((f[i], g[i]), ("double", "bool"))
+           for i in range(200)]
+    assert got == exp
+
+
+def test_murmur3_strings_vs_oracle():
+    t = gen_table([("s", StringGen(max_len=20))], n=200, seed=11)
+    got = eval_expr(t, Murmur3Hash((col("s"),)))
+    s = t.column("s").to_pylist()
+    exp = [spark_hash_row((s[i],), ("string",)) for i in range(200)]
+    assert got == exp
+
+
+def test_generated_arithmetic_matches_numpy():
+    t = gen_table([("a", LongGen(min_val=-10**6, max_val=10**6)),
+                   ("b", LongGen(min_val=1, max_val=1000))], n=1024, seed=5)
+    got = eval_expr(t, (col("a") + col("b")) * lit(3) - col("b"))
+    a = t.column("a").to_pylist()
+    b = t.column("b").to_pylist()
+    exp = [None if (x is None or y is None) else (x + y) * 3 - y
+           for x, y in zip(a, b)]
+    assert got == exp
