@@ -1,0 +1,16 @@
+"""Physical operator layer — the analogue of the reference's Gpu*Exec nodes
+(reference: sql-plugin/.../GpuExec.scala:211 base trait and the operator files
+in SURVEY.md §2.5). Operators are pull-based iterators of ColumnarBatch; each
+per-batch kernel is a jitted XLA computation compiled once per capacity bucket.
+"""
+
+from .base import Exec, LeafExec, UnaryExec, BinaryExec, Metric, collect
+from .basic import (ProjectExec, FilterExec, RangeExec, UnionExec,
+                    LocalLimitExec, GlobalLimitExec, SampleExec,
+                    InMemoryScanExec, ExpandExec)
+from .aggregate import HashAggregateExec, AggregateMode
+from .sort import SortExec, SortOrder, TakeOrderedAndProjectExec
+from .join import (HashJoinExec, BroadcastNestedLoopJoinExec, JoinType)
+from .coalesce import CoalesceBatchesExec, TargetSize, RequireSingleBatch
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,119 @@
+"""Operator base classes and metrics.
+
+Reference: sql-plugin/.../GpuExec.scala:211 (`GpuExec` trait) and its metric
+machinery at GpuExec.scala:45-135 (ESSENTIAL/MODERATE/DEBUG GpuMetric levels).
+
+Execution model: pull-based `Iterator[ColumnarBatch]` per partition, exactly
+like the reference (SURVEY.md §3.3) — but where the reference dispatches one
+JNI kernel per op per batch, here each operator's per-batch computation is a
+traced jnp function, so chains of narrow operators (project→filter→project)
+fuse into one XLA executable per capacity bucket.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import pyarrow as pa
+
+from ..batch import ColumnarBatch, Schema, to_arrow
+from ..expressions.base import EvalContext
+
+ESSENTIAL, MODERATE, DEBUG = 0, 1, 2
+
+
+@dataclass
+class Metric:
+    """Reference: GpuMetric over Spark SQLMetric (GpuExec.scala:45)."""
+
+    name: str
+    level: int = MODERATE
+    value: int = 0
+
+    def add(self, v) -> None:
+        self.value += int(v)
+
+
+class Exec:
+    """A physical operator. Subclasses define `output_schema` and
+    `do_execute() -> Iterator[ColumnarBatch]`."""
+
+    def __init__(self, children: Sequence["Exec"] = (),
+                 ctx: EvalContext = EvalContext()):
+        self.children: Tuple[Exec, ...] = tuple(children)
+        self.ctx = ctx
+        self.metrics: Dict[str, Metric] = {
+            "numOutputRows": Metric("numOutputRows", ESSENTIAL),
+            "numOutputBatches": Metric("numOutputBatches", MODERATE),
+            "opTime": Metric("opTime", MODERATE),
+        }
+
+    # ---- plan surface ----
+    @property
+    def output_schema(self) -> Schema:
+        raise NotImplementedError(type(self).__name__)
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError(type(self).__name__)
+
+    def execute(self) -> Iterator[ColumnarBatch]:
+        for batch in self.do_execute():
+            self.metrics["numOutputBatches"].add(1)
+            yield batch
+
+    # ---- debugging / explain ----
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + f"*{self.name} [{self.output_schema}]\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def __repr__(self):
+        return self.tree_string().rstrip()
+
+
+class LeafExec(Exec):
+    def __init__(self, ctx: EvalContext = EvalContext()):
+        super().__init__((), ctx)
+
+
+class UnaryExec(Exec):
+    def __init__(self, child: Exec, ctx: Optional[EvalContext] = None):
+        super().__init__((child,), ctx or child.ctx)
+
+    @property
+    def child(self) -> Exec:
+        return self.children[0]
+
+
+class BinaryExec(Exec):
+    def __init__(self, left: Exec, right: Exec,
+                 ctx: Optional[EvalContext] = None):
+        super().__init__((left, right), ctx or left.ctx)
+
+    @property
+    def left(self) -> Exec:
+        return self.children[0]
+
+    @property
+    def right(self) -> Exec:
+        return self.children[1]
+
+
+def collect(plan: Exec) -> pa.Table:
+    """Run a plan and pull the result to the host as one Arrow table — the
+    test/collect boundary (reference: GpuColumnarToRowExec)."""
+    schema = plan.output_schema
+    tables = [to_arrow(b, schema) for b in plan.execute()]
+    if not tables:
+        from .. import types as T
+        return pa.table({f.name: pa.array([], type=T.to_arrow(f.dtype))
+                         for f in schema})
+    return pa.concat_tables(tables)

@@ -1,0 +1,270 @@
+"""Basic physical operators: scan-from-memory, project, filter, limit, union,
+range, sample, expand.
+
+Reference: sql-plugin/.../basicPhysicalOperators.scala (GpuProjectExec:147,
+GpuFilterExec:423, GpuRangeExec:644, GpuSampleExec), limit.scala,
+GpuExpandExec. The TPU-first difference: FilterExec compacts with a cumsum
+scatter (no host sync, no dynamic shape) and a project→filter chain traces
+into one XLA computation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..batch import (ColumnarBatch, DeviceColumn, Field, Schema,
+                     bucket_capacity, from_arrow)
+from ..expressions.base import Alias, EvalContext, Expression
+from ..types import TypeKind
+from .base import Exec, LeafExec, UnaryExec
+from .common import compact, slice_batch
+
+
+def output_name(e: Expression, i: int) -> str:
+    if isinstance(e, Alias):
+        return e.name
+    name = getattr(e, "name", "")
+    return name or f"col{i}"
+
+
+def bind_all(exprs: Sequence[Expression], schema: Schema) -> List[Expression]:
+    return [e.bind(schema) for e in exprs]
+
+
+def schema_of(exprs: Sequence[Expression]) -> Schema:
+    return Schema([Field(output_name(e, i), e.dtype, e.nullable)
+                   for i, e in enumerate(exprs)])
+
+
+class InMemoryScanExec(LeafExec):
+    """Leaf feeding pre-loaded data; the H2D boundary for tests and caches
+    (reference: GpuInMemoryTableScanExec)."""
+
+    def __init__(self, data, schema: Optional[Schema] = None,
+                 batch_rows: Optional[int] = None,
+                 ctx: EvalContext = EvalContext()):
+        super().__init__(ctx)
+        if isinstance(data, pa.Table):
+            self._tables = [data]
+            self._batches = None
+            if schema is None:
+                from ..batch import schema_from_arrow
+                schema = schema_from_arrow(data.schema)
+        else:
+            self._batches = list(data)
+            self._tables = None
+            assert schema is not None, "schema required for device batches"
+        self._schema = schema
+        self._batch_rows = batch_rows
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        if self._batches is not None:
+            yield from self._batches
+            return
+        for table in self._tables:
+            n = table.num_rows
+            step = self._batch_rows or max(n, 1)
+            for off in range(0, max(n, 1), step):
+                chunk = table.slice(off, step)
+                batch, _ = from_arrow(chunk, schema=self._schema)
+                yield batch
+                if n == 0:
+                    break
+
+
+class ProjectExec(UnaryExec):
+    """Reference: GpuProjectExec (basicPhysicalOperators.scala:147)."""
+
+    def __init__(self, exprs: Sequence[Expression], child: Exec,
+                 ctx: Optional[EvalContext] = None):
+        super().__init__(child, ctx)
+        self.exprs = bind_all(exprs, child.output_schema)
+        self._schema = schema_of(self.exprs)
+
+        def kernel(batch: ColumnarBatch) -> ColumnarBatch:
+            cols = tuple(e.eval(batch, self.ctx) for e in self.exprs)
+            return ColumnarBatch(cols, batch.num_rows)
+
+        self._kernel = jax.jit(kernel)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for batch in self.child.execute():
+            out = self._kernel(batch)
+            self.metrics["numOutputRows"].add(0)  # traced; counted at collect
+            yield out
+
+
+class FilterExec(UnaryExec):
+    """Reference: GpuFilterExec (basicPhysicalOperators.scala:423).
+
+    Null condition values drop the row (Spark semantics). Compaction is a
+    cumsum scatter on device — no host round trip.
+    """
+
+    def __init__(self, condition: Expression, child: Exec,
+                 ctx: Optional[EvalContext] = None):
+        super().__init__(child, ctx)
+        self.condition = condition.bind(child.output_schema)
+        if self.condition.dtype.kind is not TypeKind.BOOLEAN:
+            raise TypeError(f"filter condition must be boolean, got "
+                            f"{self.condition.dtype}")
+
+        def kernel(batch: ColumnarBatch) -> ColumnarBatch:
+            c = self.condition.eval(batch, self.ctx)
+            keep = c.data & c.validity
+            return compact(batch, keep)
+
+        self._kernel = jax.jit(kernel)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for batch in self.child.execute():
+            yield self._kernel(batch)
+
+
+class LocalLimitExec(UnaryExec):
+    """Reference: limit.scala GpuLocalLimitExec — cap rows per partition."""
+
+    def __init__(self, limit: int, child: Exec):
+        super().__init__(child)
+        self.limit = limit
+        self._kernel = jax.jit(
+            lambda b, remaining: slice_batch(b, jnp.int32(0), remaining))
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        remaining = self.limit
+        for batch in self.child.execute():
+            if remaining <= 0:
+                break
+            out = self._kernel(batch, jnp.int32(remaining))
+            remaining -= int(out.num_rows)  # host sync: limits are control flow
+            yield out
+
+
+class GlobalLimitExec(LocalLimitExec):
+    """Reference: GpuGlobalLimitExec — same mechanics once single-partitioned."""
+
+
+class UnionExec(Exec):
+    """Reference: GpuUnionExec — concatenation of children's partitions."""
+
+    def __init__(self, children: Sequence[Exec]):
+        super().__init__(children)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for c in self.children:
+            yield from c.execute()
+
+
+class RangeExec(LeafExec):
+    """Reference: GpuRangeExec (basicPhysicalOperators.scala:644)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 batch_rows: int = 1 << 20, name: str = "id"):
+        super().__init__()
+        if step == 0:
+            raise ValueError("step must not be 0")
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+        self._schema = Schema([Field(name, T.INT64, nullable=False)])
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        emitted = 0
+        while emitted < total or (total == 0 and emitted == 0):
+            n = min(self.batch_rows, total - emitted)
+            cap = bucket_capacity(max(n, 1))
+            base = self.start + emitted * self.step
+            data = (jnp.arange(cap, dtype=jnp.int64) * self.step + base)
+            live = jnp.arange(cap, dtype=jnp.int32) < n
+            col = DeviceColumn(jnp.where(live, data, 0), live, None, T.INT64)
+            yield ColumnarBatch((col,), jnp.asarray(n, jnp.int32))
+            emitted += n
+            if total == 0:
+                break
+
+
+class SampleExec(UnaryExec):
+    """Bernoulli row sample (reference: GpuSampleExec, GpuPoissonSampler)."""
+
+    def __init__(self, fraction: float, seed: int, child: Exec):
+        super().__init__(child)
+        self.fraction, self.seed = fraction, seed
+
+        def kernel(batch: ColumnarBatch, key) -> ColumnarBatch:
+            u = jax.random.uniform(key, (batch.capacity,))
+            return compact(batch, u < self.fraction)
+
+        self._kernel = jax.jit(kernel)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        root = jax.random.PRNGKey(self.seed)
+        for i, batch in enumerate(self.child.execute()):
+            yield self._kernel(batch, jax.random.fold_in(root, i))
+
+
+class ExpandExec(UnaryExec):
+    """Reference: GpuExpandExec — one output batch per projection per input
+    batch (rollup/cube/grouping sets)."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 child: Exec, ctx: Optional[EvalContext] = None):
+        super().__init__(child, ctx)
+        self.projections = [bind_all(p, child.output_schema)
+                            for p in projections]
+        self._schema = schema_of(self.projections[0])
+        # nullability is the union across projections
+        fields = []
+        for i, f in enumerate(self._schema):
+            nullable = any(p[i].nullable for p in self.projections)
+            fields.append(Field(f.name, f.dtype, nullable))
+        self._schema = Schema(fields)
+
+        def kernel(batch: ColumnarBatch, pi: int) -> ColumnarBatch:
+            cols = tuple(e.eval(batch, self.ctx) for e in self.projections[pi])
+            return ColumnarBatch(cols, batch.num_rows)
+
+        self._kernel = jax.jit(kernel, static_argnums=1)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        for batch in self.child.execute():
+            for pi in range(len(self.projections)):
+                yield self._kernel(batch, pi)

@@ -1,0 +1,227 @@
+"""Shared device kernels used by every operator.
+
+These are the TPU-native replacements for libcudf's table primitives
+(reference contract in SURVEY.md §2.9: gather 13 call sites, filter 77,
+concatenate 11, orderBy 4, partition 5). Everything here is shape-static and
+jit-traceable: row counts are traced scalars, capacities are static ints, so
+operator pipelines fuse into single XLA computations.
+
+Key primitives:
+- ``compact``     — stable scatter-compaction of kept rows (cudf filter).
+- ``gather``      — row gather with out-of-bounds-as-null (cudf gather map).
+- ``concat``      — batch concatenation at a given capacity (cudf concatenate).
+- ``sort_keys``   — rank-preserving normalization of any SQL column into
+                    uint-comparable operands for ``lax.sort`` (cudf orderBy).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import ColumnarBatch, DeviceColumn, Schema
+from ..types import SqlType, TypeKind
+
+
+# ---------------------------------------------------------------------------
+# Gather / compact / concat
+# ---------------------------------------------------------------------------
+
+def gather_column(col: DeviceColumn, indices: jax.Array,
+                  row_valid: Optional[jax.Array] = None) -> DeviceColumn:
+    """Gather rows of ``col`` at ``indices`` (int32[out_cap]).
+
+    ``row_valid`` marks which output slots hold a real gathered row; slots
+    outside it become null (the cudf gather-map convention where an OOB index
+    yields null — used by outer joins).
+    """
+    idx = jnp.clip(indices, 0, col.capacity - 1)
+    data = jnp.take(col.data, idx, axis=0)
+    validity = jnp.take(col.validity, idx, axis=0)
+    lengths = jnp.take(col.lengths, idx, axis=0) if col.lengths is not None else None
+    if row_valid is not None:
+        validity = validity & row_valid
+    return DeviceColumn(data, validity, lengths, col.dtype)
+
+
+def gather(batch: ColumnarBatch, indices: jax.Array, num_rows: jax.Array,
+           row_valid: Optional[jax.Array] = None) -> ColumnarBatch:
+    cols = tuple(gather_column(c, indices, row_valid) for c in batch.columns)
+    return ColumnarBatch(cols, jnp.asarray(num_rows, jnp.int32))
+
+
+def compaction_indices(keep: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Map a keep-mask to (gather_indices, kept_count).
+
+    Stable: kept rows retain relative order. Implemented as a cumsum scatter —
+    one pass, no sort (the hot primitive behind filter and join compaction).
+    """
+    cap = keep.shape[0]
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1          # target slot per kept row
+    scatter_to = jnp.where(keep, pos, cap)                # drop non-kept at cap
+    src = jnp.arange(cap, dtype=jnp.int32)
+    indices = jnp.zeros(cap, jnp.int32).at[scatter_to].set(src, mode="drop")
+    return indices, jnp.sum(keep.astype(jnp.int32))
+
+
+def compact(batch: ColumnarBatch, keep: jax.Array) -> ColumnarBatch:
+    """Remove rows where ``keep`` is False (cudf ``Table.filter``)."""
+    keep = keep & batch.row_mask()
+    indices, count = compaction_indices(keep)
+    live = jnp.arange(batch.capacity, dtype=jnp.int32) < count
+    return gather(batch, indices, count, live)
+
+
+def concat_columns(cols: Sequence[DeviceColumn], counts: Sequence[jax.Array],
+                   capacity: int) -> DeviceColumn:
+    """Concatenate columns into one of ``capacity`` rows.
+
+    Rows of piece i land at offset sum(counts[:i]); done with one scatter per
+    piece. Counts are traced, so offsets are traced too.
+    """
+    first = cols[0]
+    is_str = first.lengths is not None
+    if is_str:
+        data = jnp.zeros((capacity, first.data.shape[1]), first.data.dtype)
+        lengths = jnp.zeros(capacity, jnp.int32)
+    else:
+        data = jnp.zeros(capacity, first.data.dtype)
+        lengths = None
+    validity = jnp.zeros(capacity, bool)
+    offset = jnp.asarray(0, jnp.int32)
+    for col, n in zip(cols, counts):
+        cap_i = col.capacity
+        src = jnp.arange(cap_i, dtype=jnp.int32)
+        live = src < n
+        dest = jnp.where(live, src + offset, capacity)
+        data = data.at[dest].set(col.data, mode="drop")
+        validity = validity.at[dest].set(col.validity, mode="drop")
+        if is_str:
+            lengths = lengths.at[dest].set(col.lengths, mode="drop")
+        offset = offset + jnp.asarray(n, jnp.int32)
+    return DeviceColumn(data, validity, lengths, first.dtype)
+
+
+def concat_batches(batches: Sequence[ColumnarBatch], capacity: int) -> ColumnarBatch:
+    """cudf ``Table.concatenate`` — the coalesce kernel."""
+    counts = [b.num_rows for b in batches]
+    ncols = batches[0].num_columns
+    cols = tuple(
+        concat_columns([b.columns[i] for b in batches], counts, capacity)
+        for i in range(ncols))
+    total = sum(jnp.asarray(c, jnp.int32) for c in counts)
+    return ColumnarBatch(cols, jnp.asarray(total, jnp.int32))
+
+
+def slice_batch(batch: ColumnarBatch, start: jax.Array, count: jax.Array,
+                capacity: Optional[int] = None) -> ColumnarBatch:
+    """Rows [start, start+count) as a new batch (cudf Table slice)."""
+    cap = capacity or batch.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32) + jnp.asarray(start, jnp.int32)
+    n = jnp.minimum(jnp.asarray(count, jnp.int32),
+                    jnp.maximum(batch.num_rows - start, 0))
+    live = jnp.arange(cap, dtype=jnp.int32) < n
+    return gather(batch, idx, n, live)
+
+
+# ---------------------------------------------------------------------------
+# Sort-key normalization (cudf orderBy contract)
+# ---------------------------------------------------------------------------
+
+def _float_orderable(x: jax.Array, bits) -> jax.Array:
+    """IEEE754 total order as unsigned ints; NaN sorts greatest (Spark)."""
+    u = x.view(bits.dtype)
+    sign = bits.dtype.type(1) << (bits.dtype.itemsize * 8 - 1)
+    flipped = jnp.where(u & sign != 0, ~u, u | sign)
+    nan = jnp.isnan(x)
+    return jnp.where(nan, ~bits.dtype.type(0), flipped)
+
+
+def orderable_words(col: DeviceColumn) -> List[jax.Array]:
+    """Normalize a column into unsigned arrays whose lexicographic order is
+    the column's SQL ascending order. Strings produce several word operands."""
+    d = col.dtype
+    k = d.kind
+    if k is TypeKind.STRING:
+        # big-endian packed padded bytes: byte-wise lexicographic == uint64
+        # word-wise lexicographic; zero padding sorts shorter strings first,
+        # matching UTF-8 byte order because 0x00 is below any content byte.
+        cap, ml = col.data.shape
+        words = []
+        for w in range(0, ml, 8):
+            chunk = col.data[:, w:w + 8]
+            if chunk.shape[1] < 8:
+                chunk = jnp.pad(chunk, ((0, 0), (0, 8 - chunk.shape[1])))
+            word = jnp.zeros(cap, jnp.uint64)
+            for b in range(8):
+                word = (word << jnp.uint64(8)) | chunk[:, b].astype(jnp.uint64)
+            words.append(word)
+        return words
+    data = col.data
+    if k is TypeKind.BOOLEAN:
+        return [data.astype(jnp.uint8)]
+    if k in (TypeKind.FLOAT32,):
+        return [_float_orderable(data, jnp.zeros((), jnp.uint32))]
+    if k in (TypeKind.FLOAT64,):
+        return [_float_orderable(data, jnp.zeros((), jnp.uint64))]
+    # integral / date / timestamp / decimal: flip the sign bit
+    u = data.astype({1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32,
+                     8: jnp.uint64}[data.dtype.itemsize])
+    sign = u.dtype.type(1) << (u.dtype.itemsize * 8 - 1)
+    return [u ^ sign]
+
+
+def sort_operands(cols: Sequence[DeviceColumn], descending: Sequence[bool],
+                  nulls_first: Sequence[bool], live: jax.Array
+                  ) -> List[jax.Array]:
+    """Build the lax.sort key operands for a multi-column sort.
+
+    Dead rows (beyond num_rows) always sort last regardless of direction.
+    """
+    ops: List[jax.Array] = [(~live).astype(jnp.uint8)]  # live rows first
+    for col, desc, nf in zip(cols, descending, nulls_first):
+        null_rank = jnp.where(col.validity, jnp.uint8(1),
+                              jnp.uint8(0) if nf else jnp.uint8(2))
+        ops.append(jnp.where(live, null_rank, jnp.uint8(3)))
+        for w in orderable_words(col):
+            ops.append(~w if desc else w)
+    return ops
+
+
+def sort_permutation(batch: ColumnarBatch, key_cols: Sequence[DeviceColumn],
+                     descending: Sequence[bool], nulls_first: Sequence[bool]
+                     ) -> jax.Array:
+    """Stable permutation ordering the batch by the given keys."""
+    cap = batch.capacity
+    live = batch.row_mask()
+    ops = sort_operands(key_cols, descending, nulls_first, live)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    out = jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)  # iota key => stable
+    return out[-1]
+
+
+# ---------------------------------------------------------------------------
+# Group-key equality over sorted rows (aggregate/window boundary detection)
+# ---------------------------------------------------------------------------
+
+def adjacent_equal(cols: Sequence[DeviceColumn]) -> jax.Array:
+    """eq[i] = row i has the same key (incl. null==null) as row i-1; eq[0]=False.
+
+    Call on ALREADY SORTED/GATHERED key columns.
+    """
+    cap = cols[0].capacity
+    eq = jnp.ones(cap, bool)
+    for c in cols:
+        if c.lengths is not None:
+            same = jnp.all(c.data[1:] == c.data[:-1], axis=1) & \
+                (c.lengths[1:] == c.lengths[:-1])
+        else:
+            same = c.data[1:] == c.data[:-1]
+        vsame = c.validity[1:] == c.validity[:-1]
+        # null==null counts equal; value comparison only if both valid
+        pair = vsame & (same | ~c.validity[1:])
+        eq = eq & jnp.concatenate([jnp.zeros(1, bool), pair])
+    return eq

@@ -1,0 +1,337 @@
+"""Joins.
+
+Reference: sql-plugin/.../execution/GpuHashJoin.scala:811 (gather-map hash
+join core with BaseHashJoinIterator batched output sizing),
+GpuShuffledHashJoinExec.scala:85, GpuBroadcastNestedLoopJoinExec.
+
+TPU-native re-design (no cudf hash table, no dynamic shapes):
+1. BUILD: hash the build keys to 64 bits and sort them — a sorted hash
+   column IS the hash table (binary search replaces probing; sort and
+   searchsorted are native XLA ops that tile well on TPU).
+2. COUNT: probe rows binary-search the sorted hashes; candidate counts come
+   from lower/upper bounds. One scalar (total candidates) syncs to the host
+   to pick the output capacity bucket — the same two-phase sizing cudf's
+   join gather-maps do (reference: join output sizing in JoinGatherer).
+3. EXPAND: each output slot finds its (probe row, candidate ordinal) via
+   searchsorted over the cumulative counts, gathers both sides, then
+   VERIFIES real key equality (hash collisions are rejected here, so join
+   results are exact, not probabilistic). Outer/semi/anti variants derive
+   from verified per-row match counts — all in the same fused computation.
+
+Null semantics: SQL equi-join keys never match NULL; null-keyed rows surface
+only through outer sides. The optional non-equi ``condition`` is evaluated on
+the candidate pair batch (the reference compiles an AST for this; here it is
+just another traced expression).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import ColumnarBatch, DeviceColumn, Field, Schema, bucket_capacity
+from ..expressions.base import EvalContext, Expression
+from ..expressions.hashing import murmur3_batch
+from ..types import TypeKind
+from .base import BinaryExec, Exec
+from .basic import bind_all
+from .common import compact, concat_batches, gather, gather_column
+
+
+class JoinType(enum.Enum):
+    INNER = "Inner"
+    LEFT_OUTER = "LeftOuter"
+    RIGHT_OUTER = "RightOuter"
+    FULL_OUTER = "FullOuter"
+    LEFT_SEMI = "LeftSemi"
+    LEFT_ANTI = "LeftAnti"
+    CROSS = "Cross"
+
+
+_PAIR_TYPES = (JoinType.INNER, JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
+               JoinType.FULL_OUTER)
+
+
+def _hash64(cols: Sequence[DeviceColumn], valid: jnp.ndarray) -> jnp.ndarray:
+    """64-bit row hash (two independent murmur3 sweeps); invalid rows get the
+    max value so they sort last and never collide with probe hashes that are
+    themselves forced to a DIFFERENT sentinel."""
+    h1 = murmur3_batch(cols, 42).view(jnp.uint32).astype(jnp.uint64)
+    h2 = murmur3_batch(cols, 0x9747B28C).view(jnp.uint32).astype(jnp.uint64)
+    h = (h1 << jnp.uint64(32)) | h2
+    # clear the top bit for real rows; sentinel has it set → no false overlap
+    h = h >> jnp.uint64(1)
+    return jnp.where(valid, h, ~jnp.uint64(0))
+
+
+def _keys_equal(a: List[DeviceColumn], b: List[DeviceColumn]) -> jnp.ndarray:
+    eq = None
+    for x, y in zip(a, b):
+        if x.lengths is not None:
+            e = jnp.all(x.data == y.data, axis=1) & (x.lengths == y.lengths)
+        else:
+            e = x.data == y.data
+        e = e & x.validity & y.validity
+        eq = e if eq is None else eq & e
+    return eq
+
+
+def _null_gather(batch: ColumnarBatch, out_cap: int) -> List[DeviceColumn]:
+    """All-null columns shaped like ``batch`` at out_cap (outer padding)."""
+    zero_idx = jnp.zeros(out_cap, jnp.int32)
+    none = jnp.zeros(out_cap, bool)
+    return [gather_column(c, zero_idx, none) for c in batch.columns]
+
+
+class HashJoinExec(BinaryExec):
+    """Equi-join; left child streams, right child builds (the planner swaps
+    children to put the smaller side on the right, like the reference's
+    build-side selection in GpuShuffledHashJoinExec)."""
+
+    def __init__(self, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], join_type: JoinType,
+                 left: Exec, right: Exec,
+                 condition: Optional[Expression] = None,
+                 ctx: Optional[EvalContext] = None):
+        super().__init__(left, right, ctx)
+        if join_type is JoinType.CROSS:
+            raise ValueError("use BroadcastNestedLoopJoinExec for cross joins")
+        self.join_type = join_type
+        self.left_keys = bind_all(left_keys, left.output_schema)
+        self.right_keys = bind_all(right_keys, right.output_schema)
+        for lk, rk in zip(self.left_keys, self.right_keys):
+            if lk.dtype != rk.dtype:
+                raise TypeError(f"join key type mismatch {lk.dtype} vs "
+                                f"{rk.dtype}; planner must insert casts")
+
+        lf, rf = left.output_schema.fields, right.output_schema.fields
+        l_nullable = join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER)
+        r_nullable = join_type in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
+        if join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            self._schema = left.output_schema
+        else:
+            self._schema = Schema(
+                [Field(f.name, f.dtype, f.nullable or l_nullable) for f in lf]
+                + [Field(f.name, f.dtype, f.nullable or r_nullable) for f in rf])
+        self.condition = condition.bind(self._pair_schema()) if condition else None
+
+        self._build_jit = jax.jit(self._build_kernel)
+        self._count_jit = jax.jit(self._count_kernel)
+        self._expand_jit = jax.jit(self._expand_kernel, static_argnums=(4,))
+        self._semi_jit = jax.jit(self._semi_kernel, static_argnums=(4,))
+
+    def _pair_schema(self) -> Schema:
+        return Schema(list(self.left.output_schema.fields)
+                      + list(self.right.output_schema.fields))
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    # ------------------------------------------------------------------
+
+    def _build_kernel(self, build: ColumnarBatch):
+        keys = [e.eval(build, self.ctx) for e in self.right_keys]
+        live = build.row_mask()
+        valid = live
+        for k in keys:
+            valid = valid & k.validity
+        h = _hash64(keys, valid)
+        iota = jnp.arange(build.capacity, dtype=jnp.int32)
+        sorted_h, perm = jax.lax.sort([h, iota], num_keys=1)
+        return sorted_h, perm, valid
+
+    def _count_kernel(self, stream: ColumnarBatch, sorted_h):
+        keys = [e.eval(stream, self.ctx) for e in self.left_keys]
+        live = stream.row_mask()
+        valid = live
+        for k in keys:
+            valid = valid & k.validity
+        # probe sentinel differs from the build sentinel: ~0 >> 1 never
+        # equals ~0, so null/dead probes find nothing.
+        h = jnp.where(valid, _hash64(keys, valid), ~jnp.uint64(0))
+        lo = jnp.searchsorted(sorted_h, h, side="left").astype(jnp.int64)
+        hi = jnp.searchsorted(sorted_h, h, side="right").astype(jnp.int64)
+        counts = jnp.where(valid, hi - lo, 0)
+        offsets = jnp.cumsum(counts)
+        return lo, counts, offsets, offsets[-1]
+
+    def _gather_pairs(self, stream, build, perm, lo, counts, offsets, out_cap):
+        """Candidate pair gather + key verification (+ condition)."""
+        j = jnp.arange(out_cap, dtype=jnp.int64)
+        total = offsets[-1]
+        probe_row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+        probe_row = jnp.clip(probe_row, 0, stream.capacity - 1)
+        start = jnp.take(offsets, probe_row) - jnp.take(counts, probe_row)
+        ordinal = j - start
+        build_pos = jnp.take(lo, probe_row) + ordinal
+        build_pos = jnp.clip(build_pos, 0, build.capacity - 1).astype(jnp.int32)
+        build_row = jnp.take(perm, build_pos)
+        in_range = j < total
+
+        s_cols = [gather_column(c, probe_row, in_range) for c in stream.columns]
+        b_cols = [gather_column(c, build_row, in_range) for c in build.columns]
+        s_keys = [gather_column(e.eval(stream, self.ctx), probe_row)
+                  for e in self.left_keys]
+        b_keys = [gather_column(e.eval(build, self.ctx), build_row)
+                  for e in self.right_keys]
+        pair_ok = in_range & _keys_equal(s_keys, b_keys)
+        if self.condition is not None:
+            pair_batch = ColumnarBatch(tuple(s_cols + b_cols), total)
+            c = self.condition.eval(pair_batch, self.ctx)
+            pair_ok = pair_ok & c.data & c.validity
+        return s_cols, b_cols, pair_ok, probe_row, build_row
+
+    def _expand_kernel(self, stream, build_pack, lo_counts, matched_build_in,
+                       out_cap: int):
+        build, perm = build_pack
+        lo, counts, offsets = lo_counts
+        s_cols, b_cols, pair_ok, probe_row, build_row = self._gather_pairs(
+            stream, build, perm, lo, counts, offsets, out_cap)
+
+        # compact verified pairs to the front
+        pairs = compact(ColumnarBatch(tuple(s_cols + b_cols),
+                                      jnp.asarray(out_cap, jnp.int32)),
+                        pair_ok)
+
+        # per-stream-row verified match count (probe_row ascending)
+        seg = jnp.where(pair_ok, probe_row, stream.capacity)
+        stream_matches = jax.ops.segment_sum(
+            pair_ok.astype(jnp.int32), seg, num_segments=stream.capacity + 1,
+            indices_are_sorted=True)[: stream.capacity]
+        matched_build = matched_build_in.at[
+            jnp.where(pair_ok, build_row, build.capacity)].set(
+            True, mode="drop")
+
+        if self.join_type in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER):
+            unmatched = stream.row_mask() & (stream_matches == 0)
+            u_cols = list(stream.columns) + _null_gather(build, stream.capacity)
+            u_batch = compact(ColumnarBatch(
+                tuple(u_cols), stream.num_rows), unmatched)
+            out = concat_batches([pairs, u_batch],
+                                 bucket_capacity(out_cap + stream.capacity))
+        else:
+            out = pairs
+        return out, matched_build
+
+    def _semi_kernel(self, stream, build_pack, lo_counts, matched_build_in,
+                     out_cap: int):
+        build, perm = build_pack
+        lo, counts, offsets = lo_counts
+        _, _, pair_ok, probe_row, _ = self._gather_pairs(
+            stream, build, perm, lo, counts, offsets, out_cap)
+        seg = jnp.where(pair_ok, probe_row, stream.capacity)
+        stream_matches = jax.ops.segment_sum(
+            pair_ok.astype(jnp.int32), seg, num_segments=stream.capacity + 1,
+            indices_are_sorted=True)[: stream.capacity]
+        if self.join_type is JoinType.LEFT_SEMI:
+            keep = stream_matches > 0
+        else:
+            keep = stream.row_mask() & (stream_matches == 0)
+        return compact(stream, keep)
+
+    def left_child_placeholder(self) -> ColumnarBatch:
+        # a zero-row batch shaped like the left child, for null padding
+        from ..batch import empty_batch
+        return empty_batch(self.left.output_schema, 1)
+
+    # ------------------------------------------------------------------
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        from ..batch import empty_batch
+        build_batches = list(self.right.execute())
+        if not build_batches:
+            build = empty_batch(self.right.output_schema)
+        elif len(build_batches) == 1:
+            build = build_batches[0]
+        else:
+            cap = bucket_capacity(sum(b.capacity for b in build_batches))
+            build = concat_batches(build_batches, cap)
+        sorted_h, perm, _ = self._build_jit(build)
+        matched_build = jnp.zeros(build.capacity, bool)
+
+        semi = self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI)
+        for stream in self.left.execute():
+            lo, counts, offsets, total = self._count_jit(stream, sorted_h)
+            out_cap = bucket_capacity(max(int(total), 1))
+            if semi:
+                yield self._semi_jit(stream, (build, perm),
+                                     (lo, counts, offsets), matched_build,
+                                     out_cap)
+            else:
+                out, matched_build = self._expand_jit(
+                    stream, (build, perm), (lo, counts, offsets),
+                    matched_build, out_cap)
+                yield out
+
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            unmatched = build.row_mask() & ~matched_build
+            null_left = _null_gather(self.left_child_placeholder(),
+                                     build.capacity)
+            tail = ColumnarBatch(tuple(null_left) + build.columns,
+                                 build.num_rows)
+            yield compact(tail, unmatched)
+
+
+class BroadcastNestedLoopJoinExec(BinaryExec):
+    """Cross / conditional nested-loop join (reference:
+    GpuBroadcastNestedLoopJoinExec). Tiles the build side so each expansion
+    stays inside a bounded capacity."""
+
+    def __init__(self, join_type: JoinType, left: Exec, right: Exec,
+                 condition: Optional[Expression] = None,
+                 ctx: Optional[EvalContext] = None,
+                 max_tile_rows: int = 1 << 20):
+        super().__init__(left, right, ctx)
+        if join_type not in (JoinType.INNER, JoinType.CROSS):
+            raise NotImplementedError(
+                f"nested-loop {join_type} lands with the planner round")
+        self.join_type = join_type
+        self.max_tile_rows = max_tile_rows
+        self._schema = Schema(list(left.output_schema.fields)
+                              + list(right.output_schema.fields))
+        self.condition = condition.bind(self._schema) if condition else None
+        self._cross_jit = jax.jit(self._cross_kernel)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def _cross_kernel(self, stream: ColumnarBatch, build: ColumnarBatch):
+        s_cap, b_cap = stream.capacity, build.capacity
+        out_cap = s_cap * b_cap
+        j = jnp.arange(out_cap, dtype=jnp.int32)
+        si, bi = j // b_cap, j % b_cap
+        live = (si < stream.num_rows) & (bi < build.num_rows)
+        s_cols = [gather_column(c, si, live) for c in stream.columns]
+        b_cols = [gather_column(c, bi, live) for c in build.columns]
+        # live slots are interleaved (row-major tiles), so always compact
+        out = ColumnarBatch(tuple(s_cols + b_cols),
+                            jnp.asarray(out_cap, jnp.int32))
+        keep = live
+        if self.condition is not None:
+            c = self.condition.eval(out, self.ctx)
+            keep = keep & c.data & c.validity
+        return compact(out, keep)
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        build_batches = list(self.right.execute())
+        for stream in self.left.execute():
+            for build in build_batches:
+                if stream.capacity * build.capacity > self.max_tile_rows:
+                    # tile the build side
+                    from .common import slice_batch
+                    tile = max(self.max_tile_rows // stream.capacity, 1)
+                    tile_cap = bucket_capacity(tile)
+                    n_build = int(build.num_rows)
+                    for off in range(0, max(n_build, 1), tile_cap):
+                        piece = jax.jit(slice_batch, static_argnums=3)(
+                            build, jnp.int32(off), jnp.int32(tile_cap),
+                            tile_cap)
+                        yield self._cross_jit(stream, piece)
+                else:
+                    yield self._cross_jit(stream, build)

@@ -1,0 +1,149 @@
+"""Sort and TopN.
+
+Reference: sql-plugin/.../GpuSortExec.scala:83 (in-core), :246 (out-of-core
+merge of spilled runs), SortUtils.scala GpuSorter; limit.scala
+GpuTakeOrderedAndProjectExec.
+
+TPU-native design: every sort key is normalized into rank-preserving unsigned
+words (exec/common.sort_operands) and ONE multi-operand `lax.sort` orders any
+schema — ints, floats (NaN greatest, Spark order), decimals, strings — in a
+single fused XLA op, instead of cudf's orderBy dispatch. Global sort = local
+sort per batch + device merge of runs (concat + one more sort; an N-way
+priority-queue merge like the reference's OOC iterator arrives with spill).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import ColumnarBatch, Schema, bucket_capacity
+from ..expressions.base import EvalContext, Expression
+from .base import Exec, UnaryExec
+from .basic import bind_all
+from .common import concat_batches, gather, gather_column, slice_batch, \
+    sort_operands
+
+
+@dataclass(frozen=True)
+class SortOrder:
+    """A sort key: expression + direction + null ordering (Spark SortOrder).
+
+    Spark defaults: ascending nulls first, descending nulls last.
+    """
+
+    child: Expression
+    descending: bool = False
+    nulls_first: Optional[bool] = None
+
+    @property
+    def effective_nulls_first(self) -> bool:
+        if self.nulls_first is None:
+            return not self.descending
+        return self.nulls_first
+
+    def bind(self, schema: Schema) -> "SortOrder":
+        return SortOrder(self.child.bind(schema), self.descending,
+                         self.nulls_first)
+
+
+def asc(e: Expression) -> SortOrder:
+    return SortOrder(e, False)
+
+
+def desc(e: Expression) -> SortOrder:
+    return SortOrder(e, True)
+
+
+def sort_batch(batch: ColumnarBatch, orders: Sequence[SortOrder],
+               ctx: EvalContext = EvalContext()) -> ColumnarBatch:
+    """Stable in-core sort of one batch (jit-traceable)."""
+    cap = batch.capacity
+    live = batch.row_mask()
+    key_cols = [o.child.eval(batch, ctx) for o in orders]
+    ops = sort_operands(key_cols, [o.descending for o in orders],
+                        [o.effective_nulls_first for o in orders], live)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    perm = jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)[-1]
+    return gather(batch, perm, batch.num_rows, live)
+
+
+class SortExec(UnaryExec):
+    def __init__(self, orders: Sequence[SortOrder], child: Exec,
+                 global_sort: bool = True, ctx: Optional[EvalContext] = None,
+                 max_rows: int = 1 << 22):
+        super().__init__(child, ctx)
+        self.orders = [o.bind(child.output_schema) for o in orders]
+        self.global_sort = global_sort
+        self.max_rows = max_rows
+        self._sort_jit = jax.jit(lambda b: sort_batch(b, self.orders, self.ctx))
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        batches = list(self.child.execute())
+        if not batches:
+            return
+        if not self.global_sort or len(batches) == 1:
+            for b in batches:
+                yield self._sort_jit(b)
+            return
+        total_cap = sum(b.capacity for b in batches)
+        if total_cap > self.max_rows:
+            raise MemoryError(
+                f"global sort of {total_cap} rows exceeds max_rows="
+                f"{self.max_rows}; out-of-core sort requires the spill tier")
+        merged = concat_batches(batches, bucket_capacity(total_cap))
+        yield self._sort_jit(merged)
+
+
+class TakeOrderedAndProjectExec(UnaryExec):
+    """TopN: per-batch sort+limit, tournament across batches, final project
+    (reference: GpuTakeOrderedAndProjectExec, GpuOverrides.scala:3735)."""
+
+    def __init__(self, limit: int, orders: Sequence[SortOrder],
+                 project: Optional[Sequence[Expression]], child: Exec,
+                 ctx: Optional[EvalContext] = None):
+        super().__init__(child, ctx)
+        self.limit = limit
+        self.orders = [o.bind(child.output_schema) for o in orders]
+        self.project = bind_all(project, child.output_schema) if project else None
+        from .basic import schema_of
+        self._schema = schema_of(self.project) if self.project \
+            else child.output_schema
+
+        def topn(b: ColumnarBatch) -> ColumnarBatch:
+            s = sort_batch(b, self.orders, self.ctx)
+            n = jnp.minimum(s.num_rows, jnp.int32(self.limit))
+            cut = bucket_capacity(min(self.limit, b.capacity))
+            return slice_batch(s, jnp.int32(0), n, cut)
+
+        self._topn_jit = jax.jit(topn)
+
+        def proj(b: ColumnarBatch) -> ColumnarBatch:
+            cols = tuple(e.eval(b, self.ctx) for e in self.project)
+            return ColumnarBatch(cols, b.num_rows)
+
+        self._proj_jit = jax.jit(proj) if self.project else None
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self) -> Iterator[ColumnarBatch]:
+        best: Optional[ColumnarBatch] = None
+        for batch in self.child.execute():
+            cand = self._topn_jit(batch)
+            if best is None:
+                best = cand
+            else:
+                cap = bucket_capacity(best.capacity + cand.capacity)
+                best = self._topn_jit(concat_batches([best, cand], cap))
+        if best is None:
+            return
+        yield self._proj_jit(best) if self._proj_jit else best

@@ -1,0 +1,215 @@
+"""Hash aggregate differential tests (oracle = Python dict group-by with
+Spark semantics: null group keys form a group, sum of empty/all-null = null,
+count never null)."""
+
+import math
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec import (AggregateMode, HashAggregateExec,
+                                   InMemoryScanExec, collect)
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import (Average, Count, First,
+                                                     Last, Max, Min,
+                                                     StddevSamp, Sum,
+                                                     VarianceSamp)
+
+from harness.asserts import assert_rows_equal, rows_of
+from harness.data_gen import (BooleanGen, DoubleGen, IntegerGen, LongGen,
+                              StringGen, gen_table)
+
+
+def scan(t, batch_rows=None):
+    return InMemoryScanExec(t, batch_rows=batch_rows)
+
+
+def oracle_groupby(keys, vals, aggs):
+    groups = {}
+    order = []
+    for k, v in zip(keys, vals):
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(v)
+    out = []
+    for k in order:
+        row = list(k) if isinstance(k, tuple) else [k]
+        for agg in aggs:
+            row.append(agg(groups[k]))
+        out.append(tuple(row))
+    return out
+
+
+def o_sum(vs):
+    xs = [v for v in vs if v is not None]
+    return sum(xs) if xs else None
+
+
+def o_count(vs):
+    return sum(1 for v in vs if v is not None)
+
+
+def o_min(vs):
+    xs = [v for v in vs if v is not None]
+    return min(xs) if xs else None
+
+
+def o_max(vs):
+    xs = [v for v in vs if v is not None]
+    return max(xs) if xs else None
+
+
+def o_avg(vs):
+    xs = [v for v in vs if v is not None]
+    return sum(xs) / len(xs) if xs else None
+
+
+@pytest.mark.parametrize("mode", [AggregateMode.COMPLETE, "two_stage"])
+def test_groupby_int_keys(mode):
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=20)),
+                   ("v", LongGen(min_val=-1000, max_val=1000))],
+                  n=2000, seed=10)
+    group = [col("k")]
+    aggs = [Sum(col("v")).alias("s"), Count(col("v")).alias("c"),
+            Min(col("v")).alias("mn"), Max(col("v")).alias("mx"),
+            Average(col("v")).alias("a"), Count().alias("star")]
+    if mode == "two_stage":
+        partial = HashAggregateExec(group, aggs, scan(t, batch_rows=256),
+                                    AggregateMode.PARTIAL)
+        plan = HashAggregateExec([col("k")], aggs, partial,
+                                 AggregateMode.FINAL)
+    else:
+        plan = HashAggregateExec(group, aggs, scan(t, batch_rows=256), mode)
+    got = rows_of(collect(plan))
+
+    ks = t.column("k").to_pylist()
+    vs = t.column("v").to_pylist()
+    exp = oracle_groupby(ks, vs, [o_sum, o_count, o_min, o_max, o_avg,
+                                  lambda g: len(g)])
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_groupby_string_keys_and_minmax_string():
+    t = gen_table([("k", StringGen(max_len=8)), ("s", StringGen(max_len=12)),
+                   ("v", IntegerGen())], n=800, seed=11)
+    plan = HashAggregateExec(
+        [col("k")],
+        [Sum(col("v")).alias("sv"), Min(col("s")).alias("mn"),
+         Max(col("s")).alias("mx")],
+        scan(t, batch_rows=128), AggregateMode.COMPLETE)
+    got = rows_of(collect(plan))
+    ks = t.column("k").to_pylist()
+    rows = list(zip(t.column("v").to_pylist(), t.column("s").to_pylist()))
+    exp = oracle_groupby(
+        ks, rows,
+        [lambda g: o_sum([r[0] for r in g]),
+         lambda g: o_min([r[1] for r in g]),
+         lambda g: o_max([r[1] for r in g])])
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_global_aggregate():
+    t = gen_table([("v", DoubleGen(no_nans=True))], n=1000, seed=12)
+    plan = HashAggregateExec(
+        [], [Sum(col("v")).alias("s"), Count(col("v")).alias("c"),
+             Average(col("v")).alias("a")],
+        scan(t, batch_rows=300), AggregateMode.COMPLETE)
+    got = rows_of(collect(plan))
+    vs = t.column("v").to_pylist()
+    exp = [(o_sum(vs), o_count(vs), o_avg(vs))]
+    assert_rows_equal(got, exp)
+
+
+def test_global_aggregate_empty_input():
+    import pyarrow as pa
+    t = pa.table({"v": pa.array([], type=pa.int64())})
+    plan = HashAggregateExec(
+        [], [Sum(col("v")).alias("s"), Count(col("v")).alias("c")],
+        scan(t), AggregateMode.COMPLETE)
+    got = rows_of(collect(plan))
+    assert got == [(None, 0)]
+
+
+def test_groupby_empty_input():
+    import pyarrow as pa
+    t = pa.table({"k": pa.array([], type=pa.int32()),
+                  "v": pa.array([], type=pa.int64())})
+    plan = HashAggregateExec([col("k")], [Sum(col("v")).alias("s")],
+                             scan(t), AggregateMode.COMPLETE)
+    assert rows_of(collect(plan)) == []
+
+
+def test_null_group_key_forms_group():
+    import pyarrow as pa
+    t = pa.table({"k": pa.array([1, None, 1, None, 2]),
+                  "v": pa.array([10, 20, 30, 40, 50])})
+    plan = HashAggregateExec([col("k")], [Sum(col("v")).alias("s")],
+                             scan(t), AggregateMode.COMPLETE)
+    got = rows_of(collect(plan))
+    assert_rows_equal(got, [(1, 40), (None, 60), (2, 50)], ignore_order=True)
+
+
+def test_sum_all_null_group_is_null():
+    import pyarrow as pa
+    t = pa.table({"k": pa.array([1, 1, 2]),
+                  "v": pa.array([None, None, 5], type=pa.int64())})
+    plan = HashAggregateExec([col("k")], [Sum(col("v")).alias("s"),
+                                          Count(col("v")).alias("c")],
+                             scan(t), AggregateMode.COMPLETE)
+    got = rows_of(collect(plan))
+    assert_rows_equal(got, [(1, None, 0), (2, 5, 1)], ignore_order=True)
+
+
+def test_stddev_variance():
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=5, nullable=False)),
+                   ("v", DoubleGen(no_nans=True))], n=500, seed=13)
+    plan = HashAggregateExec(
+        [col("k")], [StddevSamp(col("v")).alias("sd"),
+                     VarianceSamp(col("v")).alias("var")],
+        scan(t, batch_rows=100), AggregateMode.COMPLETE)
+    got = rows_of(collect(plan))
+
+    def o_var(vs):
+        xs = [v for v in vs if v is not None]
+        if len(xs) < 2:
+            return None
+        m = sum(xs) / len(xs)
+        return sum((x - m) ** 2 for x in xs) / (len(xs) - 1)
+
+    def o_sd(vs):
+        v = o_var(vs)
+        return None if v is None else math.sqrt(v)
+
+    exp = oracle_groupby(t.column("k").to_pylist(), t.column("v").to_pylist(),
+                         [o_sd, o_var])
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_first_last():
+    import pyarrow as pa
+    t = pa.table({"k": pa.array([1, 1, 1, 2, 2]),
+                  "v": pa.array([None, 10, 30, 7, None])})
+    plan = HashAggregateExec([col("k")],
+                             [First(col("v")).alias("f"),
+                              Last(col("v")).alias("l")],
+                             scan(t), AggregateMode.COMPLETE)
+    got = rows_of(collect(plan))
+    assert_rows_equal(got, [(1, None, 30), (2, 7, None)], ignore_order=True)
+
+
+def test_two_stage_bool_min_max():
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=3)),
+                   ("b", BooleanGen())], n=400, seed=14)
+    partial = HashAggregateExec([col("k")],
+                                [Min(col("b")).alias("mn"),
+                                 Max(col("b")).alias("mx")],
+                                scan(t, batch_rows=64), AggregateMode.PARTIAL)
+    plan = HashAggregateExec([col("k")],
+                             [Min(col("b")).alias("mn"),
+                              Max(col("b")).alias("mx")],
+                             partial, AggregateMode.FINAL)
+    got = rows_of(collect(plan))
+    exp = oracle_groupby(t.column("k").to_pylist(), t.column("b").to_pylist(),
+                         [o_min, o_max])
+    assert_rows_equal(got, exp, ignore_order=True)

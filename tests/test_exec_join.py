@@ -1,0 +1,159 @@
+"""Join differential tests. Oracle: brute-force nested loop with SQL null
+semantics (null keys never equi-match; outer sides pad with nulls)."""
+
+import pytest
+
+from spark_rapids_tpu.exec import (BroadcastNestedLoopJoinExec, HashJoinExec,
+                                   InMemoryScanExec, JoinType, collect)
+from spark_rapids_tpu.expressions import col
+
+from harness.asserts import assert_rows_equal, rows_of
+from harness.data_gen import IntegerGen, LongGen, StringGen, gen_table
+
+
+def scan(t, batch_rows=None):
+    return InMemoryScanExec(t, batch_rows=batch_rows)
+
+
+def oracle_join(left, right, lk, rk, how, condition=None):
+    cond = condition or (lambda l, r: True)
+    nl_r = len(right[0]) if right else 0
+    nl_l = len(left[0]) if left else 0
+    out = []
+    matched_r = [False] * len(right)
+    for lrow in left:
+        key = tuple(lrow[i] for i in lk)
+        m = False
+        for j, rrow in enumerate(right):
+            rkey = tuple(rrow[i] for i in rk)
+            if any(v is None for v in key) or key != rkey:
+                continue
+            if not cond(lrow, rrow):
+                continue
+            m = True
+            matched_r[j] = True
+            if how in ("inner", "left", "right", "full"):
+                out.append(lrow + rrow)
+        if how == "semi" and m:
+            out.append(lrow)
+        if how == "anti" and not m:
+            out.append(lrow)
+        if how in ("left", "full") and not m:
+            out.append(lrow + (None,) * nl_r)
+    if how in ("right", "full"):
+        for j, rrow in enumerate(right):
+            if not matched_r[j]:
+                out.append((None,) * nl_l + rrow)
+    return out
+
+
+HOW = {JoinType.INNER: "inner", JoinType.LEFT_OUTER: "left",
+       JoinType.RIGHT_OUTER: "right", JoinType.FULL_OUTER: "full",
+       JoinType.LEFT_SEMI: "semi", JoinType.LEFT_ANTI: "anti"}
+
+
+@pytest.mark.parametrize("jt", [JoinType.INNER, JoinType.LEFT_OUTER,
+                                JoinType.RIGHT_OUTER, JoinType.FULL_OUTER,
+                                JoinType.LEFT_SEMI, JoinType.LEFT_ANTI])
+def test_hash_join_int_key(jt):
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=50)),
+                    ("x", LongGen())], n=400, seed=30)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=60)),
+                    ("y", LongGen())], n=300, seed=31)
+    plan = HashJoinExec([col("k")], [col("k2")], jt,
+                        scan(lt, batch_rows=128), scan(rt, batch_rows=100))
+    got = rows_of(collect(plan))
+    lrows = list(zip(lt.column("k").to_pylist(), lt.column("x").to_pylist()))
+    rrows = list(zip(rt.column("k2").to_pylist(), rt.column("y").to_pylist()))
+    exp = oracle_join(lrows, rrows, [0], [0], HOW[jt])
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_hash_join_multi_key_string():
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=10)),
+                    ("s", StringGen(max_len=4)), ("x", IntegerGen())],
+                   n=200, seed=32)
+    rt = gen_table([("k", IntegerGen(min_val=0, max_val=10)),
+                    ("s", StringGen(max_len=4)), ("y", IntegerGen())],
+                   n=150, seed=33)
+    plan = HashJoinExec([col("k"), col("s")], [col("k"), col("s")],
+                        JoinType.INNER, scan(lt), scan(rt))
+    got = rows_of(collect(plan))
+    lrows = list(zip(lt.column("k").to_pylist(), lt.column("s").to_pylist(),
+                     lt.column("x").to_pylist()))
+    rrows = list(zip(rt.column("k").to_pylist(), rt.column("s").to_pylist(),
+                     rt.column("y").to_pylist()))
+    exp = oracle_join(lrows, rrows, [0, 1], [0, 1], "inner")
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_hash_join_with_condition():
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=20)),
+                    ("x", IntegerGen(min_val=0, max_val=100))],
+                   n=300, seed=34)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=20)),
+                    ("y", IntegerGen(min_val=0, max_val=100))],
+                   n=200, seed=35)
+    plan = HashJoinExec([col("k")], [col("k2")], JoinType.INNER,
+                        scan(lt), scan(rt), condition=col("x") < col("y"))
+    got = rows_of(collect(plan))
+    lrows = list(zip(lt.column("k").to_pylist(), lt.column("x").to_pylist()))
+    rrows = list(zip(rt.column("k2").to_pylist(), rt.column("y").to_pylist()))
+
+    def cond(l, r):
+        return l[1] is not None and r[1] is not None and l[1] < r[1]
+
+    exp = oracle_join(lrows, rrows, [0], [0], "inner", cond)
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_left_outer_with_condition():
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=10)),
+                    ("x", IntegerGen(min_val=0, max_val=50))], n=150, seed=36)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=10)),
+                    ("y", IntegerGen(min_val=0, max_val=50))], n=100, seed=37)
+    plan = HashJoinExec([col("k")], [col("k2")], JoinType.LEFT_OUTER,
+                        scan(lt, batch_rows=64), scan(rt),
+                        condition=col("x") < col("y"))
+    got = rows_of(collect(plan))
+    lrows = list(zip(lt.column("k").to_pylist(), lt.column("x").to_pylist()))
+    rrows = list(zip(rt.column("k2").to_pylist(), rt.column("y").to_pylist()))
+
+    def cond(l, r):
+        return l[1] is not None and r[1] is not None and l[1] < r[1]
+
+    exp = oracle_join(lrows, rrows, [0], [0], "left", cond)
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_join_empty_build():
+    import pyarrow as pa
+    lt = gen_table([("k", IntegerGen())], n=50, seed=38)
+    rt = pa.table({"k2": pa.array([], type=pa.int32()),
+                   "y": pa.array([], type=pa.int64())})
+    for jt, expect_rows in [(JoinType.INNER, 0), (JoinType.LEFT_OUTER, 50),
+                            (JoinType.LEFT_ANTI, 50), (JoinType.LEFT_SEMI, 0)]:
+        plan = HashJoinExec([col("k")], [col("k2")], jt, scan(lt), scan(rt))
+        assert len(rows_of(collect(plan))) == expect_rows, jt
+
+
+def test_cross_join():
+    lt = gen_table([("x", IntegerGen())], n=40, seed=39)
+    rt = gen_table([("y", IntegerGen())], n=30, seed=40)
+    plan = BroadcastNestedLoopJoinExec(JoinType.CROSS, scan(lt), scan(rt))
+    got = rows_of(collect(plan))
+    exp = [(x, y) for x in lt.column("x").to_pylist()
+           for y in rt.column("y").to_pylist()]
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_nested_loop_with_condition():
+    lt = gen_table([("x", IntegerGen(min_val=0, max_val=30))], n=60, seed=41)
+    rt = gen_table([("y", IntegerGen(min_val=0, max_val=30))], n=50, seed=42)
+    plan = BroadcastNestedLoopJoinExec(JoinType.INNER, scan(lt), scan(rt),
+                                       condition=col("x") == col("y"))
+    got = rows_of(collect(plan))
+    exp = [(x, y) for x in lt.column("x").to_pylist()
+           for y in rt.column("y").to_pylist()
+           if x is not None and y is not None and x == y]
+    assert_rows_equal(got, exp, ignore_order=True)
