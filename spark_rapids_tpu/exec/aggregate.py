@@ -211,9 +211,9 @@ class HashAggregateExec(UnaryExec):
     # tryMergeAggregatedBatches)
     # ------------------------------------------------------------------
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         partials: List[ColumnarBatch] = []
-        for batch in self.child.execute():
+        for batch in self.child.execute_partition(p):
             if self.mode in (AggregateMode.PARTIAL, AggregateMode.COMPLETE):
                 partials.append(self._update_jit(batch))
             else:
@@ -221,7 +221,7 @@ class HashAggregateExec(UnaryExec):
 
         finalize = self.mode in (AggregateMode.FINAL, AggregateMode.COMPLETE)
         if not partials:
-            if not self.key_fields:
+            if not self.key_fields and p == 0:
                 # global aggregate over empty input still yields one row
                 from ..batch import empty_batch
                 seed = empty_batch(Schema(self.key_fields + self.buffer_fields))

@@ -56,11 +56,31 @@ class Exec:
     def output_schema(self) -> Schema:
         raise NotImplementedError(type(self).__name__)
 
+    @property
+    def num_partitions(self) -> int:
+        """Spark RDD partition count. Narrow operators preserve their
+        child's; exchanges define their own."""
+        return self.children[0].num_partitions if self.children else 1
+
     def do_execute(self) -> Iterator[ColumnarBatch]:
-        raise NotImplementedError(type(self).__name__)
+        """All partitions chained (single-stream consumers / collect)."""
+        for p in range(self.num_partitions):
+            yield from self.do_execute_partition(p)
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        """One partition's batches. Default: only valid single-partition."""
+        if self.num_partitions != 1 or p != 0:
+            raise NotImplementedError(
+                f"{self.name} does not implement per-partition execution")
+        yield from self.do_execute()
 
     def execute(self) -> Iterator[ColumnarBatch]:
         for batch in self.do_execute():
+            self.metrics["numOutputBatches"].add(1)
+            yield batch
+
+    def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        for batch in self.do_execute_partition(p):
             self.metrics["numOutputBatches"].add(1)
             yield batch
 

@@ -48,9 +48,10 @@ class InMemoryScanExec(LeafExec):
     (reference: GpuInMemoryTableScanExec)."""
 
     def __init__(self, data, schema: Optional[Schema] = None,
-                 batch_rows: Optional[int] = None,
+                 batch_rows: Optional[int] = None, num_slices: int = 1,
                  ctx: EvalContext = EvalContext()):
         super().__init__(ctx)
+        self._num_slices = num_slices
         if isinstance(data, pa.Table):
             self._tables = [data]
             self._batches = None
@@ -68,7 +69,11 @@ class InMemoryScanExec(LeafExec):
     def output_schema(self) -> Schema:
         return self._schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return self._num_slices
+
+    def _all_batches(self):
         if self._batches is not None:
             yield from self._batches
             return
@@ -81,6 +86,11 @@ class InMemoryScanExec(LeafExec):
                 yield batch
                 if n == 0:
                     break
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        for i, b in enumerate(self._all_batches()):
+            if i % self._num_slices == p:
+                yield b
 
 
 class ProjectExec(UnaryExec):
@@ -102,11 +112,9 @@ class ProjectExec(UnaryExec):
     def output_schema(self) -> Schema:
         return self._schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
-        for batch in self.child.execute():
-            out = self._kernel(batch)
-            self.metrics["numOutputRows"].add(0)  # traced; counted at collect
-            yield out
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        for batch in self.child.execute_partition(p):
+            yield self._kernel(batch)
 
 
 class FilterExec(UnaryExec):
@@ -135,8 +143,8 @@ class FilterExec(UnaryExec):
     def output_schema(self) -> Schema:
         return self.child.output_schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
-        for batch in self.child.execute():
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        for batch in self.child.execute_partition(p):
             yield self._kernel(batch)
 
 
@@ -153,9 +161,9 @@ class LocalLimitExec(UnaryExec):
     def output_schema(self) -> Schema:
         return self.child.output_schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         remaining = self.limit
-        for batch in self.child.execute():
+        for batch in self.child.execute_partition(p):
             if remaining <= 0:
                 break
             out = self._kernel(batch, jnp.int32(remaining))
@@ -164,7 +172,22 @@ class LocalLimitExec(UnaryExec):
 
 
 class GlobalLimitExec(LocalLimitExec):
-    """Reference: GpuGlobalLimitExec — same mechanics once single-partitioned."""
+    """Reference: GpuGlobalLimitExec — drains all upstream partitions into
+    one (the planner places it after a single-partition exchange)."""
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        remaining = self.limit
+        for cp in range(self.child.num_partitions):
+            for batch in self.child.execute_partition(cp):
+                if remaining <= 0:
+                    return
+                out = self._kernel(batch, jnp.int32(remaining))
+                remaining -= int(out.num_rows)
+                yield out
 
 
 class UnionExec(Exec):
@@ -177,9 +200,17 @@ class UnionExec(Exec):
     def output_schema(self) -> Schema:
         return self.children[0].output_schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions for c in self.children)
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         for c in self.children:
-            yield from c.execute()
+            if p < c.num_partitions:
+                yield from c.execute_partition(p)
+                return
+            p -= c.num_partitions
+        raise IndexError(p)
 
 
 class RangeExec(LeafExec):
@@ -231,9 +262,9 @@ class SampleExec(UnaryExec):
     def output_schema(self) -> Schema:
         return self.child.output_schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
-        root = jax.random.PRNGKey(self.seed)
-        for i, batch in enumerate(self.child.execute()):
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        root = jax.random.fold_in(jax.random.PRNGKey(self.seed), p)
+        for i, batch in enumerate(self.child.execute_partition(p)):
             yield self._kernel(batch, jax.random.fold_in(root, i))
 
 
@@ -264,7 +295,7 @@ class ExpandExec(UnaryExec):
     def output_schema(self) -> Schema:
         return self._schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
-        for batch in self.child.execute():
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        for batch in self.child.execute_partition(p):
             for pi in range(len(self.projections)):
                 yield self._kernel(batch, pi)

@@ -58,11 +58,11 @@ class CoalesceBatchesExec(UnaryExec):
         cap = bucket_capacity(sum(b.capacity for b in pending))
         return concat_batches(pending, cap)
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         pending: List[ColumnarBatch] = []
         pending_bytes = 0
         target = self.goal.bytes if isinstance(self.goal, TargetSize) else None
-        for batch in self.child.execute():
+        for batch in self.child.execute_partition(p):
             self.metrics["numInputBatches"].add(1)
             b = batch.size_bytes()
             if target is not None and pending and (

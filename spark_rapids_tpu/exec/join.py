@@ -96,8 +96,13 @@ class HashJoinExec(BinaryExec):
                  right_keys: Sequence[Expression], join_type: JoinType,
                  left: Exec, right: Exec,
                  condition: Optional[Expression] = None,
+                 broadcast_build: bool = True,
                  ctx: Optional[EvalContext] = None):
         super().__init__(left, right, ctx)
+        # broadcast_build: build side replicated (broadcast hash join).
+        # False = co-partitioned inputs (shuffled hash join); requires both
+        # children hash-partitioned on the join keys by an exchange.
+        self.broadcast_build = broadcast_build
         if join_type is JoinType.CROSS:
             raise ValueError("use BroadcastNestedLoopJoinExec for cross joins")
         self.join_type = join_type
@@ -241,9 +246,17 @@ class HashJoinExec(BinaryExec):
 
     # ------------------------------------------------------------------
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return self.left.num_partitions
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         from ..batch import empty_batch
-        build_batches = list(self.right.execute())
+        if self.broadcast_build:
+            build_batches = [b for cp in range(self.right.num_partitions)
+                             for b in self.right.execute_partition(cp)]
+        else:
+            build_batches = list(self.right.execute_partition(p))
         if not build_batches:
             build = empty_batch(self.right.output_schema)
         elif len(build_batches) == 1:
@@ -255,7 +268,7 @@ class HashJoinExec(BinaryExec):
         matched_build = jnp.zeros(build.capacity, bool)
 
         semi = self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI)
-        for stream in self.left.execute():
+        for stream in self.left.execute_partition(p):
             lo, counts, offsets, total = self._count_jit(stream, sorted_h)
             out_cap = bucket_capacity(max(int(total), 1))
             if semi:
@@ -318,9 +331,14 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
             keep = keep & c.data & c.validity
         return compact(out, keep)
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
-        build_batches = list(self.right.execute())
-        for stream in self.left.execute():
+    @property
+    def num_partitions(self) -> int:
+        return self.left.num_partitions
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        build_batches = [b for cp in range(self.right.num_partitions)
+                         for b in self.right.execute_partition(cp)]
+        for stream in self.left.execute_partition(p):
             for build in build_batches:
                 if stream.capacity * build.capacity > self.max_tile_rows:
                     # tile the build side

@@ -85,8 +85,16 @@ class SortExec(UnaryExec):
     def output_schema(self) -> Schema:
         return self.child.output_schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
-        batches = list(self.child.execute())
+    @property
+    def num_partitions(self) -> int:
+        return 1 if self.global_sort else self.child.num_partitions
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        if self.global_sort:
+            batches = [b for cp in range(self.child.num_partitions)
+                       for b in self.child.execute_partition(cp)]
+        else:
+            batches = list(self.child.execute_partition(p))
         if not batches:
             return
         if not self.global_sort or len(batches) == 1:
@@ -135,7 +143,11 @@ class TakeOrderedAndProjectExec(UnaryExec):
     def output_schema(self) -> Schema:
         return self._schema
 
-    def do_execute(self) -> Iterator[ColumnarBatch]:
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         best: Optional[ColumnarBatch] = None
         for batch in self.child.execute():
             cand = self._topn_jit(batch)

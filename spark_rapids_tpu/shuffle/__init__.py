@@ -1,0 +1,14 @@
+"""Distribution layer: partitionings, shuffle exchange, broadcast.
+
+Reference inventory: SURVEY.md §2.8/§2.10 — GpuHashPartitioningBase,
+GpuRangePartitioner, GpuRoundRobinPartitioning, GpuSinglePartitioning,
+GpuShuffleExchangeExecBase, GpuBroadcastExchangeExec and the three-mode
+shuffle manager (RapidsShuffleInternalManagerBase).
+"""
+
+from .partitioning import (HashPartitioning, Partitioning,
+                           RangePartitioning, RoundRobinPartitioning,
+                           SinglePartitioning)
+from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,146 @@
+"""Shuffle and broadcast exchanges.
+
+Reference: GpuShuffleExchangeExecBase.scala:152,262 (prepareBatchShuffleDependency:
+partition-id eval → device slicing → serialized blocks),
+GpuBroadcastExchangeExec.scala:319. This module is the DEFAULT/host-mediated
+shuffle mode (SURVEY.md §2.10): per input batch, rows are sliced per target
+partition ON DEVICE (one fused kernel computing partition ids + cumsum
+compaction per target), and re-coalesced on the read side. The ICI
+device-collective mode lives in parallel/mesh.py; both sit behind the same
+exec surface the way the reference's three shuffle modes sit behind one
+shuffle manager.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import ColumnarBatch, Schema, bucket_capacity
+from ..exec.base import Exec, UnaryExec
+from ..exec.common import compact, concat_batches
+from ..expressions.base import EvalContext
+from .partitioning import Partitioning, RangePartitioning, SinglePartitioning
+
+
+class ShuffleExchangeExec(UnaryExec):
+    """All-to-all redistribution of rows by a partitioning."""
+
+    def __init__(self, partitioning: Partitioning, child: Exec,
+                 ctx: Optional[EvalContext] = None):
+        super().__init__(child, ctx)
+        self.partitioning = partitioning.bind(child.output_schema)
+        self._materialized: Optional[List[List[ColumnarBatch]]] = None
+
+        def slice_kernel(batch: ColumnarBatch, pids, p: int) -> ColumnarBatch:
+            return compact(batch, pids == p)
+
+        self._slice_jit = jax.jit(slice_kernel, static_argnums=2)
+        self._pids_jit = jax.jit(
+            lambda b: self.partitioning.partition_ids(b, self.ctx))
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def _sample_range_bounds(self, batches: List[ColumnarBatch]) -> None:
+        """Compute range bounds from the materialized input (reference:
+        GpuRangePartitioner.sketch/determineBounds)."""
+        from ..exec.common import sort_operands, gather_column
+        part: RangePartitioning = self.partitioning
+        n = self.num_partitions
+        # concat all key columns, sort, take n-1 evenly spaced bound rows
+        key_batches = []
+        counts = []
+        for b in batches:
+            cols = part.key_columns(b, self.ctx)
+            key_batches.append(ColumnarBatch(tuple(cols), b.num_rows))
+            counts.append(b.num_rows)
+        cap = bucket_capacity(sum(kb.capacity for kb in key_batches))
+        allk = concat_batches(key_batches, cap)
+
+        def bounds_kernel(kb: ColumnarBatch):
+            live = kb.row_mask()
+            ops = sort_operands(
+                list(kb.columns), part._descending, part._nulls_first, live)
+            iota = jnp.arange(kb.capacity, dtype=jnp.int32)
+            perm = jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)[-1]
+            skeys = [gather_column(c, perm) for c in kb.columns]
+            total = kb.num_rows
+            # bound i sits at row (i+1)*total/n
+            pos = ((jnp.arange(n - 1, dtype=jnp.int64) + 1) * total) // n
+            pos = jnp.clip(pos, 0, kb.capacity - 1).astype(jnp.int32)
+            return [gather_column(c, pos) for c in skeys]
+
+        bound_cols = jax.jit(bounds_kernel)(allk)
+        part.set_bounds(bound_cols, n - 1)
+
+    def _materialize(self) -> List[List[ColumnarBatch]]:
+        if self._materialized is not None:
+            return self._materialized
+        n = self.num_partitions
+        out: List[List[ColumnarBatch]] = [[] for _ in range(n)]
+        batches = [b for cp in range(self.child.num_partitions)
+                   for b in self.child.execute_partition(cp)]
+        if isinstance(self.partitioning, RangePartitioning) and batches:
+            self._sample_range_bounds(batches)
+        for batch in batches:
+            if n == 1:
+                out[0].append(batch)
+                continue
+            pids = self._pids_jit(batch)
+            for p in range(n):
+                piece = self._slice_jit(batch, pids, p)
+                out[p].append(piece)
+        self._materialized = out
+        return out
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        pieces = self._materialize()[p]
+        pieces = [b for b in pieces if int(b.num_rows) > 0]
+        if not pieces:
+            return
+        # shuffle-read coalesce (reference: GpuShuffleCoalesceExec)
+        cap = bucket_capacity(max(sum(int(b.num_rows) for b in pieces), 1))
+        if len(pieces) == 1:
+            yield pieces[0]
+        else:
+            yield concat_batches(pieces, cap)
+
+
+class BroadcastExchangeExec(UnaryExec):
+    """Replicate the child's full output as one batch (reference:
+    GpuBroadcastExchangeExec — host-serialized concat batches rebuilt on
+    device per executor; single-process here, so it is a concat + cache)."""
+
+    def __init__(self, child: Exec, ctx: Optional[EvalContext] = None):
+        super().__init__(child, ctx)
+        self._cached: Optional[ColumnarBatch] = None
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        if self._cached is None:
+            batches = [b for cp in range(self.child.num_partitions)
+                       for b in self.child.execute_partition(cp)]
+            if not batches:
+                from ..batch import empty_batch
+                self._cached = empty_batch(self.output_schema)
+            elif len(batches) == 1:
+                self._cached = batches[0]
+            else:
+                cap = bucket_capacity(sum(b.capacity for b in batches))
+                self._cached = concat_batches(batches, cap)
+        yield self._cached

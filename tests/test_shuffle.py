@@ -1,0 +1,157 @@
+"""Shuffle exchange + distributed plan patterns: partition-id routing,
+shuffled hash join, two-stage aggregate over an exchange, range+local sort.
+
+Mirrors the reference's GpuPartitioningSuite / shuffle integration coverage
+(SURVEY.md §4.2) without a cluster: partitions are in-process streams.
+"""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec import (AggregateMode, FilterExec,
+                                   HashAggregateExec, HashJoinExec,
+                                   InMemoryScanExec, JoinType, SortExec,
+                                   collect)
+from spark_rapids_tpu.exec.sort import asc
+from spark_rapids_tpu.expressions import col
+from spark_rapids_tpu.expressions.aggregates import Count, Sum
+from spark_rapids_tpu.shuffle import (BroadcastExchangeExec, HashPartitioning,
+                                      RangePartitioning,
+                                      RoundRobinPartitioning,
+                                      ShuffleExchangeExec, SinglePartitioning)
+
+from harness.asserts import assert_rows_equal, rows_of
+from harness.data_gen import IntegerGen, LongGen, StringGen, gen_table
+
+
+def scan(t, batch_rows=None, num_slices=1):
+    return InMemoryScanExec(t, batch_rows=batch_rows, num_slices=num_slices)
+
+
+def test_hash_partitioning_routes_all_rows_consistently():
+    t = gen_table([("k", IntegerGen()), ("v", LongGen())], n=1000, seed=50)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 4),
+                             scan(t, batch_rows=128, num_slices=2))
+    parts = [rows_of(collect_partition(ex, p)) for p in range(4)]
+    all_rows = [r for p in parts for r in p]
+    exp = list(zip(t.column("k").to_pylist(), t.column("v").to_pylist()))
+    assert_rows_equal(all_rows, exp, ignore_order=True)
+    # same key never lands in two partitions
+    seen = {}
+    for pi, p in enumerate(parts):
+        for k, _ in p:
+            if k in seen:
+                assert seen[k] == pi, f"key {k} in partitions {seen[k]},{pi}"
+            seen[k] = pi
+
+
+def collect_partition(ex, p):
+    from spark_rapids_tpu.batch import to_arrow
+    tables = [to_arrow(b, ex.output_schema) for b in ex.execute_partition(p)]
+    if not tables:
+        from spark_rapids_tpu import types as T
+        return pa.table({f.name: pa.array([], type=T.to_arrow(f.dtype))
+                         for f in ex.output_schema})
+    return pa.concat_tables(tables)
+
+
+def test_round_robin_balances():
+    t = gen_table([("v", IntegerGen(nullable=False))], n=800, seed=51)
+    ex = ShuffleExchangeExec(RoundRobinPartitioning(4), scan(t, batch_rows=100))
+    sizes = [collect_partition(ex, p).num_rows for p in range(4)]
+    assert sum(sizes) == 800
+    assert max(sizes) - min(sizes) <= 8  # 8 batches of 100
+
+def test_two_stage_aggregate_over_exchange():
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=40)),
+                   ("v", LongGen(min_val=-100, max_val=100))],
+                  n=3000, seed=52)
+    partial = HashAggregateExec([col("k")],
+                                [Sum(col("v")).alias("s"),
+                                 Count(col("v")).alias("c")],
+                                scan(t, batch_rows=512, num_slices=3),
+                                AggregateMode.PARTIAL)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 4), partial)
+    final = HashAggregateExec([col("k")],
+                              [Sum(col("v")).alias("s"),
+                               Count(col("v")).alias("c")],
+                              ex, AggregateMode.FINAL)
+    got = rows_of(collect(final))
+
+    groups = {}
+    for k, v in zip(t.column("k").to_pylist(), t.column("v").to_pylist()):
+        groups.setdefault(k, []).append(v)
+    exp = []
+    for k, vs in groups.items():
+        xs = [v for v in vs if v is not None]
+        exp.append((k, sum(xs) if xs else None, len(xs)))
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_shuffled_hash_join():
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=30)),
+                    ("x", LongGen())], n=500, seed=53)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=30)),
+                    ("y", LongGen())], n=400, seed=54)
+    lex = ShuffleExchangeExec(HashPartitioning([col("k")], 4),
+                              scan(lt, batch_rows=128, num_slices=2))
+    rex = ShuffleExchangeExec(HashPartitioning([col("k2")], 4),
+                              scan(rt, batch_rows=128, num_slices=2))
+    plan = HashJoinExec([col("k")], [col("k2")], JoinType.INNER, lex, rex,
+                        broadcast_build=False)
+    got = rows_of(collect(plan))
+
+    lrows = list(zip(lt.column("k").to_pylist(), lt.column("x").to_pylist()))
+    rrows = list(zip(rt.column("k2").to_pylist(), rt.column("y").to_pylist()))
+    exp = [l + r for l in lrows for r in rrows
+           if l[0] is not None and l[0] == r[0]]
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_broadcast_join_over_exchange():
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=30)),
+                    ("x", LongGen())], n=300, seed=55)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=30)),
+                    ("y", LongGen())], n=100, seed=56)
+    bex = BroadcastExchangeExec(scan(rt, batch_rows=32, num_slices=2))
+    plan = HashJoinExec([col("k")], [col("k2")], JoinType.LEFT_OUTER,
+                        scan(lt, batch_rows=64, num_slices=3), bex)
+    got = rows_of(collect(plan))
+    lrows = list(zip(lt.column("k").to_pylist(), lt.column("x").to_pylist()))
+    rrows = list(zip(rt.column("k2").to_pylist(), rt.column("y").to_pylist()))
+    exp = []
+    for l in lrows:
+        ms = [r for r in rrows if l[0] is not None and l[0] == r[0]]
+        if ms:
+            exp.extend(l + r for r in ms)
+        else:
+            exp.append(l + (None, None))
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_range_partition_plus_local_sort_is_global_sort():
+    t = gen_table([("a", IntegerGen()), ("b", IntegerGen())], n=1200, seed=57)
+    orders = [asc(col("a"))]
+    ex = ShuffleExchangeExec(
+        RangePartitioning([o.bind(scan(t).output_schema) for o in orders]
+                          if False else orders, 4),
+        scan(t, batch_rows=256, num_slices=2))
+    plan = SortExec(orders, ex, global_sort=False)
+    parts = [rows_of(collect_partition(plan, p)) for p in range(4)]
+    combined = [r for p in parts for r in p]
+    vals = [r[0] for r in combined]
+    # global ordering: nulls first then ascending across partition boundary
+    nn = [v for v in vals if v is not None]
+    assert vals[:len(vals) - len(nn)] == [None] * (len(vals) - len(nn))
+    assert nn == sorted(nn)
+    assert len(combined) == 1200
+
+
+def test_single_partitioning():
+    t = gen_table([("v", IntegerGen())], n=300, seed=58)
+    ex = ShuffleExchangeExec(SinglePartitioning(), scan(t, num_slices=3,
+                                                        batch_rows=64))
+    assert ex.num_partitions == 1
+    got = rows_of(collect(ex))
+    assert_rows_equal(got, [(v,) for v in t.column("v").to_pylist()],
+                      ignore_order=True)
