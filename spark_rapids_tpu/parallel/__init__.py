@@ -1,0 +1,14 @@
+"""SPMD device-mesh execution: the ICI/DCN data plane.
+
+Reference: the UCX shuffle transport (SURVEY.md §2.10,
+shuffle-plugin/.../ucx/UCXShuffleTransport.scala:47) — device-resident
+shuffle over RDMA. The TPU-native equivalent re-shapes the peer-to-peer pull
+protocol into XLA collectives over a `jax.sharding.Mesh`: row routing is ONE
+`all_to_all` on ICI, broadcast is `all_gather`, and whole
+partial→exchange→final pipelines compile into a single SPMD executable.
+"""
+
+from .mesh import (MeshPipeline, distributed_aggregate_step, mesh_exchange,
+                   stack_batches, unstack_batches)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

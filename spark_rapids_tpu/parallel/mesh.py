@@ -1,0 +1,222 @@
+"""Mesh-collective shuffle and fused distributed pipelines.
+
+The host-mediated exchange (shuffle/exchange.py) moves rows through Python;
+this module keeps them in HBM: each device holds one row-partition of the
+table (`[cap, ...]` per column, stacked to `[n_dev, cap, ...]` globally and
+sharded over the mesh's ``data`` axis), and repartitioning happens inside
+`shard_map` with `jax.lax.all_to_all` — the ICI data plane the reference
+implements with UCX/RDMA (RapidsShuffleClient/Server, SURVEY.md §3.4).
+
+A distributed aggregation compiles to ONE XLA program:
+    local partial agg → all_to_all by key hash → local merge+finalize
+with no host round-trip between stages — the analogue of a training step's
+forward+collective+update, and exactly what the reference cannot do (its
+shuffle always crosses the JVM).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..batch import ColumnarBatch, DeviceColumn, Schema, bucket_capacity
+from ..exec.common import compact, concat_columns
+from ..expressions.base import EvalContext, Expression
+from ..expressions.hashing import murmur3_batch
+
+
+# ---------------------------------------------------------------------------
+# Host-side stacking: one batch per device -> global stacked batch
+# ---------------------------------------------------------------------------
+
+def stack_batches(batches: Sequence[ColumnarBatch],
+                  mesh: Optional[Mesh] = None,
+                  axis: str = "data") -> ColumnarBatch:
+    """Stack per-partition batches into a device-axis-leading global batch;
+    with a mesh, shard the leading axis over it (one partition per device)."""
+    caps = {b.capacity for b in batches}
+    assert len(caps) == 1, f"all partitions must share a capacity: {caps}"
+    cols = []
+    for i, c in enumerate(batches[0].columns):
+        data = jnp.stack([b.columns[i].data for b in batches])
+        validity = jnp.stack([b.columns[i].validity for b in batches])
+        lengths = jnp.stack([b.columns[i].lengths for b in batches]) \
+            if c.lengths is not None else None
+        cols.append(DeviceColumn(data, validity, lengths, c.dtype))
+    num_rows = jnp.stack([jnp.asarray(b.num_rows, jnp.int32).reshape(())
+                          for b in batches])
+    out = ColumnarBatch(tuple(cols), num_rows)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(axis))
+        out = jax.device_put(out, sharding)
+    return out
+
+
+def unstack_batches(stacked: ColumnarBatch) -> List[ColumnarBatch]:
+    n_dev = stacked.num_rows.shape[0]
+    out = []
+    for d in range(n_dev):
+        cols = tuple(
+            DeviceColumn(c.data[d], c.validity[d],
+                         c.lengths[d] if c.lengths is not None else None,
+                         c.dtype)
+            for c in stacked.columns)
+        out.append(ColumnarBatch(cols, stacked.num_rows[d]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# In-SPMD exchange (called INSIDE shard_map)
+# ---------------------------------------------------------------------------
+
+def mesh_exchange(batch: ColumnarBatch, pids: jnp.ndarray, n_dev: int,
+                  axis: str = "data",
+                  out_capacity: Optional[int] = None) -> ColumnarBatch:
+    """Route rows to the device named by ``pids`` with one all_to_all.
+
+    ``batch`` is the LOCAL partition (inside shard_map). Each destination's
+    rows are compacted into a [cap] send slot; `all_to_all` swaps slots
+    across the axis; received pieces concatenate into a batch of
+    ``out_capacity`` (default n_dev*cap — lossless worst case; pass a
+    smaller bound when the partitioning is known balanced to save HBM).
+    """
+    cap = batch.capacity
+    out_cap = out_capacity or n_dev * cap
+    pieces = [compact(batch, pids == d) for d in range(n_dev)]
+    counts = jnp.stack([p.num_rows for p in pieces])          # [n_dev]
+    recv_counts = jax.lax.all_to_all(counts.reshape(n_dev, 1), axis, 0, 0,
+                                     tiled=False).reshape(n_dev)
+    out_cols = []
+    for i, col in enumerate(batch.columns):
+        data = jnp.stack([p.columns[i].data for p in pieces])
+        validity = jnp.stack([p.columns[i].validity for p in pieces])
+        data = jax.lax.all_to_all(data, axis, 0, 0)
+        validity = jax.lax.all_to_all(validity, axis, 0, 0)
+        lengths = None
+        if col.lengths is not None:
+            lengths = jnp.stack([p.columns[i].lengths for p in pieces])
+            lengths = jax.lax.all_to_all(lengths, axis, 0, 0)
+        recv = [DeviceColumn(data[d], validity[d],
+                             lengths[d] if lengths is not None else None,
+                             col.dtype) for d in range(n_dev)]
+        out_cols.append(concat_columns(recv, list(recv_counts), out_cap))
+    total = jnp.sum(recv_counts).astype(jnp.int32)
+    return ColumnarBatch(tuple(out_cols), total)
+
+
+def mesh_broadcast(batch: ColumnarBatch, n_dev: int, axis: str = "data"
+                   ) -> ColumnarBatch:
+    """Replicate every device's partition to all devices (all_gather) —
+    the build side of a distributed broadcast join."""
+    cap = batch.capacity
+    out_cap = n_dev * cap
+    counts = jax.lax.all_gather(batch.num_rows, axis)          # [n_dev]
+    out_cols = []
+    for col in batch.columns:
+        data = jax.lax.all_gather(col.data, axis)              # [n_dev, cap,…]
+        validity = jax.lax.all_gather(col.validity, axis)
+        lengths = jax.lax.all_gather(col.lengths, axis) \
+            if col.lengths is not None else None
+        recv = [DeviceColumn(data[d], validity[d],
+                             lengths[d] if lengths is not None else None,
+                             col.dtype) for d in range(n_dev)]
+        out_cols.append(concat_columns(recv, list(counts), out_cap))
+    total = jnp.sum(counts).astype(jnp.int32)
+    return ColumnarBatch(tuple(out_cols), total)
+
+
+# ---------------------------------------------------------------------------
+# Fused distributed pipelines
+# ---------------------------------------------------------------------------
+
+class MeshPipeline:
+    """Builds jitted SPMD programs over a 1-axis row mesh.
+
+    The SQL engine's parallelism is data-parallel over row partitions
+    (SURVEY.md §2.8 — the reference's only strategy); the ``data`` axis IS
+    dp. Long-input scaling ("sequence parallel" analogue) falls out of the
+    same axis: an oversized partition re-shards across the mesh by range or
+    hash before the heavy operator.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = "data"):
+        self.mesh = mesh
+        self.axis = axis
+        self.n_dev = mesh.shape[axis]
+
+    def spmd(self, fn: Callable, out_specs=None):
+        """Wrap a local-batch function into a jitted global-batch program.
+
+        shard_map keeps the (length-1) device dimension on local shards, so
+        the wrapper squeezes it on entry and restores it on exit — local
+        functions see plain per-partition batches.
+        """
+        spec = P(self.axis)
+
+        def local(stacked: ColumnarBatch):
+            squeezed = jax.tree.map(lambda x: x[0], stacked)
+            out = fn(squeezed)
+            return jax.tree.map(lambda x: x[None], out)
+
+        wrapped = shard_map(local, mesh=self.mesh, in_specs=(spec,),
+                            out_specs=out_specs if out_specs is not None
+                            else spec, check_vma=False)
+        return jax.jit(wrapped)
+
+
+def distributed_aggregate_step(mesh: Mesh, schema: Schema,
+                               group_exprs: Sequence[Expression],
+                               agg_exprs: Sequence[Expression],
+                               axis: str = "data",
+                               exchange_capacity: Optional[int] = None):
+    """One-program distributed group-by:
+    local partial → all_to_all(hash(keys)) → local merge+final.
+
+    Returns (jitted_fn, out_schema); jitted_fn maps a stacked sharded batch
+    [n_dev, cap] to stacked per-device result groups. Every key lands on
+    exactly one device (Spark-murmur3 routing), so concatenated device
+    results are the exact global aggregate.
+    """
+    from ..exec.aggregate import AggregateMode, HashAggregateExec
+    from ..exec.basic import InMemoryScanExec
+    from ..batch import empty_batch
+
+    placeholder = InMemoryScanExec([empty_batch(schema)], schema=schema)
+    partial = HashAggregateExec(group_exprs, agg_exprs, placeholder,
+                                AggregateMode.PARTIAL)
+    # chaining through `partial` lets FINAL recover the bound agg functions
+    final = HashAggregateExec(group_exprs, agg_exprs, partial,
+                              AggregateMode.FINAL)
+
+    n_dev = mesh.shape[axis]
+    nk = len(group_exprs)
+
+    def local_step(batch: ColumnarBatch) -> ColumnarBatch:
+        part = partial._update_kernel(batch)
+        if nk == 0:
+            # global aggregate: merge every partial on device 0
+            pids = jnp.zeros(part.capacity, jnp.int32)
+        else:
+            key_cols = list(part.columns[:nk])
+            h = murmur3_batch(key_cols)
+            m = h % jnp.int32(n_dev)
+            pids = jnp.where(m < 0, m + n_dev, m).astype(jnp.int32)
+        routed = mesh_exchange(part, pids, n_dev, axis,
+                               out_capacity=exchange_capacity)
+        out = final._merge_kernel(routed, final=True)
+        if nk == 0:
+            # keyless aggregate: only device 0 owns the single global group
+            dev = jax.lax.axis_index(axis)
+            out = ColumnarBatch(
+                out.columns,
+                jnp.where(dev == 0, out.num_rows, jnp.int32(0)))
+        return out
+
+    pipe = MeshPipeline(mesh, axis)
+    return pipe.spmd(local_step), final.output_schema
