@@ -1,0 +1,21 @@
+"""Planner: logical plans, type gating, replacement rules, fallback.
+
+Reference: the L6 layer (SURVEY.md) — GpuOverrides.scala:430 (rule
+registry), RapidsMeta.scala:76 (tagging/fallback-reason framework),
+TypeChecks.scala:171 (TypeSig), GpuTransitionOverrides.scala:41
+(transition insertion), explain-only mode (GpuOverrides.scala:4146).
+
+Here the "CPU side" is an in-package row interpreter (plan/interpreter.py)
+standing in for Apache Spark: it executes whatever the planner refuses to
+place on the TPU, and doubles as the differential-test oracle exactly the
+way CPU Spark does for the reference (SURVEY.md §4.1).
+"""
+
+from .logical import (DataFrame, LogicalAggregate, LogicalFilter,
+                      LogicalJoin, LogicalLimit, LogicalPlan, LogicalProject,
+                      LogicalRange, LogicalScan, LogicalSort, LogicalUnion,
+                      table)
+from .overrides import ExplainMode, Overrides, PlanMeta, plan_query
+from .session import Session
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,646 @@
+"""Row-wise CPU interpreter for logical plans — the in-package Apache Spark.
+
+Two jobs, mirroring CPU Spark's two roles around the reference plugin:
+1. FALLBACK EXECUTOR: any logical subtree the planner tags off the TPU runs
+   here (reference: untagged nodes simply stay Spark CPU operators).
+2. DIFFERENTIAL ORACLE: tests run a query twice — Session(tpu_enabled=False)
+   interprets everything here; =True plans onto the TPU — and compare, the
+   reference's assert_gpu_and_cpu_are_equal_collect pattern
+   (integration_tests/src/main/python/asserts.py:542).
+
+Deliberately independent of the device code: plain Python ints/floats with
+explicit two's-complement wrapping, row loops, dict group-bys. Slow and
+obviously correct.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from .. import types as T
+from ..batch import Schema
+from ..exec.join import JoinType
+from ..expressions import aggregates as agg_mod
+from ..expressions.base import (Alias, BoundReference, Expression, Literal)
+from ..types import SqlType, TypeKind
+from . import logical as L
+
+_INT_BITS = {TypeKind.INT8: 8, TypeKind.INT16: 16, TypeKind.INT32: 32,
+             TypeKind.INT64: 64}
+
+
+def _wrap(v: int, bits: int) -> int:
+    v &= (1 << bits) - 1
+    return v - (1 << bits) if v >= (1 << (bits - 1)) else v
+
+
+def _is_float(t: SqlType) -> bool:
+    return t.kind in (TypeKind.FLOAT32, TypeKind.FLOAT64)
+
+
+def _to_f32(v: float) -> float:
+    import numpy as np
+    return float(np.float32(v))
+
+
+class RowEvaluator:
+    """Evaluates a bound expression tree against a row tuple."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+
+    def eval(self, e: Expression, row: tuple) -> Any:
+        m = getattr(self, "_eval_" + type(e).__name__, None)
+        if m is None:
+            raise NotImplementedError(
+                f"CPU interpreter: {type(e).__name__}")
+        return m(e, row)
+
+    # ---- leaves ----
+    def _eval_BoundReference(self, e, row):
+        return row[e.ordinal]
+
+    def _eval_Literal(self, e, row):
+        return e.value
+
+    def _eval_Alias(self, e, row):
+        return self.eval(e.child, row)
+
+    # ---- arithmetic ----
+    def _num2(self, e, row):
+        return self.eval(e.children[0], row), self.eval(e.children[1], row)
+
+    def _arith(self, e, row, fn):
+        l, r = self._num2(e, row)
+        if l is None or r is None:
+            return None
+        v = fn(l, r)
+        d = e.dtype
+        if v is not None and d.kind in _INT_BITS:
+            v = _wrap(int(v), _INT_BITS[d.kind])
+        elif v is not None and d.kind is TypeKind.FLOAT32:
+            v = _to_f32(v)
+        return v
+
+    def _eval_Add(self, e, row):
+        return self._arith(e, row, lambda a, b: a + b)
+
+    def _eval_Subtract(self, e, row):
+        return self._arith(e, row, lambda a, b: a - b)
+
+    def _eval_Multiply(self, e, row):
+        return self._arith(e, row, lambda a, b: a * b)
+
+    def _eval_Divide(self, e, row):
+        # Spark `/`: double result; x/0 -> NULL in non-ANSI mode (for all
+        # numeric inputs, unlike Java IEEE division)
+        l, r = self._num2(e, row)
+        if l is None or r is None or float(r) == 0.0:
+            return None
+        return float(l) / float(r)
+
+    def _eval_IntegralDivide(self, e, row):
+        l, r = self._num2(e, row)
+        if l is None or r is None or r == 0:
+            return None
+        q = abs(l) // abs(r)              # Java truncating division
+        return _wrap(int(-q if (l < 0) != (r < 0) else q), 64)
+
+    def _eval_Remainder(self, e, row):
+        l, r = self._num2(e, row)
+        if l is None or r is None or r == 0:
+            return None
+        if isinstance(l, float) or isinstance(r, float):
+            return math.fmod(l, r)
+        return int(math.fmod(l, r))
+
+    def _eval_Pmod(self, e, row):
+        l, r = self._num2(e, row)
+        if l is None or r is None or r == 0:
+            return None
+        m = math.fmod(l, r) if isinstance(l, float) or isinstance(r, float) \
+            else int(math.fmod(l, r))
+        return m + abs(r) if (m < 0) else m
+
+    def _eval_UnaryMinus(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        d = e.dtype
+        if d.kind in _INT_BITS:
+            return _wrap(-v, _INT_BITS[d.kind])
+        return -v
+
+    def _eval_Abs(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        d = e.dtype
+        if d.kind in _INT_BITS:
+            return _wrap(abs(v), _INT_BITS[d.kind])
+        return abs(v)
+
+    def _eval_BitwiseOp(self, e, row):
+        l, r = self._num2(e, row)
+        if l is None or r is None:
+            return None
+        v = l & r if e.op == "and" else l | r if e.op == "or" else l ^ r
+        return _wrap(v, _INT_BITS[e.dtype.kind])
+
+    def _eval_BitwiseNot(self, e, row):
+        v = self.eval(e.children[0], row)
+        return None if v is None else _wrap(~v, _INT_BITS[e.dtype.kind])
+
+    # ---- comparison / boolean (3VL) ----
+    def _cmp(self, e, row, fn):
+        l = self.eval(e.children[0], row)
+        r = self.eval(e.children[1], row)
+        if l is None or r is None:
+            return None
+        return fn(self._ordkey(l), self._ordkey(r))
+
+    @staticmethod
+    def _ordkey(v):
+        if isinstance(v, float) and math.isnan(v):
+            return (1, 0.0)   # NaN greatest & equal to itself (Spark)
+        if isinstance(v, str):
+            return (0, v.encode("utf-8"))
+        if isinstance(v, bytes):
+            return (0, v)
+        return (0, v)
+
+    def _eval_EqualTo(self, e, row):
+        return self._cmp(e, row, lambda a, b: a == b)
+
+    def _eval_LessThan(self, e, row):
+        return self._cmp(e, row, lambda a, b: a < b)
+
+    def _eval_LessThanOrEqual(self, e, row):
+        return self._cmp(e, row, lambda a, b: a <= b)
+
+    def _eval_GreaterThan(self, e, row):
+        return self._cmp(e, row, lambda a, b: a > b)
+
+    def _eval_GreaterThanOrEqual(self, e, row):
+        return self._cmp(e, row, lambda a, b: a >= b)
+
+    def _eval_EqualNullSafe(self, e, row):
+        l = self.eval(e.children[0], row)
+        r = self.eval(e.children[1], row)
+        if l is None and r is None:
+            return True
+        if l is None or r is None:
+            return False
+        return self._ordkey(l) == self._ordkey(r)
+
+    def _eval_Not(self, e, row):
+        v = self.eval(e.children[0], row)
+        return None if v is None else not v
+
+    def _eval_IsNull(self, e, row):
+        return self.eval(e.children[0], row) is None
+
+    def _eval_IsNotNull(self, e, row):
+        return self.eval(e.children[0], row) is not None
+
+    def _eval_IsNaN(self, e, row):
+        v = self.eval(e.children[0], row)
+        return False if v is None else (isinstance(v, float) and math.isnan(v))
+
+    def _eval_In(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        found = False
+        saw_null = False
+        for c in e.children[1:]:
+            w = self.eval(c, row)
+            if w is None:
+                saw_null = True
+            elif self._ordkey(w) == self._ordkey(v):
+                found = True
+        return True if found else (None if saw_null else False)
+
+    def _eval_And(self, e, row):
+        l = self.eval(e.children[0], row)
+        r = self.eval(e.children[1], row)
+        if l is False or r is False:
+            return False
+        if l is None or r is None:
+            return None
+        return True
+
+    def _eval_Or(self, e, row):
+        l = self.eval(e.children[0], row)
+        r = self.eval(e.children[1], row)
+        if l is True or r is True:
+            return True
+        if l is None or r is None:
+            return None
+        return False
+
+    # ---- conditionals ----
+    def _eval_If(self, e, row):
+        c = self.eval(e.children[0], row)
+        return self.eval(e.children[1] if c is True else e.children[2], row)
+
+    def _eval_CaseWhen(self, e, row):
+        for cond, val in e.branches:
+            if self.eval(cond, row) is True:
+                return self.eval(val, row)
+        return self.eval(e.else_value, row) if e.else_value is not None \
+            else None
+
+    def _eval_Coalesce(self, e, row):
+        for c in e.children:
+            v = self.eval(c, row)
+            if v is not None:
+                return v
+        return None
+
+    def _eval_LeastGreatest(self, e, row):
+        vs = [self.eval(c, row) for c in e.children]
+        vs = [v for v in vs if v is not None]
+        if not vs:
+            return None
+        ks = [self._ordkey(v) for v in vs]
+        pick = max(range(len(vs)), key=lambda i: ks[i]) if e.greatest else \
+            min(range(len(vs)), key=lambda i: ks[i])
+        return vs[pick]
+
+    # ---- cast ----
+    def _eval_Cast(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        to = e.to
+        k = to.kind
+        try:
+            if k in _INT_BITS:
+                if isinstance(v, bool):
+                    return int(v)
+                if isinstance(v, float):
+                    if math.isnan(v):
+                        return 0
+                    v = max(min(v, 2 ** 63), -(2 ** 63))
+                    return _wrap(int(v), _INT_BITS[k])
+                if isinstance(v, str):
+                    try:
+                        return _wrap(int(v.strip()), _INT_BITS[k])
+                    except ValueError:
+                        return None
+                return _wrap(int(v), _INT_BITS[k])
+            if k is TypeKind.FLOAT64:
+                if isinstance(v, str):
+                    try:
+                        return float(v.strip())
+                    except ValueError:
+                        return None
+                return float(v)
+            if k is TypeKind.FLOAT32:
+                return _to_f32(float(v))
+            if k is TypeKind.BOOLEAN:
+                return bool(v)
+            if k is TypeKind.STRING:
+                return _spark_string_of(v, e.children[0].dtype)
+        except (ValueError, OverflowError):
+            return None
+        raise NotImplementedError(f"cast to {to}")
+
+    # ---- math ----
+    def _eval_UnaryMath(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        fn = {"sqrt": lambda x: math.sqrt(x) if x >= 0 else float("nan"),
+              "exp": math.exp, "log": lambda x: math.log(x) if x > 0
+              else (None if x <= 0 else math.log(x)),
+              "sin": math.sin, "cos": math.cos, "tan": math.tan,
+              "asin": lambda x: math.asin(x) if -1 <= x <= 1 else float("nan"),
+              "acos": lambda x: math.acos(x) if -1 <= x <= 1 else float("nan"),
+              "atan": math.atan, "sinh": math.sinh, "cosh": math.cosh,
+              "tanh": math.tanh, "cbrt": lambda x: math.copysign(
+                  abs(x) ** (1 / 3), x),
+              "log10": lambda x: math.log10(x) if x > 0 else None,
+              "log2": lambda x: math.log2(x) if x > 0 else None,
+              "log1p": lambda x: math.log1p(x) if x > -1 else None,
+              "expm1": math.expm1,
+              "degrees": math.degrees, "radians": math.radians,
+              }[e.op]
+        try:
+            return fn(float(v))
+        except (ValueError, OverflowError):
+            return float("nan")
+
+    def _eval_FloorCeil(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        if isinstance(v, int) and not isinstance(v, bool):
+            return v
+        if not math.isfinite(v):
+            return None   # device: validity &= isfinite
+        return int(math.ceil(v) if e.is_ceil else math.floor(v))
+
+    def _eval_Signum(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        x = float(v)
+        if math.isnan(x):
+            return x
+        return 0.0 if x == 0 else math.copysign(1.0, x)
+
+    def _eval_Pow(self, e, row):
+        l, r = self._num2(e, row)
+        if l is None or r is None:
+            return None
+        try:
+            return float(l) ** float(r)
+        except (OverflowError, ZeroDivisionError):
+            return float("inf")
+
+    def _eval_Atan2(self, e, row):
+        l, r = self._num2(e, row)
+        if l is None or r is None:
+            return None
+        return math.atan2(float(l), float(r))
+
+    def _eval_Round(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        import decimal
+        d = decimal.Decimal(repr(v) if isinstance(v, float) else v)
+        mode = decimal.ROUND_HALF_EVEN if getattr(e, "half_even", False) \
+            else decimal.ROUND_HALF_UP
+        q = d.quantize(decimal.Decimal(1).scaleb(-e.scale), rounding=mode)
+        return float(q) if isinstance(v, float) else int(q)
+
+    def _eval_Murmur3Hash(self, e, row):
+        from ..utils.murmur3 import spark_hash_row
+        vals = [self.eval(c, row) for c in e.exprs]
+        dts = [c.dtype for c in e.exprs]
+        return spark_hash_row(vals, dts, e.seed)
+
+
+def _spark_string_of(v, src_type: SqlType) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        return repr(v)
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# Plan interpreter
+# ---------------------------------------------------------------------------
+
+def _rows(table: pa.Table) -> List[tuple]:
+    cols = [c.to_pylist() for c in table.columns]
+    return [tuple(c[i] for c in cols) for i in range(table.num_rows)]
+
+
+def _table(rows: List[tuple], schema: Schema) -> pa.Table:
+    arrays = []
+    for i, f in enumerate(schema):
+        arrays.append(pa.array([r[i] for r in rows],
+                               type=T.to_arrow(f.dtype)))
+    return pa.table(arrays, names=schema.names)
+
+
+class Interpreter:
+    """Executes a logical plan on the CPU, row by row."""
+
+    def execute(self, plan: L.LogicalPlan) -> pa.Table:
+        rows = self._exec(plan)
+        return _table(rows, plan.schema())
+
+    def _exec(self, p: L.LogicalPlan) -> List[tuple]:
+        m = getattr(self, "_exec_" + type(p).__name__)
+        return m(p)
+
+    def _exec_LogicalScan(self, p):
+        if p.data is not None:
+            return _rows(p.data)
+        return _rows(p.source.read_all())
+
+    def _exec_LogicalRange(self, p):
+        return [(i,) for i in range(p.start, p.end, p.step)]
+
+    def _exec_LogicalProject(self, p):
+        child = p.children[0]
+        rows = self._exec(child)
+        schema = child.schema()
+        ev = RowEvaluator(schema)
+        exprs = [e.bind(schema) for e in p.exprs]
+        return [tuple(ev.eval(e, r) for e in exprs) for r in rows]
+
+    def _exec_LogicalFilter(self, p):
+        child = p.children[0]
+        rows = self._exec(child)
+        schema = child.schema()
+        ev = RowEvaluator(schema)
+        cond = p.condition.bind(schema)
+        return [r for r in rows if ev.eval(cond, r) is True]
+
+    def _exec_LogicalLimit(self, p):
+        return self._exec(p.children[0])[: p.limit]
+
+    def _exec_LogicalUnion(self, p):
+        out = []
+        for c in p.children:
+            out.extend(self._exec(c))
+        return out
+
+    def _exec_LogicalSample(self, p):
+        # seeded like the device SampleExec cannot be replicated row-exact;
+        # the planner never falls back mid-sample, so interpret with numpy
+        import numpy as np
+        rows = self._exec(p.children[0])
+        rng = np.random.default_rng(p.seed)
+        keep = rng.random(len(rows)) < p.fraction
+        return [r for r, k in zip(rows, keep) if k]
+
+    def _exec_LogicalExpand(self, p):
+        child = p.children[0]
+        rows = self._exec(child)
+        schema = child.schema()
+        ev = RowEvaluator(schema)
+        out = []
+        for proj in p.projections:
+            bound = [e.bind(schema) for e in proj]
+            out.extend(tuple(ev.eval(e, r) for e in bound) for r in rows)
+        return out
+
+    def _exec_LogicalSort(self, p):
+        child = p.children[0]
+        rows = self._exec(child)
+        schema = child.schema()
+        ev = RowEvaluator(schema)
+        orders = [o.bind(schema) for o in p.orders]
+
+        def key(row):
+            parts = []
+            for o in orders:
+                v = ev.eval(o.child, row)
+                nf = o.effective_nulls_first
+                if v is None:
+                    parts.append((0 if nf else 2, ()))
+                    continue
+                k = RowEvaluator._ordkey(v)
+                if o.descending:
+                    parts.append((1, _NegKey(k)))
+                else:
+                    parts.append((1, k))
+            return tuple(parts)
+
+        return sorted(rows, key=key)
+
+    def _exec_LogicalAggregate(self, p):
+        child = p.children[0]
+        rows = self._exec(child)
+        schema = child.schema()
+        ev = RowEvaluator(schema)
+        keys = [e.bind(schema) for e in p.group_exprs]
+        aggs = []
+        for e in p.agg_exprs:
+            a = e.child if isinstance(e, Alias) else e
+            aggs.append(a.bind(schema))
+
+        groups: Dict = {}
+        order = []
+        for r in rows:
+            k = tuple(RowEvaluator._ordkey(ev.eval(e, r))
+                      if ev.eval(e, r) is not None else _NULL
+                      for e in keys)
+            raw_k = tuple(ev.eval(e, r) for e in keys)
+            if k not in groups:
+                groups[k] = (raw_k, [])
+                order.append(k)
+            groups[k][1].append(r)
+        if not keys and not order:
+            groups[()] = ((), [])
+            order.append(())
+
+        out = []
+        for k in order:
+            raw_k, grp = groups[k]
+            vals = []
+            for a in aggs:
+                vals.append(self._agg_value(a, grp, ev))
+            out.append(tuple(raw_k) + tuple(vals))
+        return out
+
+    def _agg_value(self, a, grp_rows, ev):
+        name = type(a).__name__
+        child = a.children[0] if a.children else None
+        xs = [ev.eval(child, r) for r in grp_rows] if child is not None \
+            else [1] * len(grp_rows)
+        nn = [x for x in xs if x is not None]
+        if name == "Count":
+            return len(nn) if child is not None else len(grp_rows)
+        if name == "Sum":
+            if not nn:
+                return None
+            s = sum(nn)
+            if a.dtype.kind in _INT_BITS:
+                return _wrap(int(s), 64)
+            return float(s)
+        if name == "Min":
+            return min(nn, key=RowEvaluator._ordkey) if nn else None
+        if name == "Max":
+            return max(nn, key=RowEvaluator._ordkey) if nn else None
+        if name == "Average":
+            return float(sum(nn)) / len(nn) if nn else None
+        if name == "First":
+            return xs[0] if xs else None
+        if name == "Last":
+            return xs[-1] if xs else None
+        if name in ("StddevSamp", "VarianceSamp", "StddevPop", "VariancePop"):
+            n = len(nn)
+            need = 2 if name.endswith("Samp") else 1
+            if n < need:
+                return None
+            mean = sum(nn) / n
+            m2 = sum((x - mean) ** 2 for x in nn)
+            div = (n - 1) if name.endswith("Samp") else n
+            var = m2 / div
+            return math.sqrt(var) if name.startswith("Stddev") else var
+        raise NotImplementedError(f"CPU interpreter aggregate {name}")
+
+    def _exec_LogicalJoin(self, p):
+        lc, rc = p.children
+        lrows, rrows = self._exec(lc), self._exec(rc)
+        ls, rs = lc.schema(), rc.schema()
+        lev, rev = RowEvaluator(ls), RowEvaluator(rs)
+        lk = [e.bind(ls) for e in p.left_keys]
+        rk = [e.bind(rs) for e in p.right_keys]
+        pair_schema = Schema(list(ls.fields) + list(rs.fields))
+        pev = RowEvaluator(pair_schema)
+        cond = p.condition.bind(pair_schema) if p.condition is not None \
+            else None
+        jt = p.join_type
+
+        rkeys = [tuple(rev.eval(e, r) for e in rk) for r in rrows]
+        out = []
+        matched_r = [False] * len(rrows)
+        nl_l, nl_r = len(ls.fields), len(rs.fields)
+        for lrow in lrows:
+            key = tuple(lev.eval(e, lrow) for e in lk)
+            has_null = any(v is None for v in key)
+            key_c = tuple(RowEvaluator._ordkey(v) if v is not None else _NULL
+                          for v in key)
+            m = False
+            for j, rrow in enumerate(rrows):
+                if has_null or any(v is None for v in rkeys[j]):
+                    continue
+                rkey_c = tuple(RowEvaluator._ordkey(v) for v in rkeys[j])
+                if key_c != rkey_c:
+                    continue
+                if cond is not None and \
+                        pev.eval(cond, lrow + rrow) is not True:
+                    continue
+                m = True
+                matched_r[j] = True
+                if jt in (JoinType.INNER, JoinType.LEFT_OUTER,
+                          JoinType.RIGHT_OUTER, JoinType.FULL_OUTER,
+                          JoinType.CROSS):
+                    out.append(lrow + rrow)
+            if jt is JoinType.LEFT_SEMI and m:
+                out.append(lrow)
+            if jt is JoinType.LEFT_ANTI and not m:
+                out.append(lrow)
+            if jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER) and not m:
+                out.append(lrow + (None,) * nl_r)
+        if jt in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            for j, rrow in enumerate(rrows):
+                if not matched_r[j]:
+                    out.append((None,) * nl_l + rrow)
+        return out
+
+
+class _NULL:
+    pass
+
+
+class _NegKey:
+    """Inverts comparison order of an arbitrary key (descending sort)."""
+
+    __slots__ = ("k",)
+
+    def __init__(self, k):
+        self.k = k
+
+    def __lt__(self, other):
+        return other.k < self.k
+
+    def __eq__(self, other):
+        return self.k == other.k

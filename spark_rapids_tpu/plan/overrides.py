@@ -1,0 +1,373 @@
+"""Replacement rules, tagging, conversion, explain.
+
+Reference: GpuOverrides.scala:430 (rule registry: ExprRule/ExecRule maps),
+RapidsMeta.scala:76 (meta wrappers collecting willNotWorkOnGpu reasons),
+GpuOverrides.scala:4066-4131 (wrapAndTagPlan / convertIfNeeded),
+:4146 (explain), GpuTransitionOverrides (exchange/transition insertion).
+
+Flow (same as the reference's §3.2 call stack):
+  wrap logical plan in PlanMeta → tag (conf switches, TypeSig checks,
+  expression rule lookups) → convert: tagged-ok subtrees become TPU execs
+  with exchanges inserted for aggregates/joins; tagged-off nodes become
+  CpuFallbackExec islands running the row interpreter, reading any TPU
+  children through the Arrow boundary (GpuColumnarToRowExec analogue).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Type
+
+import pyarrow as pa
+
+from ..batch import Schema
+from ..config import RapidsTpuConf
+from ..exec import (BroadcastNestedLoopJoinExec, ExpandExec, FilterExec,
+                    GlobalLimitExec, HashAggregateExec, HashJoinExec,
+                    InMemoryScanExec, ProjectExec, RangeExec, SampleExec,
+                    SortExec, UnionExec)
+from ..exec.aggregate import AggregateMode
+from ..exec.base import Exec, LeafExec
+from ..exec.join import JoinType
+from ..expressions import aggregates as AGG
+from ..expressions import base as EB
+from ..expressions.base import Alias, Expression
+from ..shuffle import (BroadcastExchangeExec, HashPartitioning,
+                       ShuffleExchangeExec, SinglePartitioning)
+from . import logical as L
+from . import typesig as TS
+from .interpreter import Interpreter, RowEvaluator
+from .typesig import TypeSig
+
+
+class ExplainMode(enum.Enum):
+    NONE = "NONE"
+    ALL = "ALL"
+    NOT_ON_TPU = "NOT_ON_TPU"
+
+
+# ---------------------------------------------------------------------------
+# Expression rules
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExprRule:
+    cls_name: str
+    sig: TypeSig
+    incompat: bool = False
+    note: str = ""
+
+    @property
+    def conf_key(self) -> str:
+        return f"spark.rapids.tpu.sql.expression.{self.cls_name}"
+
+
+def _expr_rules() -> Dict[str, ExprRule]:
+    rules = {}
+
+    def r(name, sig, incompat=False, note=""):
+        rules[name] = ExprRule(name, sig, incompat, note)
+
+    for n in ("BoundReference", "UnresolvedColumn", "Literal", "Alias"):
+        r(n, TS.ALL_BASIC)
+    for n in ("Add", "Subtract", "Multiply", "UnaryMinus", "Abs"):
+        r(n, TS.NUMERIC)
+    for n in ("Divide", "IntegralDivide", "Remainder", "Pmod"):
+        r(n, TS.NUMERIC)
+    for n in ("BitwiseOp", "BitwiseNot"):
+        r(n, TS.INTEGRAL)
+    for n in ("EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual",
+              "GreaterThan", "GreaterThanOrEqual", "In"):
+        r(n, TS.ALL_BASIC)
+    for n in ("Not", "And", "Or"):
+        r(n, TS.BOOLEAN + TS.ALL_BASIC)
+    for n in ("IsNull", "IsNotNull", "IsNaN"):
+        r(n, TS.ALL_BASIC)
+    for n in ("If", "CaseWhen", "Coalesce", "LeastGreatest"):
+        r(n, TS.ALL_BASIC)
+    r("Cast", TS.ALL_BASIC)
+    # float transcendentals differ from JVM StrictMath in ULPs: incompat,
+    # same policy as the reference's incompatOps (RegexParser-style gating)
+    for n in ("UnaryMath", "Pow", "Atan2", "Signum"):
+        r(n, TS.NUMERIC, incompat=True,
+          note="XLA float transcendentals differ from JVM in final ULPs")
+    r("Round", TS.NUMERIC)
+    r("FloorCeil", TS.NUMERIC)
+    r("Murmur3Hash", TS.ALL_BASIC)
+    # aggregates
+    for n in ("Count", "Min", "Max", "First", "Last"):
+        r(n, TS.ALL_BASIC)
+    r("Sum", TS.NUMERIC, incompat=False)
+    r("Average", TS.NUMERIC,
+      note="float sums reassociate; parity kept by f64 accumulation")
+    for n in ("StddevSamp", "StddevPop", "VarianceSamp", "VariancePop"):
+        r(n, TS.FP)
+    return rules
+
+
+EXPR_RULES = _expr_rules()
+
+
+# ---------------------------------------------------------------------------
+# Meta wrappers (RapidsMeta analogue)
+# ---------------------------------------------------------------------------
+
+class PlanMeta:
+    def __init__(self, node: L.LogicalPlan, conf: RapidsTpuConf):
+        self.node = node
+        self.conf = conf
+        self.children = [PlanMeta(c, conf) for c in node.children]
+        self.reasons: List[str] = []
+
+    # ---- tagging ----
+    def will_not_work(self, reason: str) -> None:
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    def tag(self) -> None:
+        for c in self.children:
+            c.tag()
+        if not self.conf.sql_enabled:
+            self.will_not_work("spark.rapids.tpu.sql.enabled is false")
+            return
+        name = self.node.name
+        exec_key = f"spark.rapids.tpu.sql.exec.{name}"
+        if not self.conf.is_op_enabled(exec_key):
+            self.will_not_work(f"{exec_key} is false")
+        self._tag_expressions()
+        self._tag_types()
+
+    def _expressions(self) -> List[Expression]:
+        n = self.node
+        if isinstance(n, L.LogicalProject):
+            return list(n.exprs)
+        if isinstance(n, L.LogicalFilter):
+            return [n.condition]
+        if isinstance(n, L.LogicalAggregate):
+            return list(n.group_exprs) + list(n.agg_exprs)
+        if isinstance(n, L.LogicalJoin):
+            return list(n.left_keys) + list(n.right_keys) + (
+                [n.condition] if n.condition is not None else [])
+        if isinstance(n, L.LogicalSort):
+            return [o.child for o in n.orders]
+        if isinstance(n, L.LogicalExpand):
+            return [e for p in n.projections for e in p]
+        return []
+
+    def _tag_expressions(self) -> None:
+        for e in self._expressions():
+            self._tag_expr_tree(e)
+
+    def _tag_expr_tree(self, e: Expression) -> None:
+        name = type(e).__name__
+        rule = EXPR_RULES.get(name)
+        if rule is None:
+            self.will_not_work(f"expression {name} is not supported on TPU")
+        else:
+            if not self.conf.is_op_enabled(rule.conf_key):
+                self.will_not_work(f"{rule.conf_key} is false")
+            if rule.incompat and not self.conf.incompatible_ops:
+                self.will_not_work(
+                    f"expression {name} is incompatible ({rule.note}); "
+                    f"set spark.rapids.tpu.sql.incompatibleOps.enabled=true")
+        for c in e.children:
+            self._tag_expr_tree(c)
+
+    def _tag_types(self) -> None:
+        try:
+            schema = self.node.schema()
+        except Exception as ex:   # unresolvable → planner cannot place it
+            self.will_not_work(f"schema resolution failed: {ex}")
+            return
+        name = self.node.name
+        sig = EXEC_SIGS.get(name, TS.ALL_BASIC)
+        for f in schema:
+            reason = sig.supports(f.dtype)
+            if reason:
+                self.will_not_work(f"column {f.name}: {reason}")
+
+    # ---- explain ----
+    def explain(self, mode: ExplainMode, indent: int = 0) -> str:
+        mark = "*" if self.can_run_on_tpu else "!"
+        line = "  " * indent + f"{mark}{self.node.name}"
+        if self.reasons and mode is not ExplainMode.NONE:
+            line += "  <-- cannot run on TPU because: " + \
+                "; ".join(self.reasons)
+        lines = [line]
+        for c in self.children:
+            show = mode is ExplainMode.ALL or not c.can_run_on_tpu or \
+                any(not cc.can_run_on_tpu for cc in _walk(c))
+            lines.append(c.explain(mode, indent + 1))
+        return "\n".join(lines)
+
+
+def _walk(meta: PlanMeta):
+    yield meta
+    for c in meta.children:
+        yield from _walk(c)
+
+
+EXEC_SIGS: Dict[str, TypeSig] = {
+    "Scan": TS.ALL_BASIC,
+    "Project": TS.ALL_BASIC,
+    "Filter": TS.ALL_BASIC,
+    "Aggregate": TS.GROUPABLE,
+    "Join": TS.ALL_BASIC,
+    "Sort": TS.ORDERABLE,
+    "Limit": TS.ALL_BASIC,
+    "Union": TS.ALL_BASIC,
+    "Range": TS.ALL_BASIC,
+    "Expand": TS.ALL_BASIC,
+    "Sample": TS.ALL_BASIC,
+}
+
+
+# ---------------------------------------------------------------------------
+# CPU fallback exec (interpreter island)
+# ---------------------------------------------------------------------------
+
+class CpuFallbackExec(LeafExec):
+    """Runs one logical node on the row interpreter; TPU children are
+    materialized through Arrow first (the C2R/R2C transition boundary —
+    reference: GpuColumnarToRowExec / GpuRowToColumnarExec)."""
+
+    def __init__(self, node: L.LogicalPlan, child_execs: List[Exec]):
+        super().__init__()
+        self.node = node
+        self.child_execs = child_execs
+        self._schema = node.schema()
+
+    @property
+    def name(self):
+        return f"CpuFallback[{self.node.name}]"
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self):
+        from ..exec.base import collect as collect_exec
+        from ..batch import from_arrow
+        spliced_children = []
+        for ce in self.child_execs:
+            tbl = collect_exec(ce)
+            spliced_children.append(
+                L.LogicalScan((), data=tbl, _schema=ce.output_schema))
+        node = _with_children(self.node, spliced_children)
+        result = Interpreter().execute(node)
+        if result.num_rows == 0:
+            from ..batch import empty_batch
+            yield empty_batch(self._schema)
+            return
+        batch, _ = from_arrow(result, schema=self._schema)
+        yield batch
+
+
+def _with_children(node: L.LogicalPlan, children) -> L.LogicalPlan:
+    import copy
+    n = copy.copy(node)
+    n.children = tuple(children)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Conversion (convertIfNeeded + transition insertion)
+# ---------------------------------------------------------------------------
+
+class Overrides:
+    """applyWithContext analogue: tag, then convert."""
+
+    def __init__(self, conf: Optional[RapidsTpuConf] = None):
+        self.conf = conf or RapidsTpuConf()
+
+    def plan(self, logical: L.LogicalPlan) -> Exec:
+        meta = PlanMeta(logical, self.conf)
+        meta.tag()
+        self.last_meta = meta
+        return self._convert(meta)
+
+    def explain(self, logical: L.LogicalPlan,
+                mode: ExplainMode = ExplainMode.ALL) -> str:
+        meta = PlanMeta(logical, self.conf)
+        meta.tag()
+        return meta.explain(mode)
+
+    # ------------------------------------------------------------------
+
+    def _convert(self, meta: PlanMeta) -> Exec:
+        children = [self._convert(c) for c in meta.children]
+        if not meta.can_run_on_tpu:
+            return CpuFallbackExec(meta.node, children)
+        return self._to_exec(meta.node, children)
+
+    def _shuffle_partitions(self) -> int:
+        from ..config import SHUFFLE_PARTITIONS
+        return self.conf.get(SHUFFLE_PARTITIONS.key)
+
+    def _to_exec(self, n: L.LogicalPlan, ch: List[Exec]) -> Exec:
+        if isinstance(n, L.LogicalScan):
+            if n.source is not None:
+                from ..io.scan import FileSourceScanExec
+                return FileSourceScanExec(n.source, n.num_slices)
+            return InMemoryScanExec(n.data, schema=n._schema,
+                                    num_slices=n.num_slices)
+        if isinstance(n, L.LogicalRange):
+            return RangeExec(n.start, n.end, n.step)
+        if isinstance(n, L.LogicalProject):
+            return ProjectExec(n.exprs, ch[0])
+        if isinstance(n, L.LogicalFilter):
+            return FilterExec(n.condition, ch[0])
+        if isinstance(n, L.LogicalLimit):
+            return GlobalLimitExec(n.limit, ch[0])
+        if isinstance(n, L.LogicalUnion):
+            return UnionExec(ch)
+        if isinstance(n, L.LogicalSample):
+            return SampleExec(n.fraction, n.seed, ch[0])
+        if isinstance(n, L.LogicalExpand):
+            return ExpandExec(n.projections, ch[0])
+        if isinstance(n, L.LogicalSort):
+            return SortExec(n.orders, ch[0], global_sort=n.global_sort)
+        if isinstance(n, L.LogicalAggregate):
+            return self._convert_aggregate(n, ch[0])
+        if isinstance(n, L.LogicalJoin):
+            return self._convert_join(n, ch)
+        raise NotImplementedError(type(n).__name__)
+
+    def _convert_aggregate(self, n: L.LogicalAggregate, child: Exec) -> Exec:
+        """Partial → hash exchange on keys → Final (the physical shape
+        Spark's planner gives the reference; SURVEY.md §3.3)."""
+        partial = HashAggregateExec(n.group_exprs, n.agg_exprs, child,
+                                    AggregateMode.PARTIAL)
+        if n.group_exprs and child.num_partitions > 1:
+            from ..expressions.base import col
+            key_cols = [col(f.name) for f in partial.key_fields]
+            ex = ShuffleExchangeExec(
+                HashPartitioning(key_cols, self._shuffle_partitions()),
+                partial)
+        elif child.num_partitions > 1:
+            ex = ShuffleExchangeExec(SinglePartitioning(), partial)
+        else:
+            ex = partial
+        return HashAggregateExec(n.group_exprs, n.agg_exprs, ex,
+                                 AggregateMode.FINAL)
+
+    def _convert_join(self, n: L.LogicalJoin, ch: List[Exec]) -> Exec:
+        if n.join_type is JoinType.CROSS or not n.left_keys:
+            return BroadcastNestedLoopJoinExec(
+                JoinType.CROSS if not n.left_keys else n.join_type,
+                ch[0], BroadcastExchangeExec(ch[1]), condition=n.condition)
+        # broadcast the build side (right); shuffled-hash selection by size
+        # statistics arrives with the CBO round
+        return HashJoinExec(n.left_keys, n.right_keys, n.join_type,
+                            ch[0], BroadcastExchangeExec(ch[1]),
+                            condition=n.condition)
+
+
+def plan_query(logical: L.LogicalPlan,
+               conf: Optional[RapidsTpuConf] = None) -> Exec:
+    return Overrides(conf).plan(logical)

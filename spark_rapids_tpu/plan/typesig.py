@@ -1,0 +1,65 @@
+"""TypeSig: per-operator supported-type signatures.
+
+Reference: sql-plugin/.../TypeChecks.scala:171 — the `TypeSig` algebra that
+gates every exec/expression rule and generates docs/supported_ops.md. Same
+role here: each rule declares what SQL types it supports; the planner tags
+a node off the TPU with a recorded reason when its types don't fit, instead
+of failing at runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import FrozenSet, Optional
+
+from ..types import SqlType, TypeKind
+
+
+@dataclass(frozen=True)
+class TypeSig:
+    kinds: FrozenSet[TypeKind] = frozenset()
+    max_decimal_precision: int = 18     # DECIMAL64 on device
+    max_string_bytes: int = 1 << 16     # padded-matrix budget
+    notes: str = ""
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(self.kinds | other.kinds,
+                       max(self.max_decimal_precision,
+                           other.max_decimal_precision),
+                       max(self.max_string_bytes, other.max_string_bytes))
+
+    def supports(self, t: SqlType) -> Optional[str]:
+        """None if supported, else the human-readable reason it is not."""
+        if t.kind not in self.kinds:
+            return f"{t} is not supported"
+        if t.kind is TypeKind.DECIMAL and \
+                t.precision > self.max_decimal_precision:
+            return (f"decimal precision {t.precision} exceeds device "
+                    f"DECIMAL64 limit {self.max_decimal_precision}")
+        if t.kind is TypeKind.STRING and t.max_len > self.max_string_bytes:
+            return (f"string max_len {t.max_len} exceeds device budget "
+                    f"{self.max_string_bytes}")
+        for c in t.children:
+            r = self.supports(c)
+            if r:
+                return r
+        return None
+
+
+def _sig(*kinds: TypeKind) -> TypeSig:
+    return TypeSig(frozenset(kinds))
+
+
+BOOLEAN = _sig(TypeKind.BOOLEAN)
+INTEGRAL = _sig(TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64)
+FP = _sig(TypeKind.FLOAT32, TypeKind.FLOAT64)
+DECIMAL_64 = _sig(TypeKind.DECIMAL)
+NUMERIC = INTEGRAL + FP + DECIMAL_64
+STRING = _sig(TypeKind.STRING)
+DATETIME = _sig(TypeKind.DATE, TypeKind.TIMESTAMP)
+NULL = _sig(TypeKind.NULL)
+ALL_BASIC = NUMERIC + BOOLEAN + STRING + DATETIME + NULL
+ORDERABLE = ALL_BASIC       # everything basic sorts via key normalization
+GROUPABLE = ALL_BASIC
+NESTED = _sig(TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP)
+NONE = TypeSig()

@@ -1,0 +1,1 @@
+"""Host-side utilities (scalar murmur3 for the CPU interpreter, etc.)."""

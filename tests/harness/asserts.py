@@ -79,3 +79,39 @@ def assert_tables_equal(actual: pa.Table, expected: pa.Table,
         f"{actual.column_names} vs {expected.column_names}"
     assert_rows_equal(rows_of(actual), rows_of(expected),
                       ignore_order=ignore_order, approx_float=approx_float)
+
+
+# ---------------------------------------------------------------------------
+# Planner-level differential asserts (reference: asserts.py:542
+# assert_gpu_and_cpu_are_equal_collect and :404 assert_gpu_fallback_collect)
+# ---------------------------------------------------------------------------
+
+def assert_tpu_and_cpu_are_equal_collect(df_fn, conf=None,
+                                         ignore_order=True,
+                                         approx_float=True):
+    """Run the same DataFrame lambda twice — TPU-planned and CPU-interpreted
+    — and compare collected results."""
+    from spark_rapids_tpu.plan import Session
+    cpu = Session({**(conf or {}), "spark.rapids.tpu.sql.enabled": False})
+    tpu = Session({**(conf or {}), "spark.rapids.tpu.sql.enabled": True})
+    expected = cpu.collect(df_fn())
+    actual = tpu.collect(df_fn())
+    assert_tables_equal(actual, expected, ignore_order=ignore_order,
+                        approx_float=approx_float)
+    return actual
+
+
+def assert_tpu_fallback_collect(df_fn, fallback_exec_substring, conf=None,
+                                ignore_order=True):
+    """Assert the query STILL returns CPU-equal results AND that the named
+    operator intentionally fell back to the CPU interpreter."""
+    from spark_rapids_tpu.plan import Session
+    cpu = Session({**(conf or {}), "spark.rapids.tpu.sql.enabled": False})
+    tpu = Session({**(conf or {}), "spark.rapids.tpu.sql.enabled": True})
+    expected = cpu.collect(df_fn())
+    actual = tpu.collect(df_fn())
+    assert_tables_equal(actual, expected, ignore_order=ignore_order)
+    fallen = tpu.fell_back()
+    assert any(fallback_exec_substring in n for n in fallen), \
+        f"expected fallback containing {fallback_exec_substring!r}, " \
+        f"got {fallen} in plan:\n{tpu.last_plan!r}"

@@ -1,97 +1,5 @@
-"""Pure-Python reimplementation of org.apache.spark.unsafe.hash.Murmur3_x86_32.
+"""Scalar Spark-compatible murmur3 oracle (re-exported from the package's
+host-side utils so the interpreter and the test harness share one copy)."""
 
-Independent scalar oracle for the vectorized jnp implementation in
-spark_rapids_tpu.expressions.hashing — faithful to the Java source
-(int32 wraparound, signed tail bytes, Spark's mix-every-tail-byte variant).
-"""
-
-M32 = 0xFFFFFFFF
-
-
-def _i32(x):
-    x &= M32
-    return x - (1 << 32) if x >= (1 << 31) else x
-
-
-def _rotl(x, r):
-    x &= M32
-    return ((x << r) | (x >> (32 - r))) & M32
-
-
-def _mix_k1(k1):
-    k1 = (k1 * 0xCC9E2D51) & M32
-    k1 = _rotl(k1, 15)
-    return (k1 * 0x1B873593) & M32
-
-
-def _mix_h1(h1, k1):
-    h1 ^= _mix_k1(k1)
-    h1 = _rotl(h1, 13)
-    return (h1 * 5 + 0xE6546B64) & M32
-
-
-def _fmix(h1, length):
-    h1 ^= length
-    h1 ^= h1 >> 16
-    h1 = (h1 * 0x85EBCA6B) & M32
-    h1 ^= h1 >> 13
-    h1 = (h1 * 0xC2B2AE35) & M32
-    h1 ^= h1 >> 16
-    return h1
-
-
-def hash_int(v: int, seed: int) -> int:
-    h1 = _mix_h1(seed & M32, v & M32)
-    return _i32(_fmix(h1, 4))
-
-
-def hash_long(v: int, seed: int) -> int:
-    v &= 0xFFFFFFFFFFFFFFFF
-    low = v & M32
-    high = (v >> 32) & M32
-    h1 = _mix_h1(seed & M32, low)
-    h1 = _mix_h1(h1, high)
-    return _i32(_fmix(h1, 8))
-
-
-def hash_bytes(data: bytes, seed: int) -> int:
-    """Spark's hashUnsafeBytes: 4-byte LE words, then per-byte tail mixing."""
-    h1 = seed & M32
-    n = len(data)
-    aligned = (n // 4) * 4
-    for i in range(0, aligned, 4):
-        word = int.from_bytes(data[i:i + 4], "little")
-        h1 = _mix_h1(h1, word)
-    for i in range(aligned, n):
-        b = data[i]
-        b = b - 256 if b >= 128 else b  # signed byte
-        h1 = _mix_h1(h1, b & M32)
-    return _i32(_fmix(h1, n))
-
-
-def spark_hash_row(values, types, seed: int = 42) -> int:
-    """Fold a row like Spark's Murmur3Hash expression (nulls skip)."""
-    import struct
-    h = seed
-    for v, t in zip(values, types):
-        if v is None:
-            continue
-        if t == "int":
-            h = hash_int(v, h)
-        elif t == "long":
-            h = hash_long(v, h)
-        elif t == "float":
-            if v == 0.0:
-                v = 0.0
-            h = hash_int(struct.unpack("<i", struct.pack("<f", v))[0], h)
-        elif t == "double":
-            if v == 0.0:
-                v = 0.0
-            h = hash_long(struct.unpack("<q", struct.pack("<d", v))[0], h)
-        elif t == "bool":
-            h = hash_int(1 if v else 0, h)
-        elif t == "string":
-            h = hash_bytes(v.encode("utf-8"), h)
-        else:
-            raise ValueError(t)
-    return h
+from spark_rapids_tpu.utils.murmur3 import (hash_bytes, hash_int, hash_long,
+                                            spark_hash_row)

@@ -1,0 +1,137 @@
+"""Planner tests: tag/convert/fallback/explain + end-to-end differential
+queries through Session (the reference's integration-test pattern)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.join import JoinType
+from spark_rapids_tpu.exec.sort import asc, desc
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import (Average, Count, Max,
+                                                     Min, Sum)
+from spark_rapids_tpu.expressions.math import Pow
+from spark_rapids_tpu.plan import ExplainMode, Session, table
+
+from harness.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                             assert_tpu_fallback_collect, rows_of)
+from harness.data_gen import (DoubleGen, IntegerGen, LongGen, StringGen,
+                              gen_table)
+
+
+T1 = gen_table([("k", IntegerGen(min_val=0, max_val=20)),
+                ("v", LongGen(min_val=-1000, max_val=1000)),
+                ("s", StringGen(max_len=8)),
+                ("d", DoubleGen(no_nans=True))], n=600, seed=70)
+T2 = gen_table([("k2", IntegerGen(min_val=0, max_val=25)),
+                ("w", LongGen(min_val=0, max_val=50))], n=300, seed=71)
+
+
+def test_project_filter_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(T1).where(col("v") > lit(0))
+        .select((col("v") + col("k")).alias("x"),
+                (col("v") % lit(7)).alias("m"),
+                col("s")))
+
+
+def test_aggregate_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(T1, num_slices=3).group_by("k")
+        .agg(Sum(col("v")).alias("s"), Count(col("v")).alias("c"),
+             Min(col("d")).alias("mn"), Max(col("d")).alias("mx"),
+             Average(col("v")).alias("a")))
+
+
+def test_global_aggregate_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(T1).agg(Sum(col("v")).alias("s"),
+                              Count().alias("n")))
+
+
+@pytest.mark.parametrize("how", [JoinType.INNER, JoinType.LEFT_OUTER,
+                                 JoinType.FULL_OUTER, JoinType.LEFT_SEMI,
+                                 JoinType.LEFT_ANTI])
+def test_join_differential(how):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(T1).join(table(T2), ["k"], ["k2"], how))
+
+
+def test_sort_limit_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(T1).order_by(asc(col("k")), desc(col("v"))).limit(50),
+        ignore_order=False)
+
+
+def test_union_differential():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(T2).union(table(T2)))
+
+
+def test_chained_query_differential():
+    def q():
+        j = table(T1).join(table(T2), ["k"], ["k2"], JoinType.INNER)
+        return (j.where(col("w") > lit(5))
+                 .group_by("k")
+                 .agg(Sum(col("v")).alias("sv"), Count().alias("n"))
+                 .order_by("k"))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+
+
+def test_incompat_op_falls_back():
+    # Pow is tagged incompat (XLA vs JVM ULPs); without incompatibleOps it
+    # must fall back to the CPU interpreter AND still be correct
+    assert_tpu_fallback_collect(
+        lambda: table(T1).select(Pow(col("d"), lit(2.0)).alias("p")),
+        "CpuFallback[Project]")
+
+
+def test_incompat_op_runs_when_enabled():
+    t = assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(T1).select(Pow(col("d"), lit(2.0)).alias("p")),
+        conf={"spark.rapids.tpu.sql.incompatibleOps.enabled": True})
+    assert t.num_rows == T1.num_rows
+
+
+def test_exec_disabled_by_conf_falls_back():
+    assert_tpu_fallback_collect(
+        lambda: table(T1).where(col("v") > lit(0)),
+        "CpuFallback[Filter]",
+        conf={"spark.rapids.tpu.sql.exec.Filter": False})
+
+
+def test_fallback_island_reads_tpu_children():
+    # Filter falls back but Project above it still runs on TPU
+    ses = Session({"spark.rapids.tpu.sql.exec.Filter": False})
+    df = table(T1).where(col("v") > lit(0)).select(
+        (col("v") * lit(2)).alias("x"))
+    got = ses.collect(df)
+    names = ses.executed_exec_names()
+    assert any("CpuFallback[Filter]" in n for n in names), names
+    assert "ProjectExec" in names, names
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False}).collect(df)
+    from harness.asserts import assert_tables_equal
+    assert_tables_equal(got, cpu)
+
+
+def test_explain_shows_reasons():
+    ses = Session({"spark.rapids.tpu.sql.exec.Filter": False})
+    out = ses.explain(table(T1).where(col("v") > lit(0)))
+    assert "!Filter" in out
+    assert "spark.rapids.tpu.sql.exec.Filter is false" in out
+    assert "*Scan" in out
+
+
+def test_explainonly_mode_runs_cpu_but_plans():
+    ses = Session({"spark.rapids.tpu.sql.mode": "explainonly"})
+    df = table(T1).where(col("v") > lit(0))
+    got = ses.collect(df)
+    assert ses.last_plan is not None   # planned
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False}).collect(df)
+    from harness.asserts import assert_tables_equal
+    assert_tables_equal(got, cpu)
+
+
+def test_expand_and_sample():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(T1).select(col("k"), col("v")).limit(100))
