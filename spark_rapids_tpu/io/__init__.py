@@ -1,0 +1,21 @@
+"""I/O layer: file sources, scan exec, writers.
+
+Reference: SURVEY.md §2.7/L4 — GpuParquetScan.scala:96 (three reader
+strategies PERFILE/COALESCING/MULTITHREADED with heuristic :276),
+GpuMultiFileReader.scala (shared thread pool :123, cloud prefetch reader
+:441), GpuOrcScan, GpuCSVScan, GpuJsonScan (text funnel
+GpuTextBasedPartitionReader.scala:203), writers GpuParquetFileFormat:163 /
+ColumnarOutputWriter:64.
+
+The host decode path rides pyarrow's C++ readers (the analogue of cudf's
+native file decoders — host-side here because the TPU has no device decode
+path; the H2D copy is the from_arrow boundary).
+"""
+
+from .source import FileSource
+from .parquet import ParquetSource, write_parquet
+from .csv import CsvSource, write_csv
+from .json import JsonSource
+from .scan import FileSourceScanExec, read_csv, read_json, read_parquet
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,87 @@
+"""CSV read/write.
+
+Reference: GpuCSVScan.scala + GpuTextBasedPartitionReader.scala:203 — raw
+line buffers shipped to the device and parsed by cudf's text kernels. On
+TPU there is no device text parser, so decode stays on the host C++ reader
+(pyarrow.csv) inside the shared multi-file thread pool; the H2D boundary
+carries already-columnar data. Schema handling mirrors the reference: an
+explicit schema drives typed parsing, headerless by default like Spark.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+
+from .. import types as T
+from ..batch import Schema
+from .source import FileSource
+
+
+class CsvSource(FileSource):
+    format_name = "csv"
+
+    def __init__(self, paths, schema: Optional[Schema] = None,
+                 header: bool = False, sep: str = ",",
+                 null_value: str = "", **kw):
+        self.header = header
+        self.sep = sep
+        self.null_value = null_value
+        self._user_schema = schema
+        super().__init__(paths, schema=None, **kw)
+        self._declared = schema
+
+    def _convert_options(self, arrow_schema: Optional[pa.Schema]):
+        return pacsv.ConvertOptions(
+            column_types=dict(zip(arrow_schema.names, arrow_schema.types))
+            if arrow_schema else None,
+            null_values=[self.null_value, "null", "NULL"],
+            strings_can_be_null=True)
+
+    def _read_options(self, names):
+        return pacsv.ReadOptions(
+            column_names=None if self.header else names,
+            autogenerate_column_names=False if (self.header or names)
+            else True)
+
+    def _arrow_schema(self) -> Optional[pa.Schema]:
+        if self._declared is None:
+            return None
+        return pa.schema([pa.field(f.name, T.to_arrow(f.dtype), f.nullable)
+                          for f in self._declared])
+
+    def infer_arrow_schema(self) -> pa.Schema:
+        s = self._arrow_schema()
+        if s is not None:
+            return s
+        t = pacsv.read_csv(
+            self.files[0],
+            read_options=self._read_options(None),
+            parse_options=pacsv.ParseOptions(delimiter=self.sep))
+        return t.schema
+
+    def read_file(self, path: str) -> pa.Table:
+        s = self._arrow_schema()
+        names = s.names if s is not None else None
+        t = pacsv.read_csv(
+            path,
+            read_options=self._read_options(names),
+            parse_options=pacsv.ParseOptions(delimiter=self.sep),
+            convert_options=self._convert_options(s))
+        if self.columns:
+            t = t.select(self.columns)
+        if self.predicate is not None:
+            from .parquet import expression_to_arrow_filter
+            filt = expression_to_arrow_filter(self.predicate)
+            if filt is not None:
+                t = t.filter(filt)
+        return t
+
+
+def write_csv(table: pa.Table, path: str, header: bool = True) -> None:
+    import os
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    pacsv.write_csv(table, path,
+                    pacsv.WriteOptions(include_header=header))

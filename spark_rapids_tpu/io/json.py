@@ -1,0 +1,43 @@
+"""JSON-lines read (reference: GpuJsonScan.scala via the same text-funnel
+as CSV; see csv.py for the host-decode rationale)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.json as pajson
+
+from .. import types as T
+from ..batch import Schema
+from .source import FileSource
+
+
+class JsonSource(FileSource):
+    format_name = "json"
+
+    def __init__(self, paths, schema: Optional[Schema] = None, **kw):
+        self._declared = schema
+        super().__init__(paths, schema=None, **kw)
+
+    def _parse_options(self):
+        if self._declared is None:
+            return pajson.ParseOptions()
+        s = pa.schema([pa.field(f.name, T.to_arrow(f.dtype), f.nullable)
+                       for f in self._declared])
+        return pajson.ParseOptions(explicit_schema=s)
+
+    def infer_arrow_schema(self) -> pa.Schema:
+        return pajson.read_json(self.files[0],
+                                parse_options=self._parse_options()).schema
+
+    def read_file(self, path: str) -> pa.Table:
+        t = pajson.read_json(path, parse_options=self._parse_options())
+        if self.columns:
+            t = t.select(self.columns)
+        if self.predicate is not None:
+            from .parquet import expression_to_arrow_filter
+            filt = expression_to_arrow_filter(self.predicate)
+            if filt is not None:
+                t = t.filter(filt)
+        return t

@@ -1,0 +1,115 @@
+"""Parquet read/write with predicate + projection pushdown.
+
+Reference: GpuParquetScan.scala:96 (footer parse + row-group filtering via
+JNI :539-597, rebase handling), GpuParquetFileFormat.scala:163 (writer).
+pyarrow.parquet plays the libcudf-decoder role; predicate pushdown converts
+our Expression tree to a pyarrow dataset filter so row groups are pruned in
+the C++ reader (the same row-group statistics filtering the reference's
+footer JNI does).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..expressions import base as EB
+from ..expressions import comparison as EC
+from ..expressions import boolean as EBOOL
+from ..expressions.base import Expression
+from .source import FileSource
+
+
+def expression_to_arrow_filter(e: Expression):
+    """Best-effort conversion of a predicate to a pyarrow compute
+    expression; returns None when any part is unconvertible (the scan then
+    filters post-read — pushdown is an optimization, never a semantics
+    change, same contract as the reference's footer filter)."""
+    import pyarrow.compute as pc
+    try:
+        return _convert(e, pc)
+    except (NotImplementedError, AttributeError):
+        return None
+
+
+def _convert(e: Expression, pc):
+    if isinstance(e, EB.UnresolvedColumn):
+        return pc.field(e.name)
+    if isinstance(e, EB.BoundReference):
+        return pc.field(e.name)
+    if isinstance(e, EB.Literal):
+        return pc.scalar(e.value)
+    if isinstance(e, EC.EqualTo):
+        return _convert(e.children[0], pc) == _convert(e.children[1], pc)
+    if isinstance(e, EC.LessThan):
+        return _convert(e.children[0], pc) < _convert(e.children[1], pc)
+    if isinstance(e, EC.LessThanOrEqual):
+        return _convert(e.children[0], pc) <= _convert(e.children[1], pc)
+    if isinstance(e, EC.GreaterThan):
+        return _convert(e.children[0], pc) > _convert(e.children[1], pc)
+    if isinstance(e, EC.GreaterThanOrEqual):
+        return _convert(e.children[0], pc) >= _convert(e.children[1], pc)
+    if isinstance(e, EC.Not):
+        return ~_convert(e.children[0], pc)
+    if isinstance(e, EC.IsNull):
+        return _convert(e.children[0], pc).is_null()
+    if isinstance(e, EC.IsNotNull):
+        return ~_convert(e.children[0], pc).is_null()
+    if isinstance(e, EBOOL.And):
+        return _convert(e.children[0], pc) & _convert(e.children[1], pc)
+    if isinstance(e, EBOOL.Or):
+        return _convert(e.children[0], pc) | _convert(e.children[1], pc)
+    if isinstance(e, EC.In):
+        col = _convert(e.children[0], pc)
+        vals = [c.value for c in e.children[1:]
+                if isinstance(c, EB.Literal)]
+        if len(vals) != len(e.children) - 1:
+            raise NotImplementedError
+        return col.isin(vals)
+    raise NotImplementedError(type(e).__name__)
+
+
+class ParquetSource(FileSource):
+    format_name = "parquet"
+
+    def infer_arrow_schema(self) -> pa.Schema:
+        return pq.read_schema(self.files[0])
+
+    def read_file(self, path: str) -> pa.Table:
+        filt = expression_to_arrow_filter(self.predicate) \
+            if self.predicate is not None else None
+        if filt is not None:
+            import pyarrow.dataset as ds
+            dataset = ds.dataset(path, format="parquet")
+            return dataset.to_table(columns=self.columns, filter=filt)
+        return pq.read_table(path, columns=self.columns)
+
+    def row_group_counts(self, path: str) -> List[int]:
+        f = pq.ParquetFile(path)
+        return [f.metadata.row_group(i).num_rows
+                for i in range(f.metadata.num_row_groups)]
+
+
+def write_parquet(table: pa.Table, path: str,
+                  compression: str = "snappy",
+                  row_group_rows: int = 1 << 20,
+                  partition_by: Optional[List[str]] = None) -> List[str]:
+    """Write a table (reference: GpuParquetFileFormat + partitioned
+    GpuFileFormatDataWriter). Returns written file paths."""
+    import os
+    if partition_by:
+        import pyarrow.dataset as ds
+        ds.write_dataset(table, path, format="parquet",
+                         partitioning=ds.partitioning(
+                             pa.schema([table.schema.field(c)
+                                        for c in partition_by]),
+                             flavor="hive"),
+                         existing_data_behavior="overwrite_or_ignore")
+        return [os.path.join(dp, f) for dp, _, fs in os.walk(path)
+                for f in fs if f.endswith(".parquet")]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    pq.write_table(table, path, compression=compression,
+                   row_group_size=row_group_rows)
+    return [path]

@@ -1,0 +1,74 @@
+"""File scan exec + user-facing read helpers.
+
+Reference: GpuFileSourceScanExec.scala:67 — files are split across
+partitions, each partition's reader streams host tables through the chosen
+strategy and lands device batches at the H2D boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from ..batch import ColumnarBatch, Schema, from_arrow
+from ..exec.base import LeafExec
+from .source import FileSource
+
+
+class FileSourceScanExec(LeafExec):
+    def __init__(self, source: FileSource, num_slices: int = 1):
+        super().__init__()
+        self.source = source
+        self._num_slices = max(1, min(num_slices, len(source.files)))
+        self._schema = source.schema()
+
+    @property
+    def name(self):
+        return f"FileSourceScanExec[{self.source.format_name}]"
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self._num_slices
+
+    def _files_for(self, p: int) -> List[str]:
+        return [f for i, f in enumerate(self.source.files)
+                if i % self._num_slices == p]
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        for host_table in self.source.read_split(self._files_for(p)):
+            batch, _ = from_arrow(host_table, schema=self._schema)
+            self.metrics["numOutputRows"].add(host_table.num_rows)
+            yield batch
+
+
+# ---------------------------------------------------------------------------
+# read API (session.read.parquet(...) analogue)
+# ---------------------------------------------------------------------------
+
+def read_parquet(paths, columns=None, predicate=None, num_slices: int = 1,
+                 **kw):
+    from ..plan.logical import DataFrame, LogicalScan
+    from .parquet import ParquetSource
+    src = ParquetSource(paths, columns=columns, predicate=predicate, **kw)
+    return DataFrame(LogicalScan((), source=src, _schema=src.schema(),
+                                 num_slices=num_slices))
+
+
+def read_csv(paths, schema=None, header: bool = False, sep: str = ",",
+             num_slices: int = 1, **kw):
+    from ..plan.logical import DataFrame, LogicalScan
+    from .csv import CsvSource
+    src = CsvSource(paths, schema=schema, header=header, sep=sep, **kw)
+    return DataFrame(LogicalScan((), source=src, _schema=src.schema(),
+                                 num_slices=num_slices))
+
+
+def read_json(paths, schema=None, num_slices: int = 1, **kw):
+    from ..plan.logical import DataFrame, LogicalScan
+    from .json import JsonSource
+    src = JsonSource(paths, schema=schema, **kw)
+    return DataFrame(LogicalScan((), source=src, _schema=src.schema(),
+                                 num_slices=num_slices))

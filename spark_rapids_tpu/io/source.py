@@ -1,0 +1,134 @@
+"""FileSource base: file listing, projection/predicate pushdown, reader
+strategies (reference: GpuMultiFileReader.scala / PartitionReaderFactory)."""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import enum
+import glob
+import os
+import threading
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+from ..batch import Schema, schema_from_arrow
+from ..expressions.base import Expression
+
+
+class ReaderType(enum.Enum):
+    PERFILE = "PERFILE"
+    COALESCING = "COALESCING"
+    MULTITHREADED = "MULTITHREADED"
+    AUTO = "AUTO"
+
+
+# Shared host decode pool (reference: MultiFileReaderThreadPool:123 — one
+# pool per executor shared by all multi-file readers).
+_POOL: Optional[cf.ThreadPoolExecutor] = None
+_POOL_LOCK = threading.Lock()
+
+
+def reader_pool(num_threads: int = 8) -> cf.ThreadPoolExecutor:
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = cf.ThreadPoolExecutor(
+                max_workers=num_threads, thread_name_prefix="multifile-read")
+        return _POOL
+
+
+def expand_paths(paths) -> List[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = str(p)
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if not f.startswith((".", "_")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+class FileSource:
+    """A format + file list + pushed-down projection/predicate."""
+
+    format_name = "file"
+
+    def __init__(self, paths, schema: Optional[Schema] = None,
+                 columns: Optional[List[str]] = None,
+                 predicate: Optional[Expression] = None,
+                 reader_type: ReaderType = ReaderType.AUTO,
+                 batch_rows: int = 1 << 20,
+                 num_threads: int = 8):
+        self.files = expand_paths(paths)
+        if not self.files:
+            raise FileNotFoundError(f"no files match {paths}")
+        self.columns = columns
+        self.predicate = predicate
+        self.reader_type = reader_type
+        self.batch_rows = batch_rows
+        self.num_threads = num_threads
+        self._schema = schema
+
+    # ---- format hooks ----
+    def infer_arrow_schema(self) -> pa.Schema:
+        raise NotImplementedError
+
+    def read_file(self, path: str) -> pa.Table:
+        """Decode one file with pushdown applied."""
+        raise NotImplementedError
+
+    # ---- shared machinery ----
+    def schema(self) -> Schema:
+        if self._schema is None:
+            s = self.infer_arrow_schema()
+            if self.columns:
+                s = pa.schema([s.field(c) for c in self.columns])
+            self._schema = schema_from_arrow(s)
+        return self._schema
+
+    def effective_reader(self) -> ReaderType:
+        if self.reader_type is not ReaderType.AUTO:
+            return self.reader_type
+        # heuristic (reference GpuParquetScan.scala:276): many small files →
+        # multithreaded prefetch; few files → coalescing
+        return ReaderType.MULTITHREADED if len(self.files) > 2 \
+            else ReaderType.COALESCING
+
+    def read_all(self) -> pa.Table:
+        tables = [self.read_file(f) for f in self.files]
+        return pa.concat_tables(tables) if tables else None
+
+    def read_split(self, files: Sequence[str]) -> Iterator[pa.Table]:
+        """Host-side table stream for a subset of files, by strategy."""
+        mode = self.effective_reader()
+        if mode is ReaderType.PERFILE:
+            for f in files:
+                yield self.read_file(f)
+        elif mode is ReaderType.COALESCING:
+            # decode all files of the split, concat, re-chunk to batch_rows
+            # (reference: coalescing reader assembles row groups before H2D)
+            tabs = [self.read_file(f) for f in files]
+            if not tabs:
+                return
+            t = pa.concat_tables(tabs)
+            for off in range(0, max(t.num_rows, 1), self.batch_rows):
+                yield t.slice(off, self.batch_rows)
+                if t.num_rows == 0:
+                    break
+        else:  # MULTITHREADED: pipelined background decode
+            pool = reader_pool(self.num_threads)
+            futures = [pool.submit(self.read_file, f) for f in files]
+            for fut in futures:
+                t = fut.result()
+                for off in range(0, max(t.num_rows, 1), self.batch_rows):
+                    yield t.slice(off, self.batch_rows)
+                    if t.num_rows == 0:
+                        break
