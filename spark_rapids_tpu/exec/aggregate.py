@@ -86,8 +86,14 @@ class HashAggregateExec(UnaryExec):
                         "Final-mode aggregate functions must be bound (or "
                         "the child chain must contain the Partial stage)")
                 self.aggs = list(src.aggs)
+            # keys are positional in the buffer layout — reference them by
+            # ordinal, never re-evaluate the original grouping expressions
+            # (they may be computed, e.g. group_by(year(col("d"))))
             nk = len(group_exprs)
-            self.group_exprs = bind_all(group_exprs, child_schema)
+            from ..expressions.base import BoundReference
+            self.group_exprs = [
+                BoundReference(i, f.dtype, f.nullable, f.name)
+                for i, f in enumerate(child_schema.fields[:nk])]
             self.key_fields = [Field(f.name, f.dtype, f.nullable)
                                for f in child_schema.fields[:nk]]
 

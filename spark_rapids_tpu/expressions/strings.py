@@ -1,0 +1,571 @@
+"""String expressions over fixed-width padded byte matrices.
+
+Reference: sql-plugin/.../sql/rapids/stringFunctions.scala (1,983 LoC —
+GpuSubstring, GpuUpper/Lower, GpuConcat, GpuStringTrim, GpuContains,
+GpuStartsWith/EndsWith, GpuLike, GpuStringRepeat, GpuLength…). cudf gets
+offsets+chars columns; here every string column is ``uint8[rows, max_len]``
+plus a length vector (types.py rationale), so the kernels below are pure
+rectangular VPU ops:
+
+- per-row byte COMPACTION (the substring/trim/replace workhorse) is a
+  cumsum-scatter along the byte axis — no Python, no dynamic shapes;
+- SEARCH (contains/starts/ends/locate/replace) is a shifted-window
+  all-equal reduction, vectorized over every (row, shift) pair at once.
+
+Unicode: lengths/substr index by CODEPOINT (UTF-8 lead-byte cumsum), like
+Spark. upper/lower map ASCII only — the full simple-case-mapping table is a
+planned lookup; non-ASCII case mapping is tagged incompat in the planner
+(the reference ships the same caveat for some locales).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import ColumnarBatch, DeviceColumn
+from ..types import SqlType, TypeKind
+from .base import EvalContext, Expression, and_validity
+
+
+def _is_lead(data: jnp.ndarray) -> jnp.ndarray:
+    """True for UTF-8 lead bytes (not 10xxxxxx continuations)."""
+    return (data & 0xC0) != 0x80
+
+
+def _char_count(col: DeviceColumn) -> jnp.ndarray:
+    ml = col.data.shape[1]
+    in_str = jnp.arange(ml)[None, :] < col.lengths[:, None]
+    return jnp.sum((_is_lead(col.data) & in_str).astype(jnp.int32), axis=1)
+
+
+def _compact_bytes(data: jnp.ndarray, keep: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Left-pack kept bytes per row; returns (packed, new_lengths)."""
+    n, ml = data.shape
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    flat_target = jnp.where(keep,
+                            jnp.arange(n)[:, None] * ml + pos,
+                            n * ml)
+    out = jnp.zeros(n * ml + 1, data.dtype).at[flat_target.reshape(-1)].set(
+        data.reshape(-1), mode="drop")[: n * ml].reshape(n, ml)
+    return out, jnp.sum(keep.astype(jnp.int32), axis=1)
+
+
+def _string_column(data, lengths, validity, max_len: int) -> DeviceColumn:
+    # zero bytes past each row's length (canonical padding)
+    mask = jnp.arange(data.shape[1])[None, :] < lengths[:, None]
+    data = jnp.where(mask & validity[:, None], data, 0)
+    lengths = jnp.where(validity, lengths, 0)
+    return DeviceColumn(data, validity, lengths, T.string(max_len))
+
+
+@dataclass(frozen=True, eq=False)
+class Length(Expression):
+    """char_length: CODEPOINTS, not bytes (Spark length)."""
+
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Length(c[0])
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        from .base import numeric_column
+        return numeric_column(_char_count(c), c.validity, T.INT32)
+
+
+@dataclass(frozen=True, eq=False)
+class Upper(Expression):
+    child: Expression
+    _upper = True
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return type(self)(c[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        d = c.data
+        if self._upper:
+            is_lo = (d >= ord("a")) & (d <= ord("z"))
+            out = jnp.where(is_lo, d - 32, d)
+        else:
+            is_up = (d >= ord("A")) & (d <= ord("Z"))
+            out = jnp.where(is_up, d + 32, d)
+        return DeviceColumn(out, c.validity, c.lengths, c.dtype)
+
+
+class Lower(Upper):
+    _upper = False
+
+
+@dataclass(frozen=True, eq=False)
+class Substring(Expression):
+    """substring(str, pos, len): 1-based, negative pos counts from the end,
+    pos=0 treated as 1 (Spark). Character-indexed."""
+
+    child: Expression
+    pos: Expression
+    length: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.child, self.pos) + (
+            (self.length,) if self.length is not None else ())
+
+    def with_children(self, c):
+        return Substring(c[0], c[1], c[2] if len(c) > 2 else None)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        p = self.pos.eval(batch, ctx)
+        parts = [c, p]
+        if self.length is not None:
+            ln = self.length.eval(batch, ctx)
+            parts.append(ln)
+            want = ln.data.astype(jnp.int32)
+        else:
+            want = jnp.full(c.capacity, 1 << 30, jnp.int32)
+        validity = and_validity(parts)
+        nchars = _char_count(c)
+        pos = p.data.astype(jnp.int32)
+        start = jnp.where(pos > 0, pos - 1,
+                          jnp.where(pos < 0, nchars + pos, 0))
+        start = jnp.maximum(start, jnp.where(pos < 0, 0, start))
+        start = jnp.where((pos < 0) & (nchars + pos < 0), nchars, start)
+        end = start + jnp.maximum(want, 0)
+        ml = c.data.shape[1]
+        in_str = jnp.arange(ml)[None, :] < c.lengths[:, None]
+        lead = _is_lead(c.data) & in_str
+        # char ordinal of each byte (0-based, continuation bytes inherit)
+        char_ix = jnp.cumsum(lead.astype(jnp.int32), axis=1) - 1
+        keep = in_str & (char_ix >= start[:, None]) & (char_ix < end[:, None])
+        data, lengths = _compact_bytes(c.data, keep)
+        return _string_column(data, lengths, validity, self.dtype.max_len)
+
+
+@dataclass(frozen=True, eq=False)
+class Concat(Expression):
+    """concat(s1, s2, ...): null if ANY input is null (Spark concat)."""
+
+    exprs: Tuple[Expression, ...]
+
+    @property
+    def children(self):
+        return self.exprs
+
+    def with_children(self, c):
+        return Concat(tuple(c))
+
+    @property
+    def dtype(self):
+        total = sum(e.dtype.max_len for e in self.exprs)
+        return T.string(max(total, 1))
+
+    def eval(self, batch, ctx=EvalContext()):
+        cols = [e.eval(batch, ctx) for e in self.exprs]
+        validity = and_validity(cols)
+        out_ml = self.dtype.max_len
+        n = batch.capacity
+        out = jnp.zeros((n, out_ml), jnp.uint8)
+        offset = jnp.zeros(n, jnp.int32)
+        flat = jnp.zeros(n * out_ml + 1, jnp.uint8)
+        for c in cols:
+            ml = c.data.shape[1]
+            in_str = jnp.arange(ml)[None, :] < c.lengths[:, None]
+            target = jnp.where(in_str,
+                               jnp.arange(n)[:, None] * out_ml
+                               + offset[:, None] + jnp.arange(ml)[None, :],
+                               n * out_ml)
+            flat = flat.at[target.reshape(-1)].set(c.data.reshape(-1),
+                                                   mode="drop")
+            offset = offset + c.lengths
+        out = flat[: n * out_ml].reshape(n, out_ml)
+        return _string_column(out, jnp.minimum(offset, out_ml), validity,
+                              out_ml)
+
+
+def _window_match(data: jnp.ndarray, lengths: jnp.ndarray,
+                  pat: bytes) -> jnp.ndarray:
+    """match[row, s] = pattern equals data[row, s:s+k] (k = len(pat))."""
+    n, ml = data.shape
+    k = len(pat)
+    if k == 0:
+        return jnp.arange(ml)[None, :] <= lengths[:, None]
+    if k > ml:
+        return jnp.zeros((n, ml), bool)
+    pat_a = jnp.asarray(bytearray(pat), jnp.uint8)
+    m = jnp.ones((n, ml), bool)
+    for j in range(k):
+        shifted = jnp.roll(data, -j, axis=1)
+        # positions where s+j < ml hold data[s+j]; beyond wraps — mask below
+        m = m & (shifted == pat_a[j])
+    valid_start = jnp.arange(ml)[None, :] + k <= lengths[:, None]
+    return m & valid_start
+
+
+@dataclass(frozen=True, eq=False)
+class StringPredicate(Expression):
+    """contains / startswith / endswith with a LITERAL pattern (the
+    reference requires literal right-hand sides too — GpuContains)."""
+
+    child: Expression
+    pattern: Expression        # must be a Literal string
+    op: str = "contains"       # contains | startswith | endswith
+
+    @property
+    def children(self):
+        return (self.child, self.pattern)
+
+    def with_children(self, c):
+        return StringPredicate(c[0], c[1], self.op)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def _pat(self) -> bytes:
+        from .base import Literal
+        assert isinstance(self.pattern, Literal), \
+            "string predicate pattern must be a literal"
+        return str(self.pattern.value).encode("utf-8")
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        p = self.pattern.eval(batch, ctx)
+        validity = c.validity & p.validity
+        pat = self._pat()
+        k = len(pat)
+        m = _window_match(c.data, c.lengths, pat)
+        if self.op == "contains":
+            r = jnp.any(m, axis=1) | (k == 0)
+        elif self.op == "startswith":
+            r = (m[:, 0] | (k == 0)) & (c.lengths >= k)
+        else:
+            idx = jnp.clip(c.lengths - k, 0, c.data.shape[1] - 1)
+            r = (jnp.take_along_axis(m, idx[:, None], axis=1)[:, 0]
+                 | (k == 0)) & (c.lengths >= k)
+        from .base import numeric_column
+        return numeric_column(r, validity, T.BOOLEAN)
+
+
+@dataclass(frozen=True, eq=False)
+class StringLocate(Expression):
+    """instr/locate: 1-based position of first occurrence, 0 if absent."""
+
+    child: Expression
+    pattern: Expression
+
+    @property
+    def children(self):
+        return (self.child, self.pattern)
+
+    def with_children(self, c):
+        return StringLocate(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .base import Literal, numeric_column
+        c = self.child.eval(batch, ctx)
+        p = self.pattern.eval(batch, ctx)
+        assert isinstance(self.pattern, Literal)
+        pat = str(self.pattern.value).encode("utf-8")
+        m = _window_match(c.data, c.lengths, pat)
+        ml = c.data.shape[1]
+        first = jnp.argmax(m, axis=1)
+        found = jnp.any(m, axis=1)
+        # byte position -> char position (count leads before it) + 1
+        lead = _is_lead(c.data)
+        char_before = jnp.cumsum(lead.astype(jnp.int32), axis=1)
+        pos = jnp.take_along_axis(char_before, first[:, None], axis=1)[:, 0]
+        r = jnp.where(found, pos, 0)
+        r = jnp.where(jnp.asarray(len(pat) == 0), 1, r)
+        return numeric_column(r.astype(jnp.int32),
+                              c.validity & p.validity, T.INT32)
+
+
+@dataclass(frozen=True, eq=False)
+class StringTrim(Expression):
+    """trim/ltrim/rtrim of ASCII spaces (Spark default trim set)."""
+
+    child: Expression
+    side: str = "both"    # both | leading | trailing
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return StringTrim(c[0], self.side)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        ml = c.data.shape[1]
+        in_str = jnp.arange(ml)[None, :] < c.lengths[:, None]
+        is_space = (c.data == 32) & in_str
+        nonspace = in_str & ~is_space
+        any_ns = jnp.any(nonspace, axis=1)
+        first_ns = jnp.argmax(nonspace, axis=1)
+        last_ns = ml - 1 - jnp.argmax(nonspace[:, ::-1], axis=1)
+        lo = jnp.where(any_ns, first_ns, 0) if self.side != "trailing" \
+            else jnp.zeros(batch.capacity, jnp.int32)
+        hi = jnp.where(any_ns, last_ns + 1, 0) if self.side != "leading" \
+            else c.lengths
+        hi = jnp.where(any_ns, hi, 0) if self.side == "leading" else hi
+        keep = in_str & (jnp.arange(ml)[None, :] >= lo[:, None]) & \
+            (jnp.arange(ml)[None, :] < hi[:, None])
+        data, lengths = _compact_bytes(c.data, keep)
+        return _string_column(data, lengths, c.validity, self.dtype.max_len)
+
+
+@dataclass(frozen=True, eq=False)
+class StringPad(Expression):
+    """lpad/rpad(str, len, pad): CHARACTER-counted (ASCII pad assumed)."""
+
+    child: Expression
+    target_len: Expression
+    pad: Expression
+    left: bool = True
+
+    @property
+    def children(self):
+        return (self.child, self.target_len, self.pad)
+
+    def with_children(self, c):
+        return StringPad(c[0], c[1], c[2], self.left)
+
+    @property
+    def dtype(self):
+        return T.string(max(self.child.dtype.max_len, 64))
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .base import Literal
+        c = self.child.eval(batch, ctx)
+        tl = self.target_len.eval(batch, ctx)
+        pd = self.pad.eval(batch, ctx)
+        validity = and_validity([c, tl, pd])
+        assert isinstance(self.pad, Literal)
+        pad_bytes = str(self.pad.value).encode("utf-8")
+        out_ml = self.dtype.max_len
+        n = batch.capacity
+        want = jnp.clip(tl.data.astype(jnp.int32), 0, out_ml)
+        cur = _char_count(c)  # == byte count for ASCII content
+        deficit = jnp.maximum(want - cur, 0)
+        deficit = jnp.where(jnp.asarray(len(pad_bytes) == 0), 0, deficit)
+        # truncation case: want < cur -> keep first `want` chars
+        ml = c.data.shape[1]
+        in_str = jnp.arange(ml)[None, :] < c.lengths[:, None]
+        lead = _is_lead(c.data) & in_str
+        char_ix = jnp.cumsum(lead.astype(jnp.int32), axis=1) - 1
+        keep = in_str & (char_ix < want[:, None])
+        body, body_len = _compact_bytes(c.data, keep)
+        if len(pad_bytes) == 0:
+            pad_row = jnp.zeros(out_ml, jnp.uint8)
+        else:
+            reps = -(-out_ml // len(pad_bytes))
+            pad_row = jnp.asarray(
+                bytearray((pad_bytes * reps)[:out_ml]), jnp.uint8)
+        total = jnp.minimum(body_len + deficit, out_ml)
+        j = jnp.arange(out_ml)[None, :]
+        wide_body = jnp.pad(body, ((0, 0), (0, max(out_ml - ml, 0))))
+        wide_body = wide_body[:, :out_ml]
+        pad_mat = jnp.broadcast_to(pad_row, (n, out_ml))
+        if self.left:
+            # pad occupies [0, deficit), body shifts right
+            from_body = j >= deficit[:, None]
+            body_g = jnp.take_along_axis(
+                wide_body, jnp.clip(j - deficit[:, None], 0, out_ml - 1),
+                axis=1)
+            out = jnp.where(from_body, body_g, pad_mat)
+        else:
+            in_body = j < body_len[:, None]
+            pad_g = jnp.take_along_axis(
+                pad_mat, jnp.clip(j - body_len[:, None], 0, out_ml - 1),
+                axis=1)
+            out = jnp.where(in_body, wide_body, pad_g)
+        return _string_column(out, total, validity, out_ml)
+
+
+@dataclass(frozen=True, eq=False)
+class StringRepeat(Expression):
+    child: Expression
+    times: Expression
+
+    @property
+    def children(self):
+        return (self.child, self.times)
+
+    def with_children(self, c):
+        return StringRepeat(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.string(max(self.child.dtype.max_len * 4, 64))
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        t = self.times.eval(batch, ctx)
+        validity = c.validity & t.validity
+        out_ml = self.dtype.max_len
+        n = batch.capacity
+        reps = jnp.clip(t.data.astype(jnp.int32), 0, out_ml)
+        total = jnp.minimum(c.lengths * reps, out_ml)
+        j = jnp.arange(out_ml)[None, :]
+        safe_len = jnp.maximum(c.lengths, 1)[:, None]
+        src = (j % safe_len).astype(jnp.int32)
+        ml = c.data.shape[1]
+        g = jnp.take_along_axis(
+            jnp.pad(c.data, ((0, 0), (0, max(out_ml - ml, 0)))),
+            jnp.clip(src, 0, out_ml - 1), axis=1)
+        out = jnp.where(j < total[:, None], g, 0)
+        return _string_column(out, total, validity, out_ml)
+
+
+@dataclass(frozen=True, eq=False)
+class StringReplace(Expression):
+    """replace(str, search, replace) with LITERAL search/replace
+    (reference: GpuStringReplace has the same literal restriction)."""
+
+    child: Expression
+    search: Expression
+    replacement: Expression
+
+    @property
+    def children(self):
+        return (self.child, self.search, self.replacement)
+
+    def with_children(self, c):
+        return StringReplace(c[0], c[1], c[2])
+
+    @property
+    def dtype(self):
+        return T.string(max(self.child.dtype.max_len * 2, 64))
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .base import Literal
+        c = self.child.eval(batch, ctx)
+        assert isinstance(self.search, Literal) and \
+            isinstance(self.replacement, Literal)
+        pat = str(self.search.value).encode("utf-8")
+        rep = str(self.replacement.value).encode("utf-8")
+        out_ml = self.dtype.max_len
+        n, ml = c.data.shape
+        if len(pat) == 0:
+            padded = jnp.pad(c.data, ((0, 0), (0, max(out_ml - ml, 0))))
+            return _string_column(padded[:, :out_ml],
+                                  jnp.minimum(c.lengths, out_ml),
+                                  c.validity, out_ml)
+        m = _window_match(c.data, c.lengths, pat)
+        k = len(pat)
+        # greedy left-to-right non-overlapping matches: a match at s is real
+        # iff no real match covers s. scan over byte positions.
+        def step(carry, s_col):
+            blocked_until, _ = carry
+            s, matched = s_col
+            real = matched & (s.astype(jnp.int32) >= blocked_until)
+            blocked_until = jnp.where(
+                real, (s + k).astype(jnp.int32), blocked_until)
+            return (blocked_until, real), real
+
+        ss = jnp.arange(ml, dtype=jnp.int32)
+        (_, _), reals = jax.lax.scan(
+            step, (jnp.zeros(n, jnp.int32), jnp.zeros(n, bool)),
+            (ss, m.T))
+        real = reals.T   # [n, ml] real match starts
+        # each byte is either copied (not inside any real match) or part of
+        # a match start (emits rep bytes)
+        inside = jnp.zeros((n, ml), bool)
+        cover = jnp.cumsum(real.astype(jnp.int32), axis=1) - \
+            jnp.cumsum(jnp.pad(real, ((0, 0), (k, 0)))[:, :ml].astype(
+                jnp.int32), axis=1)
+        inside = cover > 0
+        in_str = jnp.arange(ml)[None, :] < c.lengths[:, None]
+        # output length per row
+        n_matches = jnp.sum(real.astype(jnp.int32), axis=1)
+        out_len = jnp.minimum(c.lengths + n_matches * (len(rep) - k), out_ml)
+        # emit: for each byte position, its output offset
+        emit_copy = in_str & ~inside
+        unit = emit_copy.astype(jnp.int32) + real.astype(jnp.int32) * len(rep)
+        offs = jnp.cumsum(unit, axis=1) - unit
+        out = jnp.zeros(n * out_ml + 1, jnp.uint8)
+        # copied bytes
+        tgt = jnp.where(emit_copy & (offs < out_ml),
+                        jnp.arange(n)[:, None] * out_ml + offs, n * out_ml)
+        out = out.at[tgt.reshape(-1)].set(c.data.reshape(-1), mode="drop")
+        # replacement bytes
+        rep_a = jnp.asarray(bytearray(rep), jnp.uint8) if rep else None
+        for j in range(len(rep)):
+            tgt_j = jnp.where(real & (offs + j < out_ml),
+                              jnp.arange(n)[:, None] * out_ml + offs + j,
+                              n * out_ml)
+            out = out.at[tgt_j.reshape(-1)].set(rep_a[j], mode="drop")
+        out = out[: n * out_ml].reshape(n, out_ml)
+        return _string_column(out, out_len, c.validity, out_ml)
+
+
+def upper(e):
+    return Upper(e)
+
+
+def lower(e):
+    return Lower(e)
+
+
+def length(e):
+    return Length(e)
+
+
+def substring(e, pos, ln=None):
+    from .base import lit_if_needed
+    return Substring(e, lit_if_needed(pos),
+                     lit_if_needed(ln) if ln is not None else None)
+
+
+def concat(*es):
+    return Concat(tuple(es))
+
+
+def contains(e, pat):
+    from .base import lit_if_needed
+    return StringPredicate(e, lit_if_needed(pat), "contains")
+
+
+def startswith(e, pat):
+    from .base import lit_if_needed
+    return StringPredicate(e, lit_if_needed(pat), "startswith")
+
+
+def endswith(e, pat):
+    from .base import lit_if_needed
+    return StringPredicate(e, lit_if_needed(pat), "endswith")

@@ -380,6 +380,190 @@ class RowEvaluator:
         q = d.quantize(decimal.Decimal(1).scaleb(-e.scale), rounding=mode)
         return float(q) if isinstance(v, float) else int(q)
 
+    # ---- strings (independent str-based implementations) ----
+    def _eval_Length(self, e, row):
+        v = self.eval(e.children[0], row)
+        return None if v is None else len(v)
+
+    def _eval_Upper(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        return "".join(ch.upper() if "a" <= ch <= "z" else ch for ch in v)
+
+    def _eval_Lower(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        return "".join(ch.lower() if "A" <= ch <= "Z" else ch for ch in v)
+
+    def _eval_Substring(self, e, row):
+        v = self.eval(e.child, row)
+        p = self.eval(e.pos, row)
+        ln = self.eval(e.length, row) if e.length is not None else None
+        if v is None or p is None or (e.length is not None and ln is None):
+            return None
+        n = len(v)
+        if p > 0:
+            start = p - 1
+        elif p < 0:
+            start = max(n + p, 0) if n + p >= 0 else n
+        else:
+            start = 0
+        want = ln if ln is not None else n
+        if want < 0:
+            want = 0
+        return v[start: start + want]
+
+    def _eval_Concat(self, e, row):
+        parts = [self.eval(c, row) for c in e.children]
+        if any(p is None for p in parts):
+            return None
+        return "".join(parts)
+
+    def _eval_StringPredicate(self, e, row):
+        v = self.eval(e.child, row)
+        p = self.eval(e.pattern, row)
+        if v is None or p is None:
+            return None
+        if e.op == "contains":
+            return p in v
+        if e.op == "startswith":
+            return v.startswith(p)
+        return v.endswith(p)
+
+    def _eval_StringLocate(self, e, row):
+        v = self.eval(e.child, row)
+        p = self.eval(e.pattern, row)
+        if v is None or p is None:
+            return None
+        return v.find(p) + 1
+
+    def _eval_StringTrim(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        if e.side == "leading":
+            return v.lstrip(" ")
+        if e.side == "trailing":
+            return v.rstrip(" ")
+        return v.strip(" ")
+
+    def _eval_StringPad(self, e, row):
+        v = self.eval(e.child, row)
+        t = self.eval(e.target_len, row)
+        p = self.eval(e.pad, row)
+        if v is None or t is None or p is None:
+            return None
+        t = max(t, 0)
+        if len(v) >= t or not p:
+            return v[:t] if len(v) > t else v
+        fill = (p * t)[: t - len(v)]
+        return fill + v if e.left else v + fill
+
+    def _eval_StringRepeat(self, e, row):
+        v = self.eval(e.child, row)
+        t = self.eval(e.times, row)
+        if v is None or t is None:
+            return None
+        return v * max(t, 0)
+
+    def _eval_StringReplace(self, e, row):
+        v = self.eval(e.child, row)
+        s = self.eval(e.search, row)
+        r = self.eval(e.replacement, row)
+        if v is None or s is None or r is None:
+            return None
+        return v.replace(s, r) if s else v
+
+    # ---- datetime (independent: python datetime/calendar) ----
+    @staticmethod
+    def _epoch_for(v):
+        import datetime as dt
+        return dt.datetime(1970, 1, 1, tzinfo=v.tzinfo)
+
+    def _dt_days(self, v):
+        import datetime as dt
+        if isinstance(v, dt.datetime):
+            us = (v - self._epoch_for(v)) // dt.timedelta(microseconds=1)
+            return us // 86_400_000_000
+        return (v - dt.date(1970, 1, 1)).days
+
+    def _eval_ExtractDatePart(self, e, row):
+        import datetime as dt
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        p = e.part
+        if p in ("hour", "minute", "second"):
+            return {"hour": v.hour, "minute": v.minute,
+                    "second": v.second}[p]
+        d = v.date() if isinstance(v, dt.datetime) else v
+        if p == "year":
+            return d.year
+        if p == "month":
+            return d.month
+        if p == "day":
+            return d.day
+        if p == "quarter":
+            return (d.month - 1) // 3 + 1
+        if p == "dayofweek":
+            return d.isoweekday() % 7 + 1   # Sunday=1 … Saturday=7
+        if p == "dayofyear":
+            return d.timetuple().tm_yday
+        if p == "weekofyear":
+            return d.isocalendar()[1]
+        raise ValueError(p)
+
+    def _eval_DateAddSub(self, e, row):
+        import datetime as dt
+        v = self.eval(e.child, row)
+        n = self.eval(e.days, row)
+        if v is None or n is None:
+            return None
+        return v + dt.timedelta(days=-n if e.negate else n)
+
+    def _eval_DateDiff(self, e, row):
+        a = self.eval(e.end, row)
+        b = self.eval(e.start, row)
+        if a is None or b is None:
+            return None
+        return (a - b).days
+
+    def _eval_AddMonths(self, e, row):
+        import calendar
+        import datetime as dt
+        v = self.eval(e.child, row)
+        n = self.eval(e.months, row)
+        if v is None or n is None:
+            return None
+        total = v.year * 12 + (v.month - 1) + n
+        y, m = total // 12, total % 12 + 1
+        d = min(v.day, calendar.monthrange(y, m)[1])
+        return dt.date(y, m, d)
+
+    def _eval_LastDay(self, e, row):
+        import calendar
+        import datetime as dt
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        return dt.date(v.year, v.month,
+                       calendar.monthrange(v.year, v.month)[1])
+
+    def _eval_UnixTimestampConv(self, e, row):
+        import datetime as dt
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        if e.to_unix:
+            if isinstance(v, dt.datetime):
+                us = (v - self._epoch_for(v)) // dt.timedelta(
+                    microseconds=1)
+                return us // 1_000_000    # python floor div == device floor
+            return self._dt_days(v) * 86400
+        return dt.datetime(1970, 1, 1) + dt.timedelta(seconds=v)
+
     def _eval_Murmur3Hash(self, e, row):
         from ..utils.murmur3 import spark_hash_row
         vals = [self.eval(c, row) for c in e.exprs]

@@ -95,6 +95,15 @@ def _expr_rules() -> Dict[str, ExprRule]:
     r("Round", TS.NUMERIC)
     r("FloorCeil", TS.NUMERIC)
     r("Murmur3Hash", TS.ALL_BASIC)
+    # strings
+    for n in ("Length", "Upper", "Lower", "Substring", "Concat",
+              "StringPredicate", "StringLocate", "StringTrim", "StringPad",
+              "StringRepeat", "StringReplace"):
+        r(n, TS.ALL_BASIC)
+    # datetime
+    for n in ("ExtractDatePart", "DateAddSub", "DateDiff", "AddMonths",
+              "LastDay", "UnixTimestampConv"):
+        r(n, TS.DATETIME + TS.INTEGRAL)
     # aggregates
     for n in ("Count", "Min", "Max", "First", "Last"):
         r(n, TS.ALL_BASIC)

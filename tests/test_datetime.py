@@ -1,0 +1,71 @@
+"""Datetime expression differential tests (reference: date_time_test.py).
+Device civil-calendar math vs Python's datetime module oracle, including
+pre-1970 dates and pre-epoch timestamps."""
+
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.datetime import (AddMonths, DateAddSub,
+                                                   DateDiff, LastDay,
+                                                   UnixTimestampConv,
+                                                   add_months, date_add,
+                                                   date_sub, datediff,
+                                                   dayofmonth, dayofweek,
+                                                   dayofyear, hour, minute,
+                                                   month, quarter, second,
+                                                   weekofyear, year)
+from spark_rapids_tpu.plan import table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+from harness.data_gen import DateGen, IntegerGen, TimestampGen, gen_table
+
+DT = gen_table([("d", DateGen()), ("t", TimestampGen()),
+                ("n", IntegerGen(min_val=-500, max_val=500))],
+               n=500, seed=110)
+
+
+def _q(f):
+    assert_tpu_and_cpu_are_equal_collect(f)
+
+
+@pytest.mark.parametrize("fn", [year, month, dayofmonth, quarter, dayofweek,
+                                dayofyear, weekofyear])
+def test_date_parts(fn):
+    _q(lambda: table(DT).select(fn(col("d")).alias("p")))
+
+
+@pytest.mark.parametrize("fn", [year, month, dayofmonth, hour, minute,
+                                second])
+def test_timestamp_parts(fn):
+    _q(lambda: table(DT).select(fn(col("t")).alias("p")))
+
+
+def test_date_add_sub():
+    _q(lambda: table(DT).select(date_add(col("d"), col("n")).alias("a"),
+                                date_sub(col("d"), 30).alias("s")))
+
+
+def test_datediff():
+    _q(lambda: table(DT).select(
+        datediff(col("d"), date_add(col("d"), col("n"))).alias("dd")))
+
+
+def test_add_months_clamps():
+    _q(lambda: table(DT).select(add_months(col("d"), col("n")).alias("am"),
+                                add_months(col("d"), 1).alias("m1")))
+
+
+def test_last_day():
+    _q(lambda: table(DT).select(LastDay(col("d")).alias("ld")))
+
+
+def test_unix_timestamp():
+    _q(lambda: table(DT).select(
+        UnixTimestampConv(col("t")).alias("ut"),
+        UnixTimestampConv(col("d")).alias("ud")))
+
+
+def test_date_grouping_pipeline():
+    from spark_rapids_tpu.expressions.aggregates import Count
+    _q(lambda: table(DT).group_by(year(col("d")).alias("y"))
+       .agg(Count().alias("n")))

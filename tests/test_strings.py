@@ -1,0 +1,108 @@
+"""String expression differential tests (reference: string_test.py).
+
+Device kernels on padded byte matrices vs the independent str-based
+interpreter oracle, including UTF-8 multi-byte content where supported.
+"""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.strings import (Concat, Length, Lower,
+                                                  StringLocate, StringPad,
+                                                  StringPredicate,
+                                                  StringRepeat,
+                                                  StringReplace, StringTrim,
+                                                  Substring, Upper, concat,
+                                                  contains, endswith, length,
+                                                  lower, startswith,
+                                                  substring, upper)
+from spark_rapids_tpu.plan import table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+from harness.data_gen import IntegerGen, StringGen, gen_table
+
+ST = gen_table([("s", StringGen(max_len=12)),
+                ("t", StringGen(max_len=6, charset="abcAB  ")),
+                ("n", IntegerGen(min_val=-5, max_val=15))], n=400, seed=100)
+
+UNI = pa.table({"u": pa.array(["héllo", "wörld", "日本語テキスト", "", None,
+                               "mixed日本", "café au lait", "ASCII only",
+                               "ñandú", "ß"] * 10)})
+
+
+def _q(f):
+    assert_tpu_and_cpu_are_equal_collect(f)
+
+
+def test_length_ascii():
+    _q(lambda: table(ST).select(length(col("s")).alias("l")))
+
+
+def test_length_unicode_codepoints():
+    _q(lambda: table(UNI).select(length(col("u")).alias("l")))
+
+
+def test_upper_lower():
+    _q(lambda: table(ST).select(upper(col("s")).alias("u"),
+                                lower(col("s")).alias("lo")))
+
+
+@pytest.mark.parametrize("pos,ln", [(1, 3), (3, 100), (-4, 2), (0, 2),
+                                    (2, None), (-100, 5)])
+def test_substring(pos, ln):
+    _q(lambda: table(ST).select(substring(col("s"), pos, ln).alias("ss")))
+
+
+def test_substring_unicode():
+    _q(lambda: table(UNI).select(substring(col("u"), 2, 3).alias("ss")))
+
+
+def test_concat():
+    _q(lambda: table(ST).select(
+        concat(col("s"), lit("-"), col("t")).alias("c")))
+
+
+@pytest.mark.parametrize("pat", ["ab", "", "zz9", "a"])
+def test_contains_starts_ends(pat):
+    _q(lambda: table(ST).select(contains(col("t"), pat).alias("c"),
+                                startswith(col("t"), pat).alias("sw"),
+                                endswith(col("t"), pat).alias("ew")))
+
+
+def test_locate():
+    _q(lambda: table(ST).select(
+        StringLocate(col("t"), lit("b")).alias("p")))
+
+
+@pytest.mark.parametrize("side", ["both", "leading", "trailing"])
+def test_trim(side):
+    _q(lambda: table(ST).select(StringTrim(col("t"), side).alias("tr")))
+
+
+@pytest.mark.parametrize("left", [True, False])
+def test_pad(left):
+    _q(lambda: table(ST).select(
+        StringPad(col("t"), lit(8), lit("*"), left).alias("p")))
+
+
+def test_repeat():
+    _q(lambda: table(ST).select(
+        StringRepeat(col("t"), lit(3)).alias("r")))
+
+
+def test_replace():
+    _q(lambda: table(ST).select(
+        StringReplace(col("t"), lit("ab"), lit("XY")).alias("r")))
+
+
+def test_replace_shrinking():
+    _q(lambda: table(ST).select(
+        StringReplace(col("t"), lit("a"), lit("")).alias("r")))
+
+
+def test_string_filter_pipeline():
+    _q(lambda: table(ST)
+       .where(contains(col("s"), "a"))
+       .select(upper(col("s")).alias("u"), col("n")))
