@@ -1,0 +1,294 @@
+"""Window exec.
+
+Reference: sql-plugin/.../GpuWindowExec.scala:1876 (batched partitioned
+windows; running-window :1534; cached double-pass :1846). See
+expressions/window.py for the lowering strategy: one sort, then segmented
+scans — every window expression in the projection shares the same sorted
+layout and fuses into a single XLA computation per batch.
+
+Output = child columns + one column per window expression, in the child's
+original row order (results are scattered back through the sort
+permutation), matching Spark's WindowExec contract.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import ColumnarBatch, DeviceColumn, Field, Schema, bucket_capacity
+from ..expressions.base import Alias, EvalContext, Expression
+from ..expressions.window import (LagLead, NTile, Rank, RowNumber,
+                                  WindowAgg, WindowExpression, WindowFrame,
+                                  segmented_scan)
+from ..types import TypeKind
+from .base import Exec, UnaryExec
+from .common import adjacent_equal, concat_batches, gather_column, \
+    sort_operands
+
+
+def _unalias(e: Expression) -> Tuple[WindowExpression, str]:
+    if isinstance(e, Alias):
+        return e.child, e.name
+    return e, "window"
+
+
+class WindowExec(UnaryExec):
+    """All window expressions must share one WindowSpec (the planner splits
+    multi-spec projections into a chain of WindowExecs, like the reference's
+    GpuWindowExec partitioning of window ops)."""
+
+    def __init__(self, window_exprs: Sequence[Expression], child: Exec,
+                 ctx: Optional[EvalContext] = None):
+        super().__init__(child, ctx)
+        named = [_unalias(e) for e in window_exprs]
+        self.exprs = [w.bind(child.output_schema) for w, _ in named]
+        self.names = [n for _, n in named]
+        # Expression __eq__ builds comparison trees, so compare specs by repr
+        spec_keys = {(repr(w.spec.partition_keys), repr(w.spec.orders))
+                     for w in self.exprs}
+        if len(spec_keys) > 1:
+            raise ValueError("one WindowExec handles one partition/order "
+                             "spec; chain execs for multiple")
+        self.spec = self.exprs[0].spec
+        fields = list(child.output_schema.fields)
+        for w, n in zip(self.exprs, self.names):
+            fields.append(Field(n, w.dtype, w.nullable))
+        self._schema = Schema(fields)
+        self._kernel = jax.jit(self._window_kernel)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    # ------------------------------------------------------------------
+
+    def _window_kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+        cap = batch.capacity
+        spec = self.spec
+        live = batch.row_mask()
+        pkeys = [e.eval(batch, self.ctx) for e in spec.partition_keys]
+        okeys = [o.child.eval(batch, self.ctx) for o in spec.orders]
+
+        ops = sort_operands(
+            list(pkeys) + list(okeys),
+            [False] * len(pkeys) + [o.descending for o in spec.orders],
+            [True] * len(pkeys) + [o.effective_nulls_first
+                                   for o in spec.orders], live)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        perm = jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)[-1]
+
+        s_pkeys = [gather_column(c, perm) for c in pkeys]
+        s_okeys = [gather_column(c, perm) for c in okeys]
+        sorted_live = iota < batch.num_rows
+
+        if s_pkeys:
+            same_part = adjacent_equal(s_pkeys)
+        else:
+            same_part = jnp.concatenate(
+                [jnp.zeros(1, bool), jnp.ones(cap - 1, bool)])
+        head = sorted_live & ~same_part          # first row of each partition
+        tail = sorted_live & jnp.concatenate(
+            [~same_part[1:] | ~sorted_live[1:], jnp.ones(1, bool)])
+
+        # peer groups (ties on order keys) for RANGE frames / rank
+        if s_okeys:
+            same_peer = same_part & adjacent_equal(s_okeys)
+        else:
+            same_peer = same_part
+        peer_head = sorted_live & ~same_peer
+
+        out_cols = []
+        for w in self.exprs:
+            col = self._eval_window(w, batch, perm, head, tail, peer_head,
+                                    sorted_live, cap)
+            # scatter back to original row order
+            inv = jnp.zeros(cap, jnp.int32).at[perm].set(iota)
+            out_cols.append(gather_column(col, inv, batch.row_mask()))
+        return ColumnarBatch(batch.columns + tuple(out_cols), batch.num_rows)
+
+    # ------------------------------------------------------------------
+
+    def _eval_window(self, w: WindowExpression, batch, perm, head, tail,
+                     peer_head, live, cap: int) -> DeviceColumn:
+        fn = w.function
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        seg_start = segmented_scan(
+            jnp.where(head, iota, 0), head, jnp.maximum)
+        pos = iota - seg_start                      # 0-based row in partition
+
+        if isinstance(fn, RowNumber):
+            return DeviceColumn((pos + 1).astype(jnp.int32), live, None,
+                                T.INT32)
+        if isinstance(fn, Rank):
+            peer_start = segmented_scan(
+                jnp.where(peer_head, iota, 0), head, jnp.maximum)
+            if fn.dense:
+                v = segmented_scan(peer_head.astype(jnp.int32), head,
+                                   jnp.add)
+            else:
+                v = peer_start - seg_start + 1
+            return DeviceColumn(v.astype(jnp.int32), live, None, T.INT32)
+        if isinstance(fn, NTile):
+            seg_len = self._seg_len(head, tail, iota, cap)
+            b = jnp.int32(fn.buckets)
+            base, rem = seg_len // b, seg_len % b
+            cut = rem * (base + 1)
+            v = jnp.where(pos < cut, pos // jnp.maximum(base + 1, 1),
+                          rem + (pos - cut) // jnp.maximum(base, 1)) + 1
+            return DeviceColumn(v.astype(jnp.int32), live, None, T.INT32)
+        if isinstance(fn, LagLead):
+            src = fn.child.eval(batch, self.ctx)
+            s = gather_column(src, perm)
+            off = fn.offset if fn.is_lag else -fn.offset
+            shifted_ix = jnp.clip(iota - off, 0, cap - 1)
+            ok = (iota - off >= 0) & (iota - off < cap)
+            sv = gather_column(s, shifted_ix)
+            # same partition check: partition id = cumsum(head)
+            pid = jnp.cumsum(head.astype(jnp.int32))
+            same = ok & (jnp.take(pid, shifted_ix) == pid) & live
+            data = sv.data
+            validity = sv.validity & same
+            if fn.default is not None:
+                dcol = gather_column(
+                    fn.default.eval(batch, self.ctx), perm)
+                use_d = ~same & live
+                if s.lengths is not None:
+                    data = jnp.where(use_d[:, None], dcol.data, data)
+                    lengths = jnp.where(use_d, dcol.lengths, sv.lengths)
+                    validity = jnp.where(use_d, dcol.validity, validity)
+                    return DeviceColumn(data, validity & live, lengths,
+                                        fn.dtype)
+                data = jnp.where(use_d, dcol.data, data)
+                validity = jnp.where(use_d, dcol.validity, validity)
+            return DeviceColumn(data, validity & live, sv.lengths, fn.dtype)
+        if isinstance(fn, WindowAgg):
+            return self._eval_window_agg(fn, w.spec.frame, batch, perm,
+                                         head, tail, peer_head, live, cap)
+        raise NotImplementedError(type(fn).__name__)
+
+    def _seg_len(self, head, tail, iota, cap):
+        seg_start = segmented_scan(jnp.where(head, iota, 0), head,
+                                   jnp.maximum)
+        seg_end = segmented_scan(jnp.where(tail, iota, cap), tail,
+                                 jnp.minimum, reverse=True)
+        return seg_end - seg_start + 1
+
+    def _eval_window_agg(self, fn: WindowAgg, frame: WindowFrame, batch,
+                         perm, head, tail, peer_head, live, cap: int
+                         ) -> DeviceColumn:
+        from ..expressions.aggregates import (Average, Count, Max, Min, Sum)
+        agg = fn.agg
+        child_cols = [gather_column(c.eval(batch, self.ctx), perm)
+                      for c in agg.children]
+        col = child_cols[0] if child_cols else None
+        iota = jnp.arange(cap, dtype=jnp.int32)
+
+        if isinstance(agg, Count):
+            x = ((col.validity & live) if col is not None else live
+                 ).astype(jnp.int64)
+            out_t = T.INT64
+            v, valid = self._frame_reduce(x, jnp.add, jnp.int64(0), frame,
+                                          head, tail, peer_head, live, iota,
+                                          cap)
+            return DeviceColumn(v, live, None, out_t)
+        if isinstance(agg, (Sum, Average)):
+            acc_t = jnp.float64 if isinstance(agg, Average) or \
+                agg.dtype.kind in (TypeKind.FLOAT32, TypeKind.FLOAT64) \
+                else jnp.int64
+            ok = col.validity & live
+            x = jnp.where(ok, col.data, 0).astype(acc_t)
+            s, _ = self._frame_reduce(x, jnp.add, acc_t(0), frame, head,
+                                      tail, peer_head, live, iota, cap)
+            n, _ = self._frame_reduce(ok.astype(jnp.int64), jnp.add,
+                                      jnp.int64(0), frame, head, tail,
+                                      peer_head, live, iota, cap)
+            if isinstance(agg, Average):
+                v = s / jnp.maximum(n, 1).astype(jnp.float64)
+                return DeviceColumn(jnp.where(n > 0, v, 0.0),
+                                    (n > 0) & live, None, T.FLOAT64)
+            return DeviceColumn(s.astype(agg.dtype.storage_dtype),
+                                (n > 0) & live, None, agg.dtype)
+        if isinstance(agg, (Min, Max)):
+            is_min = isinstance(agg, Min)
+            ok = col.validity & live
+            if agg.dtype.kind is TypeKind.BOOLEAN:
+                fill = jnp.asarray(is_min, bool)
+                op = jnp.logical_and if is_min else jnp.logical_or
+                x = jnp.where(ok, col.data, fill)
+            elif agg.dtype.kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+                fill = jnp.asarray(jnp.inf if is_min else -jnp.inf,
+                                   col.data.dtype)
+                op = jnp.minimum if is_min else jnp.maximum
+                x = jnp.where(ok, col.data, fill)
+            else:
+                info = jnp.iinfo(col.data.dtype)
+                fill = jnp.asarray(info.max if is_min else info.min,
+                                   col.data.dtype)
+                op = jnp.minimum if is_min else jnp.maximum
+                x = jnp.where(ok, col.data, fill)
+            v, _ = self._frame_reduce(x, op, fill, frame, head, tail,
+                                      peer_head, live, iota, cap)
+            n, _ = self._frame_reduce(ok.astype(jnp.int64), jnp.add,
+                                      jnp.int64(0), frame, head, tail,
+                                      peer_head, live, iota, cap)
+            valid = (n > 0) & live
+            return DeviceColumn(jnp.where(valid, v, jnp.zeros_like(v)),
+                                valid, None, agg.dtype)
+        raise NotImplementedError(type(agg).__name__)
+
+    def _frame_reduce(self, x, op, identity, frame: WindowFrame, head, tail,
+                      peer_head, live, iota, cap):
+        """Reduce x over each row's frame; returns (values, None)."""
+        if frame.is_full_partition:
+            # segment total broadcast back: forward running to tail, gather
+            run = segmented_scan(x, head, op)
+            seg_end = segmented_scan(jnp.where(tail, iota, cap), tail,
+                                     jnp.minimum, reverse=True)
+            return jnp.take(run, jnp.clip(seg_end, 0, cap - 1)), None
+        if frame.is_running:
+            run = segmented_scan(x, head, op)
+            if frame.is_rows:
+                return run, None
+            # RANGE running: value at each row = running at its peer END
+            peer_tail = jnp.concatenate(
+                [peer_head[1:], jnp.ones(1, bool)]) | tail
+            pe = segmented_scan(jnp.where(peer_tail, iota, cap), peer_tail,
+                                jnp.minimum, reverse=True)
+            return jnp.take(run, jnp.clip(pe, 0, cap - 1)), None
+        if frame.start is None or frame.end is None:
+            # unbounded one side; compute via reverse running
+            if frame.start is None:
+                raise NotImplementedError("bounded-end unbounded-start")
+            rev = segmented_scan(x, tail, op, reverse=True)
+            if frame.is_rows and frame.start == 0:
+                return rev, None
+            raise NotImplementedError("general unbounded-following frames")
+        # bounded ROWS frame: static shift fold (small literal windows)
+        p, f = -frame.start, frame.end
+        pid = jnp.cumsum(head.astype(jnp.int32))
+        acc = jnp.full(x.shape, identity, x.dtype)
+        for o in range(-p, f + 1):
+            ix = jnp.clip(iota + o, 0, cap - 1)
+            ok = (iota + o >= 0) & (iota + o < cap)
+            same = ok & (jnp.take(pid, ix) == pid)
+            contrib = jnp.where(same, jnp.take(x, ix), identity)
+            acc = op(acc, contrib)
+        return acc, None
+
+    # ------------------------------------------------------------------
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        # windows need the whole partition in one batch (the planner hash-
+        # exchanges on partition keys first); concat this stream partition
+        batches = list(self.child.execute_partition(p))
+        if not batches:
+            return
+        if len(batches) == 1:
+            yield self._kernel(batches[0])
+            return
+        cap = bucket_capacity(sum(b.capacity for b in batches))
+        yield self._kernel(concat_batches(batches, cap))

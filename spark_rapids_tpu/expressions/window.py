@@ -1,0 +1,228 @@
+"""Window specifications and functions.
+
+Reference: sql-plugin/.../GpuWindowExpression.scala:173 (frame specs),
+GpuWindowExec.scala (running-window :1534 and double-pass :1846
+optimizations). cudf executes windows with rolling kernels; the TPU
+re-design keeps ONE sorted layout per batch (partition keys, then order
+keys — the same device sort the aggregate uses) and lowers every window
+shape to segmented scans/reductions:
+
+- unbounded-preceding→current  : segmented inclusive scan (associative_scan
+  with reset flags) — the reference's "running window" special case is the
+  DEFAULT here, no separate exec needed;
+- unbounded↔unbounded          : segment reduce + gather-back;
+- bounded ROWS frames          : static shift-folds (window widths are
+  almost always small literals, so the fold unrolls at trace time);
+- RANGE frames                 : running value gathered at each row's peer-
+  group end (Spark ties semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..types import SqlType, TypeKind
+from .base import Expression
+
+UNBOUNDED = None
+CURRENT_ROW = 0
+
+
+@dataclass(frozen=True)
+class WindowFrame:
+    """ROWS or RANGE frame; bounds in Spark terms: negative=preceding,
+    None=unbounded on that side."""
+
+    is_rows: bool = False
+    start: Optional[int] = None   # None = UNBOUNDED PRECEDING
+    end: Optional[int] = 0        # 0 = CURRENT ROW; None = UNBOUNDED FOLLOWING
+
+    @property
+    def is_running(self) -> bool:
+        return self.start is None and self.end == 0
+
+    @property
+    def is_full_partition(self) -> bool:
+        return self.start is None and self.end is None
+
+
+DEFAULT_FRAME = WindowFrame(is_rows=False, start=None, end=0)
+FULL_FRAME = WindowFrame(is_rows=False, start=None, end=None)
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    partition_keys: Tuple[Expression, ...] = ()
+    orders: Tuple = ()          # SortOrder tuple
+    frame: WindowFrame = DEFAULT_FRAME
+
+    def bind(self, schema) -> "WindowSpec":
+        return WindowSpec(
+            tuple(e.bind(schema) for e in self.partition_keys),
+            tuple(o.bind(schema) for o in self.orders),
+            self.frame)
+
+
+@dataclass(frozen=True, eq=False)
+class WindowFunction(Expression):
+    """Marker base; evaluated by WindowExec, not columnarEval."""
+
+    @property
+    def needs_order(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True, eq=False)
+class RowNumber(WindowFunction):
+    @property
+    def dtype(self):
+        return T.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def needs_order(self):
+        return True
+
+
+@dataclass(frozen=True, eq=False)
+class Rank(WindowFunction):
+    dense: bool = False
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def needs_order(self):
+        return True
+
+
+@dataclass(frozen=True, eq=False)
+class NTile(WindowFunction):
+    buckets: int = 1
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def needs_order(self):
+        return True
+
+
+@dataclass(frozen=True, eq=False)
+class LagLead(WindowFunction):
+    child: Expression = None
+    offset: int = 1
+    default: Optional[Expression] = None
+    is_lag: bool = True
+
+    @property
+    def children(self):
+        return (self.child,) + ((self.default,) if self.default is not None
+                                else ())
+
+    def with_children(self, c):
+        return LagLead(c[0], self.offset,
+                       c[1] if len(c) > 1 else None, self.is_lag)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def needs_order(self):
+        return True
+
+
+@dataclass(frozen=True, eq=False)
+class WindowAgg(WindowFunction):
+    """An aggregate function evaluated over the window frame."""
+
+    agg: Expression = None     # AggregateFunction (Sum/Min/Max/Count/Average)
+
+    @property
+    def children(self):
+        return self.agg.children
+
+    def with_children(self, c):
+        return WindowAgg(self.agg.with_children(c))
+
+    def bind(self, schema):
+        return WindowAgg(self.agg.bind(schema))
+
+    @property
+    def dtype(self):
+        return self.agg.dtype
+
+    @property
+    def nullable(self):
+        return self.agg.nullable
+
+
+@dataclass(frozen=True, eq=False)
+class WindowExpression(Expression):
+    """function OVER spec, aliased into a projection by WindowExec."""
+
+    function: WindowFunction = None
+    spec: WindowSpec = WindowSpec()
+
+    @property
+    def children(self):
+        return (self.function,)
+
+    def bind(self, schema):
+        f = self.function
+        if f.children:
+            f = f.bind(schema) if isinstance(f, WindowAgg) else \
+                f.with_children([c.bind(schema) for c in f.children])
+        return WindowExpression(f, self.spec.bind(schema))
+
+    @property
+    def dtype(self):
+        return self.function.dtype
+
+    @property
+    def nullable(self):
+        return self.function.nullable
+
+
+def over(fn: WindowFunction, partition_by: Sequence[Expression] = (),
+         order_by: Sequence = (), frame: Optional[WindowFrame] = None
+         ) -> WindowExpression:
+    if frame is None:
+        frame = DEFAULT_FRAME if order_by else FULL_FRAME
+    return WindowExpression(fn, WindowSpec(tuple(partition_by),
+                                           tuple(order_by), frame))
+
+
+# ---------------------------------------------------------------------------
+# Segmented-scan primitives used by WindowExec
+# ---------------------------------------------------------------------------
+
+def segmented_scan(x: jnp.ndarray, head: jnp.ndarray, op, reverse=False):
+    """Inclusive segmented scan: resets at rows where head is True."""
+
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, op(av, bv))
+
+    f, v = jax.lax.associative_scan(combine, (head, x), reverse=reverse)
+    return v

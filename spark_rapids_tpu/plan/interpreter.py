@@ -760,6 +760,107 @@ class Interpreter:
             return math.sqrt(var) if name.startswith("Stddev") else var
         raise NotImplementedError(f"CPU interpreter aggregate {name}")
 
+    def _exec_LogicalWindow(self, p):
+        from ..expressions.base import Alias
+        child = p.children[0]
+        rows = self._exec(child)
+        schema = child.schema()
+        ev = RowEvaluator(schema)
+        all_vals = []
+        for e in p.window_exprs:
+            w = (e.child if isinstance(e, Alias) else e).bind(schema)
+            all_vals.append(self._window_values(w, rows, ev))
+        return [r + tuple(vals[i] for vals in all_vals)
+                for i, r in enumerate(rows)]
+
+    def _window_values(self, w, rows, ev):
+        from ..expressions.window import (LagLead, NTile, Rank, RowNumber,
+                                          WindowAgg)
+        spec = w.spec
+        n = len(rows)
+
+        def okey(i):
+            parts = []
+            for o in spec.orders:
+                v = ev.eval(o.child, rows[i])
+                nf = o.effective_nulls_first
+                if v is None:
+                    parts.append((0 if nf else 2, ()))
+                else:
+                    k = RowEvaluator._ordkey(v)
+                    parts.append((1, _NegKey(k)) if o.descending else (1, k))
+            return tuple(parts)
+
+        def pkey(i):
+            out = []
+            for e in spec.partition_keys:
+                v = ev.eval(e, rows[i])
+                out.append((1, RowEvaluator._ordkey(v)) if v is not None
+                           else (0, ()))
+            return tuple(out)
+
+        order = sorted(range(n), key=lambda i: (pkey(i), okey(i)))
+        # group contiguous equal partition keys
+        parts = []
+        for i in order:
+            if parts and pkey(parts[-1][0]) == pkey(i):
+                parts[-1].append(i)
+            else:
+                parts.append([i])
+
+        out = [None] * n
+        fn = w.function
+        frame = spec.frame
+        for part in parts:
+            m = len(part)
+            okeys = [okey(i) for i in part]
+            if isinstance(fn, RowNumber):
+                for j, i in enumerate(part):
+                    out[i] = j + 1
+            elif isinstance(fn, Rank):
+                rank = 0
+                dense = 0
+                for j, i in enumerate(part):
+                    if j == 0 or okeys[j] != okeys[j - 1]:
+                        rank = j + 1
+                        dense += 1
+                    out[i] = dense if fn.dense else rank
+            elif isinstance(fn, NTile):
+                b = fn.buckets
+                base, rem = m // b, m % b
+                cut = rem * (base + 1)
+                for j, i in enumerate(part):
+                    out[i] = (j // (base + 1) if j < cut
+                              else rem + (j - cut) // max(base, 1)) + 1
+            elif isinstance(fn, LagLead):
+                for j, i in enumerate(part):
+                    src = j - fn.offset if fn.is_lag else j + fn.offset
+                    if 0 <= src < m:
+                        out[i] = ev.eval(fn.child, rows[part[src]])
+                    elif fn.default is not None:
+                        out[i] = ev.eval(fn.default, rows[i])
+                    else:
+                        out[i] = None
+            elif isinstance(fn, WindowAgg):
+                for j, i in enumerate(part):
+                    if frame.is_full_partition:
+                        lo, hi = 0, m - 1
+                    elif frame.is_running and not frame.is_rows:
+                        lo = 0
+                        hi = j
+                        while hi + 1 < m and okeys[hi + 1] == okeys[j]:
+                            hi += 1
+                    elif frame.is_rows:
+                        lo = 0 if frame.start is None else j + frame.start
+                        hi = m - 1 if frame.end is None else j + frame.end
+                        lo, hi = max(lo, 0), min(hi, m - 1)
+                    else:
+                        raise NotImplementedError("bounded RANGE frame")
+                    grp = [rows[part[x]] for x in range(lo, hi + 1)] \
+                        if lo <= hi else []
+                    out[i] = self._agg_value(fn.agg, grp, ev)
+        return out
+
     def _exec_LogicalJoin(self, p):
         lc, rc = p.children
         lrows, rrows = self._exec(lc), self._exec(rc)

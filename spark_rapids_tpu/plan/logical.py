@@ -152,6 +152,22 @@ class LogicalExpand(LogicalPlan):
 
 
 @dataclass
+class LogicalWindow(LogicalPlan):
+    window_exprs: Sequence[Expression] = ()   # WindowExpression or Alias
+
+    def schema(self) -> Schema:
+        from ..exec.basic import output_name
+        child_schema = self.children[0].schema()
+        fields = list(child_schema.fields)
+        for i, e in enumerate(self.window_exprs):
+            w = e.child if isinstance(e, Alias) else e
+            name = e.name if isinstance(e, Alias) else f"window{i}"
+            b = w.bind(child_schema)
+            fields.append(SField(name, b.dtype, b.nullable))
+        return Schema(fields)
+
+
+@dataclass
 class LogicalSample(LogicalPlan):
     fraction: float = 0.1
     seed: int = 0
@@ -206,6 +222,10 @@ class DataFrame:
 
     def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
         return DataFrame(LogicalSample((self.plan,), fraction, seed))
+
+    def window(self, *window_exprs) -> "DataFrame":
+        """Append window-function columns (select(fn.over(...)) analogue)."""
+        return DataFrame(LogicalWindow((self.plan,), list(window_exprs)))
 
     def schema(self) -> Schema:
         return self.plan.schema()

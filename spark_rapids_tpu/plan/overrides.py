@@ -104,6 +104,10 @@ def _expr_rules() -> Dict[str, ExprRule]:
     for n in ("ExtractDatePart", "DateAddSub", "DateDiff", "AddMonths",
               "LastDay", "UnixTimestampConv"):
         r(n, TS.DATETIME + TS.INTEGRAL)
+    # window
+    for n in ("WindowExpression", "RowNumber", "Rank", "NTile", "LagLead",
+              "WindowAgg"):
+        r(n, TS.ALL_BASIC)
     # aggregates
     for n in ("Count", "Min", "Max", "First", "Last"):
         r(n, TS.ALL_BASIC)
@@ -166,6 +170,8 @@ class PlanMeta:
             return [o.child for o in n.orders]
         if isinstance(n, L.LogicalExpand):
             return [e for p in n.projections for e in p]
+        if isinstance(n, L.LogicalWindow):
+            return list(n.window_exprs)
         return []
 
     def _tag_expressions(self) -> None:
@@ -233,6 +239,7 @@ EXEC_SIGS: Dict[str, TypeSig] = {
     "Range": TS.ALL_BASIC,
     "Expand": TS.ALL_BASIC,
     "Sample": TS.ALL_BASIC,
+    "Window": TS.ALL_BASIC,
 }
 
 
@@ -341,6 +348,8 @@ class Overrides:
             return ExpandExec(n.projections, ch[0])
         if isinstance(n, L.LogicalSort):
             return SortExec(n.orders, ch[0], global_sort=n.global_sort)
+        if isinstance(n, L.LogicalWindow):
+            return self._convert_window(n, ch[0])
         if isinstance(n, L.LogicalAggregate):
             return self._convert_aggregate(n, ch[0])
         if isinstance(n, L.LogicalJoin):
@@ -364,6 +373,20 @@ class Overrides:
             ex = partial
         return HashAggregateExec(n.group_exprs, n.agg_exprs, ex,
                                  AggregateMode.FINAL)
+
+    def _convert_window(self, n: L.LogicalWindow, child: Exec) -> Exec:
+        from ..exec.window import WindowExec
+        from ..expressions.window import WindowExpression
+        from ..expressions.base import Alias
+        first = n.window_exprs[0]
+        w = first.child if isinstance(first, Alias) else first
+        pkeys = list(w.spec.partition_keys)
+        if pkeys and child.num_partitions > 1:
+            child = ShuffleExchangeExec(
+                HashPartitioning(pkeys, self._shuffle_partitions()), child)
+        elif child.num_partitions > 1:
+            child = ShuffleExchangeExec(SinglePartitioning(), child)
+        return WindowExec(n.window_exprs, child)
 
     def _convert_join(self, n: L.LogicalJoin, ch: List[Exec]) -> Exec:
         if n.join_type is JoinType.CROSS or not n.left_keys:

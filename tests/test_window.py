@@ -1,0 +1,109 @@
+"""Window function differential tests (reference:
+window_function_test.py). Device segmented scans vs the row-wise oracle."""
+
+import pytest
+
+from spark_rapids_tpu.exec.sort import asc, desc
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import (Average, Count, Max,
+                                                     Min, Sum)
+from spark_rapids_tpu.expressions.window import (LagLead, NTile, Rank,
+                                                 RowNumber, WindowAgg,
+                                                 WindowFrame, over)
+from spark_rapids_tpu.plan import table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+from harness.data_gen import (DoubleGen, IntegerGen, LongGen, StringGen,
+                              gen_table)
+
+WT = gen_table([("k", IntegerGen(min_val=0, max_val=8)),
+                ("o", IntegerGen(min_val=0, max_val=50)),
+                ("v", LongGen(min_val=-100, max_val=100)),
+                ("d", DoubleGen(no_nans=True))], n=400, seed=120)
+
+
+def _q(f):
+    assert_tpu_and_cpu_are_equal_collect(f)
+
+
+def test_row_number():
+    _q(lambda: table(WT).window(
+        over(RowNumber(), [col("k")], [asc(col("o")), asc(col("v"))])
+        .alias("rn")))
+
+
+def test_rank_dense_rank():
+    _q(lambda: table(WT).window(
+        over(Rank(), [col("k")], [asc(col("o"))]).alias("r"),
+        over(Rank(dense=True), [col("k")], [asc(col("o"))]).alias("dr")))
+
+
+def test_ntile():
+    _q(lambda: table(WT).window(
+        over(NTile(4), [col("k")], [asc(col("o")), asc(col("v"))])
+        .alias("nt")))
+
+
+@pytest.mark.parametrize("is_lag,off", [(True, 1), (True, 3), (False, 1),
+                                        (False, 2)])
+def test_lag_lead(is_lag, off):
+    _q(lambda: table(WT).window(
+        over(LagLead(col("v"), off, None, is_lag), [col("k")],
+             [asc(col("o")), asc(col("v"))]).alias("x")))
+
+
+def test_lag_with_default():
+    _q(lambda: table(WT).window(
+        over(LagLead(col("v"), 2, lit(-999), True), [col("k")],
+             [asc(col("o")), asc(col("v"))]).alias("x")))
+
+
+def test_running_sum_range_ties():
+    # default RANGE frame: ties share the running value
+    _q(lambda: table(WT).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")], [asc(col("o"))])
+        .alias("rs")))
+
+
+def test_running_rows_frame():
+    _q(lambda: table(WT).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")],
+             [asc(col("o")), asc(col("v"))],
+             WindowFrame(is_rows=True, start=None, end=0)).alias("rs")))
+
+
+def test_full_partition_aggs():
+    _q(lambda: table(WT).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")]).alias("s"),
+        over(WindowAgg(Count(col("v"))), [col("k")]).alias("c"),
+        over(WindowAgg(Min(col("v"))), [col("k")]).alias("mn"),
+        over(WindowAgg(Max(col("v"))), [col("k")]).alias("mx"),
+        over(WindowAgg(Average(col("d"))), [col("k")]).alias("a")))
+
+
+@pytest.mark.parametrize("start,end", [(-2, 0), (-1, 1), (0, 2), (-3, -1)])
+def test_sliding_rows_frames(start, end):
+    _q(lambda: table(WT).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")],
+             [asc(col("o")), asc(col("v"))],
+             WindowFrame(is_rows=True, start=start, end=end)).alias("s"),
+        over(WindowAgg(Min(col("v"))), [col("k")],
+             [asc(col("o")), asc(col("v"))],
+             WindowFrame(is_rows=True, start=start, end=end)).alias("mn")))
+
+
+def test_window_no_partition():
+    _q(lambda: table(WT).window(
+        over(RowNumber(), [], [asc(col("o")), asc(col("v")),
+                               asc(col("k"))]).alias("rn")))
+
+
+def test_window_over_multislice_input():
+    _q(lambda: table(WT, num_slices=3).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")]).alias("s")))
+
+
+def test_window_then_filter():
+    _q(lambda: table(WT).window(
+        over(RowNumber(), [col("k")], [asc(col("o")), asc(col("v"))])
+        .alias("rn")).where(col("rn") <= lit(3)))
