@@ -218,12 +218,23 @@ class HashAggregateExec(UnaryExec):
     # ------------------------------------------------------------------
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        partials: List[ColumnarBatch] = []
+        # accumulated partials ride the spill catalog (reference:
+        # LazySpillableColumnarBatch deque in GpuHashAggregateIterator)
+        from ..memory import SpillableBatch, device_budget
+        cat = device_budget()
+        buf_schema = Schema(self.key_fields + self.buffer_fields)
+        spillables: List[SpillableBatch] = []
         for batch in self.child.execute_partition(p):
             if self.mode in (AggregateMode.PARTIAL, AggregateMode.COMPLETE):
-                partials.append(self._update_jit(batch))
+                part = self._update_jit(batch)
             else:
-                partials.append(batch)
+                part = batch
+            sb = SpillableBatch(cat, part, buf_schema)
+            sb.done_with()
+            spillables.append(sb)
+        partials: List[ColumnarBatch] = [sb.get() for sb in spillables]
+        for sb in spillables:
+            sb.done_with()
 
         finalize = self.mode in (AggregateMode.FINAL, AggregateMode.COMPLETE)
         if not partials:
@@ -235,6 +246,13 @@ class HashAggregateExec(UnaryExec):
                 yield out
             return
 
+        try:
+            yield from self._merge_and_emit(partials, finalize)
+        finally:
+            for sb in spillables:
+                sb.close()
+
+    def _merge_and_emit(self, partials, finalize):
         if len(partials) == 1:
             merged = partials[0]
         else:

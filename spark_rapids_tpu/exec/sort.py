@@ -90,24 +90,44 @@ class SortExec(UnaryExec):
         return 1 if self.global_sort else self.child.num_partitions
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        if self.global_sort:
-            batches = [b for cp in range(self.child.num_partitions)
-                       for b in self.child.execute_partition(cp)]
-        else:
-            batches = list(self.child.execute_partition(p))
-        if not batches:
-            return
-        if not self.global_sort or len(batches) == 1:
-            for b in batches:
+        if not self.global_sort:
+            for b in self.child.execute_partition(p):
                 yield self._sort_jit(b)
             return
-        total_cap = sum(b.capacity for b in batches)
-        if total_cap > self.max_rows:
-            raise MemoryError(
-                f"global sort of {total_cap} rows exceeds max_rows="
-                f"{self.max_rows}; out-of-core sort requires the spill tier")
-        merged = concat_batches(batches, bucket_capacity(total_cap))
-        yield self._sort_jit(merged)
+        # Global sort: accumulate input batches through the spill catalog so
+        # the accumulation phase cannot blow the device budget (reference:
+        # GpuOutOfCoreSortIterator spills pending batches; the final merge
+        # still materializes the full result — OOC chunked merge is the
+        # planned refinement).
+        from ..memory import SpillableBatch, device_budget
+        cat = device_budget()
+        spillables = []
+        schema = self.output_schema
+        for cp in range(self.child.num_partitions):
+            for b in self.child.execute_partition(cp):
+                sb = SpillableBatch(cat, b, schema)
+                sb.done_with()
+                spillables.append(sb)
+        if not spillables:
+            return
+        try:
+            if len(spillables) == 1:
+                yield self._sort_jit(spillables[0].get())
+                spillables[0].done_with()
+                return
+            batches = []
+            for sb in spillables:
+                batches.append(sb.get())
+            total_cap = sum(b.capacity for b in batches)
+            if total_cap > self.max_rows:
+                raise MemoryError(
+                    f"global sort of {total_cap} rows exceeds max_rows="
+                    f"{self.max_rows}")
+            merged = concat_batches(batches, bucket_capacity(total_cap))
+            yield self._sort_jit(merged)
+        finally:
+            for sb in spillables:
+                sb.close()
 
 
 class TakeOrderedAndProjectExec(UnaryExec):

@@ -1,0 +1,20 @@
+"""Memory runtime: admission control, HBM budgeting, tiered spill.
+
+Reference: SURVEY.md §2.3 — GpuSemaphore.scala:115 (N concurrent device
+tasks), RapidsBufferCatalog.scala:58 (handle registry), RapidsBufferStore
+tiers DEVICE/HOST/DISK (RapidsBuffer.scala:53), DeviceMemoryEventHandler
+(RMM alloc-failure → synchronous spill), SpillableColumnarBatch.
+
+The TPU twist (SURVEY.md §7 hard parts): there is no RMM-style allocator
+callback to trap — XLA owns HBM. So the design inverts: a RESERVATION
+budget sits above the runtime; operators reserve before materializing,
+and a failed reservation synchronously spills lower-priority registered
+buffers device→host→disk until the reservation fits. Same catalog/tier
+shape as the reference, pull- instead of push-triggered.
+"""
+
+from .semaphore import TpuSemaphore
+from .catalog import (BufferCatalog, SpillableBatch, StorageTier,
+                      device_budget)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,277 @@
+"""Tiered buffer catalog with reservation-triggered spill.
+
+Reference: RapidsBufferCatalog.scala:58,352 (handle registry, tier lookup,
+synchronousSpill), RapidsBufferStore.scala:42 (priority-ordered eviction),
+RapidsDeviceMemoryStore/HostMemoryStore/DiskStore, SpillableColumnarBatch
+(SpillableColumnarBatch.scala:28 — operators make held batches spillable
+between uses). GDS tier intentionally omitted (no TPU twin; SURVEY.md §2.9).
+
+Tiers:
+  DEVICE — live jax arrays (HBM via the runtime)
+  HOST   — numpy copies (device_get), bounded by host_limit
+  DISK   — .npz files under the spill dir
+
+Spill priority: smaller value spills FIRST (matches the reference's
+convention where active-use buffers get higher priority).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..batch import ColumnarBatch, DeviceColumn, Schema
+from .. import types as T
+
+
+class StorageTier(Enum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+class OutOfBudgetError(MemoryError):
+    pass
+
+
+@dataclass
+class _Entry:
+    handle_id: int
+    tier: StorageTier
+    size: int
+    priority: int
+    batch: Optional[ColumnarBatch] = None          # DEVICE
+    host: Optional[dict] = None                    # HOST: name -> np array
+    path: Optional[str] = None                     # DISK
+    schema: Optional[Schema] = None
+    pinned: int = 0
+
+
+class BufferCatalog:
+    def __init__(self, device_limit: int = 8 << 30,
+                 host_limit: int = 4 << 30,
+                 spill_dir: str = "/tmp/rapids_tpu_spill"):
+        self.device_limit = device_limit
+        self.host_limit = host_limit
+        self.spill_dir = spill_dir
+        self._entries: Dict[int, _Entry] = {}
+        self._next = 0
+        self._lock = threading.RLock()
+        self.device_used = 0
+        self.host_used = 0
+        self.spilled_to_host = 0
+        self.spilled_to_disk = 0
+
+    # ------------------------------------------------------------------
+    # registration / reservation
+    # ------------------------------------------------------------------
+
+    def register(self, batch: ColumnarBatch, schema: Schema,
+                 priority: int = 0) -> int:
+        size = batch.size_bytes()
+        with self._lock:
+            self.reserve(size)
+            hid = self._next
+            self._next += 1
+            self._entries[hid] = _Entry(hid, StorageTier.DEVICE, size,
+                                        priority, batch=batch, schema=schema)
+            return hid
+
+    def reserve(self, nbytes: int) -> None:
+        """Ensure nbytes of device budget, spilling if necessary
+        (reference: DeviceMemoryEventHandler.onAllocFailure, inverted)."""
+        with self._lock:
+            if self.device_used + nbytes <= self.device_limit:
+                self.device_used += nbytes
+                return
+            need = self.device_used + nbytes - self.device_limit
+            freed = self.synchronous_spill(need)
+            if self.device_used + nbytes > self.device_limit:
+                raise OutOfBudgetError(
+                    f"cannot reserve {nbytes}b: used {self.device_used}b of "
+                    f"{self.device_limit}b after spilling {freed}b")
+            self.device_used += nbytes
+
+    def unreserve(self, nbytes: int) -> None:
+        with self._lock:
+            self.device_used = max(0, self.device_used - nbytes)
+
+    # ------------------------------------------------------------------
+    # spill machinery
+    # ------------------------------------------------------------------
+
+    def synchronous_spill(self, need: int) -> int:
+        """Spill unpinned device buffers in priority order until `need`
+        bytes are freed (or no candidates remain). Returns bytes freed."""
+        freed = 0
+        with self._lock:
+            victims = sorted(
+                [e for e in self._entries.values()
+                 if e.tier is StorageTier.DEVICE and e.pinned == 0],
+                key=lambda e: e.priority)
+            for e in victims:
+                if freed >= need:
+                    break
+                self._spill_to_host(e)
+                freed += e.size
+        return freed
+
+    def _spill_to_host(self, e: _Entry) -> None:
+        host = {}
+        for i, c in enumerate(e.batch.columns):
+            host[f"d{i}"] = np.asarray(jax.device_get(c.data))
+            host[f"v{i}"] = np.asarray(jax.device_get(c.validity))
+            if c.lengths is not None:
+                host[f"l{i}"] = np.asarray(jax.device_get(c.lengths))
+        host["n"] = np.asarray(jax.device_get(e.batch.num_rows))
+        e.host = host
+        e.batch = None
+        e.tier = StorageTier.HOST
+        self.device_used = max(0, self.device_used - e.size)
+        self.host_used += e.size
+        self.spilled_to_host += e.size
+        if self.host_used > self.host_limit:
+            self._overflow_host_to_disk()
+
+    def _overflow_host_to_disk(self) -> None:
+        victims = sorted(
+            [e for e in self._entries.values()
+             if e.tier is StorageTier.HOST and e.pinned == 0],
+            key=lambda e: e.priority)
+        for e in victims:
+            if self.host_used <= self.host_limit:
+                break
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, f"buf-{e.handle_id}.npz")
+            np.savez(path, **e.host)
+            e.path = path
+            e.host = None
+            e.tier = StorageTier.DISK
+            self.host_used = max(0, self.host_used - e.size)
+            self.spilled_to_disk += e.size
+
+    # ------------------------------------------------------------------
+    # access
+    # ------------------------------------------------------------------
+
+    def acquire(self, hid: int) -> ColumnarBatch:
+        """Materialize a handle on device (unspilling as needed) and pin it."""
+        with self._lock:
+            e = self._entries[hid]
+            if e.tier is not StorageTier.DEVICE:
+                self.reserve(e.size)
+                if e.tier is StorageTier.DISK:
+                    data = np.load(e.path)
+                    e.host = {k: data[k] for k in data.files}
+                    os.remove(e.path)
+                    e.path = None
+                    e.tier = StorageTier.HOST
+                    self.host_used += e.size
+                e.batch = self._host_to_device(e)
+                self.host_used = max(0, self.host_used - e.size)
+                e.host = None
+                e.tier = StorageTier.DEVICE
+            e.pinned += 1
+            return e.batch
+
+    def _host_to_device(self, e: _Entry) -> ColumnarBatch:
+        import jax.numpy as jnp
+        cols = []
+        for i, f in enumerate(e.schema):
+            lengths = jnp.asarray(e.host[f"l{i}"]) if f"l{i}" in e.host \
+                else None
+            cols.append(DeviceColumn(jnp.asarray(e.host[f"d{i}"]),
+                                     jnp.asarray(e.host[f"v{i}"]),
+                                     lengths, f.dtype))
+        return ColumnarBatch(tuple(cols),
+                             jnp.asarray(e.host["n"], jnp.int32))
+
+    def release(self, hid: int) -> None:
+        with self._lock:
+            e = self._entries[hid]
+            e.pinned = max(0, e.pinned - 1)
+
+    def remove(self, hid: int) -> None:
+        with self._lock:
+            e = self._entries.pop(hid, None)
+            if e is None:
+                return
+            if e.tier is StorageTier.DEVICE:
+                self.device_used = max(0, self.device_used - e.size)
+            elif e.tier is StorageTier.HOST:
+                self.host_used = max(0, self.host_used - e.size)
+            elif e.path:
+                try:
+                    os.remove(e.path)
+                except OSError:
+                    pass
+
+    def tier_of(self, hid: int) -> StorageTier:
+        return self._entries[hid].tier
+
+    def dump_state(self) -> str:
+        """OOM diagnostics (reference: spark.rapids.memory.gpu.oomDumpDir)."""
+        with self._lock:
+            lines = [f"device_used={self.device_used} "
+                     f"host_used={self.host_used}"]
+            for e in self._entries.values():
+                lines.append(f"  #{e.handle_id} {e.tier.name} {e.size}b "
+                             f"prio={e.priority} pinned={e.pinned}")
+            return "\n".join(lines)
+
+
+class SpillableBatch:
+    """Operator-facing wrapper (reference: SpillableColumnarBatch.scala:28):
+    hold between uses, get() to touch, close() when done."""
+
+    def __init__(self, catalog: BufferCatalog, batch: ColumnarBatch,
+                 schema: Schema, priority: int = 0):
+        self.catalog = catalog
+        self.schema = schema
+        self.hid = catalog.register(batch, schema, priority)
+        self._open = True
+
+    def get(self) -> ColumnarBatch:
+        assert self._open
+        return self.catalog.acquire(self.hid)
+
+    def done_with(self) -> None:
+        """Release the pin so the batch becomes spillable again."""
+        self.catalog.release(self.hid)
+
+    def close(self) -> None:
+        if self._open:
+            self.catalog.remove(self.hid)
+            self._open = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+_BUDGET: Optional[BufferCatalog] = None
+_BUDGET_LOCK = threading.Lock()
+
+
+def device_budget(device_limit: Optional[int] = None,
+                  host_limit: Optional[int] = None,
+                  spill_dir: Optional[str] = None) -> BufferCatalog:
+    """Process-wide catalog (reference: RapidsBufferCatalog singleton)."""
+    global _BUDGET
+    with _BUDGET_LOCK:
+        if _BUDGET is None:
+            from ..config import (HOST_SPILL_LIMIT, RapidsTpuConf, SPILL_DIR)
+            conf = RapidsTpuConf()
+            _BUDGET = BufferCatalog(
+                device_limit or (8 << 30),
+                host_limit or conf.get(HOST_SPILL_LIMIT.key),
+                spill_dir or conf.get(SPILL_DIR.key))
+        return _BUDGET

@@ -1,0 +1,71 @@
+"""Device-task admission control.
+
+Reference: GpuSemaphore.scala:115 — tasks acquire before first touching the
+device and release around blocking I/O, bounding concurrent HBM footprints
+(spark.rapids.sql.concurrentGpuTasks=2). Same contract here for the
+host-threaded parts of the engine (multi-file readers, shuffle writers):
+XLA executes one program at a time per chip, but host threads staging H2D
+buffers still multiply peak memory.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class TpuSemaphore:
+    def __init__(self, max_concurrent: int = 2):
+        self._sem = threading.BoundedSemaphore(max_concurrent)
+        self._holders: Dict[int, int] = {}      # thread id -> depth
+        self._lock = threading.Lock()
+        self.max_concurrent = max_concurrent
+        self.wait_time_ns = 0
+
+    def acquire_if_necessary(self) -> None:
+        """Re-entrant per thread (a task acquires once; reference
+        GpuSemaphore.acquireIfNecessary)."""
+        tid = threading.get_ident()
+        with self._lock:
+            if self._holders.get(tid, 0) > 0:
+                self._holders[tid] += 1
+                return
+        import time
+        t0 = time.perf_counter_ns()
+        self._sem.acquire()
+        self.wait_time_ns += time.perf_counter_ns() - t0
+        with self._lock:
+            self._holders[tid] = 1
+
+    def release_if_held(self) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            d = self._holders.get(tid, 0)
+            if d == 0:
+                return
+            if d > 1:
+                self._holders[tid] = d - 1
+                return
+            del self._holders[tid]
+        self._sem.release()
+
+    @contextmanager
+    def task(self):
+        self.acquire_if_necessary()
+        try:
+            yield
+        finally:
+            self.release_if_held()
+
+
+_GLOBAL: Optional[TpuSemaphore] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_semaphore(max_concurrent: int = 2) -> TpuSemaphore:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = TpuSemaphore(max_concurrent)
+        return _GLOBAL

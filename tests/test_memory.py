@@ -1,0 +1,140 @@
+"""Memory runtime tests (reference: RapidsBufferCatalogSuite,
+RapidsDeviceMemoryStoreSuite, RapidsHostMemoryStoreSuite,
+RapidsDiskStoreSuite, GpuSemaphoreSuite)."""
+
+import threading
+
+import pytest
+
+from spark_rapids_tpu.batch import from_arrow
+from spark_rapids_tpu.memory import (BufferCatalog, SpillableBatch,
+                                     StorageTier, TpuSemaphore)
+from spark_rapids_tpu.memory.catalog import OutOfBudgetError
+
+from harness.asserts import assert_tables_equal
+from harness.data_gen import IntegerGen, StringGen, gen_table
+
+
+def make_batch(n=256, seed=0):
+    t = gen_table([("a", IntegerGen()), ("s", StringGen(max_len=8))],
+                  n=n, seed=seed)
+    batch, schema = from_arrow(t)
+    return t, batch, schema
+
+
+def test_register_reserves_budget(tmp_path):
+    t, batch, schema = make_batch()
+    cat = BufferCatalog(device_limit=1 << 20, spill_dir=str(tmp_path))
+    hid = cat.register(batch, schema)
+    assert cat.device_used == batch.size_bytes()
+    cat.remove(hid)
+    assert cat.device_used == 0
+
+
+def test_spill_to_host_and_back(tmp_path):
+    t, batch, schema = make_batch()
+    size = batch.size_bytes()
+    cat = BufferCatalog(device_limit=size + 100, host_limit=1 << 30,
+                        spill_dir=str(tmp_path))
+    hid = cat.register(batch, schema)
+    # a second registration must evict the first to host
+    t2, batch2, _ = make_batch(seed=1)
+    hid2 = cat.register(batch2, schema)
+    assert cat.tier_of(hid) is StorageTier.HOST
+    assert cat.spilled_to_host == size
+    # acquiring the spilled handle unspills it (and spills the other)
+    got = cat.acquire(hid)
+    assert cat.tier_of(hid) is StorageTier.DEVICE
+    from spark_rapids_tpu.batch import to_arrow
+    assert_tables_equal(to_arrow(got, schema), t)
+    cat.release(hid)
+
+
+def test_overflow_to_disk_and_back(tmp_path):
+    t, batch, schema = make_batch()
+    size = batch.size_bytes()
+    cat = BufferCatalog(device_limit=size + 100, host_limit=size // 2,
+                        spill_dir=str(tmp_path))
+    hid = cat.register(batch, schema)
+    _, batch2, _ = make_batch(seed=1)
+    hid2 = cat.register(batch2, schema)
+    # host tier too small -> straight to disk
+    assert cat.tier_of(hid) is StorageTier.DISK
+    assert cat.spilled_to_disk == size
+    got = cat.acquire(hid)
+    from spark_rapids_tpu.batch import to_arrow
+    assert_tables_equal(to_arrow(got, schema), t)
+    cat.release(hid)
+
+
+def test_pinned_buffers_do_not_spill(tmp_path):
+    t, batch, schema = make_batch()
+    size = batch.size_bytes()
+    cat = BufferCatalog(device_limit=int(size * 1.5), spill_dir=str(tmp_path))
+    hid = cat.register(batch, schema)
+    cat.acquire(hid)   # pin
+    _, batch2, _ = make_batch(seed=1)
+    with pytest.raises(OutOfBudgetError):
+        cat.register(batch2, schema)
+    cat.release(hid)   # unpin -> now it can spill
+    hid2 = cat.register(batch2, schema)
+    assert cat.tier_of(hid) is StorageTier.HOST
+
+
+def test_spill_priority_order(tmp_path):
+    _, b1, schema = make_batch(seed=1)
+    _, b2, _ = make_batch(seed=2)
+    size = b1.size_bytes()
+    cat = BufferCatalog(device_limit=int(size * 2.5), spill_dir=str(tmp_path))
+    low = cat.register(b1, schema, priority=0)
+    high = cat.register(b2, schema, priority=100)
+    _, b3, _ = make_batch(seed=3)
+    cat.register(b3, schema, priority=50)
+    # low priority spilled first
+    assert cat.tier_of(low) is StorageTier.HOST
+    assert cat.tier_of(high) is StorageTier.DEVICE
+
+
+def test_spillable_batch_wrapper(tmp_path):
+    t, batch, schema = make_batch()
+    cat = BufferCatalog(device_limit=1 << 30, spill_dir=str(tmp_path))
+    with SpillableBatch(cat, batch, schema) as sb:
+        got = sb.get()
+        from spark_rapids_tpu.batch import to_arrow
+        assert_tables_equal(to_arrow(got, schema), t)
+        sb.done_with()
+    assert cat.device_used == 0
+
+
+def test_semaphore_bounds_concurrency():
+    sem = TpuSemaphore(2)
+    active = []
+    peak = []
+    lock = threading.Lock()
+
+    def task():
+        with sem.task():
+            with lock:
+                active.append(1)
+                peak.append(len(active))
+            import time
+            time.sleep(0.01)
+            with lock:
+                active.pop()
+
+    threads = [threading.Thread(target=task) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert max(peak) <= 2
+
+
+def test_semaphore_reentrant():
+    sem = TpuSemaphore(1)
+    sem.acquire_if_necessary()
+    sem.acquire_if_necessary()   # same thread: no deadlock
+    sem.release_if_held()
+    sem.release_if_held()
+    sem.acquire_if_necessary()   # fully released: can re-acquire
+    sem.release_if_held()
