@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build librtpu_native.so (called by spark_rapids_tpu.utils.native on first
+# import if the shared object is missing).
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -fPIC -shared -std=c++17 -o librtpu_native.so src/rtpu_native.cpp
+echo "built $(pwd)/librtpu_native.so"

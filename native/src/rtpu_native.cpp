@@ -1,0 +1,248 @@
+// Host-side native kernels for spark-rapids-tpu.
+//
+// Role: the reference links against native libraries for everything the JVM
+// is too slow for (SURVEY.md §2.9): nvcomp batched LZ4 for shuffle/spill
+// compression (TableCompressionCodec.scala), JCudfSerialization framing,
+// and string columnar layout conversion. This library provides the
+// TPU-build equivalents on the host side:
+//
+//   rtpu_lz4_compress / rtpu_lz4_decompress
+//       LZ4 block format (greedy hash-table matcher), used by the batch
+//       serializer and the disk spill tier.
+//   rtpu_strings_to_matrix / rtpu_matrix_to_strings
+//       Arrow offsets+bytes  <->  fixed-width padded byte matrix (the H2D
+//       string staging hot path in batch.py).
+//   rtpu_murmur3_int32 / rtpu_murmur3_long
+//       Spark-compatible Murmur3 x86_32 batch hashing for host-side
+//       partition routing.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// LZ4 block format
+// ---------------------------------------------------------------------------
+
+// Compress src[0..n) into dst (capacity dst_cap). Returns compressed size,
+// or -1 if dst_cap is too small. Standard LZ4 block format: token byte
+// (literal len high nibble, match len low nibble), literals, 2-byte LE
+// offset, extension bytes for lengths >= 15.
+static inline uint32_t lz4_hash(uint32_t v) {
+    return (v * 2654435761u) >> 20;   // 12-bit table
+}
+
+int64_t rtpu_lz4_compress(const uint8_t* src, int64_t n,
+                          uint8_t* dst, int64_t dst_cap) {
+    const int64_t MINMATCH = 4;
+    const int64_t MFLIMIT = 12;       // last 12 bytes are always literals
+    int32_t table[1 << 12];
+    for (auto& t : table) t = -1;
+
+    int64_t ip = 0, op = 0, anchor = 0;
+    if (n >= MFLIMIT) {
+        const int64_t mflimit = n - MFLIMIT;
+        while (ip <= mflimit) {
+            uint32_t seq;
+            std::memcpy(&seq, src + ip, 4);
+            uint32_t h = lz4_hash(seq);
+            int64_t ref = table[h];
+            table[h] = (int32_t)ip;
+            uint32_t refseq;
+            bool match = false;
+            if (ref >= 0 && ip - ref <= 65535) {
+                std::memcpy(&refseq, src + ref, 4);
+                match = (refseq == seq);
+            }
+            if (!match) { ip++; continue; }
+
+            // extend match forward
+            int64_t mlen = MINMATCH;
+            const int64_t limit = n - 5;   // keep 5 trailing literal bytes
+            while (ip + mlen < limit && src[ref + mlen] == src[ip + mlen])
+                mlen++;
+
+            int64_t lit = ip - anchor;
+            // token + literal extension + literals + offset + match ext
+            int64_t need = 1 + lit / 255 + 1 + lit + 2 + (mlen - MINMATCH) / 255 + 1;
+            if (op + need > dst_cap) return -1;
+
+            uint8_t* token = dst + op++;
+            if (lit >= 15) {
+                *token = 15 << 4;
+                int64_t rest = lit - 15;
+                while (rest >= 255) { dst[op++] = 255; rest -= 255; }
+                dst[op++] = (uint8_t)rest;
+            } else {
+                *token = (uint8_t)(lit << 4);
+            }
+            std::memcpy(dst + op, src + anchor, lit);
+            op += lit;
+
+            uint16_t off = (uint16_t)(ip - ref);
+            dst[op++] = off & 0xFF;
+            dst[op++] = off >> 8;
+
+            int64_t mrem = mlen - MINMATCH;
+            if (mrem >= 15) {
+                *token |= 15;
+                mrem -= 15;
+                while (mrem >= 255) { dst[op++] = 255; mrem -= 255; }
+                dst[op++] = (uint8_t)mrem;
+            } else {
+                *token |= (uint8_t)mrem;
+            }
+            ip += mlen;
+            anchor = ip;
+        }
+    }
+    // trailing literals
+    int64_t lit = n - anchor;
+    int64_t need = 1 + lit / 255 + 1 + lit;
+    if (op + need > dst_cap) return -1;
+    uint8_t* token = dst + op++;
+    if (lit >= 15) {
+        *token = 15 << 4;
+        int64_t rest = lit - 15;
+        while (rest >= 255) { dst[op++] = 255; rest -= 255; }
+        dst[op++] = (uint8_t)rest;
+    } else {
+        *token = (uint8_t)(lit << 4);
+    }
+    std::memcpy(dst + op, src + anchor, lit);
+    op += lit;
+    return op;
+}
+
+// Decompress exactly out_n bytes. Returns out_n, or -1 on malformed input.
+int64_t rtpu_lz4_decompress(const uint8_t* src, int64_t n,
+                            uint8_t* dst, int64_t out_n) {
+    int64_t ip = 0, op = 0;
+    while (ip < n) {
+        uint8_t token = src[ip++];
+        int64_t lit = token >> 4;
+        if (lit == 15) {
+            uint8_t b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                lit += b;
+            } while (b == 255);
+        }
+        if (ip + lit > n || op + lit > out_n) return -1;
+        std::memcpy(dst + op, src + ip, lit);
+        ip += lit; op += lit;
+        if (ip >= n) break;   // last sequence has no match part
+        if (ip + 2 > n) return -1;
+        uint16_t off = src[ip] | (src[ip + 1] << 8);
+        ip += 2;
+        if (off == 0 || off > op) return -1;
+        int64_t mlen = (token & 15);
+        if (mlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                mlen += b;
+            } while (b == 255);
+        }
+        mlen += 4;
+        if (op + mlen > out_n) return -1;
+        // overlapping copy byte-by-byte (offset can be < mlen)
+        for (int64_t i = 0; i < mlen; i++) {
+            dst[op + i] = dst[op - off + i];
+        }
+        op += mlen;
+    }
+    return op == out_n ? op : -1;
+}
+
+// ---------------------------------------------------------------------------
+// String layout conversion (Arrow offsets+data <-> padded matrix)
+// ---------------------------------------------------------------------------
+
+// Returns 0 on success, -1 if any string exceeds max_len.
+int32_t rtpu_strings_to_matrix(const int32_t* offsets, const uint8_t* data,
+                               int64_t n, int64_t max_len,
+                               uint8_t* out_matrix, int32_t* out_lengths) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t start = offsets[i];
+        int64_t len = offsets[i + 1] - start;
+        if (len > max_len) return -1;
+        uint8_t* row = out_matrix + i * max_len;
+        std::memcpy(row, data + start, len);
+        std::memset(row + len, 0, max_len - len);
+        out_lengths[i] = (int32_t)len;
+    }
+    return 0;
+}
+
+// Packs rows back to contiguous bytes; caller passes out_data sized to
+// sum(lengths). Fills offsets[n+1].
+void rtpu_matrix_to_strings(const uint8_t* matrix, const int32_t* lengths,
+                            int64_t n, int64_t max_len,
+                            uint8_t* out_data, int32_t* out_offsets) {
+    int64_t pos = 0;
+    out_offsets[0] = 0;
+    for (int64_t i = 0; i < n; i++) {
+        std::memcpy(out_data + pos, matrix + i * max_len, lengths[i]);
+        pos += lengths[i];
+        out_offsets[i + 1] = (int32_t)pos;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Spark Murmur3 x86_32 (scalar batch; parity with Murmur3_x86_32.java)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mixk1(uint32_t k1) {
+    k1 *= 0xCC9E2D51u;
+    k1 = rotl32(k1, 15);
+    return k1 * 0x1B873593u;
+}
+
+static inline uint32_t mixh1(uint32_t h1, uint32_t k1) {
+    h1 ^= mixk1(k1);
+    h1 = rotl32(h1, 13);
+    return h1 * 5 + 0xE6546B64u;
+}
+
+static inline uint32_t fmix(uint32_t h1, uint32_t len) {
+    h1 ^= len;
+    h1 ^= h1 >> 16;
+    h1 *= 0x85EBCA6Bu;
+    h1 ^= h1 >> 13;
+    h1 *= 0xC2B2AE35u;
+    return h1 ^ (h1 >> 16);
+}
+
+void rtpu_murmur3_int32(const int32_t* vals, const uint8_t* valid,
+                        int64_t n, const int32_t* seeds, int32_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t seed = (uint32_t)seeds[i];
+        if (!valid[i]) { out[i] = (int32_t)seed; continue; }
+        out[i] = (int32_t)fmix(mixh1(seed, (uint32_t)vals[i]), 4);
+    }
+}
+
+void rtpu_murmur3_long(const int64_t* vals, const uint8_t* valid,
+                       int64_t n, const int32_t* seeds, int32_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t seed = (uint32_t)seeds[i];
+        if (!valid[i]) { out[i] = (int32_t)seed; continue; }
+        uint64_t v = (uint64_t)vals[i];
+        uint32_t h1 = mixh1(seed, (uint32_t)(v & 0xFFFFFFFFu));
+        h1 = mixh1(h1, (uint32_t)(v >> 32));
+        out[i] = (int32_t)fmix(h1, 8);
+    }
+}
+
+}  // extern "C"

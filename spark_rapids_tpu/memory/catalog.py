@@ -148,8 +148,11 @@ class BufferCatalog:
             if self.host_used <= self.host_limit:
                 break
             os.makedirs(self.spill_dir, exist_ok=True)
-            path = os.path.join(self.spill_dir, f"buf-{e.handle_id}.npz")
-            np.savez(path, **e.host)
+            path = os.path.join(self.spill_dir, f"buf-{e.handle_id}.rtpu")
+            from ..shuffle.serializer import serialize_host
+            n = int(e.host["n"])
+            with open(path, "wb") as f:
+                f.write(serialize_host(e.host, n))
             e.path = path
             e.host = None
             e.tier = StorageTier.DISK
@@ -167,8 +170,9 @@ class BufferCatalog:
             if e.tier is not StorageTier.DEVICE:
                 self.reserve(e.size)
                 if e.tier is StorageTier.DISK:
-                    data = np.load(e.path)
-                    e.host = {k: data[k] for k in data.files}
+                    from ..shuffle.serializer import deserialize_host
+                    with open(e.path, "rb") as f:
+                        e.host, _ = deserialize_host(f.read())
                     os.remove(e.path)
                     e.path = None
                     e.tier = StorageTier.HOST

@@ -1,0 +1,123 @@
+"""Framed, compressed batch wire format.
+
+Reference: GpuColumnarBatchSerializer.scala:124 over JCudfSerialization
+(host-framed tables for the default shuffle path) + TableCompressionCodec
+(batched nvcomp LZ4). Same layering here: a host-framed format whose column
+payloads run through the native LZ4 (utils/native.py, C++) — used by the
+disk spill tier and the multithreaded shuffle, and as the DCN wire format.
+
+Frame layout (little-endian):
+  magic 'RTPU' | u32 version | u32 ncols | i64 nrows
+  per column:
+    u8 has_lengths | u8 codec(0=none,1=lz4,2=zlib) padding x2
+    u32 name_len | name bytes
+    u8  numpy dtype string len | dtype bytes | u32 extra(max_len)
+    i64 raw_data_len | i64 comp_data_len | payload
+    i64 raw_valid_len | i64 comp_valid_len | payload
+    [i64 raw_lengths_len | i64 comp_len | payload]
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..batch import ColumnarBatch, DeviceColumn, Schema
+from ..types import TypeKind
+from ..utils import native
+
+MAGIC = b"RTPU"
+VERSION = 1
+_CODEC = {"none": 0, "lz4": 1, "zlib": 2}
+_CODEC_R = {v: k for k, v in _CODEC.items()}
+
+
+def _write_blob(out: io.BytesIO, raw: bytes) -> None:
+    payload, codec = native.compress(raw)
+    if len(payload) >= len(raw):
+        payload, codec = raw, "none"
+    out.write(struct.pack("<qqB", len(raw), len(payload), _CODEC[codec]))
+    out.write(payload)
+
+
+def _read_blob(buf: memoryview, pos: int) -> Tuple[bytes, int]:
+    raw_len, comp_len, codec = struct.unpack_from("<qqB", buf, pos)
+    pos += 17
+    payload = bytes(buf[pos: pos + comp_len])
+    pos += comp_len
+    return native.decompress(payload, _CODEC_R[codec], raw_len), pos
+
+
+def serialize_host(arrays: Dict[str, np.ndarray], num_rows: int) -> bytes:
+    """Serialize named host arrays (the spill-store / shuffle-write side)."""
+    out = io.BytesIO()
+    out.write(MAGIC)
+    out.write(struct.pack("<IIq", VERSION, len(arrays), num_rows))
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)   # NOT ascontiguousarray: it promotes 0-d to 1-d
+        nb = name.encode()
+        dt = arr.dtype.str.encode()
+        out.write(struct.pack("<I", len(nb)))
+        out.write(nb)
+        out.write(struct.pack("<B", len(dt)))
+        out.write(dt)
+        out.write(struct.pack("<B", arr.ndim))
+        for s in arr.shape:
+            out.write(struct.pack("<q", s))
+        _write_blob(out, arr.tobytes())
+    return out.getvalue()
+
+
+def deserialize_host(data: bytes) -> Tuple[Dict[str, np.ndarray], int]:
+    buf = memoryview(data)
+    assert bytes(buf[:4]) == MAGIC, "bad frame magic"
+    version, ncols, num_rows = struct.unpack_from("<IIq", buf, 4)
+    assert version == VERSION
+    pos = 4 + 16
+    arrays: Dict[str, np.ndarray] = {}
+    for _ in range(ncols):
+        (nlen,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        name = bytes(buf[pos: pos + nlen]).decode()
+        pos += nlen
+        (dlen,) = struct.unpack_from("<B", buf, pos)
+        pos += 1
+        dt = bytes(buf[pos: pos + dlen]).decode()
+        pos += dlen
+        (ndim,) = struct.unpack_from("<B", buf, pos)
+        pos += 1
+        shape = []
+        for _ in range(ndim):
+            (s,) = struct.unpack_from("<q", buf, pos)
+            pos += 8
+            shape.append(s)
+        raw, pos = _read_blob(buf, pos)
+        arrays[name] = np.frombuffer(raw, dtype=np.dtype(dt)).reshape(shape)
+    return arrays, num_rows
+
+
+def serialize_batch(batch: ColumnarBatch, schema: Schema) -> bytes:
+    """Device batch -> framed bytes (D2H then frame)."""
+    import jax
+    arrays: Dict[str, np.ndarray] = {}
+    for i, c in enumerate(batch.columns):
+        arrays[f"d{i}"] = np.asarray(jax.device_get(c.data))
+        arrays[f"v{i}"] = np.asarray(jax.device_get(c.validity))
+        if c.lengths is not None:
+            arrays[f"l{i}"] = np.asarray(jax.device_get(c.lengths))
+    return serialize_host(arrays, int(batch.num_rows))
+
+
+def deserialize_batch(data: bytes, schema: Schema) -> ColumnarBatch:
+    import jax.numpy as jnp
+    arrays, num_rows = deserialize_host(data)
+    cols: List[DeviceColumn] = []
+    for i, f in enumerate(schema):
+        lengths = jnp.asarray(arrays[f"l{i}"]) if f"l{i}" in arrays else None
+        cols.append(DeviceColumn(jnp.asarray(arrays[f"d{i}"]),
+                                 jnp.asarray(arrays[f"v{i}"]),
+                                 lengths, f.dtype))
+    return ColumnarBatch(tuple(cols), jnp.asarray(num_rows, jnp.int32))

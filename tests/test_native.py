@@ -1,0 +1,86 @@
+"""Native library + serializer tests (reference: the shuffle compression
+codec suites and JCudfSerialization roundtrip coverage)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.batch import from_arrow, to_arrow
+from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                 deserialize_host,
+                                                 serialize_batch,
+                                                 serialize_host)
+from spark_rapids_tpu.utils import native
+
+from harness.asserts import assert_tables_equal
+from harness.data_gen import (DoubleGen, IntegerGen, StringGen, TimestampGen,
+                              gen_table)
+
+
+def test_native_library_builds():
+    assert native.available(), "g++ build of librtpu_native.so failed"
+
+
+@pytest.mark.parametrize("data", [
+    b"", b"a", b"hello world " * 100, bytes(range(256)) * 50,
+    np.random.default_rng(0).integers(0, 4, 100000, dtype=np.uint8)
+    .tobytes(),
+    np.random.default_rng(1).integers(0, 255, 10000, dtype=np.uint8)
+    .tobytes(),
+])
+def test_lz4_roundtrip(data):
+    payload, codec = native.compress(data)
+    back = native.decompress(payload, codec, len(data))
+    assert back == data
+
+
+def test_lz4_compresses_repetitive_data():
+    data = b"abcdefgh" * 10000
+    payload, codec = native.compress(data)
+    assert codec == "lz4"
+    assert len(payload) < len(data) // 10
+
+
+def test_strings_to_matrix_native_matches_numpy():
+    import pyarrow as pa
+    strs = ["hello", "", "a" * 16, "héllo wörld", None, "x"] * 50
+    arr = pa.array(strs)
+    offsets = np.frombuffer(arr.buffers()[1], np.int32, len(arr) + 1)
+    data = np.frombuffer(arr.buffers()[2], np.uint8)
+    out = native.strings_to_matrix(offsets, data, 32)
+    assert out is not None
+    matrix, lengths = out
+    for i, s in enumerate(strs):
+        b = (s or "").encode()
+        assert lengths[i] == len(b)
+        assert matrix[i, :len(b)].tobytes() == b
+    # roundtrip
+    back = native.matrix_to_strings(matrix, lengths)
+    assert back is not None
+    out_data, out_offsets = back
+    joined = b"".join((s or "").encode() for s in strs)
+    assert out_data.tobytes() == joined
+
+
+def test_serialize_host_roundtrip():
+    arrays = {
+        "a": np.arange(1000, dtype=np.int64),
+        "m": np.random.default_rng(2).integers(0, 255, (100, 16),
+                                               dtype=np.uint8),
+        "f": np.linspace(0, 1, 500),
+        "b": np.array([True, False] * 100),
+    }
+    data = serialize_host(arrays, 1000)
+    back, n = deserialize_host(data)
+    assert n == 1000
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(back[k], v)
+
+
+def test_serialize_batch_roundtrip():
+    t = gen_table([("a", IntegerGen()), ("s", StringGen(max_len=10)),
+                   ("d", DoubleGen()), ("ts", TimestampGen())],
+                  n=400, seed=130)
+    batch, schema = from_arrow(t)
+    data = serialize_batch(batch, schema)
+    back = deserialize_batch(data, schema)
+    assert_tables_equal(to_arrow(back, schema), t)
