@@ -329,7 +329,7 @@ class RowEvaluator:
               "log1p": lambda x: math.log1p(x) if x > -1 else None,
               "expm1": math.expm1,
               "degrees": math.degrees, "radians": math.radians,
-              }[e.op]
+              }[e.fn]
         try:
             return fn(float(v))
         except (ValueError, OverflowError):

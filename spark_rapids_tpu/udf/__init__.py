@@ -1,0 +1,16 @@
+"""UDF compiler: Python bytecode -> TPU-plannable expression trees.
+
+Reference: udf-compiler/ (SURVEY.md §2.13) — the reference reflects a Scala
+UDF's JVM bytecode (LambdaReflection.scala), walks a CFG (CFG.scala),
+abstract-interprets the instructions (Instruction.scala, 980 LoC) and emits
+equivalent Catalyst expressions so the UDF body becomes GPU-plannable.
+Identical idea here, against CPython bytecode: `dis` is the reflection
+layer, a fork-on-branch symbolic interpreter is the CFG walk, and the
+output is this engine's Expression tree. Gated by
+spark.rapids.tpu.sql.udfCompiler.enabled, falling back to the row
+interpreter (the reference falls back to the JVM row UDF the same way).
+"""
+
+from .compiler import CompileError, compile_udf, udf
+
+__all__ = ["compile_udf", "udf", "CompileError"]

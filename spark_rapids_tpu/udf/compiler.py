@@ -1,0 +1,360 @@
+"""CPython-bytecode -> Expression abstract interpreter.
+
+Mirrors the reference's three stages (udf-compiler/, SURVEY.md §2.13):
+  LambdaReflection  -> `dis.get_instructions` + closure/global resolution
+  CFG + Instruction -> `_Simulator`: a stack machine over Expression values
+                       that FORKS at conditional jumps and joins the arms
+                       with If(cond, then, else) — loops are rejected
+                       (same restriction as the reference's CFG, which only
+                       accepts reducible acyclic flow for expressions)
+  CatalystExpressionBuilder -> the Expression constructors themselves
+
+Supported surface: arithmetic/comparison/boolean operators, ternaries,
+`is None` checks, abs/min/max, math.* calls, str methods
+(upper/lower/strip/startswith/endswith/replace…), len, constants, nested
+calls of already-compiled UDFs. Anything else raises CompileError and the
+planner leaves the UDF on the CPU row path.
+"""
+
+from __future__ import annotations
+
+import dis
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..expressions import base as EB
+from ..expressions import comparison as EC
+from ..expressions import boolean as EBOOL
+from ..expressions import arithmetic as EA
+from ..expressions import conditional as ECOND
+from ..expressions import math as EM
+from ..expressions import strings as ES
+from ..expressions.base import Expression, Literal, lit
+
+
+class CompileError(Exception):
+    pass
+
+
+def _py_mod(l, r):
+    """Python %: floor-mod (sign of divisor). SQL Remainder is Java %
+    (sign of dividend); ((a % b) + b) % b converts exactly."""
+    return EA.Remainder(EA.Add(EA.Remainder(l, r), r), r)
+
+
+def _py_floordiv(l, r):
+    """Python //: floor division; SQL IntegralDivide truncates toward zero.
+    floor = trunc - 1 when the remainder is nonzero and signs differ."""
+    import copy
+    trunc = EA.IntegralDivide(l, r)
+    rem_nz = EC.Not(EC.EqualTo(EA.Remainder(l, r), lit(0)))
+    sign_mix = EC.LessThan(EA.Multiply(l, r), lit(0))
+    from ..expressions.boolean import And
+    return ECOND.If(And(rem_nz, sign_mix),
+                    EA.Subtract(trunc, lit(1)), trunc)
+
+
+_BINARY_OPS = {
+    0: lambda l, r: EA.Add(l, r),            # +
+    5: lambda l, r: EA.Multiply(l, r),       # *
+    10: lambda l, r: EA.Subtract(l, r),      # -
+    11: lambda l, r: EA.Divide(l, r),        # /
+    2: _py_floordiv,                         # //
+    6: _py_mod,                              # %
+    8: lambda l, r: EM.Pow(l, r),            # **
+    1: lambda l, r: EA.BitwiseOp(l, r, "and"),
+    7: lambda l, r: EA.BitwiseOp(l, r, "or"),
+    12: lambda l, r: EA.BitwiseOp(l, r, "xor"),
+    # in-place variants (x += 1 inside a lambda body via aug-assign)
+    13: lambda l, r: EA.Add(l, r),
+    18: lambda l, r: EA.Multiply(l, r),
+    23: lambda l, r: EA.Subtract(l, r),
+    24: lambda l, r: EA.Divide(l, r),
+    15: _py_floordiv,
+    19: _py_mod,
+}
+
+_COMPARE_OPS = {
+    "<": EC.LessThan, "<=": EC.LessThanOrEqual, ">": EC.GreaterThan,
+    ">=": EC.GreaterThanOrEqual, "==": EC.EqualTo,
+}
+
+_MATH_FNS = {"sqrt": "sqrt", "exp": "exp", "log": "log", "sin": "sin",
+             "cos": "cos", "tan": "tan", "asin": "asin", "acos": "acos",
+             "atan": "atan", "sinh": "sinh", "cosh": "cosh", "tanh": "tanh",
+             "log10": "log10", "log2": "log2", "log1p": "log1p",
+             "expm1": "expm1", "degrees": "degrees", "radians": "radians"}
+
+
+@dataclass
+class _Method:
+    """A bound-method placeholder on the stack (LOAD_ATTR on a value)."""
+
+    obj: Expression
+    name: str
+
+
+class _Simulator:
+    def __init__(self, code, arg_exprs: List[Expression],
+                 globals_: Dict[str, Any], closure: Dict[str, Any]):
+        self.instructions = list(dis.get_instructions(code))
+        self.by_offset = {i.offset: idx
+                          for idx, i in enumerate(self.instructions)}
+        self.code = code
+        self.globals = globals_
+        self.closure = closure
+        self.arg_exprs = arg_exprs
+        self.nargs = len(arg_exprs)
+
+    def run(self) -> Expression:
+        locals_: Dict[int, Any] = dict(enumerate(self.arg_exprs))
+        return self._exec(0, [], locals_, depth=0)
+
+    # ------------------------------------------------------------------
+
+    def _exec(self, idx: int, stack: List[Any], locals_: Dict[int, Any],
+              depth: int) -> Expression:
+        if depth > 40:
+            raise CompileError("branch nesting too deep (loop?)")
+        stack = list(stack)
+        locals_ = dict(locals_)
+        n = len(self.instructions)
+        while idx < n:
+            ins = self.instructions[idx]
+            op = ins.opname
+            if op in ("RESUME", "NOP", "CACHE", "PRECALL", "PUSH_NULL",
+                      "COPY_FREE_VARS", "MAKE_CELL"):
+                idx += 1
+            elif op == "LOAD_FAST":
+                if ins.arg not in locals_:
+                    raise CompileError(f"unbound local {ins.argval}")
+                stack.append(locals_[ins.arg])
+                idx += 1
+            elif op == "STORE_FAST":
+                locals_[ins.arg] = stack.pop()
+                idx += 1
+            elif op == "LOAD_CONST":
+                try:
+                    stack.append(lit(ins.argval))
+                except TypeError as ex:
+                    raise CompileError(str(ex))
+                idx += 1
+            elif op == "RETURN_CONST":
+                try:
+                    return lit(ins.argval)
+                except TypeError as ex:
+                    raise CompileError(str(ex))
+            elif op == "LOAD_GLOBAL":
+                import builtins
+                name = ins.argval
+                if name in self.globals:
+                    val = self.globals[name]
+                else:
+                    val = getattr(builtins, name, None)
+                if val is None:
+                    raise CompileError(f"unresolvable global {name}")
+                stack.append(val)
+                idx += 1
+            elif op == "LOAD_DEREF":
+                if ins.argval not in self.closure:
+                    raise CompileError(f"unresolvable closure {ins.argval}")
+                stack.append(self.closure[ins.argval])
+                idx += 1
+            elif op in ("LOAD_ATTR", "LOAD_METHOD"):
+                obj = stack.pop()
+                if isinstance(obj, Expression):
+                    stack.append(_Method(obj, ins.argval))
+                elif obj is math:
+                    stack.append(getattr(math, ins.argval))
+                else:
+                    raise CompileError(f"attr {ins.argval} on {obj!r}")
+                idx += 1
+            elif op == "UNARY_NEGATIVE":
+                stack.append(EA.UnaryMinus(self._expr(stack.pop())))
+                idx += 1
+            elif op == "UNARY_NOT":
+                stack.append(EC.Not(self._expr(stack.pop())))
+                idx += 1
+            elif op == "BINARY_OP":
+                r = self._expr(stack.pop())
+                l = self._expr(stack.pop())
+                fn = _BINARY_OPS.get(ins.arg)
+                if fn is None:
+                    raise CompileError(f"binary op {ins.argrepr}")
+                stack.append(fn(l, r))
+                idx += 1
+            elif op == "COMPARE_OP":
+                r = self._expr(stack.pop())
+                l = self._expr(stack.pop())
+                sym = ins.argval
+                if sym == "!=":
+                    stack.append(EC.Not(EC.EqualTo(l, r)))
+                elif sym in _COMPARE_OPS:
+                    stack.append(_COMPARE_OPS[sym](l, r))
+                else:
+                    raise CompileError(f"compare {sym}")
+                idx += 1
+            elif op == "IS_OP":
+                r = stack.pop()
+                l = self._expr(stack.pop())
+                if not (isinstance(r, Literal) and r.value is None):
+                    raise CompileError("`is` supported only against None")
+                e = EC.IsNull(l)
+                stack.append(EC.Not(e) if ins.arg == 1 else e)
+                idx += 1
+            elif op == "CALL":
+                args = [stack.pop() for _ in range(ins.arg)][::-1]
+                fn = stack.pop()
+                stack.append(self._call(fn, args))
+                idx += 1
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
+                        "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                tos = stack.pop()
+                if op == "POP_JUMP_IF_FALSE":
+                    cond = self._expr(tos)
+                elif op == "POP_JUMP_IF_TRUE":
+                    cond = EC.Not(self._expr(tos))
+                elif op == "POP_JUMP_IF_NONE":
+                    cond = EC.IsNotNull(self._expr(tos))
+                else:
+                    cond = EC.IsNull(self._expr(tos))
+                then_e = self._exec(idx + 1, stack, locals_, depth + 1)
+                else_e = self._exec(self.by_offset[ins.argval], stack,
+                                    locals_, depth + 1)
+                return ECOND.If(cond, then_e, else_e)
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
+                tgt = self.by_offset.get(ins.argval)
+                if tgt is None or tgt <= idx and op != "JUMP_FORWARD":
+                    raise CompileError("backward jump (loop) unsupported")
+                idx = tgt
+            elif op == "JUMP_BACKWARD":
+                raise CompileError("loops are not compilable")
+            elif op == "RETURN_VALUE":
+                return self._expr(stack.pop())
+            elif op in ("COPY",):
+                stack.append(stack[-ins.arg])
+                idx += 1
+            elif op in ("SWAP",):
+                stack[-1], stack[-ins.arg] = stack[-ins.arg], stack[-1]
+                idx += 1
+            elif op == "TO_BOOL":
+                idx += 1
+            else:
+                raise CompileError(f"unsupported opcode {op}")
+        raise CompileError("fell off the end of the bytecode")
+
+    # ------------------------------------------------------------------
+
+    def _expr(self, v) -> Expression:
+        if isinstance(v, Expression):
+            return v
+        if isinstance(v, (int, float, str, bool)) or v is None:
+            return lit(v)
+        raise CompileError(f"non-expression on stack: {v!r}")
+
+    def _call(self, fn, args) -> Expression:
+        import builtins
+        if isinstance(fn, _Method):
+            return self._str_method(fn, args)
+        if fn is builtins.abs:
+            return EA.Abs(self._expr(args[0]))
+        if fn is builtins.min:
+            return ECOND.LeastGreatest(
+                tuple(self._expr(a) for a in args), greatest=False)
+        if fn is builtins.max:
+            return ECOND.LeastGreatest(
+                tuple(self._expr(a) for a in args), greatest=True)
+        if fn is builtins.len:
+            return ES.Length(self._expr(args[0]))
+        if fn is builtins.float:
+            from .. import types as T
+            from ..expressions.cast import Cast
+            return Cast(self._expr(args[0]), T.FLOAT64)
+        if fn is builtins.int:
+            from .. import types as T
+            from ..expressions.cast import Cast
+            return Cast(self._expr(args[0]), T.INT64)
+        if fn is builtins.round and len(args) <= 2:
+            scale = 0
+            if len(args) == 2:
+                s = args[1]
+                if not isinstance(s, Literal):
+                    raise CompileError("round() scale must be constant")
+                scale = s.value
+            return EM.Round(self._expr(args[0]), scale, half_even=True)
+        if callable(fn) and getattr(fn, "__module__", "") == "math":
+            name = fn.__name__
+            if name in _MATH_FNS:
+                return EM.UnaryMath(self._expr(args[0]), _MATH_FNS[name])
+            if name == "pow":
+                return EM.Pow(self._expr(args[0]), self._expr(args[1]))
+            if name == "atan2":
+                return EM.Atan2(self._expr(args[0]), self._expr(args[1]))
+            if name == "floor":
+                return EM.FloorCeil(self._expr(args[0]), is_ceil=False)
+            if name == "ceil":
+                return EM.FloorCeil(self._expr(args[0]), is_ceil=True)
+        if callable(fn) and hasattr(fn, "__code__"):
+            # nested Python function: inline-compile it (reference: the udf
+            # compiler recurses into called methods the same way)
+            inner_args = [self._expr(a) for a in args]
+            return _compile_code(fn, inner_args)
+        raise CompileError(f"uncompilable call target {fn!r}")
+
+    def _str_method(self, m: _Method, args) -> Expression:
+        name = m.name
+        obj = m.obj
+        if name == "upper":
+            return ES.Upper(obj)
+        if name == "lower":
+            return ES.Lower(obj)
+        if name == "strip":
+            return ES.StringTrim(obj, "both")
+        if name == "lstrip":
+            return ES.StringTrim(obj, "leading")
+        if name == "rstrip":
+            return ES.StringTrim(obj, "trailing")
+        if name == "startswith":
+            return ES.StringPredicate(obj, self._expr(args[0]), "startswith")
+        if name == "endswith":
+            return ES.StringPredicate(obj, self._expr(args[0]), "endswith")
+        if name == "replace":
+            return ES.StringReplace(obj, self._expr(args[0]),
+                                    self._expr(args[1]))
+        if name == "find":
+            return EA.Subtract(ES.StringLocate(obj, self._expr(args[0])),
+                               lit(1))
+        raise CompileError(f"string method {name}")
+
+
+def _compile_code(fn, arg_exprs: List[Expression]) -> Expression:
+    code = fn.__code__
+    if code.co_argcount != len(arg_exprs):
+        raise CompileError(
+            f"UDF takes {code.co_argcount} args, got {len(arg_exprs)}")
+    closure = {}
+    if fn.__closure__:
+        for name, cell in zip(code.co_freevars, fn.__closure__):
+            closure[name] = cell.cell_contents
+    sim = _Simulator(code, arg_exprs, fn.__globals__, closure)
+    return sim.run()
+
+
+def compile_udf(fn, arg_exprs: List[Expression]) -> Expression:
+    """Compile a Python function of N args applied to N column expressions
+    into an equivalent Expression tree. Raises CompileError if any construct
+    falls outside the supported surface."""
+    return _compile_code(fn, list(arg_exprs))
+
+
+def udf(fn):
+    """Decorator: returns a callable that builds compiled expressions —
+    `my_udf(col("x"))` yields the translated tree (or raises CompileError,
+    which the planner turns into a CPU fallback)."""
+
+    def apply(*cols):
+        return compile_udf(fn, list(cols))
+
+    apply.__wrapped__ = fn
+    return apply

@@ -1,0 +1,144 @@
+"""UDF compiler tests (reference: udf-compiler OpcodeSuite, 2,447 LoC —
+bytecode patterns in, expression-equivalent results out, verified
+differentially against calling the Python function row-by-row)."""
+
+import math
+
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.plan import Session, table
+from spark_rapids_tpu.udf import CompileError, compile_udf, udf
+
+from harness.asserts import assert_tables_equal, rows_of
+from harness.data_gen import (DoubleGen, IntegerGen, StringGen, gen_table)
+
+# Non-nullable inputs: a compiled UDF follows EXPRESSION null semantics
+# (null condition takes the else branch), while calling the Python function
+# row-wise on None raises — same divergence the reference documents for its
+# compiled Scala UDFs, so the differential harness feeds non-null rows.
+T1 = gen_table([("x", IntegerGen(min_val=-100, max_val=100,
+                                 nullable=False)),
+                ("y", IntegerGen(min_val=1, max_val=50, nullable=False)),
+                ("d", DoubleGen(no_nans=True, nullable=False)),
+                ("s", StringGen(max_len=10, nullable=False))],
+               n=300, seed=140)
+
+
+def run_compiled(fn, *cols_, conf=None):
+    expr = compile_udf(fn, [col(c) for c in cols_])
+    ses = Session(conf or {"spark.rapids.tpu.sql.incompatibleOps.enabled":
+                           True})
+    return ses.collect(table(T1).select(expr.alias("r"))), ses
+
+
+def oracle(fn, *cols_):
+    vals = [T1.column(c).to_pylist() for c in cols_]
+    out = []
+    for row in zip(*vals):
+        if any(v is None for v in row):
+            out.append(None)     # null-intolerant surface like Spark UDFs
+        else:
+            out.append(fn(*row))
+    return out
+
+
+def check(fn, *cols_, approx=False):
+    got, _ = run_compiled(fn, *cols_)
+    exp = oracle(fn, *cols_)
+    g = [r[0] for r in rows_of(got)]
+    assert len(g) == len(exp)
+    for a, b in zip(g, exp):
+        if b is None:
+            assert a is None
+        elif approx or isinstance(b, float):
+            assert a is not None and abs(a - b) < 1e-6 * max(1, abs(b)), \
+                (a, b)
+        else:
+            assert a == b, (a, b)
+
+
+def test_arithmetic_lambda():
+    check(lambda x, y: x * 2 + y - 3, "x", "y")
+
+
+def test_ternary():
+    check(lambda x, y: x + y if x > y else x - y, "x", "y")
+
+
+def test_nested_ternary():
+    check(lambda x: 1 if x > 50 else (2 if x > 0 else 3), "x")
+
+
+def test_abs_min_max():
+    check(lambda x, y: abs(x) + min(x, y) + max(x, y, 10), "x", "y")
+
+
+def test_math_module():
+    check(lambda d: math.sqrt(abs(d)) + math.sin(d), "d", approx=True)
+
+
+def test_string_methods():
+    f = lambda s: s.upper().strip()
+    got, _ = run_compiled(f, "s")
+    exp = oracle(lambda s: "".join(
+        ch.upper() if "a" <= ch <= "z" else ch for ch in s).strip(" "), "s")
+    assert [r[0] for r in rows_of(got)] == exp
+
+
+def test_string_predicate():
+    check(lambda s: 1 if s.startswith("a") else 0, "s")
+
+
+def test_local_variable():
+    def f(x, y):
+        t = x + y
+        return t * t
+    check(f, "x", "y")
+
+
+def test_nested_function_inlined():
+    def double(v):
+        return v * 2
+
+    def f(x):
+        return double(x) + 1
+    check(f, "x")
+
+
+def test_closure_constant():
+    k = 7
+    check(lambda x: x + k, "x")
+
+
+def test_float_int_cast():
+    check(lambda x: float(x) / 2.0, "x")
+
+
+def test_modulo_negate():
+    check(lambda x: (-x) % 7 if x != 0 else 0, "x")
+
+
+def test_loop_rejected():
+    def f(x):
+        t = 0
+        for i in range(3):
+            t += x
+        return t
+    with pytest.raises(CompileError):
+        compile_udf(f, [col("x")])
+
+
+def test_unsupported_call_rejected():
+    with pytest.raises(CompileError):
+        compile_udf(lambda x: sorted([x]), [col("x")])
+
+
+def test_udf_decorator():
+    @udf
+    def times3(v):
+        return v * 3
+    ses = Session()
+    got = ses.collect(table(T1).select(times3(col("x")).alias("r")))
+    exp = oracle(lambda x: x * 3, "x")
+    assert [r[0] for r in rows_of(got)] == exp
