@@ -185,6 +185,16 @@ TEST_RETAG = conf("spark.rapids.tpu.sql.test.allowedNonTpu").doc(
     "Comma-separated exec names allowed to stay on CPU during tests "
     "(reference: the integration harness's allow_non_gpu marker).").internal().text("")
 
+ADAPTIVE_ENABLED = conf("spark.rapids.tpu.sql.adaptive.enabled").doc(
+    "Adaptive query execution: coalesce small shuffle output partitions "
+    "using materialized stage statistics (reference: "
+    "GpuCustomShuffleReaderExec / AQE integration).").boolean(True)
+
+ADAPTIVE_TARGET_ROWS = conf(
+    "spark.rapids.tpu.sql.adaptive.coalescePartitions.targetRows").doc(
+    "Row target when coalescing adjacent small shuffle partitions."
+).integer(1 << 20)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "
     "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."

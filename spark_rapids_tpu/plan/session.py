@@ -43,6 +43,17 @@ class Session:
         from ..exec.base import collect as collect_exec
         return collect_exec(plan)
 
+    def cache(self, df: DataFrame) -> DataFrame:
+        """Materialize as parquet-compressed cached partitions (reference:
+        ParquetCachedBatchSerializer behind df.cache())."""
+        from ..io.cache import CachedRelation
+        from .logical import LogicalScan
+        from .overrides import Overrides
+        plan = Overrides(self.conf).plan(df.plan)
+        cached = CachedRelation.build(plan)
+        return DataFrame(LogicalScan((), source=cached,
+                                     _schema=cached.schema))
+
     def explain(self, df: DataFrame,
                 mode: ExplainMode = ExplainMode.ALL) -> str:
         return Overrides(self.conf).explain(df.plan, mode)

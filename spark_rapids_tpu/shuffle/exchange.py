@@ -29,10 +29,17 @@ class ShuffleExchangeExec(UnaryExec):
     """All-to-all redistribution of rows by a partitioning."""
 
     def __init__(self, partitioning: Partitioning, child: Exec,
-                 ctx: Optional[EvalContext] = None):
+                 ctx: Optional[EvalContext] = None, adaptive: bool = False,
+                 target_rows: int = 1 << 20):
         super().__init__(child, ctx)
         self.partitioning = partitioning.bind(child.output_schema)
         self._materialized: Optional[List[List[ColumnarBatch]]] = None
+        # AQE (reference: GpuCustomShuffleReaderExec): after the stage
+        # materializes, adjacent small output partitions coalesce into one
+        # reader partition using real row counts.
+        self.adaptive = adaptive
+        self.target_rows = target_rows
+        self._groups: Optional[List[List[int]]] = None
 
         def slice_kernel(batch: ColumnarBatch, pids, p: int) -> ColumnarBatch:
             return compact(batch, pids == p)
@@ -47,14 +54,37 @@ class ShuffleExchangeExec(UnaryExec):
 
     @property
     def num_partitions(self) -> int:
+        if self.adaptive:
+            return len(self._partition_groups())
         return self.partitioning.num_partitions
+
+    def _partition_groups(self) -> List[List[int]]:
+        """Greedy adjacent coalesce of small partitions by materialized row
+        counts (AQE coalesce-partitions)."""
+        if self._groups is not None:
+            return self._groups
+        parts = self._materialize()
+        counts = [sum(int(b.num_rows) for b in pieces) for pieces in parts]
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_rows = 0
+        for p, c in enumerate(counts):
+            if cur and cur_rows + c > self.target_rows:
+                groups.append(cur)
+                cur, cur_rows = [], 0
+            cur.append(p)
+            cur_rows += c
+        if cur:
+            groups.append(cur)
+        self._groups = groups or [[0]]
+        return self._groups
 
     def _sample_range_bounds(self, batches: List[ColumnarBatch]) -> None:
         """Compute range bounds from the materialized input (reference:
         GpuRangePartitioner.sketch/determineBounds)."""
         from ..exec.common import sort_operands, gather_column
         part: RangePartitioning = self.partitioning
-        n = self.num_partitions
+        n = self.partitioning.num_partitions
         # concat all key columns, sort, take n-1 evenly spaced bound rows
         key_batches = []
         counts = []
@@ -84,7 +114,7 @@ class ShuffleExchangeExec(UnaryExec):
     def _materialize(self) -> List[List[ColumnarBatch]]:
         if self._materialized is not None:
             return self._materialized
-        n = self.num_partitions
+        n = self.partitioning.num_partitions   # write-side nominal count
         out: List[List[ColumnarBatch]] = [[] for _ in range(n)]
         batches = [b for cp in range(self.child.num_partitions)
                    for b in self.child.execute_partition(cp)]
@@ -102,7 +132,11 @@ class ShuffleExchangeExec(UnaryExec):
         return out
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        pieces = self._materialize()[p]
+        if self.adaptive:
+            group = self._partition_groups()[p]
+            pieces = [b for op_ in group for b in self._materialize()[op_]]
+        else:
+            pieces = self._materialize()[p]
         pieces = [b for b in pieces if int(b.num_rows) > 0]
         if not pieces:
             return

@@ -1,0 +1,48 @@
+"""Trace annotations for profiling.
+
+Reference: NVTX ranges wrap every significant operator/transport section
+(SURVEY.md §5 — 44 importing files, analyzed in Nsight). TPU equivalent:
+`jax.profiler.TraceAnnotation` + `jax.named_scope` so operator names show
+up in xprof/TensorBoard traces, gated by the same style of opt-in flag.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+_ENABLED = os.environ.get("RAPIDS_TPU_TRACE", "0") not in ("", "0", "false")
+
+
+def enable(on: bool = True) -> None:
+    global _ENABLED
+    _ENABLED = on
+
+
+@contextlib.contextmanager
+def op_range(name: str):
+    """Host-side range (shows as a TraceMe slice in xprof)."""
+    if not _ENABLED:
+        yield
+        return
+    import jax.profiler
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def named(name: str):
+    """Trace-time scope: names the XLA ops emitted inside (jax.named_scope);
+    zero cost at runtime — the names are baked into the HLO."""
+    import jax
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str):
+    """Capture an xprof trace around a block (nsys analogue)."""
+    import jax.profiler
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,77 @@
+"""AQE partition coalescing + CBO tests (reference: aqe_test.py,
+CostBasedOptimizerSuite)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Count, Sum
+from spark_rapids_tpu.plan import Session, table
+from spark_rapids_tpu.shuffle import HashPartitioning, ShuffleExchangeExec
+from spark_rapids_tpu.exec import InMemoryScanExec
+
+from harness.asserts import (assert_tables_equal,
+                             assert_tpu_and_cpu_are_equal_collect, rows_of)
+from harness.data_gen import IntegerGen, LongGen, gen_table
+
+
+def test_adaptive_coalesces_small_partitions():
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=100)),
+                   ("v", LongGen())], n=500, seed=160)
+    scan = InMemoryScanExec(t, batch_rows=100, num_slices=2)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 16), scan,
+                             adaptive=True, target_rows=1 << 20)
+    # 500 rows over 16 partitions, huge target -> everything coalesces to 1
+    assert ex.num_partitions == 1
+    rows = []
+    from spark_rapids_tpu.batch import to_arrow
+    for b in ex.execute_partition(0):
+        rows.extend(rows_of(to_arrow(b, ex.output_schema)))
+    assert len(rows) == 500
+
+
+def test_adaptive_respects_target():
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=100,
+                                    nullable=False)),
+                   ("v", LongGen())], n=1000, seed=161)
+    scan = InMemoryScanExec(t, batch_rows=250)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 8), scan,
+                             adaptive=True, target_rows=300)
+    n = ex.num_partitions
+    assert 1 < n <= 8
+    total = 0
+    from spark_rapids_tpu.batch import to_arrow
+    for p in range(n):
+        for b in ex.execute_partition(p):
+            total += int(b.num_rows)
+    assert total == 1000
+
+
+def test_query_with_adaptive_enabled_is_correct():
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=30)),
+                   ("v", LongGen())], n=800, seed=162)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t, num_slices=3).group_by("k")
+        .agg(Sum(col("v")).alias("s"), Count().alias("n")),
+        conf={"spark.rapids.tpu.sql.adaptive.enabled": True,
+              "spark.rapids.tpu.sql.adaptive.coalescePartitions.targetRows":
+                  100})
+
+
+def test_cbo_keeps_small_scan_on_cpu():
+    tiny = gen_table([("v", IntegerGen())], n=10, seed=163)
+    ses = Session({"spark.rapids.tpu.sql.optimizer.enabled": True})
+    df = table(tiny).select((col("v") + lit(1)).alias("x"))
+    got = ses.collect(df)
+    # CBO: 10 rows never pay for the TPU; the whole plan falls back
+    assert any("CpuFallback" in n for n in ses.executed_exec_names()), \
+        ses.executed_exec_names()
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False}).collect(df)
+    assert_tables_equal(got, cpu)
+
+
+def test_cbo_disabled_by_default():
+    tiny = gen_table([("v", IntegerGen())], n=10, seed=164)
+    ses = Session()
+    ses.collect(table(tiny).select((col("v") + lit(1)).alias("x")))
+    assert not any("CpuFallback" in n for n in ses.executed_exec_names())

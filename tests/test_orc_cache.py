@@ -1,0 +1,61 @@
+"""ORC scan + cache serializer tests (reference: orc_test.py, cache_test.py)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Sum
+from spark_rapids_tpu.io.orc import read_orc, write_orc
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import (assert_tables_equal,
+                             assert_tpu_and_cpu_are_equal_collect, rows_of)
+from harness.data_gen import DoubleGen, IntegerGen, StringGen, gen_table
+
+
+def test_orc_roundtrip(tmp_path):
+    t = gen_table([("a", IntegerGen()), ("s", StringGen(max_len=10)),
+                   ("d", DoubleGen())], n=500, seed=150)
+    path = str(tmp_path / "data.orc")
+    write_orc(t, path)
+    got = Session().collect(read_orc(path))
+    assert_tables_equal(got, t)
+
+
+def test_orc_scan_query_differential(tmp_path):
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=10)),
+                   ("v", IntegerGen())], n=400, seed=151)
+    path = str(tmp_path / "q.orc")
+    write_orc(t, path)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: read_orc(path).where(col("v") > lit(0)).group_by("k")
+        .agg(Sum(col("v")).alias("s")))
+
+
+def test_cache_materializes_once_and_reuses():
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=5)),
+                   ("v", IntegerGen())], n=300, seed=152)
+    ses = Session()
+    cached = ses.cache(table(t, num_slices=2).where(col("v") > lit(0)))
+    # two different consumers of the same cached relation
+    r1 = ses.collect(cached.group_by("k").agg(Sum(col("v")).alias("s")))
+    r2 = ses.collect(cached.select(col("v")))
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False})
+    e1 = cpu.collect(
+        table(t).where(col("v") > lit(0)).group_by("k")
+        .agg(Sum(col("v")).alias("s")))
+    e2 = cpu.collect(table(t).where(col("v") > lit(0)).select(col("v")))
+    assert_tables_equal(r1, e1, ignore_order=True)
+    assert_tables_equal(r2, e2, ignore_order=True)
+
+
+def test_cached_relation_is_compressed():
+    import numpy as np
+    reps = pa.table({"s": pa.array(["same-string"] * 5000)})
+    ses = Session()
+    from spark_rapids_tpu.plan.overrides import Overrides
+    from spark_rapids_tpu.io.cache import CachedRelation
+    plan = Overrides(ses.conf).plan(table(reps).plan)
+    cached = CachedRelation.build(plan)
+    raw = 5000 * len("same-string")
+    assert cached.size_bytes() < raw
