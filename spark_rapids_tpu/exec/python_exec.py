@@ -1,0 +1,98 @@
+"""Arrow-exchange Python UDF execs.
+
+Reference: SURVEY.md §2.11 / §3.5 — GpuArrowEvalPythonExec.scala:241
+(device → Arrow IPC → python worker → Arrow → device), GpuMapInBatchExec,
+GpuAggregateInPandasExec, gated by PythonWorkerSemaphore.scala:41. Here the
+engine IS Python, so the "worker" is an in-process callable behind the same
+Arrow columnar boundary (to_arrow/from_arrow is the exact exchange the
+reference does over a socket), and the worker semaphore bounds concurrent
+evaluation the same way.
+
+Two shapes, mirroring the reference's exec family:
+- ArrowEvalPythonExec: per-batch scalar pandas UDF — f(pd.Series...) ->
+  pd.Series appended as new columns.
+- MapInBatchExec: f(pd.DataFrame) -> pd.DataFrame with an arbitrary output
+  schema (mapInPandas).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+from ..batch import ColumnarBatch, Field, Schema, from_arrow, to_arrow
+from ..memory.semaphore import TpuSemaphore
+from .base import Exec, UnaryExec
+
+# reference: PythonWorkerSemaphore bounds concurrent GPU-using workers
+_python_semaphore = TpuSemaphore(4)
+
+
+class ArrowEvalPythonExec(UnaryExec):
+    """Append columns computed by a scalar pandas UDF."""
+
+    def __init__(self, fn: Callable, input_cols: Sequence[str],
+                 output_fields: Sequence[Field], child: Exec):
+        super().__init__(child)
+        self.fn = fn
+        self.input_cols = list(input_cols)
+        self.output_fields = list(output_fields)
+        self._schema = Schema(list(child.output_schema.fields)
+                              + self.output_fields)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        child_schema = self.child.output_schema
+        for batch in self.child.execute_partition(p):
+            with _python_semaphore.task():
+                table = to_arrow(batch, child_schema)     # D2H + Arrow
+                pdf = table.to_pandas()
+                args = [pdf[c] for c in self.input_cols]
+                result = self.fn(*args)
+                if not isinstance(result, (list, tuple)):
+                    result = [result]
+                for f, series in zip(self.output_fields, result):
+                    pdf[f.name] = series
+                out = pa.Table.from_pandas(pdf, preserve_index=False)
+                # cast to the declared output schema (pandas widens types)
+                from .. import types as T
+                target = pa.schema(
+                    [pa.field(f.name, T.to_arrow(f.dtype), f.nullable)
+                     for f in self._schema])
+                out = out.select(self._schema.names).cast(target)
+            nb, _ = from_arrow(out, schema=self._schema)   # H2D
+            yield nb
+
+
+class MapInBatchExec(UnaryExec):
+    """mapInPandas: df-in, df-out with a new schema (reference:
+    GpuMapInBatchExec)."""
+
+    def __init__(self, fn: Callable, output_schema: Schema, child: Exec):
+        super().__init__(child)
+        self.fn = fn
+        self._schema = output_schema
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        child_schema = self.child.output_schema
+        from .. import types as T
+        target = pa.schema([pa.field(f.name, T.to_arrow(f.dtype), f.nullable)
+                            for f in self._schema])
+        for batch in self.child.execute_partition(p):
+            with _python_semaphore.task():
+                pdf = to_arrow(batch, child_schema).to_pandas()
+                out_pdf = self.fn(pdf)
+                out = pa.Table.from_pandas(out_pdf, preserve_index=False)
+                out = out.select(self._schema.names).cast(target)
+            if out.num_rows == 0:
+                continue
+            nb, _ = from_arrow(out, schema=self._schema)
+            yield nb

@@ -1,0 +1,55 @@
+"""Arrow/pandas UDF exec tests (reference: udf_test.py pandas-UDF suites)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import Field, Schema
+from spark_rapids_tpu.exec import FilterExec, InMemoryScanExec, collect
+from spark_rapids_tpu.exec.python_exec import (ArrowEvalPythonExec,
+                                               MapInBatchExec)
+from spark_rapids_tpu.expressions import col, lit
+
+from harness.asserts import assert_rows_equal, rows_of
+from harness.data_gen import DoubleGen, IntegerGen, StringGen, gen_table
+
+
+def test_arrow_eval_python_scalar_udf():
+    t = gen_table([("a", IntegerGen()), ("b", IntegerGen())], n=300, seed=170)
+    scan = InMemoryScanExec(t, batch_rows=100)
+    plan = ArrowEvalPythonExec(
+        lambda a, b: a.fillna(0) * 2 + b.fillna(0),
+        ["a", "b"], [Field("c", T.INT64)], scan)
+    got = rows_of(collect(plan))
+    exp = [(a, b, (a or 0) * 2 + (b or 0))
+           for a, b in zip(t.column("a").to_pylist(),
+                           t.column("b").to_pylist())]
+    assert_rows_equal(got, exp)
+
+
+def test_arrow_eval_python_after_tpu_filter():
+    t = gen_table([("a", IntegerGen())], n=200, seed=171)
+    plan = ArrowEvalPythonExec(
+        lambda a: a.astype("int64") * a, ["a"], [Field("sq", T.INT64)],
+        FilterExec(col("a") > lit(0), InMemoryScanExec(t)))
+    got = rows_of(collect(plan))
+    exp = [(a, a * a) for a in t.column("a").to_pylist()
+           if a is not None and a > 0]
+    assert_rows_equal(got, exp)
+
+
+def test_map_in_batch():
+    t = gen_table([("a", IntegerGen(nullable=False)),
+                   ("s", StringGen(max_len=6))], n=150, seed=172)
+
+    def f(pdf):
+        out = pdf[pdf["a"] % 2 == 0][["a"]].copy()
+        out["half"] = out["a"] // 2
+        return out
+
+    schema = Schema([Field("a", T.INT32), Field("half", T.INT64)])
+    plan = MapInBatchExec(f, schema, InMemoryScanExec(t, batch_rows=50))
+    got = rows_of(collect(plan))
+    exp = [(a, a // 2) for a in t.column("a").to_pylist() if a % 2 == 0]
+    assert_rows_equal(got, exp)
