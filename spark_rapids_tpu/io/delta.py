@@ -1,0 +1,269 @@
+"""Transactional table format (Delta-protocol style).
+
+Reference: delta-lake/ (SURVEY.md §2.12, 9,721 LoC) — GPU-accelerated Delta
+writes with optimistic transactions (GpuOptimisticTransaction), DELETE/
+UPDATE command rewrites, per-file statistics collection. This module is the
+TPU-native equivalent on the same on-disk protocol shape: a `_delta_log/`
+of ordered JSON commits holding metaData/add/remove actions over parquet
+data files, optimistic concurrency via O_EXCL commit-file creation, row-
+level DELETE/UPDATE as copy-on-write file rewrites executed by the TPU
+engine, snapshot isolation and time travel by log replay.
+
+(MERGE INTO and z-ordered layout land in a later round; the log protocol
+here already carries what they need.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..batch import Schema
+from ..expressions.base import Expression
+from .. import types as T
+
+
+class CommitConflict(Exception):
+    """Another writer committed this version first (optimistic retry)."""
+
+
+def _log_dir(path: str) -> str:
+    return os.path.join(path, "_delta_log")
+
+
+def _version_file(path: str, v: int) -> str:
+    return os.path.join(_log_dir(path), f"{v:020d}.json")
+
+
+@dataclass
+class Snapshot:
+    version: int
+    files: List[str]
+    metadata: Dict[str, Any]
+
+    @property
+    def schema_json(self):
+        return self.metadata.get("schemaString")
+
+
+class DeltaTable:
+    def __init__(self, path: str):
+        self.path = path
+
+    # ------------------------------------------------------------------
+    # log replay
+    # ------------------------------------------------------------------
+
+    def latest_version(self) -> int:
+        d = _log_dir(self.path)
+        if not os.path.isdir(d):
+            return -1
+        vs = [int(f.split(".")[0]) for f in os.listdir(d)
+              if f.endswith(".json")]
+        return max(vs) if vs else -1
+
+    def snapshot(self, version: Optional[int] = None) -> Snapshot:
+        latest = self.latest_version()
+        if latest < 0:
+            raise FileNotFoundError(f"not a delta table: {self.path}")
+        v = latest if version is None else version
+        if v > latest:
+            raise ValueError(f"version {v} > latest {latest} (time travel "
+                             f"only goes backwards)")
+        live: Dict[str, bool] = {}
+        metadata: Dict[str, Any] = {}
+        for i in range(v + 1):
+            with open(_version_file(self.path, i)) as f:
+                for line in f:
+                    action = json.loads(line)
+                    if "metaData" in action:
+                        metadata = action["metaData"]
+                    elif "add" in action:
+                        live[action["add"]["path"]] = True
+                    elif "remove" in action:
+                        live.pop(action["remove"]["path"], None)
+        files = [os.path.join(self.path, p) for p in sorted(live)]
+        return Snapshot(v, files, metadata)
+
+    # ------------------------------------------------------------------
+    # commits (optimistic: O_EXCL create of the next version file)
+    # ------------------------------------------------------------------
+
+    def _commit(self, version: int, actions: List[Dict[str, Any]],
+                op: str) -> None:
+        os.makedirs(_log_dir(self.path), exist_ok=True)
+        actions = actions + [{"commitInfo": {
+            "timestamp": int(time.time() * 1000), "operation": op}}]
+        payload = "\n".join(json.dumps(a) for a in actions) + "\n"
+        target = _version_file(self.path, version)
+        try:
+            fd = os.open(target, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            raise CommitConflict(f"version {version} already committed")
+        with os.fdopen(fd, "w") as f:
+            f.write(payload)
+
+    def _write_data_file(self, table: pa.Table) -> Dict[str, Any]:
+        name = f"part-{uuid.uuid4().hex}.parquet"
+        full = os.path.join(self.path, name)
+        os.makedirs(self.path, exist_ok=True)
+        pq.write_table(table, full, compression="snappy")
+        # per-file statistics (reference: GpuStatisticsCollection during
+        # the GPU write — min/max/nullCount power data skipping)
+        stats = {"numRecords": table.num_rows, "minValues": {},
+                 "maxValues": {}, "nullCount": {}}
+        for col in table.column_names:
+            c = table.column(col)
+            stats["nullCount"][col] = c.null_count
+            try:
+                import pyarrow.compute as pc
+                if table.num_rows > c.null_count:
+                    mn = pc.min(c).as_py()
+                    mx = pc.max(c).as_py()
+                    if not isinstance(mn, (bytes,)):
+                        stats["minValues"][col] = _json_safe(mn)
+                        stats["maxValues"][col] = _json_safe(mx)
+            except Exception:
+                pass
+        return {"add": {"path": name, "size": os.path.getsize(full),
+                        "dataChange": True, "stats": json.dumps(stats)}}
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def write(cls, path: str, table: pa.Table, mode: str = "append",
+              max_retries: int = 10) -> "DeltaTable":
+        dt = cls(path)
+        for _ in range(max_retries):
+            latest = dt.latest_version()
+            actions: List[Dict[str, Any]] = []
+            if latest < 0:
+                actions.append({"metaData": {
+                    "id": uuid.uuid4().hex,
+                    "format": {"provider": "parquet"},
+                    "schemaString": json.dumps(
+                        {"fields": [{"name": n} for n in
+                                    table.column_names]}),
+                    "createdTime": int(time.time() * 1000)}})
+            elif mode == "overwrite":
+                snap = dt.snapshot()
+                for f in snap.files:
+                    actions.append({"remove": {
+                        "path": os.path.relpath(f, path),
+                        "dataChange": True}})
+            elif mode != "append":
+                raise ValueError(mode)
+            actions.append(dt._write_data_file(table))
+            try:
+                dt._commit(latest + 1, actions,
+                           "WRITE" if latest < 0 else mode.upper())
+                return dt
+            except CommitConflict:
+                continue
+        raise CommitConflict(f"gave up after {max_retries} retries")
+
+    def to_dataframe(self, version: Optional[int] = None,
+                     num_slices: int = 1):
+        """Snapshot read as a DataFrame (GPU scan path)."""
+        from .scan import read_parquet
+        snap = self.snapshot(version)
+        if not snap.files:
+            raise ValueError("empty table snapshot")
+        return read_parquet(snap.files, num_slices=num_slices)
+
+    def delete(self, predicate: Expression, session=None) -> int:
+        """Copy-on-write DELETE (reference: GpuDelete command). Returns the
+        number of deleted rows."""
+        from ..plan import Session, table as df_table
+        from ..expressions.comparison import Not
+        from ..expressions.boolean import And
+        from ..expressions.base import lit
+        ses = session or Session()
+        snap = self.snapshot()
+        actions: List[Dict[str, Any]] = []
+        deleted = 0
+        for f in snap.files:
+            t = pq.read_table(f)
+            # DELETE removes rows where the predicate is TRUE; false and
+            # null-valued rows stay (null OR true short-circuits in Or)
+            keep_cond = Not(predicate) | _pred_null(predicate)
+            kept = ses.collect(df_table(t).where(keep_cond))
+            dropped = t.num_rows - kept.num_rows
+            if dropped <= 0:
+                continue
+            deleted += dropped
+            actions.append({"remove": {
+                "path": os.path.relpath(f, self.path), "dataChange": True}})
+            if kept.num_rows:
+                actions.append(self._write_data_file(kept))
+        if actions:
+            self._commit(snap.version + 1, actions, "DELETE")
+        return deleted
+
+    def update(self, assignments: Dict[str, Expression],
+               predicate: Expression, session=None) -> int:
+        """Copy-on-write UPDATE (reference: GpuUpdate command)."""
+        from ..plan import Session, table as df_table
+        from ..expressions.base import col
+        from ..expressions.conditional import If
+        ses = session or Session()
+        snap = self.snapshot()
+        actions: List[Dict[str, Any]] = []
+        updated = 0
+        for f in snap.files:
+            t = pq.read_table(f)
+            matched = ses.collect(df_table(t).where(predicate))
+            if matched.num_rows == 0:
+                continue
+            updated += matched.num_rows
+            exprs = []
+            for name in t.column_names:
+                if name in assignments:
+                    exprs.append(If(predicate, assignments[name],
+                                    col(name)).alias(name))
+                else:
+                    exprs.append(col(name).alias(name))
+            rewritten = ses.collect(df_table(t).select(*exprs))
+            actions.append({"remove": {
+                "path": os.path.relpath(f, self.path), "dataChange": True}})
+            actions.append(self._write_data_file(rewritten))
+        if actions:
+            self._commit(snap.version + 1, actions, "UPDATE")
+        return updated
+
+    def history(self) -> List[Dict[str, Any]]:
+        out = []
+        for v in range(self.latest_version() + 1):
+            with open(_version_file(self.path, v)) as f:
+                for line in f:
+                    a = json.loads(line)
+                    if "commitInfo" in a:
+                        out.append({"version": v, **a["commitInfo"]})
+        return out
+
+
+def _pred_null(predicate: Expression) -> Expression:
+    from ..expressions.comparison import IsNull
+    return IsNull(predicate)
+
+
+def _json_safe(v):
+    import datetime as dt
+    import decimal
+    if isinstance(v, (dt.date, dt.datetime)):
+        return v.isoformat()
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                 float("-inf"))):
+        return str(v)
+    return v

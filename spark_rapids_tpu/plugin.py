@@ -1,0 +1,182 @@
+"""Runtime bootstrap: the plugin/executor lifecycle.
+
+Reference: SURVEY.md §2.1/§3.1 — SQLPlugin → RapidsDriverPlugin (conf
+fixup, heartbeat host) and RapidsExecutorPlugin (device acquire, RMM init,
+version handshake, semaphore init, fatal-error exit policy,
+Plugin.scala:215-393). The standalone TPU engine folds both roles into one
+process; multi-host deployments run one `ExecutorRuntime` per host with
+`jax.distributed` supplying the DCN control plane.
+
+Failure policy mirrors the reference (SURVEY.md §5): a fatal device error
+marks the runtime unusable and (optionally) exits with a dedicated code so
+a scheduler reschedules the executor — the plugin adds fast failure, the
+cluster manager supplies recovery (Spark task-retry in the reference).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .config import (CONCURRENT_TPU_TASKS, HBM_POOL_FRACTION, HBM_RESERVE,
+                     HOST_SPILL_LIMIT, RapidsTpuConf, SPILL_DIR)
+
+log = logging.getLogger("spark_rapids_tpu")
+
+FATAL_EXIT_CODE = 20     # reference: executor exits 20 on fatal CUDA error
+
+_MIN_JAX = (0, 4, 30)
+
+
+@dataclass
+class DeviceInfo:
+    platform: str
+    device_kind: str
+    num_local: int
+    num_global: int
+    hbm_bytes: Optional[int] = None
+
+
+class ExecutorRuntime:
+    """Per-process device runtime (reference: RapidsExecutorPlugin.init)."""
+
+    _instance: Optional["ExecutorRuntime"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[RapidsTpuConf] = None,
+                 exit_on_fatal: bool = False):
+        self.conf = conf or RapidsTpuConf()
+        self.exit_on_fatal = exit_on_fatal
+        self.fatal_error: Optional[BaseException] = None
+        self.started_at = time.time()
+        self._heartbeats: Dict[str, float] = {}
+
+        self._version_handshake()
+        self.device = self._acquire_device()
+        self.semaphore = self._init_semaphore()
+        self.catalog = self._init_memory()
+        atexit.register(self.shutdown)
+        log.info("ExecutorRuntime up: %s", self.device)
+
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def get(cls, conf: Optional[RapidsTpuConf] = None) -> "ExecutorRuntime":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = ExecutorRuntime(conf)
+            return cls._instance
+
+    def _version_handshake(self) -> None:
+        """Reference: cudf/JNI version checks (Plugin.scala:300-324)."""
+        import jax
+        ver = tuple(int(x) for x in jax.__version__.split(".")[:3])
+        if ver < _MIN_JAX:
+            raise RuntimeError(
+                f"jax {jax.__version__} is older than the minimum supported "
+                f"{'.'.join(map(str, _MIN_JAX))}")
+        if not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "x64 mode is off — int64/float64 SQL semantics require it "
+                "(spark_rapids_tpu enables it at import; something reset it)")
+
+    def _acquire_device(self) -> DeviceInfo:
+        """Reference: one GPU per executor (GpuDeviceManager.scala:93-114) —
+        one TPU chip per executor process here."""
+        import jax
+        local = jax.local_devices()
+        dev = local[0]
+        hbm = None
+        try:
+            stats = dev.memory_stats()
+            if stats:
+                hbm = stats.get("bytes_limit")
+        except Exception:
+            pass
+        return DeviceInfo(platform=dev.platform,
+                          device_kind=getattr(dev, "device_kind", "?"),
+                          num_local=len(local),
+                          num_global=jax.device_count(), hbm_bytes=hbm)
+
+    def _init_semaphore(self):
+        from .memory.semaphore import TpuSemaphore
+        return TpuSemaphore(self.conf.get(CONCURRENT_TPU_TASKS.key))
+
+    def _init_memory(self):
+        """Reference: initializeRmm pool sizing (GpuDeviceManager:192-317) —
+        here the reservation budget is sized from real HBM when known."""
+        from .memory.catalog import BufferCatalog
+        frac = self.conf.get(HBM_POOL_FRACTION.key)
+        reserve = self.conf.get(HBM_RESERVE.key)
+        hbm = self.device.hbm_bytes or (16 << 30)
+        limit = max(int(hbm * frac) - reserve, 1 << 30)
+        return BufferCatalog(device_limit=limit,
+                             host_limit=self.conf.get(HOST_SPILL_LIMIT.key),
+                             spill_dir=self.conf.get(SPILL_DIR.key))
+
+    # ------------------------------------------------------------------
+    # failure handling (reference: Plugin.scala:370-392 onTaskFailed)
+    # ------------------------------------------------------------------
+
+    FATAL_MARKERS = ("DEADLINE_EXCEEDED", "device is in an invalid state",
+                     "HBM OOM", "halted", "RESOURCE_EXHAUSTED: XLA")
+
+    def classify_failure(self, exc: BaseException) -> bool:
+        """True if fatal for the device (executor must be replaced)."""
+        msg = str(exc)
+        return any(m in msg for m in self.FATAL_MARKERS)
+
+    def on_task_failed(self, exc: BaseException) -> None:
+        if not self.classify_failure(exc):
+            return
+        self.fatal_error = exc
+        log.error("fatal device error; executor unusable: %s", exc)
+        self._dump_device_state()
+        if self.exit_on_fatal:
+            sys.exit(FATAL_EXIT_CODE)
+
+    def _dump_device_state(self) -> None:
+        """Reference: nvidia-smi capture on death (Plugin.scala:341-361)."""
+        try:
+            import jax
+            for d in jax.local_devices():
+                stats = d.memory_stats() or {}
+                log.error("device %s stats: %s", d, stats)
+            log.error("catalog:\n%s", self.catalog.dump_state())
+        except Exception:
+            pass
+
+    def ensure_healthy(self) -> None:
+        if self.fatal_error is not None:
+            raise RuntimeError(
+                f"executor poisoned by earlier fatal error: "
+                f"{self.fatal_error}")
+
+    # ------------------------------------------------------------------
+    # liveness (reference: RapidsShuffleHeartbeatManager — driver-side
+    # registry of executor heartbeats for shuffle peer discovery)
+    # ------------------------------------------------------------------
+
+    def heartbeat(self, executor_id: str) -> None:
+        self._heartbeats[executor_id] = time.time()
+
+    def live_executors(self, timeout_s: float = 30.0) -> List[str]:
+        now = time.time()
+        return [e for e, t in self._heartbeats.items()
+                if now - t <= timeout_s]
+
+    def shutdown(self) -> None:
+        pass
+
+
+def init(conf_dict: Optional[Dict] = None) -> ExecutorRuntime:
+    """Engine entry point (the `spark.plugins=com.nvidia.spark.SQLPlugin`
+    moment). Idempotent."""
+    conf = RapidsTpuConf(conf_dict) if conf_dict else None
+    return ExecutorRuntime.get(conf)

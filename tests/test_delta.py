@@ -1,0 +1,115 @@
+"""Transactional table tests (reference: delta_lake_write_test.py /
+delta_lake_delete_test.py / delta_lake_update_test.py patterns)."""
+
+import json
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.io.delta import CommitConflict, DeltaTable
+from spark_rapids_tpu.plan import Session
+
+from harness.asserts import assert_tables_equal, rows_of
+from harness.data_gen import IntegerGen, LongGen, StringGen, gen_table
+
+
+def t1(seed=180, n=200):
+    return gen_table([("k", IntegerGen(min_val=0, max_val=20)),
+                      ("v", LongGen())], n=n, seed=seed)
+
+
+def test_create_and_read(tmp_path):
+    path = str(tmp_path / "dt")
+    t = t1()
+    DeltaTable.write(path, t)
+    got = Session().collect(DeltaTable(path).to_dataframe())
+    assert_tables_equal(got, t, ignore_order=True)
+
+
+def test_append_and_overwrite(tmp_path):
+    path = str(tmp_path / "dt")
+    a, b = t1(1), t1(2)
+    DeltaTable.write(path, a)
+    DeltaTable.write(path, b, mode="append")
+    dt = DeltaTable(path)
+    got = Session().collect(dt.to_dataframe())
+    assert got.num_rows == a.num_rows + b.num_rows
+    DeltaTable.write(path, b, mode="overwrite")
+    got = Session().collect(dt.to_dataframe())
+    assert_tables_equal(got, b, ignore_order=True)
+
+
+def test_time_travel(tmp_path):
+    path = str(tmp_path / "dt")
+    a, b = t1(3), t1(4)
+    DeltaTable.write(path, a)
+    DeltaTable.write(path, b, mode="overwrite")
+    dt = DeltaTable(path)
+    v0 = Session().collect(dt.to_dataframe(version=0))
+    assert_tables_equal(v0, a, ignore_order=True)
+    v1 = Session().collect(dt.to_dataframe(version=1))
+    assert_tables_equal(v1, b, ignore_order=True)
+
+
+def test_delete_rows(tmp_path):
+    path = str(tmp_path / "dt")
+    t = t1(5)
+    DeltaTable.write(path, t)
+    dt = DeltaTable(path)
+    n = dt.delete(col("k") < lit(5))
+    exp_deleted = sum(1 for k in t.column("k").to_pylist()
+                      if k is not None and k < 5)
+    assert n == exp_deleted
+    got = Session().collect(dt.to_dataframe())
+    exp = [(k, v) for k, v in zip(t.column("k").to_pylist(),
+                                  t.column("v").to_pylist())
+           if not (k is not None and k < 5)]
+    from harness.asserts import assert_rows_equal
+    assert_rows_equal(rows_of(got), exp, ignore_order=True)
+
+
+def test_update_rows(tmp_path):
+    path = str(tmp_path / "dt")
+    t = pa.table({"k": pa.array([1, 2, 3, 4, 5]),
+                  "v": pa.array([10, 20, 30, 40, 50], pa.int64())})
+    DeltaTable.write(path, t)
+    dt = DeltaTable(path)
+    n = dt.update({"v": col("v") + lit(100, )},
+                  col("k") >= lit(4))
+    assert n == 2
+    got = rows_of(Session().collect(dt.to_dataframe()))
+    from harness.asserts import assert_rows_equal
+    assert_rows_equal(got, [(1, 10), (2, 20), (3, 30), (4, 140), (5, 150)],
+                      ignore_order=True)
+
+
+def test_commit_conflict_detected(tmp_path):
+    path = str(tmp_path / "dt")
+    DeltaTable.write(path, t1(6))
+    dt = DeltaTable(path)
+    # simulate a racing writer that claimed version 1
+    os.makedirs(os.path.join(path, "_delta_log"), exist_ok=True)
+    with open(os.path.join(path, "_delta_log", f"{1:020d}.json"), "w") as f:
+        f.write(json.dumps({"commitInfo": {"operation": "RACE"}}) + "\n")
+    with pytest.raises(CommitConflict):
+        dt._commit(1, [], "WRITE")
+    # but the public write API retries onto version 2
+    DeltaTable.write(path, t1(7), mode="append")
+    assert dt.latest_version() == 2
+
+
+def test_history_and_stats(tmp_path):
+    path = str(tmp_path / "dt")
+    DeltaTable.write(path, t1(8))
+    dt = DeltaTable(path)
+    dt.delete(col("k") == lit(0))
+    h = dt.history()
+    assert [e["operation"] for e in h][:1] == ["WRITE"]
+    # add actions carry numRecords/min/max stats
+    with open(os.path.join(path, "_delta_log", f"{0:020d}.json")) as f:
+        adds = [json.loads(l) for l in f if "add" in l]
+    stats = json.loads(adds[0]["add"]["stats"])
+    assert stats["numRecords"] == 200
+    assert "k" in stats["minValues"]
