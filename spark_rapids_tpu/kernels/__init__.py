@@ -1,0 +1,15 @@
+"""Pallas TPU kernels for hot operations.
+
+Where XLA's fusion already saturates the VPU/MXU (elementwise chains,
+matmuls, sorts) the engine stays on plain jnp; Pallas enters where manual
+tiling or memory placement beats the compiler (SURVEY.md §7: joins,
+string/regex scanning). First resident: the murmur3 row-hash kernel —
+every shuffle route and join build hashes every row, and the Pallas version
+keeps the whole multi-column hash chain in VMEM registers instead of
+round-tripping intermediate columns (guide: /opt/skills/guides/
+pallas_guide.md).
+"""
+
+from .murmur3 import pallas_available, pallas_murmur3_int32
+
+__all__ = ["pallas_murmur3_int32", "pallas_available"]

@@ -1,0 +1,83 @@
+"""Pallas murmur3 row-hash kernel.
+
+Spark Murmur3_x86_32 over an int32 column with per-row seeds (the fold-left
+chain hash of expressions/hashing.py): one VMEM-resident fused kernel —
+load tile, run the whole mix/fmix chain in registers, store tile. Tiled
+(8, 128) per the 32-bit tiling constraint; callers pad row counts to the
+1024-row tile (capacity buckets already are powers of two >= 128).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TILE_ROWS = 8 * 128
+
+
+def _u32(x):
+    return x.astype(jnp.uint32)
+
+
+def _kernel(data_ref, valid_ref, seed_ref, out_ref):
+    k = data_ref[:].astype(jnp.int32).view(jnp.uint32)
+    seed = seed_ref[:].view(jnp.uint32)
+    c1 = jnp.uint32(0xCC9E2D51)
+    c2 = jnp.uint32(0x1B873593)
+    k1 = k * c1
+    k1 = (k1 << 15) | (k1 >> 17)
+    k1 = k1 * c2
+    h1 = seed ^ k1
+    h1 = (h1 << 13) | (h1 >> 19)
+    h1 = h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    h1 = h1 ^ jnp.uint32(4)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> 16)
+    valid = valid_ref[:]
+    out_ref[:] = jnp.where(valid, h1, seed).view(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_murmur3_int32(data: jax.Array, validity: jax.Array,
+                         seeds: jax.Array, interpret: bool = False
+                         ) -> jax.Array:
+    """hashInt per row: data int32[n], validity bool[n], seeds int32[n]
+    (the running fold-left hash) -> int32[n]. n must be a multiple of 1024.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = data.shape[0]
+    assert n % _TILE_ROWS == 0, n
+    tiles = n // _TILE_ROWS
+    shape2d = (tiles * 8, 128)
+    d2 = data.reshape(shape2d)
+    v2 = validity.reshape(shape2d)
+    s2 = seeds.reshape(shape2d)
+    # index map: `0` must be i32 — under x64 mode a literal 0 traces as
+    # i64 and Mosaic rejects the mixed (i32, i64) return
+    block = pl.BlockSpec((8, 128), lambda i: (i, i - i),
+                         memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _kernel,
+        grid=(tiles,),
+        out_shape=jax.ShapeDtypeStruct(shape2d, jnp.int32),
+        in_specs=[block, block, block],
+        out_specs=block,
+        interpret=interpret,
+    )(d2, v2, s2)
+    return out.reshape(n)
+
+
+def pallas_available() -> bool:
+    """True when the default backend can run compiled Pallas TPU kernels."""
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
