@@ -1,0 +1,89 @@
+"""Z-order (Morton) interleave expressions for clustered data layout.
+
+Reference: sql-plugin/.../sql/rapids/zorder/ (GpuInterleaveBits,
+GpuHilbertLongIndex, ZOrderRules — used by the Delta OPTIMIZE ZORDER BY
+acceleration). Interleaving the rank-normalized bits of the clustering
+columns gives a space-filling-curve sort key; files written in that order
+carry tight min/max stats per column, so predicate-pushdown skips most of
+them (delta.py collects exactly those stats).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import DeviceColumn
+from ..types import TypeKind
+from .base import EvalContext, Expression, numeric_column
+
+
+def _orderable_u32(col: DeviceColumn) -> jnp.ndarray:
+    """Rank-preserving uint32 of a numeric/date column (nulls lowest)."""
+    k = col.dtype.kind
+    d = col.data
+    if k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+        x = d.astype(jnp.float32)
+        import jax
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        sign = jnp.uint32(0x80000000)
+        v = jnp.where(u & sign != 0, ~u, u | sign)
+    elif k is TypeKind.BOOLEAN:
+        v = d.astype(jnp.uint32)
+    else:
+        # 64-bit ints clamp (saturating) into int32 range: order-preserving
+        # and keeps low-bit locality for in-range values, unlike taking the
+        # top word which zeroes everything below 2^32
+        x = jnp.clip(d.astype(jnp.int64), -(2 ** 31), 2 ** 31 - 1)
+        v = x.astype(jnp.int32).view(jnp.uint32) ^ jnp.uint32(0x80000000)
+    # nulls sort first: shift range up by one and reserve 0
+    return jnp.where(col.validity, jnp.maximum(v, 1), 0)
+
+
+@dataclass(frozen=True, eq=False)
+class InterleaveBits(Expression):
+    """Morton key over up to 8 columns: each column contributes its top
+    64//k bits, bit-interleaved into one int64."""
+
+    exprs: Tuple[Expression, ...]
+
+    @property
+    def children(self):
+        return self.exprs
+
+    def with_children(self, c):
+        return InterleaveBits(tuple(c))
+
+    @property
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, batch, ctx=EvalContext()):
+        cols = [e.eval(batch, ctx) for e in self.exprs]
+        k = len(cols)
+        assert 1 <= k <= 8
+        bits_per = 64 // k
+        words = [_orderable_u32(c).astype(jnp.uint64) >> jnp.uint64(
+            32 - bits_per) for c in cols]
+        out = jnp.zeros(batch.capacity, jnp.uint64)
+        # bit j of column i lands at position j*k + (k-1-i)
+        for j in range(bits_per):
+            for i, w in enumerate(words):
+                bit = (w >> jnp.uint64(bits_per - 1 - j)) & jnp.uint64(1)
+                pos = (bits_per - 1 - j) * k + (k - 1 - i)
+                out = out | (bit << jnp.uint64(pos))
+        # flip the MSB so SIGNED int64 order equals unsigned morton order
+        out = out ^ (jnp.uint64(1) << jnp.uint64(63))
+        return numeric_column(out.astype(jnp.int64), batch.row_mask(),
+                              T.INT64)
+
+
+def zorder_key(*exprs) -> InterleaveBits:
+    return InterleaveBits(tuple(exprs))

@@ -141,7 +141,19 @@ class DeltaTable:
 
     @classmethod
     def write(cls, path: str, table: pa.Table, mode: str = "append",
-              max_retries: int = 10) -> "DeltaTable":
+              max_retries: int = 10,
+              z_order_by: Optional[Sequence[str]] = None,
+              files: int = 1) -> "DeltaTable":
+        if z_order_by:
+            # cluster rows along the space-filling curve on the engine
+            # (reference: delta z-order acceleration, ZOrderRules)
+            from ..plan import Session, table as df_table
+            from ..exec.sort import asc
+            from ..expressions.base import col
+            from ..expressions.zorder import zorder_key
+            key = zorder_key(*[col(c) for c in z_order_by])
+            ses = Session()
+            table = ses.collect(df_table(table).order_by(asc(key)))
         dt = cls(path)
         for _ in range(max_retries):
             latest = dt.latest_version()
@@ -162,7 +174,13 @@ class DeltaTable:
                         "dataChange": True}})
             elif mode != "append":
                 raise ValueError(mode)
-            actions.append(dt._write_data_file(table))
+            if files <= 1:
+                actions.append(dt._write_data_file(table))
+            else:
+                step = -(-table.num_rows // files)
+                for off in range(0, table.num_rows, step):
+                    actions.append(dt._write_data_file(
+                        table.slice(off, step)))
             try:
                 dt._commit(latest + 1, actions,
                            "WRITE" if latest < 0 else mode.upper())

@@ -104,6 +104,7 @@ def _expr_rules() -> Dict[str, ExprRule]:
     for n in ("ExtractDatePart", "DateAddSub", "DateDiff", "AddMonths",
               "LastDay", "UnixTimestampConv"):
         r(n, TS.DATETIME + TS.INTEGRAL)
+    r("InterleaveBits", TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
     # window
     for n in ("WindowExpression", "RowNumber", "Rank", "NTile", "LagLead",
               "WindowAgg"):
@@ -329,7 +330,13 @@ class Overrides:
         return self.conf.get(SHUFFLE_PARTITIONS.key)
 
     def _exchange(self, partitioning, child: Exec) -> Exec:
-        from ..config import ADAPTIVE_ENABLED, ADAPTIVE_TARGET_ROWS
+        from ..config import (ADAPTIVE_ENABLED, ADAPTIVE_TARGET_ROWS,
+                              SHUFFLE_MODE)
+        mode = str(self.conf.get(SHUFFLE_MODE.key)).upper()
+        if mode == "MULTITHREADED":
+            from ..shuffle.multithreaded import \
+                MultithreadedShuffleExchangeExec
+            return MultithreadedShuffleExchangeExec(partitioning, child)
         return ShuffleExchangeExec(
             partitioning, child,
             adaptive=self.conf.get(ADAPTIVE_ENABLED.key),

@@ -10,5 +10,6 @@ from .partitioning import (HashPartitioning, Partitioning,
                            RangePartitioning, RoundRobinPartitioning,
                            SinglePartitioning)
 from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+from .multithreaded import MultithreadedShuffleExchangeExec
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,157 @@
+"""Multithreaded file-backed shuffle mode.
+
+Reference: SURVEY.md §2.10 — RapidsShuffleThreadedWriterBase:228 /
+ReaderBase:504 (thread-pooled parallel writers/readers over Spark shuffle
+files, with BytesInFlightLimiter:574). This is the middle of the three
+shuffle modes: rows leave the device once (serialize), land in per-
+(mapper, reducer) framed files via the writer pool, and reducers decode
+with a reader pool — the shape that scales past one process and feeds the
+DCN path, with the in-flight byte limiter bounding host memory.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import threading
+import uuid
+from typing import Iterator, List, Optional
+
+import jax
+
+from ..batch import ColumnarBatch, Schema, bucket_capacity
+from ..exec.base import Exec, UnaryExec
+from ..exec.common import compact, concat_batches
+from ..expressions.base import EvalContext
+from .partitioning import Partitioning, RangePartitioning
+from .serializer import deserialize_batch, serialize_batch
+
+
+class BytesInFlightLimiter:
+    """Bounds serialized bytes buffered across the writer pool
+    (reference: BytesInFlightLimiter — backpressure, not a hard error)."""
+
+    def __init__(self, limit: int = 512 << 20):
+        self.limit = limit
+        self._used = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int) -> None:
+        with self._cv:
+            while self._used + n > self.limit and self._used > 0:
+                self._cv.wait()
+            self._used += n
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._used -= n
+            self._cv.notify_all()
+
+
+class MultithreadedShuffleExchangeExec(UnaryExec):
+    """Shuffle through framed spill files with writer/reader thread pools."""
+
+    def __init__(self, partitioning: Partitioning, child: Exec,
+                 shuffle_dir: Optional[str] = None,
+                 num_threads: int = 8,
+                 max_bytes_in_flight: int = 512 << 20,
+                 ctx: Optional[EvalContext] = None):
+        super().__init__(child, ctx)
+        self.partitioning = partitioning.bind(child.output_schema)
+        self.shuffle_dir = shuffle_dir or os.path.join(
+            "/tmp/rapids_tpu_shuffle", uuid.uuid4().hex)
+        self.num_threads = num_threads
+        self.limiter = BytesInFlightLimiter(max_bytes_in_flight)
+        self._written = False
+        self._write_lock = threading.Lock()
+        self._files: List[List[str]] = []
+
+        def slice_kernel(batch, pids, p: int):
+            return compact(batch, pids == p)
+
+        self._slice_jit = jax.jit(slice_kernel, static_argnums=2)
+        self._pids_jit = jax.jit(
+            lambda b: self.partitioning.partition_ids(b, self.ctx))
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.child.output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    # ------------------------------------------------------------------
+    # write side (map tasks)
+    # ------------------------------------------------------------------
+
+    def _write_all(self) -> None:
+        with self._write_lock:
+            if self._written:
+                return
+            os.makedirs(self.shuffle_dir, exist_ok=True)
+            n = self.num_partitions
+            schema = self.output_schema
+            self._files = [[] for _ in range(n)]
+            pool = cf.ThreadPoolExecutor(self.num_threads,
+                                         thread_name_prefix="shuffle-write")
+            futures = []
+            seq = 0
+            for cp in range(self.child.num_partitions):
+                for batch in self.child.execute_partition(cp):
+                    pids = self._pids_jit(batch)
+                    for p in range(n):
+                        piece = self._slice_jit(batch, pids, p)
+                        if int(piece.num_rows) == 0:
+                            continue
+                        path = os.path.join(self.shuffle_dir,
+                                            f"m{seq}-r{p}.rtpu")
+                        self._files[p].append(path)
+                        futures.append(pool.submit(
+                            self._write_piece, piece, schema, path))
+                        seq += 1
+            for f in futures:
+                f.result()
+            pool.shutdown()
+            self._written = True
+
+    def _write_piece(self, piece: ColumnarBatch, schema: Schema,
+                     path: str) -> None:
+        data = serialize_batch(piece, schema)   # D2H + frame + compress
+        self.limiter.acquire(len(data))
+        try:
+            with open(path, "wb") as f:
+                f.write(data)
+        finally:
+            self.limiter.release(len(data))
+
+    # ------------------------------------------------------------------
+    # read side (reduce tasks)
+    # ------------------------------------------------------------------
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        self._write_all()
+        paths = self._files[p]
+        if not paths:
+            return
+        schema = self.output_schema
+        pool = cf.ThreadPoolExecutor(self.num_threads,
+                                     thread_name_prefix="shuffle-read")
+        futures = [pool.submit(self._read_piece, path) for path in paths]
+        batches = [deserialize_batch(f.result(), schema) for f in futures]
+        pool.shutdown()
+        total = sum(int(b.num_rows) for b in batches)
+        if total == 0:
+            return
+        if len(batches) == 1:
+            yield batches[0]
+        else:
+            yield concat_batches(batches, bucket_capacity(total))
+
+    def _read_piece(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def cleanup(self) -> None:
+        import shutil
+        shutil.rmtree(self.shuffle_dir, ignore_errors=True)
