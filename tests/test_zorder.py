@@ -35,7 +35,8 @@ def test_zorder_write_improves_file_skipping(tmp_path):
     import numpy as np
     rng = np.random.default_rng(7)
     n = 2000
-    cluster = rng.integers(0, 2, n)
+    cluster = np.arange(n) % 2   # exactly half per cluster: the z-sorted
+    # file boundary then coincides with the cluster boundary
     x = np.where(cluster, rng.integers(1000, 1100, n),
                  rng.integers(0, 100, n)).astype(np.int32)
     y = np.where(cluster, rng.integers(1000, 1100, n),
