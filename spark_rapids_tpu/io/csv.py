@@ -80,6 +80,25 @@ class CsvSource(FileSource):
         return t
 
 
+class HiveTextSource(CsvSource):
+    """Hive delimited text (reference: GpuHiveTableScanExec — ^A-separated,
+    \\N nulls, headerless)."""
+
+    format_name = "hive-text"
+
+    def __init__(self, paths, schema=None, sep: str = "\x01", **kw):
+        super().__init__(paths, schema=schema, header=False, sep=sep,
+                         null_value="\\N", **kw)
+
+
+def read_hive_text(paths, schema, sep: str = "\x01", num_slices: int = 1,
+                   **kw):
+    from ..plan.logical import DataFrame, LogicalScan
+    src = HiveTextSource(paths, schema=schema, sep=sep, **kw)
+    return DataFrame(LogicalScan((), source=src, _schema=src.schema(),
+                                 num_slices=num_slices))
+
+
 def write_csv(table: pa.Table, path: str, header: bool = True) -> None:
     import os
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

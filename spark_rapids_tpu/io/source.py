@@ -39,12 +39,36 @@ def reader_pool(num_threads: int = 8) -> cf.ThreadPoolExecutor:
         return _POOL
 
 
+# ---------------------------------------------------------------------------
+# Transparent path rewriting (reference: AlluxioUtils.scala:73 — s3:// paths
+# rewritten to an alluxio:// cache cluster, with automount). Register
+# prefix rules once; every scan then reads through the cache tier.
+# ---------------------------------------------------------------------------
+
+_PATH_RULES: List[tuple] = []
+
+
+def register_path_rewrite(src_prefix: str, dst_prefix: str) -> None:
+    _PATH_RULES.append((src_prefix, dst_prefix))
+
+
+def clear_path_rewrites() -> None:
+    _PATH_RULES.clear()
+
+
+def rewrite_path(p: str) -> str:
+    for src, dst in _PATH_RULES:
+        if p.startswith(src):
+            return dst + p[len(src):]
+    return p
+
+
 def expand_paths(paths) -> List[str]:
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
     out: List[str] = []
     for p in paths:
-        p = str(p)
+        p = rewrite_path(str(p))
         if os.path.isdir(p):
             for root, _, files in os.walk(p):
                 out.extend(os.path.join(root, f) for f in sorted(files)

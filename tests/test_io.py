@@ -134,3 +134,31 @@ def test_multifile_scan_differential_query(pq_files):
         lambda: read_parquet(str(d), num_slices=4)
         .where(col("d") > lit(0.0))
         .select(col("k"), (col("v") + lit(1)).alias("v1")))
+
+
+def test_path_rewrite_hook(tmp_path):
+    from spark_rapids_tpu.io.source import (clear_path_rewrites,
+                                            register_path_rewrite)
+    t = gen_table([("a", IntegerGen())], n=50, seed=95)
+    real = str(tmp_path / "cached.parquet")
+    pq.write_table(t, real)
+    register_path_rewrite("remote://bucket/", str(tmp_path) + "/")
+    try:
+        df = read_parquet("remote://bucket/cached.parquet")
+        got = Session().collect(df)
+        assert_tables_equal(got, t)
+    finally:
+        clear_path_rewrites()
+
+
+def test_hive_text_scan(tmp_path):
+    from spark_rapids_tpu.io.csv import read_hive_text
+    path = str(tmp_path / "hive.txt")
+    with open(path, "w") as f:
+        f.write("1\x01alpha\x012.5\n")
+        f.write("2\x01\\N\x013.5\n")
+        f.write("3\x01gamma\x01\\N\n")
+    schema = Schema([Field("i", T.INT32), Field("s", T.string(16)),
+                     Field("d", T.FLOAT64)])
+    got = rows_of(Session().collect(read_hive_text(path, schema)))
+    assert got == [(1, "alpha", 2.5), (2, None, 3.5), (3, "gamma", None)]
