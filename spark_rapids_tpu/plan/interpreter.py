@@ -564,6 +564,21 @@ class RowEvaluator:
             return self._dt_days(v) * 86400
         return dt.datetime(1970, 1, 1) + dt.timedelta(seconds=v)
 
+    def _eval_RLike(self, e, row):
+        import re
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        return re.search(e.pattern, v) is not None
+
+    def _eval_Like(self, e, row):
+        import re
+        from ..expressions.regex import like_to_regex
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        return re.search(like_to_regex(e.pattern), v, re.DOTALL) is not None
+
     def _eval_Murmur3Hash(self, e, row):
         from ..utils.murmur3 import spark_hash_row
         vals = [self.eval(c, row) for c in e.exprs]

@@ -105,6 +105,9 @@ def _expr_rules() -> Dict[str, ExprRule]:
               "LastDay", "UnixTimestampConv"):
         r(n, TS.DATETIME + TS.INTEGRAL)
     r("InterleaveBits", TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
+    r("RLike", TS.ALL_BASIC,
+      note="DFA subset; unsupported constructs raise at plan build")
+    r("Like", TS.ALL_BASIC)
     # window
     for n in ("WindowExpression", "RowNumber", "Rank", "NTile", "LagLead",
               "WindowAgg"):
