@@ -102,9 +102,12 @@ class ProjectExec(UnaryExec):
         self.exprs = bind_all(exprs, child.output_schema)
         self._schema = schema_of(self.exprs)
 
-        def kernel(batch: ColumnarBatch) -> ColumnarBatch:
-            cols = tuple(e.eval(batch, self.ctx) for e in self.exprs)
-            return ColumnarBatch(cols, batch.num_rows)
+        def kernel(batch: ColumnarBatch):
+            ctx = EvalContext(self.ctx.ansi, {}) if self.ctx.ansi \
+                else self.ctx
+            cols = tuple(e.eval(batch, ctx) for e in self.exprs)
+            errs = _sum_errors(ctx) if self.ctx.ansi else {}
+            return ColumnarBatch(cols, batch.num_rows), errs
 
         self._kernel = jax.jit(kernel)
 
@@ -114,7 +117,24 @@ class ProjectExec(UnaryExec):
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         for batch in self.child.execute_partition(p):
-            yield self._kernel(batch)
+            out, errs = self._kernel(batch)
+            _raise_ansi(errs)
+            yield out
+
+
+class ArithmeticException(ArithmeticError):
+    """ANSI-mode evaluation error (Spark's ArithmeticException parity)."""
+
+
+def _sum_errors(ctx) -> dict:
+    return {k: sum(v) for k, v in ctx.errors.items()}
+
+
+def _raise_ansi(errs: dict) -> None:
+    for kind, count in errs.items():
+        if int(count) > 0:
+            raise ArithmeticException(
+                f"[{kind}] {int(count)} row(s) failed (ANSI mode)")
 
 
 class FilterExec(UnaryExec):
@@ -132,10 +152,13 @@ class FilterExec(UnaryExec):
             raise TypeError(f"filter condition must be boolean, got "
                             f"{self.condition.dtype}")
 
-        def kernel(batch: ColumnarBatch) -> ColumnarBatch:
-            c = self.condition.eval(batch, self.ctx)
+        def kernel(batch: ColumnarBatch):
+            ctx = EvalContext(self.ctx.ansi, {}) if self.ctx.ansi \
+                else self.ctx
+            c = self.condition.eval(batch, ctx)
             keep = c.data & c.validity
-            return compact(batch, keep)
+            errs = _sum_errors(ctx) if self.ctx.ansi else {}
+            return compact(batch, keep), errs
 
         self._kernel = jax.jit(kernel)
 
@@ -145,7 +168,9 @@ class FilterExec(UnaryExec):
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         for batch in self.child.execute_partition(p):
-            yield self._kernel(batch)
+            out, errs = self._kernel(batch)
+            _raise_ansi(errs)
+            yield out
 
 
 class LocalLimitExec(UnaryExec):

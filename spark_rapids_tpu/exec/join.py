@@ -49,6 +49,7 @@ class JoinType(enum.Enum):
     FULL_OUTER = "FullOuter"
     LEFT_SEMI = "LeftSemi"
     LEFT_ANTI = "LeftAnti"
+    EXISTENCE = "Existence"   # left cols + exists flag (IN-subquery rewrite)
     CROSS = "Cross"
 
 
@@ -118,6 +119,9 @@ class HashJoinExec(BinaryExec):
         r_nullable = join_type in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
         if join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
             self._schema = left.output_schema
+        elif join_type is JoinType.EXISTENCE:
+            self._schema = Schema(list(lf) + [Field("exists", T.BOOLEAN,
+                                                    False)])
         else:
             self._schema = Schema(
                 [Field(f.name, f.dtype, f.nullable or l_nullable) for f in lf]
@@ -235,8 +239,13 @@ class HashJoinExec(BinaryExec):
             indices_are_sorted=True)[: stream.capacity]
         if self.join_type is JoinType.LEFT_SEMI:
             keep = stream_matches > 0
-        else:
+        elif self.join_type is JoinType.LEFT_ANTI:
             keep = stream.row_mask() & (stream_matches == 0)
+        else:   # EXISTENCE: no filtering, append the flag column
+            exists = DeviceColumn((stream_matches > 0), stream.row_mask(),
+                                  None, T.BOOLEAN)
+            return ColumnarBatch(stream.columns + (exists,),
+                                 stream.num_rows)
         return compact(stream, keep)
 
     def left_child_placeholder(self) -> ColumnarBatch:
@@ -267,7 +276,8 @@ class HashJoinExec(BinaryExec):
         sorted_h, perm, _ = self._build_jit(build)
         matched_build = jnp.zeros(build.capacity, bool)
 
-        semi = self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI)
+        semi = self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                                  JoinType.EXISTENCE)
         for stream in self.left.execute_partition(p):
             lo, counts, offsets, total = self._count_jit(stream, sorted_h)
             out_cap = bucket_capacity(max(int(total), 1))

@@ -53,7 +53,11 @@ class Add(BinaryArithmetic):
 
     def eval(self, batch, ctx=EvalContext()):
         l, r, v, d = self._operands(batch, ctx)
-        return numeric_column(l + r, v, d)
+        res = l + r
+        if ctx.ansi and d.is_integral:
+            # two's-complement overflow: result sign differs from both
+            ctx.report((((l ^ res) & (r ^ res)) < 0) & v)
+        return numeric_column(res, v, d)
 
 
 class Subtract(BinaryArithmetic):
@@ -61,7 +65,10 @@ class Subtract(BinaryArithmetic):
 
     def eval(self, batch, ctx=EvalContext()):
         l, r, v, d = self._operands(batch, ctx)
-        return numeric_column(l - r, v, d)
+        res = l - r
+        if ctx.ansi and d.is_integral:
+            ctx.report((((l ^ r) & (l ^ res)) < 0) & v)
+        return numeric_column(res, v, d)
 
 
 class Multiply(BinaryArithmetic):
@@ -82,7 +89,15 @@ class Multiply(BinaryArithmetic):
         d = self.dtype
         l = lc.data.astype(d.storage_dtype)
         r = rc.data.astype(d.storage_dtype)
-        return numeric_column(l * r, and_validity([lc, rc]), d)
+        res = l * r
+        v = and_validity([lc, rc])
+        if ctx.ansi and d.is_integral:
+            # detect via truncating re-division: res / r != l (r != 0)
+            safe_r = jnp.where(r == 0, 1, r)
+            q = jnp.sign(res) * jnp.sign(safe_r) * \
+                (jnp.abs(res) // jnp.abs(safe_r))
+            ctx.report(((r != 0) & (q != l)) & v)
+        return numeric_column(res, v, d)
 
 
 class Divide(BinaryArithmetic):
@@ -100,7 +115,10 @@ class Divide(BinaryArithmetic):
         rc = self.right.eval(batch, ctx)
         l = lc.data.astype(jnp.float64)
         r = rc.data.astype(jnp.float64)
-        valid = and_validity([lc, rc]) & (r != 0.0)
+        both = and_validity([lc, rc])
+        if ctx.ansi:
+            ctx.report(both & (r == 0.0), "DIVIDE_BY_ZERO")
+        valid = both & (r != 0.0)
         safe_r = jnp.where(r == 0.0, 1.0, r)
         return numeric_column(l / safe_r, valid, T.FLOAT64)
 
@@ -120,7 +138,10 @@ class IntegralDivide(BinaryArithmetic):
         rc = self.right.eval(batch, ctx)
         l = lc.data.astype(jnp.int64)
         r = rc.data.astype(jnp.int64)
-        valid = and_validity([lc, rc]) & (r != 0)
+        both = and_validity([lc, rc])
+        if ctx.ansi:
+            ctx.report(both & (r == 0), "DIVIDE_BY_ZERO")
+        valid = both & (r != 0)
         safe_r = jnp.where(r == 0, 1, r)
         q = jnp.sign(l) * jnp.sign(safe_r) * (jnp.abs(l) // jnp.abs(safe_r))
         return numeric_column(q, valid, T.INT64)

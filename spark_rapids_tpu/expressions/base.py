@@ -32,9 +32,22 @@ from ..types import SqlType, TypeKind
 
 @dataclass(frozen=True)
 class EvalContext:
-    """Static evaluation flags (participates in jit cache keys via closure)."""
+    """Static evaluation flags (participates in jit cache keys via closure).
+
+    Under ANSI mode, expressions report row errors (overflow, division by
+    zero) by appending traced error-counts to ``errors``; the enclosing
+    exec sums them and raises after the kernel (reference: ANSI overflow
+    semantics, RapidsConf spark.sql.ansi.enabled handling)."""
 
     ansi: bool = False
+    errors: object = None    # Optional[dict[str, list]]; trace-time collector
+
+    def report(self, bad, kind: str = "ARITHMETIC_OVERFLOW") -> None:
+        """bad: bool array of rows that must error under ANSI."""
+        if self.ansi and self.errors is not None:
+            import jax.numpy as jnp
+            self.errors.setdefault(kind, []).append(
+                jnp.sum(bad.astype(jnp.int32)))
 
 
 @dataclass(frozen=True)

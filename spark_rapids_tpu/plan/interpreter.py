@@ -46,11 +46,16 @@ def _to_f32(v: float) -> float:
     return float(np.float32(v))
 
 
+class AnsiError(ArithmeticError):
+    """Row-level ANSI evaluation error (overflow / division by zero)."""
+
+
 class RowEvaluator:
     """Evaluates a bound expression tree against a row tuple."""
 
-    def __init__(self, schema: Schema):
+    def __init__(self, schema: Schema, ansi: bool = False):
         self.schema = schema
+        self.ansi = ansi
 
     def eval(self, e: Expression, row: tuple) -> Any:
         m = getattr(self, "_eval_" + type(e).__name__, None)
@@ -80,6 +85,11 @@ class RowEvaluator:
         v = fn(l, r)
         d = e.dtype
         if v is not None and d.kind in _INT_BITS:
+            bits = _INT_BITS[d.kind]
+            if self.ansi and not -(1 << (bits - 1)) <= int(v) \
+                    < (1 << (bits - 1)):
+                raise AnsiError("[ARITHMETIC_OVERFLOW] integer overflow "
+                                "(ANSI mode)")
             v = _wrap(int(v), _INT_BITS[d.kind])
         elif v is not None and d.kind is TypeKind.FLOAT32:
             v = _to_f32(v)
@@ -98,7 +108,12 @@ class RowEvaluator:
         # Spark `/`: double result; x/0 -> NULL in non-ANSI mode (for all
         # numeric inputs, unlike Java IEEE division)
         l, r = self._num2(e, row)
-        if l is None or r is None or float(r) == 0.0:
+        if l is None or r is None:
+            return None
+        if float(r) == 0.0:
+            if self.ansi:
+                raise AnsiError("[DIVIDE_BY_ZERO] division by zero "
+                                "(ANSI mode)")
             return None
         return float(l) / float(r)
 
@@ -618,6 +633,9 @@ def _table(rows: List[tuple], schema: Schema) -> pa.Table:
 class Interpreter:
     """Executes a logical plan on the CPU, row by row."""
 
+    def __init__(self, ansi: bool = False):
+        self.ansi = ansi
+
     def execute(self, plan: L.LogicalPlan) -> pa.Table:
         rows = self._exec(plan)
         return _table(rows, plan.schema())
@@ -638,7 +656,7 @@ class Interpreter:
         child = p.children[0]
         rows = self._exec(child)
         schema = child.schema()
-        ev = RowEvaluator(schema)
+        ev = RowEvaluator(schema, self.ansi)
         exprs = [e.bind(schema) for e in p.exprs]
         return [tuple(ev.eval(e, r) for e in exprs) for r in rows]
 
@@ -646,7 +664,7 @@ class Interpreter:
         child = p.children[0]
         rows = self._exec(child)
         schema = child.schema()
-        ev = RowEvaluator(schema)
+        ev = RowEvaluator(schema, self.ansi)
         cond = p.condition.bind(schema)
         return [r for r in rows if ev.eval(cond, r) is True]
 
@@ -672,7 +690,7 @@ class Interpreter:
         child = p.children[0]
         rows = self._exec(child)
         schema = child.schema()
-        ev = RowEvaluator(schema)
+        ev = RowEvaluator(schema, self.ansi)
         out = []
         for proj in p.projections:
             bound = [e.bind(schema) for e in proj]
@@ -683,7 +701,7 @@ class Interpreter:
         child = p.children[0]
         rows = self._exec(child)
         schema = child.schema()
-        ev = RowEvaluator(schema)
+        ev = RowEvaluator(schema, self.ansi)
         orders = [o.bind(schema) for o in p.orders]
 
         def key(row):
@@ -707,7 +725,7 @@ class Interpreter:
         child = p.children[0]
         rows = self._exec(child)
         schema = child.schema()
-        ev = RowEvaluator(schema)
+        ev = RowEvaluator(schema, self.ansi)
         keys = [e.bind(schema) for e in p.group_exprs]
         aggs = []
         for e in p.agg_exprs:
@@ -780,7 +798,7 @@ class Interpreter:
         child = p.children[0]
         rows = self._exec(child)
         schema = child.schema()
-        ev = RowEvaluator(schema)
+        ev = RowEvaluator(schema, self.ansi)
         all_vals = []
         for e in p.window_exprs:
             w = (e.child if isinstance(e, Alias) else e).bind(schema)
@@ -880,11 +898,11 @@ class Interpreter:
         lc, rc = p.children
         lrows, rrows = self._exec(lc), self._exec(rc)
         ls, rs = lc.schema(), rc.schema()
-        lev, rev = RowEvaluator(ls), RowEvaluator(rs)
+        lev, rev = RowEvaluator(ls, self.ansi), RowEvaluator(rs, self.ansi)
         lk = [e.bind(ls) for e in p.left_keys]
         rk = [e.bind(rs) for e in p.right_keys]
         pair_schema = Schema(list(ls.fields) + list(rs.fields))
-        pev = RowEvaluator(pair_schema)
+        pev = RowEvaluator(pair_schema, self.ansi)
         cond = p.condition.bind(pair_schema) if p.condition is not None \
             else None
         jt = p.join_type
@@ -914,6 +932,8 @@ class Interpreter:
                           JoinType.RIGHT_OUTER, JoinType.FULL_OUTER,
                           JoinType.CROSS):
                     out.append(lrow + rrow)
+            if jt is JoinType.EXISTENCE:
+                out.append(lrow + (m,))
             if jt is JoinType.LEFT_SEMI and m:
                 out.append(lrow)
             if jt is JoinType.LEFT_ANTI and not m:

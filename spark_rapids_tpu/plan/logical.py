@@ -111,6 +111,10 @@ class LogicalJoin(LogicalPlan):
         l, r = self.children[0].schema(), self.children[1].schema()
         if self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
             return l
+        if self.join_type is JoinType.EXISTENCE:
+            from .. import types as T
+            return Schema(list(l.fields)
+                          + [SField("exists", T.BOOLEAN, False)])
         ln = self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER)
         rn = self.join_type in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
         return Schema(

@@ -256,10 +256,12 @@ class CpuFallbackExec(LeafExec):
     materialized through Arrow first (the C2R/R2C transition boundary —
     reference: GpuColumnarToRowExec / GpuRowToColumnarExec)."""
 
-    def __init__(self, node: L.LogicalPlan, child_execs: List[Exec]):
+    def __init__(self, node: L.LogicalPlan, child_execs: List[Exec],
+                 ansi: bool = False):
         super().__init__()
         self.node = node
         self.child_execs = child_execs
+        self.ansi = ansi
         self._schema = node.schema()
 
     @property
@@ -279,7 +281,7 @@ class CpuFallbackExec(LeafExec):
             spliced_children.append(
                 L.LogicalScan((), data=tbl, _schema=ce.output_schema))
         node = _with_children(self.node, spliced_children)
-        result = Interpreter().execute(node)
+        result = Interpreter(ansi=self.ansi).execute(node)
         if result.num_rows == 0:
             from ..batch import empty_batch
             yield empty_batch(self._schema)
@@ -325,8 +327,12 @@ class Overrides:
     def _convert(self, meta: PlanMeta) -> Exec:
         children = [self._convert(c) for c in meta.children]
         if not meta.can_run_on_tpu:
-            return CpuFallbackExec(meta.node, children)
+            return CpuFallbackExec(meta.node, children, ansi=self.conf.ansi)
         return self._to_exec(meta.node, children)
+
+    def _ctx(self):
+        from ..expressions.base import EvalContext
+        return EvalContext(ansi=self.conf.ansi)
 
     def _shuffle_partitions(self) -> int:
         from ..config import SHUFFLE_PARTITIONS
@@ -358,9 +364,9 @@ class Overrides:
         if isinstance(n, L.LogicalRange):
             return RangeExec(n.start, n.end, n.step)
         if isinstance(n, L.LogicalProject):
-            return ProjectExec(n.exprs, ch[0])
+            return ProjectExec(n.exprs, ch[0], ctx=self._ctx())
         if isinstance(n, L.LogicalFilter):
-            return FilterExec(n.condition, ch[0])
+            return FilterExec(n.condition, ch[0], ctx=self._ctx())
         if isinstance(n, L.LogicalLimit):
             return GlobalLimitExec(n.limit, ch[0])
         if isinstance(n, L.LogicalUnion):

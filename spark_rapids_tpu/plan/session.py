@@ -32,12 +32,12 @@ class Session:
     def collect(self, df: DataFrame) -> pa.Table:
         if not self.conf.sql_enabled:
             self.last_plan = None
-            return Interpreter().execute(df.plan)
+            return Interpreter(ansi=self.conf.ansi).execute(df.plan)
         from ..config import MODE
         if self.conf.get(MODE.key) == "explainonly":
             # plan as if a TPU were present, execute on CPU
             self.last_plan = Overrides(self.conf).plan(df.plan)
-            return Interpreter().execute(df.plan)
+            return Interpreter(ansi=self.conf.ansi).execute(df.plan)
         plan = Overrides(self.conf).plan(df.plan)
         self.last_plan = plan
         from ..exec.base import collect as collect_exec

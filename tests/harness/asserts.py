@@ -115,3 +115,22 @@ def assert_tpu_fallback_collect(df_fn, fallback_exec_substring, conf=None,
     assert any(fallback_exec_substring in n for n in fallen), \
         f"expected fallback containing {fallback_exec_substring!r}, " \
         f"got {fallen} in plan:\n{tpu.last_plan!r}"
+
+
+def assert_tpu_and_cpu_error(df_fn, error_substring, conf=None):
+    """Both engines must RAISE, with messages containing the same marker
+    (reference: asserts.py:603 assert_gpu_and_cpu_error)."""
+    from spark_rapids_tpu.plan import Session
+    for enabled in (False, True):
+        ses = Session({**(conf or {}),
+                       "spark.rapids.tpu.sql.enabled": enabled})
+        try:
+            ses.collect(df_fn())
+        except Exception as ex:
+            assert error_substring in str(ex), \
+                f"engine(tpu={enabled}) raised {ex!r}, expected " \
+                f"{error_substring!r}"
+        else:
+            raise AssertionError(
+                f"engine(tpu={enabled}) did not raise; expected "
+                f"{error_substring!r}")

@@ -1,0 +1,50 @@
+"""ANSI mode tests (reference: arithmetic_ops_test.py ANSI paths +
+assert_gpu_and_cpu_error parity)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                             assert_tpu_and_cpu_error)
+
+ANSI = {"spark.rapids.tpu.sql.ansi.enabled": True}
+
+OVERFLOW_T = pa.table({"a": pa.array([2**62, 2**62, 5], pa.int64()),
+                       "b": pa.array([2**62, 1, 7], pa.int64())})
+SAFE_T = pa.table({"a": pa.array([1, 2, 3], pa.int64()),
+                   "b": pa.array([4, 5, 6], pa.int64())})
+DIV_T = pa.table({"a": pa.array([1, 2, 3], pa.int64()),
+                  "b": pa.array([1, 0, 2], pa.int64())})
+
+
+def test_ansi_add_overflow_errors_both_engines():
+    assert_tpu_and_cpu_error(
+        lambda: table(OVERFLOW_T).select((col("a") + col("b")).alias("s")),
+        "ARITHMETIC_OVERFLOW", conf=ANSI)
+
+
+def test_ansi_multiply_overflow():
+    assert_tpu_and_cpu_error(
+        lambda: table(OVERFLOW_T).select((col("a") * lit(4)).alias("m")),
+        "ARITHMETIC_OVERFLOW", conf=ANSI)
+
+
+def test_ansi_divide_by_zero():
+    assert_tpu_and_cpu_error(
+        lambda: table(DIV_T).select((col("a") / col("b")).alias("d")),
+        "DIVIDE_BY_ZERO", conf=ANSI)
+
+
+def test_ansi_safe_values_pass():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(SAFE_T).select((col("a") + col("b")).alias("s"),
+                                     (col("a") * col("b")).alias("m")),
+        conf=ANSI)
+
+
+def test_non_ansi_wraps_silently():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(OVERFLOW_T).select((col("a") + col("b")).alias("s")))

@@ -157,3 +157,29 @@ def test_nested_loop_with_condition():
            for y in rt.column("y").to_pylist()
            if x is not None and y is not None and x == y]
     assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_existence_join():
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=20)),
+                    ("x", LongGen())], n=200, seed=43)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=10)),
+                    ("y", LongGen())], n=100, seed=44)
+    plan = HashJoinExec([col("k")], [col("k2")], JoinType.EXISTENCE,
+                        scan(lt, batch_rows=64), scan(rt))
+    got = rows_of(collect(plan))
+    rkeys = {k for k in rt.column("k2").to_pylist() if k is not None}
+    exp = [(k, x, k is not None and k in rkeys)
+           for k, x in zip(lt.column("k").to_pylist(),
+                           lt.column("x").to_pylist())]
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_existence_join_through_planner():
+    from spark_rapids_tpu.plan import table
+    from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=20)),
+                    ("x", LongGen())], n=150, seed=45)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=10))], n=80, seed=46)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(lt).join(table(rt), ["k"], ["k2"],
+                               JoinType.EXISTENCE))
