@@ -1,0 +1,73 @@
+"""Multi-host (DCN) bootstrap and mesh construction.
+
+Reference: the reference scales multi-node through Spark's cluster manager
+plus UCX peer discovery via driver heartbeats (SURVEY.md §2.10/§5:
+RapidsShuffleHeartbeatManager). The TPU-native equivalent rides
+`jax.distributed`: one engine process per host, the JAX coordination
+service as the control plane (the heartbeat registry's role), and a global
+mesh whose leading axis spans hosts — XLA then routes intra-slice
+collectives over ICI and inter-slice traffic over DCN automatically, which
+is exactly the tiering the reference builds by hand with
+UCX-for-data/netty-for-control.
+
+Single-chip CI cannot exercise real multi-host; this module is the launch
+recipe plus mesh helpers, validated by the virtual-device path
+(dryrun_multichip) the same way the reference validates UCX protocol logic
+against mocked peers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Join the coordination service (idempotent). On Cloud TPU slices all
+    three arguments auto-detect from the metadata server; set them
+    explicitly for DCN-connected multi-slice or non-TPU test rigs:
+
+        RAPIDS_TPU_COORDINATOR=host0:8476 RAPIDS_TPU_NPROCS=4 \
+        RAPIDS_TPU_PROC_ID=$SLURM_PROCID python my_query.py
+    """
+    import jax
+    coordinator = coordinator or os.environ.get("RAPIDS_TPU_COORDINATOR")
+    num_processes = num_processes or _int_env("RAPIDS_TPU_NPROCS")
+    process_id = process_id if process_id is not None \
+        else _int_env("RAPIDS_TPU_PROC_ID")
+    if coordinator is None and num_processes is None:
+        jax.distributed.initialize()            # TPU auto-detection
+    else:
+        jax.distributed.initialize(coordinator, num_processes, process_id)
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def global_row_mesh(axis: str = "data"):
+    """1-axis mesh over every chip in the job (hosts × local chips). Row
+    partitions land one per chip; all_to_all exchanges ride ICI within a
+    host's slice and DCN across hosts."""
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()), (axis,))
+
+
+def hierarchical_mesh(axes: Tuple[str, str] = ("dcn", "ici")):
+    """2-axis mesh separating the network tiers: axis 0 spans processes
+    (DCN), axis 1 the chips within a process (ICI). Exchanges that
+    pre-aggregate per-slice before crossing hosts shard over ("dcn","ici")
+    the way the reference stages shuffle through executor-local
+    consolidation first."""
+    import jax
+    from jax.sharding import Mesh
+    n_proc = jax.process_count()
+    local = jax.local_device_count()
+    devs = np.array(jax.devices()).reshape(n_proc, local)
+    return Mesh(devs, axes)
