@@ -115,15 +115,21 @@ class SortExec(UnaryExec):
                 yield self._sort_jit(spillables[0].get())
                 spillables[0].done_with()
                 return
-            batches = []
+            caps = []
             for sb in spillables:
-                batches.append(sb.get())
-            total_cap = sum(b.capacity for b in batches)
+                b = sb.get()
+                caps.append(b)
+                sb.done_with()
+            total_cap = sum(b.capacity for b in caps)
             if total_cap > self.max_rows:
-                raise MemoryError(
-                    f"global sort of {total_cap} rows exceeds max_rows="
-                    f"{self.max_rows}")
-            merged = concat_batches(batches, bucket_capacity(total_cap))
+                # out-of-core chunked merge (reference: GpuOutOfCoreSort)
+                from ..memory import device_budget
+                from .ooc_sort import OutOfCoreSorter
+                sorter = OutOfCoreSorter(self.orders, schema,
+                                         device_budget())
+                yield from sorter.sort(iter(caps))
+                return
+            merged = concat_batches(caps, bucket_capacity(total_cap))
             yield self._sort_jit(merged)
         finally:
             for sb in spillables:
