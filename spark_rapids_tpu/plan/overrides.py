@@ -272,16 +272,28 @@ class CpuFallbackExec(LeafExec):
     def output_schema(self) -> Schema:
         return self._schema
 
-    def do_execute(self):
+    def spliced_logical(self) -> L.LogicalPlan:
+        """Collapse a contiguous CPU island into ONE logical tree: nested
+        fallback execs splice directly (no device round-trip between CPU
+        operators — unsupported types like decimal128 never touch HBM);
+        TPU children materialize through Arrow at the island boundary."""
         from ..exec.base import collect as collect_exec
-        from ..batch import from_arrow
         spliced_children = []
         for ce in self.child_execs:
-            tbl = collect_exec(ce)
-            spliced_children.append(
-                L.LogicalScan((), data=tbl, _schema=ce.output_schema))
-        node = _with_children(self.node, spliced_children)
-        result = Interpreter(ansi=self.ansi).execute(node)
+            if isinstance(ce, CpuFallbackExec):
+                spliced_children.append(ce.spliced_logical())
+            else:
+                tbl = collect_exec(ce)
+                spliced_children.append(
+                    L.LogicalScan((), data=tbl, _schema=ce.output_schema))
+        return _with_children(self.node, spliced_children)
+
+    def interpret(self):
+        return Interpreter(ansi=self.ansi).execute(self.spliced_logical())
+
+    def do_execute(self):
+        from ..batch import from_arrow
+        result = self.interpret()
         if result.num_rows == 0:
             from ..batch import empty_batch
             yield empty_batch(self._schema)

@@ -40,6 +40,11 @@ class Session:
             return Interpreter(ansi=self.conf.ansi).execute(df.plan)
         plan = Overrides(self.conf).plan(df.plan)
         self.last_plan = plan
+        from .overrides import CpuFallbackExec as _CFE
+        if isinstance(plan, _CFE):
+            # CPU-topped plan: stay on the host (no device round-trip for
+            # the final island — required for device-unsupported types)
+            return plan.interpret()
         from ..exec.base import collect as collect_exec
         return collect_exec(plan)
 
@@ -53,6 +58,28 @@ class Session:
         cached = CachedRelation.build(plan)
         return DataFrame(LogicalScan((), source=cached,
                                      _schema=cached.schema))
+
+    def write_parquet(self, df: DataFrame, path: str,
+                      partition_by=None, **kw) -> None:
+        """Execute and write (reference: GpuParquetFileFormat via
+        GpuInsertIntoHadoopFsRelationCommand)."""
+        from ..io.parquet import write_parquet
+        write_parquet(self.collect(df), path, partition_by=partition_by,
+                      **kw)
+
+    def write_csv(self, df: DataFrame, path: str, header: bool = True
+                  ) -> None:
+        from ..io.csv import write_csv
+        write_csv(self.collect(df), path, header=header)
+
+    def write_orc(self, df: DataFrame, path: str) -> None:
+        from ..io.orc import write_orc
+        write_orc(self.collect(df), path)
+
+    def write_delta(self, df: DataFrame, path: str, mode: str = "append",
+                    **kw):
+        from ..io.delta import DeltaTable
+        return DeltaTable.write(path, self.collect(df), mode=mode, **kw)
 
     def explain(self, df: DataFrame,
                 mode: ExplainMode = ExplainMode.ALL) -> str:

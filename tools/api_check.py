@@ -1,0 +1,86 @@
+"""JAX API drift checker.
+
+Reference: api_validation/ (SURVEY.md §2.1) — a reflection diff of the
+Spark exec constructor signatures the plugin depends on, run per supported
+Spark version so upstream drift fails fast at build time rather than with
+ClassNotFound at runtime. Same job here for the JAX surface this engine
+leans on: verify every API and keyword we call still exists before a jax
+upgrade lands.
+
+Run: python tools/api_check.py   (exit 1 on drift)
+"""
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+FAILURES = []
+
+
+def need(cond, what):
+    if not cond:
+        FAILURES.append(what)
+
+
+def has_params(fn, *params):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return True   # builtins without signatures: presence is enough
+    return all(p in sig.parameters for p in params)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    need(hasattr(jax, "jit"), "jax.jit")
+    need(hasattr(jax, "shard_map"), "jax.shard_map")
+    need(has_params(jax.shard_map, "mesh", "in_specs", "out_specs"),
+         "jax.shard_map(mesh=, in_specs=, out_specs=)")
+    need(hasattr(jax.lax, "sort"), "jax.lax.sort")
+    need(has_params(jax.lax.sort, "num_keys"), "lax.sort(num_keys=)")
+    need(hasattr(jax.lax, "all_to_all"), "lax.all_to_all")
+    need(hasattr(jax.lax, "all_gather"), "lax.all_gather")
+    need(hasattr(jax.lax, "associative_scan"), "lax.associative_scan")
+    need(has_params(jax.lax.associative_scan, "reverse"),
+         "associative_scan(reverse=)")
+    need(hasattr(jax.lax, "scan"), "lax.scan")
+    need(hasattr(jax.ops, "segment_sum"), "jax.ops.segment_sum")
+    need(hasattr(jax.ops, "segment_min"), "jax.ops.segment_min")
+    need(hasattr(jax.ops, "segment_max"), "jax.ops.segment_max")
+    need(has_params(jax.ops.segment_sum, "indices_are_sorted"),
+         "segment_sum(indices_are_sorted=)")
+    need(hasattr(jnp, "searchsorted"), "jnp.searchsorted")
+    need(hasattr(jax, "device_put"), "jax.device_put")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    need(Mesh is not None and NamedSharding is not None
+         and PartitionSpec is not None, "jax.sharding.{Mesh,NamedSharding,"
+         "PartitionSpec}")
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        need(hasattr(pl, "pallas_call"), "pallas.pallas_call")
+        need(hasattr(pl, "BlockSpec"), "pallas.BlockSpec")
+        need(hasattr(pltpu, "VMEM"), "pltpu.VMEM")
+    except ImportError:
+        FAILURES.append("jax.experimental.pallas")
+    need(hasattr(jax, "named_scope"), "jax.named_scope")
+    need(hasattr(jax.profiler, "TraceAnnotation"),
+         "jax.profiler.TraceAnnotation")
+
+    import flax.struct
+    need(hasattr(flax.struct, "dataclass"), "flax.struct.dataclass")
+
+    if FAILURES:
+        print("API DRIFT DETECTED:")
+        for f in FAILURES:
+            print("  missing:", f)
+        sys.exit(1)
+    print(f"api_check: OK (jax {jax.__version__})")
+
+
+if __name__ == "__main__":
+    main()
