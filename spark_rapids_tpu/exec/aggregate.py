@@ -111,9 +111,22 @@ class HashAggregateExec(UnaryExec):
                 Field(n, a.dtype, a.nullable)
                 for a, n in zip(self.aggs, self.agg_names)])
 
+        self.sort_sensitive = [
+            a for a in self.aggs
+            if getattr(a, "requires_sorted_input", False)]
+        if len(self.sort_sensitive) > 1:
+            raise ValueError(
+                "one sort-sensitive aggregate (percentile) per exec; the "
+                "planner must split multi-percentile projections")
+        if self.sort_sensitive and mode is not AggregateMode.COMPLETE:
+            raise ValueError(
+                f"{type(self.sort_sensitive[0]).__name__} supports "
+                f"COMPLETE mode only (not decomposable)")
+
         self._update_jit = jax.jit(self._update_kernel)
         self._merge_jit = jax.jit(lambda b: self._merge_kernel(b, final=False))
         self._final_jit = jax.jit(lambda b: self._merge_kernel(b, final=True))
+        self._eval_buffers_jit = jax.jit(self._eval_buffers_kernel)
 
     @property
     def output_schema(self) -> Schema:
@@ -123,20 +136,28 @@ class HashAggregateExec(UnaryExec):
     # Shared segment machinery
     # ------------------------------------------------------------------
 
-    def _segments(self, key_cols: List[DeviceColumn], num_rows, cap: int):
-        """Sort rows by key; return (perm, seg ids, new_group mask, count)."""
+    def _segments(self, key_cols: List[DeviceColumn], num_rows, cap: int,
+                  value_cols: List[DeviceColumn] = ()):
+        """Sort rows by key (+ optional value columns for sort-sensitive
+        aggregates); return (perm, seg ids, new_group mask, count)."""
         live = jnp.arange(cap, dtype=jnp.int32) < num_rows
-        if not key_cols:
+        if not key_cols and not value_cols:
             seg = jnp.where(live, 0, cap)
             new_group = jnp.arange(cap, dtype=jnp.int32) == 0
             return None, seg, new_group, jnp.asarray(1, jnp.int32), live
-        ops = sort_operands(key_cols, [False] * len(key_cols),
-                            [True] * len(key_cols), live)
+        all_cols = list(key_cols) + list(value_cols)
+        ops = sort_operands(all_cols, [False] * len(all_cols),
+                            [True] * len(all_cols), live)
         iota = jnp.arange(cap, dtype=jnp.int32)
         perm = jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)[-1]
         sorted_keys = [gather_column(c, perm) for c in key_cols]
         sorted_live = jnp.arange(cap, dtype=jnp.int32) < num_rows
-        eq = adjacent_equal(sorted_keys)
+        if key_cols:
+            eq = adjacent_equal(sorted_keys)
+        else:
+            # value-only sort (global percentile): one segment
+            eq = jnp.concatenate([jnp.zeros(1, bool),
+                                  jnp.ones(cap - 1, bool)])
         new_group = sorted_live & ~eq
         group_id = jnp.cumsum(new_group.astype(jnp.int32)) - 1
         seg = jnp.where(sorted_live, group_id, cap)
@@ -170,8 +191,12 @@ class HashAggregateExec(UnaryExec):
         key_cols = [e.eval(batch, self.ctx) for e in self.group_exprs]
         input_cols = [[c.eval(batch, self.ctx) for c in agg.children]
                       for agg in self.aggs]
+        value_sort = []
+        if self.sort_sensitive:
+            si = self.aggs.index(self.sort_sensitive[0])
+            value_sort = list(input_cols[si])
         perm, seg, new_group, count, live = self._segments(
-            key_cols, batch.num_rows, cap)
+            key_cols, batch.num_rows, cap, value_sort)
         if perm is not None:
             key_cols = [gather_column(c, perm) for c in key_cols]
             input_cols = [[gather_column(c, perm) for c in cols]
@@ -212,6 +237,21 @@ class HashAggregateExec(UnaryExec):
                     if i < nk else c for i, c in enumerate(out_cols)]
         return ColumnarBatch(tuple(out_cols), count)
 
+    def _eval_buffers_kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """buffer-layout rows -> final results WITHOUT a merge pass (the
+        sort-sensitive COMPLETE path: groups are already unique)."""
+        cap = batch.capacity
+        nk = len(self.key_fields)
+        group_live = batch.row_mask()
+        out_cols = list(batch.columns[:nk])
+        off = nk
+        for agg in self.aggs:
+            nb = len(agg.buffer_types())
+            bufs = list(batch.columns[off:off + nb])
+            out_cols.append(agg.evaluate(bufs, group_live))
+            off += nb
+        return ColumnarBatch(tuple(out_cols), batch.num_rows)
+
     # ------------------------------------------------------------------
     # Iterator (reference: GpuHashAggregateIterator.aggregateInputBatches +
     # tryMergeAggregatedBatches)
@@ -224,6 +264,25 @@ class HashAggregateExec(UnaryExec):
         cat = device_budget()
         buf_schema = Schema(self.key_fields + self.buffer_fields)
         spillables: List[SpillableBatch] = []
+        if self.sort_sensitive:
+            # non-decomposable aggregates: ONE update over the whole
+            # partition's rows, then evaluate (no merge step exists)
+            raw = list(self.child.execute_partition(p))
+            if not raw:
+                if not self.key_fields and p == 0:
+                    from ..batch import empty_batch
+                    seed = empty_batch(Schema(self.key_fields
+                                              + self.buffer_fields))
+                    yield self._eval_buffers_jit(self._update_jit(
+                        empty_batch(self.child.output_schema)))
+                return
+            if len(raw) == 1:
+                whole = raw[0]
+            else:
+                whole = concat_batches(
+                    raw, bucket_capacity(sum(b.capacity for b in raw)))
+            yield self._eval_buffers_jit(self._update_jit(whole))
+            return
         for batch in self.child.execute_partition(p):
             if self.mode in (AggregateMode.PARTIAL, AggregateMode.COMPLETE):
                 part = self._update_jit(batch)

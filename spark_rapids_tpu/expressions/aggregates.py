@@ -345,6 +345,70 @@ class StddevPop(_CentralMoment):
         return jnp.sqrt(m2 / jnp.where(n > 0, n, 1.0)), n > 0
 
 
+@dataclass(frozen=True, eq=False)
+class Percentile(AggregateFunction):
+    """percentile(col, q): EXACT interpolated percentile (reference ships
+    t-digest approx_percentile — GpuApproximatePercentile.scala; computing
+    on the sorted segment layout makes the exact answer as cheap as the
+    sketch here: the group's k-th value is one gather).
+
+    Not decomposable: supports COMPLETE mode only; the planner routes raw
+    rows through a key exchange first. Requires the exec to sort by
+    (group keys, input value) — requires_sorted_input."""
+
+    child: Optional[Expression] = None
+    percentage: float = 0.5
+
+    supports_partial = False
+    requires_sorted_input = True
+
+    def with_children(self, c):
+        return Percentile(c[0] if c else None, self.percentage)
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def buffer_types(self):
+        return [T.FLOAT64]
+
+    def update(self, inputs, seg, live, cap):
+        # rows are sorted by (keys, value) with nulls first inside each
+        # segment (sort_operands null ordering), so the k-th VALID value of
+        # segment g sits at seg_start[g] + null_count[g] + k
+        col = inputs[0]
+        ok = col.validity & live
+        iota = jnp.arange(col.capacity, dtype=jnp.int64)
+        seg_start = jax.ops.segment_min(
+            jnp.where(seg < cap, iota, jnp.int64(col.capacity)),
+            jnp.clip(seg, 0, cap), num_segments=cap + 1,
+            indices_are_sorted=True)[:cap]
+        cnt = _seg_sum(ok.astype(jnp.int64), seg, cap)
+        rows = _seg_sum(live.astype(jnp.int64), seg, cap)
+        nulls = rows - cnt
+        r = self.percentage * jnp.maximum(cnt - 1, 0).astype(jnp.float64)
+        lo = jnp.floor(r).astype(jnp.int64)
+        hi = jnp.ceil(r).astype(jnp.int64)
+        frac = r - lo.astype(jnp.float64)
+        base = jnp.clip(seg_start, 0, col.capacity - 1) + nulls
+        idx_lo = jnp.clip(base + lo, 0, col.capacity - 1)
+        idx_hi = jnp.clip(base + hi, 0, col.capacity - 1)
+        x = col.data.astype(jnp.float64)
+        v = (1.0 - frac) * jnp.take(x, idx_lo) + frac * jnp.take(x, idx_hi)
+        valid = cnt > 0
+        return [DeviceColumn(jnp.where(valid, v, 0.0), valid, None,
+                             T.FLOAT64)]
+
+    def merge(self, buffers, seg, live, cap):
+        raise NotImplementedError(
+            "percentile is not decomposable; COMPLETE mode only")
+
+    def evaluate(self, buffers, group_live):
+        b = buffers[0]
+        return DeviceColumn(b.data, b.validity & group_live, None,
+                            T.FLOAT64)
+
+
 class First(AggregateFunction):
     """first(x, ignoreNulls=False) — order-dependent like the reference's
     (marked non-deterministic there too)."""

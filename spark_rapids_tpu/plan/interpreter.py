@@ -781,6 +781,14 @@ class Interpreter:
             return xs[0] if xs else None
         if name == "Last":
             return xs[-1] if xs else None
+        if name == "Percentile":
+            xs = sorted(nn)
+            if not xs:
+                return None
+            r = a.percentage * (len(xs) - 1)
+            lo, hi = int(math.floor(r)), int(math.ceil(r))
+            frac = r - lo
+            return (1 - frac) * float(xs[lo]) + frac * float(xs[hi])
         if name in ("StddevSamp", "VarianceSamp", "StddevPop", "VariancePop"):
             n = len(nn)
             need = 2 if name.endswith("Samp") else 1

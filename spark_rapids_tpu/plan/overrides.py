@@ -116,6 +116,7 @@ def _expr_rules() -> Dict[str, ExprRule]:
     for n in ("Count", "Min", "Max", "First", "Last"):
         r(n, TS.ALL_BASIC)
     r("Sum", TS.NUMERIC, incompat=False)
+    r("Percentile", TS.NUMERIC + TS.DATETIME)
     r("Average", TS.NUMERIC,
       note="float sums reassociate; parity kept by f64 accumulation")
     for n in ("StddevSamp", "StddevPop", "VarianceSamp", "VariancePop"):
@@ -399,7 +400,22 @@ class Overrides:
 
     def _convert_aggregate(self, n: L.LogicalAggregate, child: Exec) -> Exec:
         """Partial → hash exchange on keys → Final (the physical shape
-        Spark's planner gives the reference; SURVEY.md §3.3)."""
+        Spark's planner gives the reference; SURVEY.md §3.3). Aggregates
+        that cannot decompose (percentile) exchange RAW rows by key and run
+        COMPLETE (Spark's ObjectHashAggregate single-stage shape)."""
+        from ..expressions.base import Alias as _Alias
+        raw_aggs = [e.child if isinstance(e, _Alias) else e
+                    for e in n.agg_exprs]
+        if any(not getattr(a, "supports_partial", True) for a in raw_aggs):
+            if child.num_partitions > 1:
+                if n.group_exprs:
+                    child = self._exchange(
+                        HashPartitioning(list(n.group_exprs),
+                                         self._shuffle_partitions()), child)
+                else:
+                    child = self._exchange(SinglePartitioning(), child)
+            return HashAggregateExec(n.group_exprs, n.agg_exprs, child,
+                                     AggregateMode.COMPLETE)
         partial = HashAggregateExec(n.group_exprs, n.agg_exprs, child,
                                     AggregateMode.PARTIAL)
         if n.group_exprs and child.num_partitions > 1:

@@ -1,0 +1,34 @@
+"""Exact percentile tests (reference: GpuApproximatePercentile coverage —
+ours is exact, so the oracle is the interpolated definition itself)."""
+
+import pytest
+
+from spark_rapids_tpu.expressions import col
+from spark_rapids_tpu.expressions.aggregates import Count, Percentile, Sum
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+from harness.data_gen import DoubleGen, IntegerGen, LongGen, gen_table
+
+PT = gen_table([("k", IntegerGen(min_val=0, max_val=6)),
+                ("v", LongGen(min_val=-1000, max_val=1000)),
+                ("d", DoubleGen(no_nans=True))], n=700, seed=230)
+
+
+@pytest.mark.parametrize("q", [0.0, 0.25, 0.5, 0.9, 1.0])
+def test_percentile_groupby(q):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(PT, num_slices=3).group_by("k")
+        .agg(Percentile(col("v"), q).alias("p")))
+
+
+def test_percentile_global():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(PT).agg(Percentile(col("d"), 0.5).alias("med")))
+
+
+def test_percentile_alongside_decomposable_aggs():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(PT, num_slices=2).group_by("k")
+        .agg(Percentile(col("v"), 0.5).alias("med"),
+             Sum(col("v")).alias("s"), Count().alias("n")))
