@@ -330,6 +330,28 @@ def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
                 if not validity.all() else None)
             arrays.append(sa)
             continue
+        if f.dtype.kind is TypeKind.ARRAY:
+            mat = np.asarray(col.data[:n])
+            counts = np.where(validity, np.asarray(col.lengths[:n]), 0)
+            mask2 = np.arange(mat.shape[1])[None, :] < counts[:, None]
+            flat = mat[mask2]
+            offsets = np.zeros(n + 1, np.int32)
+            np.cumsum(counts, out=offsets[1:])
+            elem_t = T.to_arrow(f.dtype.children[0])
+            values = pa.array(flat, type=elem_t)
+            la = pa.ListArray.from_arrays(pa.array(offsets, pa.int32()),
+                                          values)
+            if not validity.all():
+                # rebuild with a null mask (from_arrays has no mask param
+                # for offsets-based construction)
+                la = pa.ListArray.from_arrays(
+                    pa.array(offsets, pa.int32()), values)
+                pl = la.to_pylist()
+                la = pa.array([v if ok else None
+                               for v, ok in zip(pl, validity)],
+                              type=pa.list_(elem_t))
+            arrays.append(la)
+            continue
         data = np.asarray(col.data[:n])
         if f.dtype.kind is TypeKind.DECIMAL:
             import decimal as pydec

@@ -409,6 +409,79 @@ class Percentile(AggregateFunction):
                             T.FLOAT64)
 
 
+@dataclass(frozen=True, eq=False)
+class CollectList(AggregateFunction):
+    """collect_list(x): nulls skipped (Spark), elements in value-sorted
+    order (Spark's order is undefined; sorted is deterministic here).
+    Device arrays are fixed-budget matrices (reference: cudf collect_list
+    builds offsets+child; the static budget is the TPU trade, checked at
+    the host boundary). COMPLETE-only, like percentile."""
+
+    child: Optional[Expression] = None
+    max_elems: int = 256
+
+    supports_partial = False
+    requires_sorted_input = True
+    _dedupe = False
+
+    def with_children(self, c):
+        return type(self)(c[0] if c else None, self.max_elems)
+
+    @property
+    def dtype(self):
+        return T.array(self.child.dtype, self.max_elems)
+
+    def buffer_types(self):
+        return [self.dtype]
+
+    def update(self, inputs, seg, live, cap):
+        col = inputs[0]
+        if col.lengths is not None:
+            raise NotImplementedError("collect over strings lands with "
+                                      "nested-string arrays")
+        ok = col.validity & live
+        if self._dedupe:
+            # rows are sorted by (keys, value): drop adjacent duplicates
+            same_seg = jnp.concatenate(
+                [jnp.zeros(1, bool), seg[1:] == seg[:-1]])
+            same_val = jnp.concatenate(
+                [jnp.zeros(1, bool), col.data[1:] == col.data[:-1]])
+            prev_ok = jnp.concatenate([jnp.zeros(1, bool), ok[:-1]])
+            ok = ok & ~(same_seg & same_val & prev_ok)
+        segc = jnp.clip(seg, 0, cap - 1)
+        # position among the group's kept values (exclusive running count)
+        run = jnp.cumsum(ok.astype(jnp.int32))
+        seg_base = jax.ops.segment_min(
+            jnp.where(ok, run - 1, jnp.int32(1 << 30)), seg,
+            num_segments=cap + 1, indices_are_sorted=True)[:cap]
+        pos = (run - 1) - jnp.take(seg_base, segc)
+        me = self.max_elems
+        flat_target = jnp.where(ok & (pos < me),
+                                segc.astype(jnp.int64) * me + pos,
+                                jnp.int64(cap) * me)
+        mat = jnp.zeros(cap * me + 1, col.data.dtype).at[flat_target].set(
+            col.data, mode="drop")[: cap * me].reshape(cap, me)
+        counts = _seg_sum(ok.astype(jnp.int32), seg, cap)
+        overflow = jnp.max(counts) > me
+        counts = jnp.minimum(counts, me)
+        valid = jnp.ones(cap, bool)   # empty group -> empty list (not null)
+        return [DeviceColumn(mat, valid, counts, self.dtype)]
+
+    def merge(self, buffers, seg, live, cap):
+        raise NotImplementedError("collect_* is COMPLETE-only")
+
+    def evaluate(self, buffers, group_live):
+        b = buffers[0]
+        return DeviceColumn(b.data, b.validity & group_live,
+                            jnp.where(group_live, b.lengths, 0), self.dtype)
+
+
+class CollectSet(CollectList):
+    """collect_set(x): deduplicated (sorted) elements."""
+
+    _dedupe = True
+
+
 class First(AggregateFunction):
     """first(x, ignoreNulls=False) — order-dependent like the reference's
     (marked non-deterministic there too)."""

@@ -781,6 +781,16 @@ class Interpreter:
             return xs[0] if xs else None
         if name == "Last":
             return xs[-1] if xs else None
+        if name in ("CollectList", "CollectSet"):
+            xs = sorted(nn, key=RowEvaluator._ordkey)
+            if name == "CollectSet":
+                out = []
+                for x in xs:
+                    if not out or RowEvaluator._ordkey(out[-1]) != \
+                            RowEvaluator._ordkey(x):
+                        out.append(x)
+                xs = out
+            return xs
         if name == "Percentile":
             xs = sorted(nn)
             if not xs:

@@ -117,6 +117,8 @@ def _expr_rules() -> Dict[str, ExprRule]:
         r(n, TS.ALL_BASIC)
     r("Sum", TS.NUMERIC, incompat=False)
     r("Percentile", TS.NUMERIC + TS.DATETIME)
+    for n in ("CollectList", "CollectSet"):
+        r(n, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
     r("Average", TS.NUMERIC,
       note="float sums reassociate; parity kept by f64 accumulation")
     for n in ("StddevSamp", "StddevPop", "VarianceSamp", "VariancePop"):
@@ -236,7 +238,7 @@ EXEC_SIGS: Dict[str, TypeSig] = {
     "Scan": TS.ALL_BASIC,
     "Project": TS.ALL_BASIC,
     "Filter": TS.ALL_BASIC,
-    "Aggregate": TS.GROUPABLE,
+    "Aggregate": TS.GROUPABLE + TS.NESTED,
     "Join": TS.ALL_BASIC,
     "Sort": TS.ORDERABLE,
     "Limit": TS.ALL_BASIC,

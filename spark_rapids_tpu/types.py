@@ -149,8 +149,10 @@ def string(max_len: int = 64) -> SqlType:
 STRING = string()
 
 
-def array(elem: SqlType) -> SqlType:
-    return SqlType(TypeKind.ARRAY, children=(elem,))
+def array(elem: SqlType, max_elems: int = 256) -> SqlType:
+    """array<elem> with a static device element budget (max_len field),
+    the same fixed-width strategy as strings."""
+    return SqlType(TypeKind.ARRAY, max_len=max_elems, children=(elem,))
 
 
 def struct(*fields: SqlType) -> SqlType:

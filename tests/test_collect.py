@@ -1,0 +1,49 @@
+"""collect_list / collect_set tests (reference: collection_ops /
+hash_aggregate collect coverage)."""
+
+import pytest
+
+from spark_rapids_tpu.expressions import col
+from spark_rapids_tpu.expressions.aggregates import (CollectList, CollectSet,
+                                                     Count)
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_rows_equal, rows_of
+from harness.data_gen import IntegerGen, LongGen, gen_table
+
+CT = gen_table([("k", IntegerGen(min_val=0, max_val=6)),
+                ("v", IntegerGen(min_val=0, max_val=20))], n=400, seed=240)
+
+
+def oracle(dedupe):
+    groups = {}
+    for k, v in zip(CT.column("k").to_pylist(), CT.column("v").to_pylist()):
+        groups.setdefault(k, []).append(v)
+    out = []
+    for k, vs in groups.items():
+        xs = sorted(v for v in vs if v is not None)
+        if dedupe:
+            xs = sorted(set(xs))
+        out.append((k, xs))
+    return out
+
+
+def test_collect_list():
+    got = rows_of(Session().collect(
+        table(CT, num_slices=2).group_by("k")
+        .agg(CollectList(col("v")).alias("vs"))))
+    assert_rows_equal(got, oracle(False), ignore_order=True)
+
+
+def test_collect_set():
+    got = rows_of(Session().collect(
+        table(CT, num_slices=2).group_by("k")
+        .agg(CollectSet(col("v")).alias("vs"))))
+    assert_rows_equal(got, oracle(True), ignore_order=True)
+
+
+def test_collect_matches_cpu_oracle():
+    from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(CT).group_by("k")
+        .agg(CollectList(col("v")).alias("vs"), Count().alias("n")))
