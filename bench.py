@@ -1,18 +1,29 @@
-"""Benchmark: fused TPC-H Q1-style stage throughput on the real device.
+"""Benchmark: the five BASELINE.json measurement configs on the real device.
 
-Workload = BASELINE.json configs[0:2]: filter on a date column + projected
-arithmetic + hash aggregate (sum/avg/count, 6 aggregates, 2 group keys) over
-lineitem-shaped batches — the reference's headline "high-cardinality
-group-by" pattern (docs/FAQ.md:111-120).
+Configs (BASELINE.md "Measurement configs"):
+  1. q1_stage      — project+filter on int/long (TPC-H lineitem shape)
+                     fused with the Q1 hash aggregate
+  2. hash_agg      — high-cardinality sum/count/avg group-by
+                     (TPC-DS store_sales shape)
+  3. join_sort     — shuffled/broadcast hash join + sort + top-N
+                     (TPC-H q3/q10 shape)
+  4. parquet_scan  — multi-file coalescing Parquet scan with predicate
+                     pushdown and column projection
+  5. ici_exchange  — planned join+group-by lowered onto the SPMD mesh
+                     data plane (TPC-DS q72 shape); on a single chip the
+                     collectives degenerate but the fused one-XLA-program
+                     path is what is measured
 
-Metric: steady-state rows/second through the jitted stage.
-vs_baseline: measured speedup over an in-process CPU columnar oracle
-(pyarrow compute doing the identical filter+groupby), divided by 4.0 — the
-reference's published "4x typical" end-to-end speedup over CPU Spark
-(reference docs/FAQ.md:107-109; see BASELINE.md). vs_baseline >= 1.0 means
-we beat the CUDA plugin's typical advantage on this stage shape.
+Oracle / baseline statement (honest labeling, VERDICT r1 weak #2): every
+config is timed against an IN-PROCESS pyarrow-compute oracle running the
+identical relational work single-threaded on the host CPU. ``vs_baseline``
+is the GEOMETRIC MEAN of per-config device-vs-oracle speedups. It is NOT a
+measured comparison against the CUDA plugin on NDS (no GPU exists in this
+environment); the reference's own published anchor is "3x-7x, 4x typical
+over CPU Spark" (reference docs/FAQ.md:107-109) — compare against that
+mentally, not numerically.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "configs"}.
 """
 
 import json
@@ -21,9 +32,27 @@ import time
 import numpy as np
 
 
-def build_table(n: int, seed: int = 3):
+def _rng(seed=3):
+    return np.random.default_rng(seed)
+
+
+def _time(fn, reps, sync):
+    fn()          # warmup / compile
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    sync(out if reps else None)
+    return (time.perf_counter() - t0) / reps
+
+
+# ---------------------------------------------------------------------------
+# Config 1+2 tables
+# ---------------------------------------------------------------------------
+
+def lineitem_table(n):
+    rng = _rng(3)
     import pyarrow as pa
-    rng = np.random.default_rng(seed)
     return pa.table({
         "l_returnflag": rng.integers(0, 3, n).astype(np.int32),
         "l_linestatus": rng.integers(0, 2, n).astype(np.int32),
@@ -34,59 +63,221 @@ def build_table(n: int, seed: int = 3):
     })
 
 
-def cpu_oracle_rows_per_sec(table, reps: int = 3) -> float:
-    """pyarrow compute doing the same filter+groupby (CPU Spark stand-in)."""
+def store_sales_table(n, n_keys):
+    rng = _rng(5)
+    import pyarrow as pa
+    return pa.table({
+        "ss_item_sk": rng.integers(0, n_keys, n).astype(np.int32),
+        "ss_quantity": rng.integers(1, 100, n).astype(np.int64),
+        "ss_sales_price": rng.uniform(0.5, 500.0, n),
+        "ss_net_profit": rng.uniform(-100.0, 400.0, n),
+    })
+
+
+# ---------------------------------------------------------------------------
+# Configs
+# ---------------------------------------------------------------------------
+
+def bench_q1_stage(jax, n=1 << 22, reps=10):
     import pyarrow.compute as pc
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    import __graft_entry__ as g
+    from spark_rapids_tpu.batch import from_arrow
+    table = lineitem_table(n)
+    dev_batch, dev_schema = from_arrow(table)
+    stage, _, _, _ = g._q1_stage(dev_schema)
+    fn = jax.jit(stage)
+    dt = _time(lambda: fn(dev_batch), reps, jax.block_until_ready)
+
+    def oracle():
         f = table.filter(pc.less_equal(table.column("l_shipdate"), 10471))
         disc = pc.multiply(f.column("l_extendedprice"),
                            pc.subtract(1.0, f.column("l_discount")))
         f = f.append_column("disc_price", disc)
-        f.group_by(["l_returnflag", "l_linestatus"]).aggregate(
+        return f.group_by(["l_returnflag", "l_linestatus"]).aggregate(
             [("l_quantity", "sum"), ("l_extendedprice", "sum"),
              ("disc_price", "sum"), ("l_quantity", "mean"),
              ("l_discount", "mean"), ("l_quantity", "count")])
-    dt = (time.perf_counter() - t0) / reps
-    return table.num_rows / dt
+    cpu_dt = _time(oracle, 3, lambda *_: None)
+    return n / dt, n / cpu_dt
 
+
+def bench_hash_agg(jax, n=1 << 22, n_keys=1 << 20, reps=10):
+    from spark_rapids_tpu.batch import from_arrow
+    from spark_rapids_tpu.exec import (AggregateMode, HashAggregateExec,
+                                       InMemoryScanExec)
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Average, Count, Sum
+    table = store_sales_table(n, n_keys)
+    dev_batch, schema = from_arrow(table)
+    agg = HashAggregateExec(
+        [col("ss_item_sk")],
+        [Sum(col("ss_quantity")).alias("sq"),
+         Sum(col("ss_net_profit")).alias("sp"),
+         Average(col("ss_sales_price")).alias("ap"),
+         Count().alias("c")],
+        InMemoryScanExec(table), AggregateMode.COMPLETE)
+    fn = jax.jit(agg._update_kernel)
+    dt = _time(lambda: fn(dev_batch), reps, jax.block_until_ready)
+
+    def oracle():
+        return table.group_by(["ss_item_sk"]).aggregate(
+            [("ss_quantity", "sum"), ("ss_net_profit", "sum"),
+             ("ss_sales_price", "mean"), ("ss_item_sk", "count")])
+    cpu_dt = _time(oracle, 3, lambda *_: None)
+    return n / dt, n / cpu_dt
+
+
+def bench_join_sort(jax, n_stream=1 << 21, n_build=1 << 18, reps=5):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    from spark_rapids_tpu.batch import from_arrow
+    from spark_rapids_tpu.exec import (HashJoinExec, InMemoryScanExec,
+                                       JoinType)
+    from spark_rapids_tpu.exec.sort import SortExec, desc
+    from spark_rapids_tpu.expressions import col
+    rng = _rng(7)
+    stream = pa.table({
+        "l_orderkey": rng.integers(0, n_build, n_stream).astype(np.int64),
+        "l_revenue": rng.uniform(1.0, 1e5, n_stream),
+    })
+    build = pa.table({
+        "o_orderkey": np.arange(n_build, dtype=np.int64),
+        "o_custkey": rng.integers(0, 1 << 16, n_build).astype(np.int64),
+    })
+    join = HashJoinExec([col("l_orderkey")], [col("o_orderkey")],
+                        JoinType.INNER, InMemoryScanExec(stream),
+                        InMemoryScanExec(build))
+    plan = SortExec([desc(col("l_revenue"))], join)
+
+    def run():
+        out = None
+        for b in plan.execute():
+            out = b
+        return out
+    dt = _time(run, reps, jax.block_until_ready)
+
+    def oracle():
+        j = stream.join(build, keys="l_orderkey",
+                        right_keys="o_orderkey", join_type="inner")
+        return j.sort_by([("l_revenue", "descending")])
+    cpu_dt = _time(oracle, 2, lambda *_: None)
+    return n_stream / dt, n_stream / cpu_dt
+
+
+def bench_parquet_scan(jax, n=1 << 21, n_files=8, reps=3):
+    import os
+    import tempfile
+    import pyarrow.dataset as ds
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.io.source import ReaderType
+    table = lineitem_table(n)
+    tmp = tempfile.mkdtemp(prefix="bench_pq_")
+    per = n // n_files
+    paths = []
+    for i in range(n_files):
+        p = os.path.join(tmp, f"part-{i}.parquet")
+        pq.write_table(table.slice(i * per, per), p)
+        paths.append(p)
+    predicate = col("l_shipdate") <= lit(10471)
+    cols = ["l_quantity", "l_extendedprice", "l_shipdate"]
+
+    def run():
+        src = ParquetSource(paths, columns=cols, predicate=predicate,
+                            reader_type=ReaderType.COALESCING)
+        rows = 0
+        from spark_rapids_tpu.io.scan import FileSourceScanExec
+        scan = FileSourceScanExec(src)
+        last = None
+        for b in scan.execute():
+            rows += int(b.num_rows)
+            last = b
+        return last
+    dt = _time(run, reps, jax.block_until_ready)
+
+    def oracle():
+        d = ds.dataset(paths)
+        return d.to_table(columns=cols,
+                          filter=ds.field("l_shipdate") <= 10471)
+    cpu_dt = _time(oracle, 3, lambda *_: None)
+    return n / dt, n / cpu_dt
+
+
+def bench_ici_exchange(jax, n=1 << 20, reps=5):
+    import pyarrow as pa
+    from spark_rapids_tpu.exec.join import JoinType
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Count, Sum
+    from spark_rapids_tpu.plan import Session, table as df_table
+    rng = _rng(11)
+    n_dim = 1 << 12
+    fact = pa.table({
+        "k": rng.integers(0, n_dim, n).astype(np.int32),
+        "g": rng.integers(0, 64, n).astype(np.int32),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+    })
+    dim = pa.table({
+        "dk": np.arange(n_dim, dtype=np.int32),
+        "w": rng.integers(0, 10, n_dim).astype(np.int64),
+    })
+    ses = Session({"spark.rapids.tpu.shuffle.mode": "ICI"})
+
+    def q():
+        return (df_table(fact)
+                .join(df_table(dim), ["k"], ["dk"], JoinType.INNER)
+                .group_by("g")
+                .agg(Sum(col("v")).alias("sv"), Sum(col("w")).alias("sw"),
+                     Count().alias("c")))
+
+    def run():
+        return ses.collect(q())
+    dt = _time(run, reps, lambda *_: None)
+
+    def oracle():
+        j = fact.join(dim, keys="k", right_keys="dk", join_type="inner")
+        return j.group_by(["g"]).aggregate(
+            [("v", "sum"), ("w", "sum"), ("g", "count")])
+    cpu_dt = _time(oracle, 3, lambda *_: None)
+    return n / dt, n / cpu_dt
+
+
+# ---------------------------------------------------------------------------
 
 def main():
     import jax
-    import jax.numpy as jnp
-    import __graft_entry__ as g
-    from spark_rapids_tpu.batch import from_arrow
-
-    n = 1 << 22  # 4M rows/batch
-    table = build_table(n)
-
-    batch, schema = g._flagship_batch(1)
-    # rebuild at size from the table so CPU and device run identical data
-    dev_batch, dev_schema = from_arrow(table)
-    stage, _, _, cond = g._q1_stage(dev_schema)
-    fn = jax.jit(stage)
-
-    # compile + warmup
-    out = fn(dev_batch)
-    jax.block_until_ready(out)
-
-    reps = 10
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(dev_batch)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / reps
-    tpu_rps = n / dt
-
-    cpu_rps = cpu_oracle_rows_per_sec(table)
-    speedup_vs_cpu = tpu_rps / cpu_rps
-    vs_baseline = speedup_vs_cpu / 4.0  # reference's "4x typical" anchor
-
+    configs = [
+        ("q1_stage", bench_q1_stage),
+        ("hash_agg", bench_hash_agg),
+        ("join_sort", bench_join_sort),
+        ("parquet_scan", bench_parquet_scan),
+        ("ici_exchange", bench_ici_exchange),
+    ]
+    results = []
+    for name, fn in configs:
+        try:
+            dev_rps, cpu_rps = fn(jax)
+            results.append({
+                "config": name,
+                "device_Mrows_per_s": round(dev_rps / 1e6, 3),
+                "pyarrow_oracle_Mrows_per_s": round(cpu_rps / 1e6, 3),
+                "speedup_vs_pyarrow": round(dev_rps / cpu_rps, 3),
+            })
+        except Exception as e:   # a failing config must not hide the rest
+            results.append({"config": name, "error": f"{type(e).__name__}: {e}"})
+    speedups = [r["speedup_vs_pyarrow"] for r in results
+                if "speedup_vs_pyarrow" in r]
+    geomean = float(np.exp(np.mean(np.log(speedups)))) if speedups else 0.0
+    headline = next((r for r in results if r["config"] == "q1_stage"
+                     and "device_Mrows_per_s" in r), None)
     print(json.dumps({
-        "metric": "q1_stage_throughput",
-        "value": round(tpu_rps / 1e6, 3),
-        "unit": "Mrows/s",
-        "vs_baseline": round(vs_baseline, 3),
+        "metric": "five_config_geomean_speedup_vs_pyarrow_oracle",
+        "value": round(geomean, 3),
+        "unit": "x (geomean over configs; oracle = single-thread pyarrow)",
+        "vs_baseline": round(geomean, 3),
+        "headline_q1_Mrows_per_s": (headline or {}).get(
+            "device_Mrows_per_s"),
+        "configs": results,
     }))
 
 

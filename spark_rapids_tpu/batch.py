@@ -42,6 +42,11 @@ class StringOverflowError(ValueError):
     """A string exceeded its column's device max_len byte budget."""
 
 
+class CapacityError(ValueError):
+    """A fixed device budget (array max_elems, …) was exceeded; the result
+    would be silently truncated, so the host boundary fails loud instead."""
+
+
 def bucket_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
     """Round a row count up to the compile-cache bucket (next power of two)."""
     if n <= minimum:
@@ -333,6 +338,12 @@ def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
         if f.dtype.kind is TypeKind.ARRAY:
             mat = np.asarray(col.data[:n])
             counts = np.where(validity, np.asarray(col.lengths[:n]), 0)
+            if counts.size and int(counts.max()) > mat.shape[1]:
+                raise CapacityError(
+                    f"array column '{f.name}' holds a list of "
+                    f"{int(counts.max())} elements but the device budget is "
+                    f"{mat.shape[1]}; raise max_elems (collect_list/set) or "
+                    f"fall back to CPU")
             mask2 = np.arange(mat.shape[1])[None, :] < counts[:, None]
             flat = mat[mask2]
             offsets = np.zeros(n + 1, np.int32)

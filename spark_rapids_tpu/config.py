@@ -168,6 +168,23 @@ SHUFFLE_MODE = conf("spark.rapids.tpu.shuffle.mode").doc(
     "(thread-pooled writers/readers) or ICI (device-resident, collective "
     "data plane; reference: rapids-shuffle.md three modes).").text("DEFAULT")
 
+BROADCAST_THRESHOLD = conf(
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
+    "Max estimated build-side bytes for a broadcast hash join; larger (or "
+    "unknown-size) builds shuffle both sides on the join keys instead "
+    "(spark.sql.autoBroadcastJoinThreshold analogue; reference: "
+    "GpuShuffledHashJoinExec build-side selection).").integer(10 << 20)
+
+JOIN_MAX_BUILD_ROWS = conf("spark.rapids.tpu.sql.join.maxBuildRows").doc(
+    "Per-partition build-side row budget; bigger builds grace-hash "
+    "sub-partition both sides (reference: GpuHashJoin.scala:811 oversized-"
+    "build sub-partitioning).").integer(1 << 22)
+
+MESH_DEVICES = conf("spark.rapids.tpu.mesh.devices").doc(
+    "Device count for the ICI mesh data axis (0 = all visible devices). "
+    "Used when shuffle.mode=ICI fuses planned queries onto one SPMD "
+    "program.").integer(0)
+
 SHUFFLE_PARTITIONS = conf("spark.rapids.tpu.shuffle.partitions").doc(
     "Default number of shuffle partitions (spark.sql.shuffle.partitions "
     "analogue).").integer(8)

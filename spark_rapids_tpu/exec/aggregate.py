@@ -290,13 +290,10 @@ class HashAggregateExec(UnaryExec):
                 part = batch
             sb = SpillableBatch(cat, part, buf_schema)
             sb.done_with()
-            spillables.append(sb)
-        partials: List[ColumnarBatch] = [sb.get() for sb in spillables]
-        for sb in spillables:
-            sb.done_with()
+            spillables.append((sb, int(part.capacity)))
 
         finalize = self.mode in (AggregateMode.FINAL, AggregateMode.COMPLETE)
-        if not partials:
+        if not spillables:
             if not self.key_fields and p == 0:
                 # global aggregate over empty input still yields one row
                 from ..batch import empty_batch
@@ -306,23 +303,121 @@ class HashAggregateExec(UnaryExec):
             return
 
         try:
-            yield from self._merge_and_emit(partials, finalize)
+            yield from self._merge_and_emit(spillables, finalize, cat,
+                                            buf_schema)
         finally:
-            for sb in spillables:
+            for sb, _ in spillables:
                 sb.close()
 
-    def _merge_and_emit(self, partials, finalize):
-        if len(partials) == 1:
-            merged = partials[0]
-        else:
-            total_cap = sum(b.capacity for b in partials)
-            if total_cap > self.max_result_rows:
-                # out-of-core path lands with the spill framework; fail loud
-                # rather than silently wrong (reference falls back to
-                # sort-based OOC aggregation here).
-                raise MemoryError(
-                    f"aggregate merge of {total_cap} buffered rows exceeds "
-                    f"max_result_rows={self.max_result_rows}")
-            merged = concat_batches(partials, bucket_capacity(total_cap))
+    def _merge_and_emit(self, entries, finalize, cat, buf_schema):
+        """Merge spilled partials WITHOUT ever acquiring more than
+        ``max_result_rows`` of buffered rows at once (reference:
+        tryMergeAggregatedBatches under targetMergeBatchSize,
+        aggregate.scala:86-125). Two phases:
 
-        yield self._final_jit(merged) if finalize else self._merge_jit(merged)
+        1. windowed concat+merge passes — shrinks fast when keys repeat
+           across batches;
+        2. if a pass stops shrinking (high-cardinality keys), sort-based
+           out-of-core fallback: key-sort all partials through the spilled
+           chunked merge tree, then stream chunks in global key order,
+           merging each and emitting every group except the boundary one
+           (carried into the next chunk)."""
+        from ..memory import SpillableBatch
+        window = self.max_result_rows
+        while True:
+            total = sum(c for _, c in entries)
+            if len(entries) == 1 or total <= window:
+                batches = [sb.get() for sb, _ in entries]
+                merged = batches[0] if len(batches) == 1 else concat_batches(
+                    batches, bucket_capacity(total))
+                for sb, _ in entries:
+                    sb.done_with()
+                yield self._final_jit(merged) if finalize \
+                    else self._merge_jit(merged)
+                return
+            # one windowed pre-merge pass
+            new_entries, shrunk = [], 0
+            i = 0
+            while i < len(entries):
+                grp, cap_sum = [], 0
+                while i < len(entries) and (
+                        not grp or cap_sum + entries[i][1] <= window):
+                    grp.append(entries[i])
+                    cap_sum += entries[i][1]
+                    i += 1
+                if len(grp) == 1:
+                    new_entries.append(grp[0])
+                    continue
+                batches = [sb.get() for sb, _ in grp]
+                merged = self._merge_jit(
+                    concat_batches(batches, bucket_capacity(cap_sum)))
+                n = int(merged.num_rows)
+                out_cap = bucket_capacity(max(n, 1))
+                if out_cap < merged.capacity:
+                    merged = self._slice_compact(merged, out_cap)
+                for sb, _ in grp:
+                    sb.done_with()
+                    sb.close()
+                nsb = SpillableBatch(cat, merged, buf_schema)
+                nsb.done_with()
+                new_entries.append((nsb, int(merged.capacity)))
+                shrunk += cap_sum - int(merged.capacity)
+            # mutate the caller's list so the finally-close sees live handles
+            entries[:] = new_entries
+            if shrunk * 10 < total:
+                # high-cardinality: merging barely shrinks → sort-based OOC
+                yield from self._ooc_sorted_merge(entries, finalize, cat,
+                                                  buf_schema)
+                return
+
+    def _slice_compact(self, batch: ColumnarBatch, cap: int) -> ColumnarBatch:
+        from .common import slice_batch
+        return jax.jit(slice_batch, static_argnums=3)(
+            batch, jnp.int32(0), jnp.int32(cap), cap)
+
+    def _ooc_sorted_merge(self, entries, finalize, cat, buf_schema):
+        """Sort-based OOC aggregation: global key order via the spilled
+        chunked merge tree, then bounded per-chunk merges. Only the boundary
+        group can span chunks, so it is carried forward and every other
+        group is emitted as soon as its chunk is merged."""
+        from ..batch import MIN_CAPACITY
+        from ..expressions.base import BoundReference
+        from .common import slice_batch
+        from .ooc_sort import OutOfCoreSorter
+        from .sort import SortOrder
+
+        orders = [SortOrder(BoundReference(i, f.dtype, f.nullable, f.name))
+                  for i, f in enumerate(self.key_fields)]
+        chunk_rows = max(min(self.max_result_rows // 4, 1 << 16),
+                         MIN_CAPACITY)
+        sorter = OutOfCoreSorter(orders, buf_schema, cat,
+                                 chunk_rows=chunk_rows)
+        slice_jit = jax.jit(slice_batch, static_argnums=3)
+
+        def batches():
+            for sb, _ in entries:
+                b = sb.get()
+                sb.done_with()
+                yield b
+
+        carry: Optional[ColumnarBatch] = None
+        for chunk in sorter.sort(batches()):
+            if carry is not None:
+                cap = bucket_capacity(carry.capacity + chunk.capacity)
+                chunk = concat_batches([carry, chunk], cap)
+            merged = self._merge_jit(chunk)
+            n = int(merged.num_rows)
+            if n == 0:
+                carry = None
+                continue
+            if n == 1:
+                carry = slice_jit(merged, jnp.int32(0), jnp.int32(1),
+                                  MIN_CAPACITY)
+                continue
+            emit = slice_jit(merged, jnp.int32(0), jnp.int32(n - 1),
+                             bucket_capacity(n - 1))
+            carry = slice_jit(merged, jnp.int32(n - 1), jnp.int32(1),
+                              MIN_CAPACITY)
+            yield self._eval_buffers_jit(emit) if finalize else emit
+        if carry is not None:
+            yield self._eval_buffers_jit(carry) if finalize else carry

@@ -98,12 +98,20 @@ class HashJoinExec(BinaryExec):
                  left: Exec, right: Exec,
                  condition: Optional[Expression] = None,
                  broadcast_build: bool = True,
-                 ctx: Optional[EvalContext] = None):
+                 ctx: Optional[EvalContext] = None,
+                 max_build_rows: int = 1 << 22):
         super().__init__(left, right, ctx)
         # broadcast_build: build side replicated (broadcast hash join).
         # False = co-partitioned inputs (shuffled hash join); requires both
         # children hash-partitioned on the join keys by an exchange.
         self.broadcast_build = broadcast_build
+        # Oversized-build sub-partitioning (reference: GpuHashJoin.scala:811
+        # build-side sub-partitioning in GpuShuffledHashJoinExec): when the
+        # build side exceeds this row budget, grace-hash split BOTH sides
+        # into murmur3(key) % S buckets and join bucket-by-bucket — every
+        # join type stays correct because equal keys land in the same
+        # bucket and each build/stream row lands in exactly one.
+        self.max_build_rows = max_build_rows
         if join_type is JoinType.CROSS:
             raise ValueError("use BroadcastNestedLoopJoinExec for cross joins")
         self.join_type = join_type
@@ -257,15 +265,42 @@ class HashJoinExec(BinaryExec):
 
     @property
     def num_partitions(self) -> int:
+        # With a replicated build side, RIGHT/FULL outer needs GLOBAL
+        # matched-build state: a per-partition tail would both duplicate
+        # unmatched build rows (once per stream partition) and null-pad
+        # build rows matched in a different partition. Fold every stream
+        # partition into one so the tail is emitted exactly once. The
+        # co-partitioned (shuffled) path keeps per-partition tails — each
+        # build row lives in exactly one partition there.
+        if (self.broadcast_build and
+                self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER)):
+            return 1
         return self.left.num_partitions
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        from ..batch import empty_batch
         if self.broadcast_build:
             build_batches = [b for cp in range(self.right.num_partitions)
                              for b in self.right.execute_partition(cp)]
         else:
             build_batches = list(self.right.execute_partition(p))
+        if self.num_partitions == 1 and self.left.num_partitions > 1:
+            stream_parts: Sequence[int] = range(self.left.num_partitions)
+        else:
+            stream_parts = (p,)
+        stream_iter = (b for sp in stream_parts
+                       for b in self.left.execute_partition(sp))
+
+        build_rows = sum(int(b.num_rows) for b in build_batches)
+        if build_rows > self.max_build_rows:
+            yield from self._grace_join(build_batches, stream_iter)
+        else:
+            yield from self._probe(build_batches, stream_iter)
+
+    def _probe(self, build_batches: List[ColumnarBatch],
+               stream_iter: Iterator[ColumnarBatch]
+               ) -> Iterator[ColumnarBatch]:
+        """Core probe loop against ONE in-memory build table."""
+        from ..batch import empty_batch
         if not build_batches:
             build = empty_batch(self.right.output_schema)
         elif len(build_batches) == 1:
@@ -278,7 +313,7 @@ class HashJoinExec(BinaryExec):
 
         semi = self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
                                   JoinType.EXISTENCE)
-        for stream in self.left.execute_partition(p):
+        for stream in stream_iter:
             lo, counts, offsets, total = self._count_jit(stream, sorted_h)
             out_cap = bucket_capacity(max(int(total), 1))
             if semi:
@@ -298,6 +333,68 @@ class HashJoinExec(BinaryExec):
             tail = ColumnarBatch(tuple(null_left) + build.columns,
                                  build.num_rows)
             yield compact(tail, unmatched)
+
+    # ------------------------------------------------------------------
+    # Grace-hash sub-partitioning (reference: GpuHashJoin.scala:811 /
+    # GpuShuffledHashJoinExec oversized-build handling)
+    # ------------------------------------------------------------------
+
+    def _bucket_pids(self, batch: ColumnarBatch, keys, n_buckets: int):
+        cols = [e.eval(batch, self.ctx) for e in keys]
+        h = murmur3_batch(cols, 77)   # independent of the join's _hash64
+        m = h % jnp.int32(n_buckets)
+        return jnp.where(m < 0, m + n_buckets, m).astype(jnp.int32)
+
+    def _grace_join(self, build_batches: List[ColumnarBatch],
+                    stream_iter: Iterator[ColumnarBatch]
+                    ) -> Iterator[ColumnarBatch]:
+        """Split BOTH sides into murmur3(key) % S buckets, join each bucket
+        pair independently with the normal probe loop. Stream buckets wait
+        in the spill catalog, so peak device residency stays one bucket's
+        build + one stream batch regardless of input size."""
+        from ..memory import SpillableBatch, device_budget
+        cat = device_budget()
+        build_rows = sum(int(b.num_rows) for b in build_batches)
+        n_buckets = -(-build_rows // self.max_build_rows)
+
+        split_build = jax.jit(
+            lambda b, s: compact(
+                b, self._bucket_pids(b, self.right_keys, n_buckets) == s),
+            static_argnums=1)
+        split_stream = jax.jit(
+            lambda b, s: compact(
+                b, self._bucket_pids(b, self.left_keys, n_buckets) == s),
+            static_argnums=1)
+
+        sub_builds: List[List[ColumnarBatch]] = [[] for _ in range(n_buckets)]
+        for b in build_batches:
+            for s in range(n_buckets):
+                piece = split_build(b, s)
+                if int(piece.num_rows) > 0:
+                    sub_builds[s].append(piece)
+
+        sub_stream: List[List[SpillableBatch]] = \
+            [[] for _ in range(n_buckets)]
+        stream_schema = self.left.output_schema
+        for batch in stream_iter:
+            for s in range(n_buckets):
+                piece = split_stream(batch, s)
+                if int(piece.num_rows) > 0:
+                    sp = SpillableBatch(cat, piece, stream_schema)
+                    sp.done_with()
+                    sub_stream[s].append(sp)
+
+        for s in range(n_buckets):
+            def pieces(bucket=s):
+                for sp in sub_stream[bucket]:
+                    out = sp.get()
+                    sp.done_with()
+                    yield out
+            try:
+                yield from self._probe(sub_builds[s], pieces())
+            finally:
+                for sp in sub_stream[s]:
+                    sp.close()
 
 
 class BroadcastNestedLoopJoinExec(BinaryExec):

@@ -54,6 +54,17 @@ class WindowExec(UnaryExec):
             raise ValueError("one WindowExec handles one partition/order "
                              "spec; chain execs for multiple")
         self.spec = self.exprs[0].spec
+        # fail fast on frames the device kernel cannot express — the planner
+        # tags these for CPU fallback before ever constructing this exec;
+        # without this guard a bounded RANGE frame would silently get ROWS
+        # semantics from the shift-fold path
+        from ..expressions.window import WindowAgg as _WA, \
+            unsupported_frame_reason
+        for w in self.exprs:
+            if isinstance(w.function, _WA):
+                reason = unsupported_frame_reason(w.spec.frame)
+                if reason:
+                    raise NotImplementedError(reason)
         fields = list(child.output_schema.fields)
         for w, n in zip(self.exprs, self.names):
             fields.append(Field(n, w.dtype, w.nullable))

@@ -100,8 +100,11 @@ class Sum(AggregateFunction):
         if k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
             return T.FLOAT64
         if k is TypeKind.DECIMAL:
+            # Spark widens to min(p+10, 38); results wider than DECIMAL64
+            # are planner-gated to CPU (overrides._check_dtype_tree), so the
+            # int64 storage never sees them — but the TYPE must be Spark's.
             d = self.child.dtype
-            return T.decimal(min(d.precision + 10, 18), d.scale)
+            return T.decimal(min(d.precision + 10, 38), d.scale)
         return T.INT64
 
     def buffer_types(self):
@@ -241,10 +244,15 @@ class Max(_MinMax):
 
 
 class Average(AggregateFunction):
-    """avg(x) → double (or decimal widening); buffer = (sum: double, count)."""
+    """avg(x) → double (or decimal widening); buffer = (sum: double, count).
+    Decimal averages return Spark's decimal(p+4, s+4) type and are
+    planner-gated to CPU (the device buffer is double)."""
 
     @property
     def dtype(self):
+        if self.child.dtype.kind is TypeKind.DECIMAL:
+            d = self.child.dtype
+            return T.decimal(min(d.precision + 4, 38), min(d.scale + 4, 38))
         return T.FLOAT64
 
     def buffer_types(self):
@@ -461,9 +469,11 @@ class CollectList(AggregateFunction):
                                 jnp.int64(cap) * me)
         mat = jnp.zeros(cap * me + 1, col.data.dtype).at[flat_target].set(
             col.data, mode="drop")[: cap * me].reshape(cap, me)
+        # counts stay UNCLAMPED: a group with more than max_elems values
+        # surfaces as lengths > max_elems, which the host boundary
+        # (to_arrow) rejects loudly — same contract as string max_len —
+        # instead of silently truncating the list.
         counts = _seg_sum(ok.astype(jnp.int32), seg, cap)
-        overflow = jnp.max(counts) > me
-        counts = jnp.minimum(counts, me)
         valid = jnp.ones(cap, bool)   # empty group -> empty list (not null)
         return [DeviceColumn(mat, valid, counts, self.dtype)]
 

@@ -1,0 +1,621 @@
+"""Array expressions + higher-order functions.
+
+Reference: sql-plugin/.../sql/rapids/collectionOperations.scala (1,465 LoC),
+higherOrderFunctions.scala (598), complexTypeExtractors.scala. The cudf
+implementation works on offsets+child columns; the TPU layout is the
+fixed-budget matrix ``data[cap, max_elems]`` + ``lengths[cap]`` that
+strings already use, so every array op is a rectangular vector op:
+
+- element access    → take_along_axis
+- contains/min/max  → masked row-reduction
+- sort_array        → one lax.sort along axis 1
+- transform (HOF)   → evaluate the lambda body on the FLATTENED [cap*me]
+                      element column with outer columns repeated per slot;
+                      the whole lambda fuses into the surrounding kernel
+- filter (HOF)      → per-row stable compaction via argsort of the drop mask
+
+Element nullability: fixed-budget arrays hold NON-NULL elements only
+(matching collect_list's output, the main device producer). Lists with null
+elements are rejected at the H2D boundary, and HOF bodies that can
+introduce nulls (nullable lambda result) raise `CollectionUnsupported` at
+bind time → planner CPU fallback. This is the same fail-loud-or-fallback
+policy as regex/window gating.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import ColumnarBatch, DeviceColumn, Schema
+from ..types import SqlType, TypeKind
+from .base import EvalContext, Expression, Literal, lit_if_needed
+
+
+class CollectionUnsupported(ValueError):
+    """Array construct outside the device subset (CPU fallback signal)."""
+
+
+def _require_array(e: Expression, who: str) -> SqlType:
+    if e.dtype.kind is not TypeKind.ARRAY:
+        raise TypeError(f"{who} expects an array, got {e.dtype}")
+    return e.dtype.children[0]
+
+
+def _elem_mask(col: DeviceColumn) -> jnp.ndarray:
+    """bool[cap, me] — which slots hold real elements."""
+    me = col.data.shape[1]
+    return (jnp.arange(me, dtype=jnp.int32)[None, :] <
+            col.lengths[:, None]) & col.validity[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Basic array ops
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True, eq=False)
+class CreateArray(Expression):
+    """array(e1, e2, …) — children must share a type; result is a fixed
+    array with max_elems = len(children)."""
+
+    elems: Tuple[Expression, ...] = ()
+
+    @property
+    def children(self):
+        return self.elems
+
+    def with_children(self, c):
+        return CreateArray(tuple(c))
+
+    @property
+    def dtype(self):
+        if not self.elems:
+            raise CollectionUnsupported("empty array() literal")
+        t = self.elems[0].dtype
+        for e in self.elems[1:]:
+            if e.dtype != t:
+                raise TypeError(f"array() element types differ: {t} vs "
+                                f"{e.dtype}")
+        return T.array(t, max(len(self.elems), 1))
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, batch, ctx=EvalContext()):
+        cols = [e.eval(batch, ctx) for e in self.elems]
+        if any(c.lengths is not None for c in cols):
+            raise CollectionUnsupported("array() over strings")
+        if any(e.nullable for e in self.elems):
+            raise CollectionUnsupported("array() with nullable elements")
+        data = jnp.stack([c.data for c in cols], axis=1)
+        n = len(cols)
+        lengths = jnp.full(batch.capacity, n, jnp.int32)
+        return DeviceColumn(data, jnp.ones(batch.capacity, bool), lengths,
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class Size(Expression):
+    """size(arr): element count; size(null) = -1 (Spark legacy default)."""
+
+    child: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Size(c[0])
+
+    @property
+    def dtype(self):
+        _require_array(self.child, "size")
+        return T.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        data = jnp.where(c.validity, c.lengths, jnp.int32(-1))
+        return DeviceColumn(data.astype(jnp.int32),
+                            jnp.ones(batch.capacity, bool), None, T.INT32)
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayContains(Expression):
+    """array_contains(arr, value) — null iff arr is null or value is null."""
+
+    arr: Optional[Expression] = None
+    value: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.arr, self.value)
+
+    def with_children(self, c):
+        return ArrayContains(c[0], c[1])
+
+    @property
+    def dtype(self):
+        et = _require_array(self.arr, "array_contains")
+        if self.value.dtype != et:
+            raise TypeError(f"array_contains value {self.value.dtype} vs "
+                            f"element {et}")
+        return T.BOOLEAN
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.arr.eval(batch, ctx)
+        v = self.value.eval(batch, ctx)
+        live = _elem_mask(a)
+        hit = jnp.any(live & (a.data == v.data[:, None]), axis=1)
+        return DeviceColumn(hit, a.validity & v.validity, None, T.BOOLEAN)
+
+
+@dataclass(frozen=True, eq=False)
+class ElementAt(Expression):
+    """element_at(arr, i): 1-based; negative from the end; OOB → null."""
+
+    arr: Optional[Expression] = None
+    index: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.arr, self.index)
+
+    def with_children(self, c):
+        return ElementAt(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return _require_array(self.arr, "element_at")
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.arr.eval(batch, ctx)
+        i = self.index.eval(batch, ctx)
+        idx = i.data.astype(jnp.int32)
+        n = a.lengths
+        pos = jnp.where(idx > 0, idx - 1, n + idx)      # 1-based / from-end
+        ok = a.validity & i.validity & (pos >= 0) & (pos < n)
+        safe = jnp.clip(pos, 0, a.data.shape[1] - 1)
+        data = jnp.take_along_axis(a.data, safe[:, None], axis=1)[:, 0]
+        return DeviceColumn(data, ok, None, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class GetArrayItem(ElementAt):
+    """arr[i]: 0-based Spark subscript; OOB → null."""
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.arr.eval(batch, ctx)
+        i = self.index.eval(batch, ctx)
+        pos = i.data.astype(jnp.int32)
+        ok = a.validity & i.validity & (pos >= 0) & (pos < a.lengths)
+        safe = jnp.clip(pos, 0, a.data.shape[1] - 1)
+        data = jnp.take_along_axis(a.data, safe[:, None], axis=1)[:, 0]
+        return DeviceColumn(data, ok, None, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class SortArray(Expression):
+    """sort_array(arr, asc) — one lax.sort along the element axis; dead
+    slots sort to the end via +/-inf sentinels."""
+
+    child: Optional[Expression] = None
+    ascending: bool = True
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return SortArray(c[0], self.ascending)
+
+    @property
+    def dtype(self):
+        _require_array(self.child, "sort_array")
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.child.eval(batch, ctx)
+        live = _elem_mask(a)
+        kind = a.dtype.children[0].kind
+        if kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+            big = jnp.asarray(jnp.inf, a.data.dtype)
+        else:
+            big = jnp.asarray(jnp.iinfo(a.data.dtype).max, a.data.dtype)
+        if self.ascending:
+            x = jnp.where(live, a.data, big)
+            s = jnp.sort(x, axis=1)
+        else:
+            x = jnp.where(live, a.data, ~big if a.data.dtype.kind == "i"
+                          else -big)
+            s = -jnp.sort(-x, axis=1) if kind in (TypeKind.FLOAT32,
+                                                  TypeKind.FLOAT64) \
+                else jnp.flip(jnp.sort(x, axis=1), axis=1)
+        # restore zeros in dead slots (host boundary masks by lengths)
+        s = jnp.where(_elem_mask(DeviceColumn(s, a.validity, a.lengths,
+                                              a.dtype)), s,
+                      jnp.zeros((), a.data.dtype))
+        return DeviceColumn(s, a.validity, a.lengths, a.dtype)
+
+
+class _MinMaxArray(Expression):
+    _is_min = True
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    @property
+    def dtype(self):
+        return _require_array(self.child, type(self).__name__)
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.child.eval(batch, ctx)
+        live = _elem_mask(a)
+        kind = a.dtype.children[0].kind
+        if kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+            sent = jnp.asarray(jnp.inf, a.data.dtype)
+        else:
+            sent = jnp.asarray(jnp.iinfo(a.data.dtype).max, a.data.dtype)
+        if self._is_min:
+            v = jnp.min(jnp.where(live, a.data, sent), axis=1)
+        else:
+            neg = -sent if kind in (TypeKind.FLOAT32, TypeKind.FLOAT64) \
+                else jnp.asarray(jnp.iinfo(a.data.dtype).min, a.data.dtype)
+            v = jnp.max(jnp.where(live, a.data, neg), axis=1)
+        ok = a.validity & (a.lengths > 0)
+        return DeviceColumn(jnp.where(ok, v, jnp.zeros((), v.dtype)), ok,
+                            None, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayMin(_MinMaxArray):
+    child: Optional[Expression] = None
+    _is_min = True
+
+    def with_children(self, c):
+        return ArrayMin(c[0])
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayMax(_MinMaxArray):
+    child: Optional[Expression] = None
+    _is_min = False
+
+    def with_children(self, c):
+        return ArrayMax(c[0])
+
+
+# ---------------------------------------------------------------------------
+# Struct create/extract (fold-at-bind; structs never materialize on device)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True, eq=False)
+class CreateStruct(Expression):
+    """named_struct(...) — only consumable by GetStructField, which folds
+    the pair away at bind time; a struct that would need device STORAGE
+    (materialized output) is unsupported → CPU fallback."""
+
+    elems: Tuple[Expression, ...] = ()
+    names: Tuple[str, ...] = ()
+
+    @property
+    def children(self):
+        return self.elems
+
+    def with_children(self, c):
+        return CreateStruct(tuple(c), self.names)
+
+    @property
+    def dtype(self):
+        return T.struct(*(e.dtype for e in self.elems))
+
+    def eval(self, batch, ctx=EvalContext()):
+        raise CollectionUnsupported(
+            "struct values have no device storage; only field extraction "
+            "is supported (folds at bind time)")
+
+
+@dataclass(frozen=True, eq=False)
+class GetStructField(Expression):
+    """struct.field — folds to the child expression when the struct is a
+    CreateStruct (bind-time); struct INPUT columns are planner-gated."""
+
+    child: Optional[Expression] = None
+    ordinal: int = 0
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return GetStructField(c[0], self.ordinal)
+
+    def bind(self, schema: Schema) -> Expression:
+        bound = self.child.bind(schema)
+        if isinstance(bound, CreateStruct):
+            return bound.elems[self.ordinal]
+        return GetStructField(bound, self.ordinal)
+
+    @property
+    def dtype(self):
+        if self.child.dtype.kind is not TypeKind.STRUCT:
+            raise TypeError(f"GetStructField over {self.child.dtype}")
+        return self.child.dtype.children[self.ordinal]
+
+    def eval(self, batch, ctx=EvalContext()):
+        raise CollectionUnsupported(
+            "struct columns have no device storage (CPU fallback)")
+
+
+# ---------------------------------------------------------------------------
+# Higher-order functions
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True, eq=False)
+class LambdaVariable(Expression):
+    """The lambda's element variable; its column is set by the enclosing
+    HOF just before the body evaluates (single-trace mutation)."""
+
+    name: str = "x"
+    elem_type: Optional[SqlType] = None
+
+    _cell: List = None   # type: ignore  # [DeviceColumn] set by the HOF
+
+    def __post_init__(self):
+        object.__setattr__(self, "_cell", [None])
+
+    @property
+    def resolved(self):
+        return self.elem_type is not None
+
+    @property
+    def dtype(self):
+        return self.elem_type
+
+    @property
+    def nullable(self):
+        return False   # device arrays hold non-null elements only
+
+    def eval(self, batch, ctx=EvalContext()):
+        col = self._cell[0]
+        assert col is not None, "LambdaVariable outside its HOF"
+        return col
+
+
+def _flat_elem_batch(batch: ColumnarBatch, a: DeviceColumn
+                     ) -> Tuple[ColumnarBatch, jnp.ndarray, int]:
+    """Expand the row batch to one slot per (row, element): outer columns
+    repeat per element so the lambda body can reference them."""
+    from ..exec.common import gather_column
+    cap = batch.capacity
+    me = a.data.shape[1]
+    row = jnp.repeat(jnp.arange(cap, dtype=jnp.int32), me)
+    pos = jnp.tile(jnp.arange(me, dtype=jnp.int32), cap)
+    live = (pos < jnp.take(a.lengths, row)) & jnp.take(a.validity, row)
+    cols = tuple(gather_column(c, row) for c in batch.columns)
+    flat = ColumnarBatch(cols, jnp.asarray(cap * me, jnp.int32))
+    return flat, live, me
+
+
+class _HofBase(Expression):
+    """transform/filter/exists/forall share the flatten-eval machinery."""
+
+    @property
+    def children(self):
+        return (self.arr,)
+
+    def _check(self):
+        et = _require_array(self.arr, type(self).__name__)
+        if self.var.elem_type != et:
+            raise TypeError(f"lambda var {self.var.elem_type} vs element "
+                            f"{et}")
+        return et
+
+    def _eval_body(self, batch, ctx):
+        a = self.arr.eval(batch, ctx)
+        flat, live, me = _flat_elem_batch(batch, a)
+        elem = DeviceColumn(a.data.reshape(-1), live, None,
+                            a.dtype.children[0])
+        self.var._cell[0] = elem
+        try:
+            out = self.body.eval(flat, ctx)
+        finally:
+            self.var._cell[0] = None
+        return a, out, live, me
+
+
+def hof_var(elem_type: SqlType, name: str = "x") -> LambdaVariable:
+    return LambdaVariable(name, elem_type)
+
+
+@dataclass(frozen=True, eq=False)
+class TransformArray(_HofBase):
+    """transform(arr, x -> body): element-wise map. The body must be
+    provably non-null over non-null inputs (nullable bodies → CPU)."""
+
+    arr: Optional[Expression] = None
+    var: Optional[LambdaVariable] = None
+    body: Optional[Expression] = None
+
+    def with_children(self, c):
+        return TransformArray(c[0], self.var, self.body)
+
+    def bind(self, schema):
+        bound = TransformArray(self.arr.bind(schema), self.var,
+                               self.body.bind(schema))
+        bound._check()
+        if bound.body.nullable:
+            raise CollectionUnsupported(
+                "transform body may produce null elements; fixed-budget "
+                "arrays cannot store them (CPU fallback)")
+        return bound
+
+    @property
+    def dtype(self):
+        return T.array(self.body.dtype, self.arr.dtype.max_len)
+
+    @property
+    def nullable(self):
+        return self.arr.nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        a, out, live, me = self._eval_body(batch, ctx)
+        data = jnp.where(live, out.data, jnp.zeros((), out.data.dtype))
+        return DeviceColumn(data.reshape(batch.capacity, me), a.validity,
+                            a.lengths, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class FilterArray(_HofBase):
+    """filter(arr, x -> pred): per-row stable compaction of kept elements
+    (argsort of the drop mask along the element axis)."""
+
+    arr: Optional[Expression] = None
+    var: Optional[LambdaVariable] = None
+    body: Optional[Expression] = None
+
+    def with_children(self, c):
+        return FilterArray(c[0], self.var, self.body)
+
+    def bind(self, schema):
+        bound = FilterArray(self.arr.bind(schema), self.var,
+                            self.body.bind(schema))
+        bound._check()
+        if bound.body.dtype.kind is not TypeKind.BOOLEAN:
+            raise TypeError("filter predicate must be boolean")
+        return bound
+
+    @property
+    def dtype(self):
+        return self.arr.dtype
+
+    @property
+    def nullable(self):
+        return self.arr.nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        a, out, live, me = self._eval_body(batch, ctx)
+        keep = (live & out.data & out.validity).reshape(batch.capacity, me)
+        # stable left-compaction: argsort(drop) keeps relative order of kept
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        data = jnp.take_along_axis(a.data, order, axis=1)
+        new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+        slot = jnp.arange(me, dtype=jnp.int32)[None, :]
+        data = jnp.where(slot < new_len[:, None], data,
+                         jnp.zeros((), data.dtype))
+        return DeviceColumn(data, a.validity, new_len, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ExistsArray(_HofBase):
+    """exists(arr, x -> pred)."""
+
+    arr: Optional[Expression] = None
+    var: Optional[LambdaVariable] = None
+    body: Optional[Expression] = None
+    _forall = False
+
+    def with_children(self, c):
+        return type(self)(c[0], self.var, self.body)
+
+    def bind(self, schema):
+        bound = type(self)(self.arr.bind(schema), self.var,
+                           self.body.bind(schema))
+        bound._check()
+        return bound
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return self.arr.nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        a, out, live, me = self._eval_body(batch, ctx)
+        hit = (live & out.data & out.validity).reshape(batch.capacity, me)
+        if self._forall:
+            lv = live.reshape(batch.capacity, me)
+            v = jnp.all(~lv | hit, axis=1)
+        else:
+            v = jnp.any(hit, axis=1)
+        return DeviceColumn(v, a.validity, None, T.BOOLEAN)
+
+
+@dataclass(frozen=True, eq=False)
+class ForallArray(ExistsArray):
+    arr: Optional[Expression] = None
+    var: Optional[LambdaVariable] = None
+    body: Optional[Expression] = None
+    _forall = True
+
+
+@dataclass(frozen=True, eq=False)
+class AggregateArray(Expression):
+    """aggregate(arr, zero, (acc, x) -> merge): left fold, unrolled over
+    the static element budget (keep budgets small for this one)."""
+
+    arr: Optional[Expression] = None
+    zero: Optional[Expression] = None
+    acc_var: Optional[LambdaVariable] = None
+    elem_var: Optional[LambdaVariable] = None
+    merge: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.arr, self.zero)
+
+    def with_children(self, c):
+        return AggregateArray(c[0], c[1], self.acc_var, self.elem_var,
+                              self.merge)
+
+    def bind(self, schema):
+        bound = AggregateArray(self.arr.bind(schema), self.zero.bind(schema),
+                               self.acc_var, self.elem_var,
+                               self.merge.bind(schema))
+        _require_array(bound.arr, "aggregate")
+        me = bound.arr.dtype.max_len
+        if me > 64:
+            raise CollectionUnsupported(
+                f"aggregate() unrolls the element budget; {me} > 64")
+        return bound
+
+    @property
+    def dtype(self):
+        return self.zero.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.arr.eval(batch, ctx)
+        me = a.data.shape[1]
+        acc = self.zero.eval(batch, ctx)
+        live = _elem_mask(a)
+        for j in range(me):
+            elem = DeviceColumn(a.data[:, j], live[:, j], None,
+                                a.dtype.children[0])
+            self.acc_var._cell[0] = acc
+            self.elem_var._cell[0] = elem
+            try:
+                step = self.merge.eval(batch, ctx)
+            finally:
+                self.acc_var._cell[0] = None
+                self.elem_var._cell[0] = None
+            acc = DeviceColumn(
+                jnp.where(live[:, j], step.data, acc.data),
+                jnp.where(live[:, j], step.validity, acc.validity),
+                None, acc.dtype)
+        validity = acc.validity & a.validity
+        return DeviceColumn(acc.data, validity, None, self.dtype)

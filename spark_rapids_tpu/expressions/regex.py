@@ -17,8 +17,10 @@ groups. Unsupported constructs raise RegexUnsupported at plan time and the
 planner falls back to the CPU (exactly the reference's policy).
 
 Semantics: RLIKE = Java Matcher.find() (unanchored substring search) over
-UTF-8 BYTES; patterns restricted to ASCII-only matching units so byte-wise
-scanning is codepoint-correct.
+UTF-8 BYTES. Positive matching units are restricted to ASCII, but '.' and
+negated classes ('\\D', '[^a]', …) match one full NON-ASCII character via a
+UTF-8 lead+continuation submachine (_build_atom), so char-counting holds
+over multi-byte text.
 """
 
 from __future__ import annotations
@@ -61,7 +63,16 @@ _CLASS_W = _CLASS_D | frozenset(range(ord("a"), ord("z") + 1)) | \
     frozenset(range(ord("A"), ord("Z") + 1)) | {ord("_")}
 _CLASS_S = {ord(" "), ord("\t"), ord("\n"), ord("\r"), 0x0B, 0x0C}
 _ALL = frozenset(range(1, 128))     # ASCII sans NUL (padding byte)
-_DOT = _ALL - {ord("\n")}           # Java '.' excludes line terminators
+# Sentinel member: "plus any single NON-ASCII character". Java regex treats
+# e.g. 'é' as ONE '.'/'\\D'/'[^a]' unit; the byte-level NFA realizes it as a
+# UTF-8 submachine (lead byte + continuation bytes) in _build_atom, so
+# char-counting semantics hold over multi-byte text.
+NONASCII = -1
+_CONT = frozenset(range(0x80, 0xC0))    # UTF-8 continuation bytes
+_LEAD2 = frozenset(range(0xC2, 0xE0))
+_LEAD3 = frozenset(range(0xE0, 0xF0))
+_LEAD4 = frozenset(range(0xF0, 0xF5))
+_DOT = (_ALL - {ord("\n")}) | {NONASCII}   # Java '.' excludes line terminators
 
 
 class _Parser:
@@ -87,9 +98,9 @@ class _Parser:
     # grammar: alt := seq ('|' seq)* ; seq := rep* ; rep := atom [*+?{m,n}]
     def parse(self, nfa: _NFA) -> Tuple[int, int]:
         if self.p.startswith("(?s)"):
-            # inline DOTALL: '.' matches any byte incl. newline (LIKE '%')
+            # inline DOTALL: '.' matches any char incl. newline (LIKE '%'/'_')
             self.i = 4
-            self.dot = frozenset(range(1, 256))
+            self.dot = _ALL | {NONASCII}
         if self.peek() == "^":
             self.next()
             self.anchored_start = True
@@ -237,7 +248,23 @@ class _Parser:
         if spec is None:
             raise RegexUnsupported("counted repetition of a group")
         s, e = nfa.new_state(), nfa.new_state()
-        nfa.add(s, spec, e)
+        ascii_part = frozenset(b for b in spec if b >= 0)
+        if ascii_part:
+            nfa.add(s, ascii_part, e)
+        if NONASCII in spec:
+            # one full UTF-8 character: lead byte then continuation bytes
+            m1 = nfa.new_state()
+            nfa.add(s, _LEAD2, m1)
+            nfa.add(m1, _CONT, e)
+            m2, m3 = nfa.new_state(), nfa.new_state()
+            nfa.add(s, _LEAD3, m2)
+            nfa.add(m2, _CONT, m3)
+            nfa.add(m3, _CONT, e)
+            m4, m5, m6 = (nfa.new_state() for _ in range(3))
+            nfa.add(s, _LEAD4, m4)
+            nfa.add(m4, _CONT, m5)
+            nfa.add(m5, _CONT, m6)
+            nfa.add(m6, _CONT, e)
         return s, e
 
     def _charset(self) -> FrozenSet[int]:
@@ -261,15 +288,15 @@ class _Parser:
         if c == "d":
             return frozenset(_CLASS_D)
         if c == "D":
-            return _ALL - _CLASS_D
+            return (_ALL - _CLASS_D) | {NONASCII}
         if c == "w":
             return frozenset(_CLASS_W)
         if c == "W":
-            return _ALL - _CLASS_W
+            return (_ALL - _CLASS_W) | {NONASCII}
         if c == "s":
             return frozenset(_CLASS_S)
         if c == "S":
-            return _ALL - frozenset(_CLASS_S)
+            return (_ALL - frozenset(_CLASS_S)) | {NONASCII}
         if c in ".\\[](){}*+?|^$":
             return frozenset({ord(c)})
         if c == "n":
@@ -309,7 +336,7 @@ class _Parser:
                 out |= set(range(ord(c), ord(hi) + 1))
             else:
                 out.add(ord(c))
-        return _ALL - out if neg else frozenset(out)
+        return ((_ALL - out) | {NONASCII}) if neg else frozenset(out)
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,24 @@ DEFAULT_FRAME = WindowFrame(is_rows=False, start=None, end=0)
 FULL_FRAME = WindowFrame(is_rows=False, start=None, end=None)
 
 
+def unsupported_frame_reason(frame: WindowFrame) -> Optional[str]:
+    """None if the device window kernel supports this frame, else why not.
+    The planner tags unsupported frames for CPU fallback (reference policy:
+    GpuWindowExecMeta tagging) instead of a runtime error."""
+    if frame.is_full_partition or frame.is_running:
+        return None
+    if frame.start is None:
+        return (f"bounded-end/unbounded-start frame (end={frame.end}) not "
+                f"supported on device")
+    if frame.end is None:
+        if frame.is_rows and frame.start == 0:
+            return None
+        return "general unbounded-following frames not supported on device"
+    if not frame.is_rows:
+        return "bounded RANGE frames not supported on device"
+    return None
+
+
 @dataclass(frozen=True)
 class WindowSpec:
     partition_keys: Tuple[Expression, ...] = ()

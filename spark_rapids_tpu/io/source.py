@@ -101,6 +101,13 @@ class FileSource:
         self.num_threads = num_threads
         self._schema = schema
 
+    def estimated_bytes(self) -> Optional[int]:
+        """On-disk size (planner build-side selection input)."""
+        try:
+            return sum(os.path.getsize(f) for f in self.files)
+        except OSError:
+            return None
+
     # ---- format hooks ----
     def infer_arrow_schema(self) -> pa.Schema:
         raise NotImplementedError

@@ -1,0 +1,339 @@
+"""Planner→mesh lowering: compile a PLANNED physical query onto one SPMD
+XLA program over a device mesh.
+
+Reference shape: GpuShuffleExchangeExecBase.scala:262 — the planner's
+exchange nodes define the distributed dataflow; executors move the bytes.
+Here the planner's output (Overrides.plan) is pattern-matched bottom-up and
+each supported operator chain is fused into a single `shard_map` program:
+
+    scan partitions          → per-device input shards (host-side split)
+    Project/Filter           → per-device traced kernels
+    ShuffleExchangeExec      → `mesh_exchange` (all_to_all over ICI)
+    BroadcastExchangeExec    → `mesh_broadcast` (all_gather)
+    HashAggregateExec P/F    → update / merge segment kernels
+    HashJoinExec (broadcast) → sorted-hash join with STATIC output capacity
+
+The whole query stage becomes ONE XLA program — no host round-trip between
+operators, which is the TPU-native answer to the reference's per-task
+iterator pipeline (SURVEY.md §3.3/§3.4).
+
+Static shapes: a jitted program cannot host-sync to size join output the
+way the host path does (exec/join.py two-phase sizing), so the mesh join
+uses `join_expansion × stream_capacity` slots and returns an OVERFLOW flag;
+the stage re-lowers with a doubled factor when it fires (the same
+retry-on-capacity contract the bucketed batch design uses everywhere).
+Unsupported plan shapes simply stay on the host path — lowering is an
+optimization pass, never a correctness gate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..batch import ColumnarBatch, Schema, bucket_capacity
+from ..exec.aggregate import AggregateMode, HashAggregateExec
+from ..exec.base import Exec, LeafExec
+from ..exec.basic import FilterExec, InMemoryScanExec, ProjectExec
+from ..exec.coalesce import CoalesceBatchesExec
+from ..exec.common import compact, concat_batches, slice_batch
+from ..exec.join import HashJoinExec, JoinType
+from ..expressions.hashing import murmur3_batch
+from ..shuffle.exchange import BroadcastExchangeExec, ShuffleExchangeExec
+from ..shuffle.partitioning import (HashPartitioning, RoundRobinPartitioning,
+                                    SinglePartitioning)
+from .mesh import mesh_broadcast, mesh_exchange, stack_batches, \
+    unstack_batches
+
+
+class MeshUnsupported(Exception):
+    """Plan shape outside the mesh-fusable subset (host path runs it)."""
+
+
+class MeshCapacityError(RuntimeError):
+    """Join expansion overflowed even after retries."""
+
+
+_MESH_JOIN_TYPES = (JoinType.INNER, JoinType.LEFT_OUTER, JoinType.LEFT_SEMI,
+                    JoinType.LEFT_ANTI, JoinType.EXISTENCE)
+
+
+class MeshLowering:
+    """Bottom-up pattern matcher producing a local-step function."""
+
+    def __init__(self, mesh: Mesh, axis: str = "data",
+                 join_expansion: int = 2):
+        self.mesh = mesh
+        self.axis = axis
+        self.n_dev = mesh.shape[axis]
+        self.join_expansion = join_expansion
+        self.inputs: List[Exec] = []
+        self.lowered_names: List[str] = []
+        self._trace_flags: List[jax.Array] = []
+
+    # ------------------------------------------------------------------
+
+    def lower(self, plan: Exec) -> "MeshStageExec":
+        self.inputs = []
+        self.lowered_names = []
+        fn = self._lower_node(plan)
+        return MeshStageExec(self, plan, fn)
+
+    def build_local_step(self, plan: Exec) -> Callable:
+        """(Re-)trace entry: rebuilds closures so a changed join_expansion
+        takes effect (overflow retry)."""
+        self.inputs = []
+        self.lowered_names = []
+        top = self._lower_node(plan)
+
+        def local_step(*args):
+            self._trace_flags = []
+            out = top(list(args))
+            flags = jnp.stack(self._trace_flags) if self._trace_flags \
+                else jnp.zeros(1, bool)
+            return out, flags
+
+        return local_step
+
+    # ------------------------------------------------------------------
+
+    def _lower_node(self, node: Exec) -> Callable:
+        self.lowered_names.append(node.name)
+        if isinstance(node, (InMemoryScanExec, LeafExec)):
+            from ..plan.overrides import CpuFallbackExec
+            if isinstance(node, CpuFallbackExec):
+                raise MeshUnsupported("CPU fallback island in plan")
+            idx = len(self.inputs)
+            self.inputs.append(node)
+            return lambda args: args[idx]
+
+        if isinstance(node, FilterExec):
+            if node.ctx.ansi:
+                raise MeshUnsupported("ANSI error channels need host sync")
+            child = self._lower_node(node.child)
+            cond = node.condition
+
+            def filt(args):
+                b = child(args)
+                c = cond.eval(b, node.ctx)
+                return compact(b, c.data & c.validity)
+            return filt
+
+        if isinstance(node, ProjectExec):
+            if node.ctx.ansi:
+                raise MeshUnsupported("ANSI error channels need host sync")
+            child = self._lower_node(node.child)
+            exprs = node.exprs
+
+            def proj(args):
+                b = child(args)
+                cols = tuple(e.eval(b, node.ctx) for e in exprs)
+                return ColumnarBatch(cols, b.num_rows)
+            return proj
+
+        if isinstance(node, CoalesceBatchesExec):
+            # batch-size discipline is a host-path concern; inside one
+            # program the stage is already a single computation
+            return self._lower_node(node.child)
+
+        if isinstance(node, HashAggregateExec):
+            return self._lower_aggregate(node)
+
+        if isinstance(node, HashJoinExec):
+            return self._lower_join(node)
+
+        raise MeshUnsupported(f"{node.name} has no mesh lowering")
+
+    # ------------------------------------------------------------------
+
+    def _lower_aggregate(self, final: HashAggregateExec) -> Callable:
+        if final.mode is not AggregateMode.FINAL:
+            raise MeshUnsupported(f"aggregate mode {final.mode}")
+        # two planner shapes: FINAL(exchange(PARTIAL)) for multi-partition
+        # children, FINAL(PARTIAL) when the host plan was single-partition.
+        # On the mesh the input is ALWAYS sharded across devices, so both
+        # lower to partial → all_to_all → final.
+        ex = final.child
+        part_kind = None
+        if isinstance(ex, ShuffleExchangeExec):
+            part_kind = ex.partitioning
+            if not isinstance(part_kind,
+                              (HashPartitioning, SinglePartitioning)):
+                raise MeshUnsupported(f"{type(part_kind).__name__} exchange")
+            self.lowered_names.append(ex.name)
+            partial = ex.child
+        else:
+            partial = ex
+        if not isinstance(partial, HashAggregateExec) or \
+                partial.mode is not AggregateMode.PARTIAL or \
+                partial.sort_sensitive:
+            raise MeshUnsupported("FINAL child is not a PARTIAL agg")
+        self.lowered_names.append(partial.name)
+        self.lowered_names.append("mesh_exchange(all_to_all)")
+        child = self._lower_node(partial.child)
+        nk = len(partial.key_fields)
+        n_dev, axis = self.n_dev, self.axis
+
+        def agg(args):
+            b = child(args)
+            part = partial._update_kernel(b)
+            if nk == 0 or isinstance(part_kind, SinglePartitioning):
+                pids = jnp.zeros(part.capacity, jnp.int32)
+            else:
+                # planner structure, mesh-width routing: keys land on
+                # hash(key) % n_dev regardless of conf shuffle partitions
+                h = murmur3_batch(list(part.columns[:nk]))
+                m = h % jnp.int32(n_dev)
+                pids = jnp.where(m < 0, m + n_dev, m).astype(jnp.int32)
+            routed = mesh_exchange(part, pids, n_dev, axis)
+            out = final._merge_kernel(routed, final=True)
+            if nk == 0:
+                dev = jax.lax.axis_index(axis)
+                out = ColumnarBatch(
+                    out.columns,
+                    jnp.where(dev == 0, out.num_rows, jnp.int32(0)))
+            return out
+        return agg
+
+    def _lower_join(self, join: HashJoinExec) -> Callable:
+        if not join.broadcast_build or \
+                not isinstance(join.right, BroadcastExchangeExec):
+            raise MeshUnsupported("only broadcast-build joins lower (v1)")
+        if join.join_type not in _MESH_JOIN_TYPES:
+            raise MeshUnsupported(
+                f"{join.join_type} needs global matched-build state")
+        self.lowered_names.append(join.right.name)
+        self.lowered_names.append("mesh_broadcast(all_gather)")
+        stream = self._lower_node(join.left)
+        build = self._lower_node(join.right.child)
+        n_dev, axis = self.n_dev, self.axis
+        factor = self.join_expansion
+        semi = join.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                                  JoinType.EXISTENCE)
+
+        def jn(args):
+            s = stream(args)
+            full_build = mesh_broadcast(build(args), n_dev, axis)
+            sorted_h, perm, _ = join._build_kernel(full_build)
+            lo, counts, offsets, total = join._count_kernel(s, sorted_h)
+            out_cap = bucket_capacity(factor * s.capacity)
+            matched0 = jnp.zeros(full_build.capacity, bool)
+            self._trace_flags.append(total > out_cap)
+            if semi:
+                return join._semi_kernel(s, (full_build, perm),
+                                         (lo, counts, offsets), matched0,
+                                         out_cap)
+            out, _ = join._expand_kernel(s, (full_build, perm),
+                                         (lo, counts, offsets), matched0,
+                                         out_cap)
+            return out
+        return jn
+
+
+# ---------------------------------------------------------------------------
+# The stage exec the planner hands the rest of the plan
+# ---------------------------------------------------------------------------
+
+class MeshStageExec(LeafExec):
+    """One fused SPMD stage; partitions = mesh devices.
+
+    Owns input staging (host split → per-device shards), program execution,
+    overflow retries, and unstacking. Inputs re-execute through their
+    original exec subtrees, so scans/caches keep their own semantics.
+    """
+
+    def __init__(self, lowering: MeshLowering, plan: Exec, _fn):
+        super().__init__()
+        self.lowering = lowering
+        self.plan = plan
+        self._schema = plan.output_schema
+        self._results: Optional[List[ColumnarBatch]] = None
+        self.lowered = list(lowering.lowered_names)
+
+    @property
+    def name(self) -> str:
+        return "MeshStageExec"
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.lowering.n_dev
+
+    # ------------------------------------------------------------------
+
+    def _stack_input(self, e: Exec) -> ColumnarBatch:
+        n_dev = self.lowering.n_dev
+        batches = [b for p in range(e.num_partitions)
+                   for b in e.execute_partition(p)]
+        if not batches:
+            from ..batch import empty_batch
+            pieces = [empty_batch(e.output_schema) for _ in range(n_dev)]
+            return stack_batches(pieces, self.lowering.mesh,
+                                 self.lowering.axis)
+        total = sum(int(b.num_rows) for b in batches)
+        big = batches[0] if len(batches) == 1 else concat_batches(
+            batches, bucket_capacity(max(total, 1)))
+        per_dev = max(-(-total // n_dev), 1)
+        cap = bucket_capacity(per_dev)
+        sl = jax.jit(slice_batch, static_argnums=3)
+        pieces = [sl(big, jnp.int32(d * per_dev), jnp.int32(per_dev), cap)
+                  for d in range(n_dev)]
+        return stack_batches(pieces, self.lowering.mesh, self.lowering.axis)
+
+    def prepare(self):
+        """Build (program, stacked_inputs) at the current join_expansion.
+        Exposed so benchmarks can time steady-state program executions."""
+        low = self.lowering
+        local_step = low.build_local_step(self.plan)
+        stacked = [self._stack_input(e) for e in low.inputs]
+        spec = P(low.axis)
+
+        def wrapped(*args):
+            squeezed = [jax.tree.map(lambda x: x[0], a) for a in args]
+            out, flags = local_step(*squeezed)
+            return (jax.tree.map(lambda x: x[None], out),
+                    flags[None])
+
+        program = jax.jit(shard_map(
+            wrapped, mesh=low.mesh, in_specs=(spec,) * len(stacked),
+            out_specs=(spec, spec), check_vma=False))
+        return program, stacked
+
+    def _run(self) -> List[ColumnarBatch]:
+        if self._results is not None:
+            return self._results
+        low = self.lowering
+        for attempt in range(4):
+            program, stacked = self.prepare()
+            out, flags = program(*stacked)
+            if not bool(np.any(np.asarray(jax.device_get(flags)))):
+                self._results = unstack_batches(out)
+                return self._results
+            low.join_expansion *= 2
+        raise MeshCapacityError(
+            f"mesh join overflowed at expansion {low.join_expansion}")
+
+    def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        yield self._run()[p]
+
+
+# ---------------------------------------------------------------------------
+# Session hook
+# ---------------------------------------------------------------------------
+
+def try_lower_to_mesh(plan: Exec, mesh: Mesh,
+                      join_expansion: int = 2) -> Optional[MeshStageExec]:
+    """Return the fused mesh stage, or None when the plan shape (or any
+    node in it) is outside the fusable subset."""
+    try:
+        return MeshLowering(mesh, join_expansion=join_expansion).lower(plan)
+    except MeshUnsupported:
+        return None

@@ -768,6 +768,10 @@ class Interpreter:
             if not nn:
                 return None
             s = sum(nn)
+            if a.dtype.kind is TypeKind.DECIMAL:
+                import decimal as _d
+                q = _d.Decimal(1).scaleb(-a.dtype.scale)
+                return _d.Decimal(s).quantize(q)
             if a.dtype.kind in _INT_BITS:
                 return _wrap(int(s), 64)
             return float(s)
@@ -776,7 +780,16 @@ class Interpreter:
         if name == "Max":
             return max(nn, key=RowEvaluator._ordkey) if nn else None
         if name == "Average":
-            return float(sum(nn)) / len(nn) if nn else None
+            if not nn:
+                return None
+            if a.dtype.kind is TypeKind.DECIMAL:
+                import decimal as _d
+                q = _d.Decimal(1).scaleb(-a.dtype.scale)
+                with _d.localcontext() as cx:
+                    cx.prec = 38
+                    return (_d.Decimal(sum(nn)) / len(nn)).quantize(
+                        q, rounding=_d.ROUND_HALF_UP)
+            return float(sum(nn)) / len(nn)
         if name == "First":
             return xs[0] if xs else None
         if name == "Last":
@@ -906,7 +919,30 @@ class Interpreter:
                         hi = m - 1 if frame.end is None else j + frame.end
                         lo, hi = max(lo, 0), min(hi, m - 1)
                     else:
-                        raise NotImplementedError("bounded RANGE frame")
+                        # bounded RANGE over a single numeric order key:
+                        # rows whose key falls in [key+start, key+end];
+                        # a null key ranges only over its null peers (Spark)
+                        if len(spec.orders) != 1 or \
+                                spec.orders[0].descending:
+                            raise NotImplementedError(
+                                "bounded RANGE needs one ascending order key")
+                        ovals = [ev.eval(spec.orders[0].child, rows[part[x]])
+                                 for x in range(m)]
+                        k = ovals[j]
+                        if k is None:
+                            idxs = [x for x in range(m) if ovals[x] is None]
+                        else:
+                            klo = None if frame.start is None \
+                                else k + frame.start
+                            khi = None if frame.end is None \
+                                else k + frame.end
+                            idxs = [x for x in range(m)
+                                    if ovals[x] is not None
+                                    and (klo is None or ovals[x] >= klo)
+                                    and (khi is None or ovals[x] <= khi)]
+                        grp = [rows[part[x]] for x in idxs]
+                        out[i] = self._agg_value(fn.agg, grp, ev)
+                        continue
                     grp = [rows[part[x]] for x in range(lo, hi + 1)] \
                         if lo <= hi else []
                     out[i] = self._agg_value(fn.agg, grp, ev)

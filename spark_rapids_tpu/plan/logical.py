@@ -46,6 +46,7 @@ class LogicalScan(LogicalPlan):
     _schema: Optional[Schema] = None
     source: Optional[object] = None    # io-layer FileSource
     num_slices: int = 1
+    batch_rows: Optional[int] = None   # scan batch granularity (tests/bench)
 
     def schema(self) -> Schema:
         if self._schema is None:
@@ -244,8 +245,10 @@ class GroupedData:
         return DataFrame(LogicalAggregate((self.plan,), self.keys, list(aggs)))
 
 
-def table(data: pa.Table, num_slices: int = 1) -> DataFrame:
-    return DataFrame(LogicalScan((), data=data, num_slices=num_slices))
+def table(data: pa.Table, num_slices: int = 1,
+          batch_rows: Optional[int] = None) -> DataFrame:
+    return DataFrame(LogicalScan((), data=data, num_slices=num_slices,
+                                 batch_rows=batch_rows))
 
 
 def range_(start: int, end: int, step: int = 1) -> DataFrame:

@@ -161,6 +161,7 @@ class PlanMeta:
             self.will_not_work(f"{exec_key} is false")
         self._tag_expressions()
         self._tag_types()
+        self._tag_node_specifics()
 
     def _expressions(self) -> List[Expression]:
         n = self.node
@@ -199,6 +200,77 @@ class PlanMeta:
                     f"set spark.rapids.tpu.sql.incompatibleOps.enabled=true")
         for c in e.children:
             self._tag_expr_tree(c)
+
+    def _tag_node_specifics(self) -> None:
+        """Per-node-type tagging beyond TypeSig — the reference's per-meta
+        tagForGpu overrides (GpuWindowExecMeta, agg metas)."""
+        n = self.node
+        if isinstance(n, L.LogicalWindow):
+            from ..expressions.window import (WindowAgg, WindowExpression,
+                                              unsupported_frame_reason)
+            for e in n.window_exprs:
+                w = e.child if isinstance(e, Alias) else e
+                if isinstance(w, WindowExpression) and \
+                        isinstance(w.function, WindowAgg):
+                    reason = unsupported_frame_reason(w.spec.frame)
+                    if reason:
+                        self.will_not_work(reason)
+        self._tag_dtype_hazards()
+
+    # aggregates whose f64 accumulation hits the backend's emulated-double
+    # range/precision hazard (docs/tpu_compat.md): f32-pair arithmetic has
+    # ~48 mantissa bits and f32 exponent range, so large-magnitude double
+    # sums silently diverge from Spark. incompatOps-gated, like the
+    # reference's variableFloatAgg/incompatibleOps policy.
+    _F64_HAZARD_AGGS = ("Sum", "Average", "StddevSamp", "StddevPop",
+                        "VarianceSamp", "VariancePop")
+
+    def _tag_dtype_hazards(self) -> None:
+        """Dtype-dependent gating TypeSig alone cannot express: checks need
+        BOUND expression types, so bind against the child schema here."""
+        from ..types import TypeKind
+        n = self.node
+        if not n.children:
+            return
+        try:
+            child_schema = n.children[0].schema()
+        except Exception:
+            return
+        for e in self._expressions():
+            try:
+                bound = e.bind(child_schema)
+            except Exception:
+                continue   # join right-keys etc. bind elsewhere
+            self._check_dtype_tree(bound, TypeKind)
+
+    def _check_dtype_tree(self, e: Expression, TypeKind) -> None:
+        name = type(e).__name__
+        child = e.children[0] if e.children else None
+        if child is not None:
+            kind = child.dtype.kind
+            if name == "Sum" and kind is TypeKind.DECIMAL:
+                p, s = child.dtype.precision, child.dtype.scale
+                if p + 10 > 18:
+                    self.will_not_work(
+                        f"sum over decimal({p},{s}) widens to Spark result "
+                        f"precision {min(p + 10, 38)} > device DECIMAL64 "
+                        f"limit 18")
+            if name == "Average" and kind is TypeKind.DECIMAL:
+                p, s = child.dtype.precision, child.dtype.scale
+                self.will_not_work(
+                    f"avg over decimal({p},{s}) must return Spark's "
+                    f"decimal({min(p + 4, 38)},{min(s + 4, 38)}) with "
+                    f"HALF_UP rounding; the device buffer is double")
+            if name in self._F64_HAZARD_AGGS and \
+                    kind is TypeKind.FLOAT64 and \
+                    not self.conf.incompatible_ops:
+                self.will_not_work(
+                    f"{name} over float64 is incompatible on backends that "
+                    f"emulate f64 (f32-pair: ~48-bit mantissa, f32 exponent "
+                    f"range — docs/tpu_compat.md); set "
+                    f"spark.rapids.tpu.sql.incompatibleOps.enabled=true")
+        for c in e.children:
+            self._check_dtype_tree(c, TypeKind)
 
     def _tag_types(self) -> None:
         try:
@@ -316,6 +388,72 @@ def _with_children(node: L.LogicalPlan, children) -> L.LogicalPlan:
 # Conversion (convertIfNeeded + transition insertion)
 # ---------------------------------------------------------------------------
 
+def insert_coalesce_transitions(plan: Exec, target_bytes: int) -> Exec:
+    """Post-conversion transition pass (reference:
+    GpuTransitionOverrides.scala:41): wrap batch-fragmenting producers in
+    CoalesceBatchesExec wherever the consumer declares a coalesce goal
+    (GpuCoalesceBatches.scala:156-228 TargetSize semantics), so filters and
+    joins emitting many small batches cannot starve the MXU downstream."""
+    from ..exec.coalesce import CoalesceBatchesExec, TargetSize
+    from ..exec.sort import SortExec, TakeOrderedAndProjectExec
+    from ..exec.window import WindowExec
+
+    fragmenting = (FilterExec, HashJoinExec, BroadcastNestedLoopJoinExec)
+    wants_target = (HashAggregateExec, SortExec, TakeOrderedAndProjectExec,
+                    WindowExec, HashJoinExec, BroadcastNestedLoopJoinExec)
+
+    def rewrite(node: Exec) -> Exec:
+        if isinstance(node, CpuFallbackExec):
+            node.child_execs = [rewrite(c) for c in node.child_execs]
+            return node
+        new_children = []
+        for i, c in enumerate(node.children):
+            c = rewrite(c)
+            is_build_side = isinstance(
+                node, (HashJoinExec, BroadcastNestedLoopJoinExec)) and i == 1
+            if isinstance(node, wants_target) and \
+                    isinstance(c, fragmenting) and not is_build_side:
+                # build sides are concatenated whole by the join itself
+                c = CoalesceBatchesExec(c, TargetSize(target_bytes))
+            new_children.append(c)
+        node.children = tuple(new_children)
+        return node
+
+    return rewrite(plan)
+
+
+def estimate_bytes(node: L.LogicalPlan) -> Optional[int]:
+    """Coarse logical size estimate for build-side selection (the role of
+    Spark's statistics sizeInBytes feeding GpuShuffledHashJoinExec). None =
+    unknown, which the join planner treats as too-big-to-broadcast."""
+    if isinstance(node, L.LogicalScan):
+        if node.data is not None:
+            return node.data.nbytes
+        est = getattr(node.source, "estimated_bytes", None)
+        if callable(est):
+            return est()
+        return None
+    if isinstance(node, L.LogicalRange):
+        step = node.step or 1
+        return 8 * max(0, (node.end - node.start) // step)
+    if isinstance(node, L.LogicalJoin):
+        a = estimate_bytes(node.children[0])
+        b = estimate_bytes(node.children[1])
+        return None if a is None or b is None else a + b
+    if isinstance(node, L.LogicalUnion):
+        total = 0
+        for c in node.children:
+            e = estimate_bytes(c)
+            if e is None:
+                return None
+            total += e
+        return total
+    if len(node.children) == 1:
+        # narrow operators: child size is a (conservative) upper bound
+        return estimate_bytes(node.children[0])
+    return None
+
+
 class Overrides:
     """applyWithContext analogue: tag, then convert."""
 
@@ -329,7 +467,9 @@ class Overrides:
         if self.conf.get(CBO_ENABLED.key):
             CostBasedOptimizer(self.conf).optimize(meta)
         self.last_meta = meta
-        return self._convert(meta)
+        converted = self._convert(meta)
+        return insert_coalesce_transitions(converted,
+                                           self.conf.batch_size_bytes)
 
     def explain(self, logical: L.LogicalPlan,
                 mode: ExplainMode = ExplainMode.ALL) -> str:
@@ -375,7 +515,8 @@ class Overrides:
                 from ..io.scan import FileSourceScanExec
                 return FileSourceScanExec(n.source, n.num_slices)
             return InMemoryScanExec(n.data, schema=n._schema,
-                                    num_slices=n.num_slices)
+                                    num_slices=n.num_slices,
+                                    batch_rows=n.batch_rows)
         if isinstance(n, L.LogicalRange):
             return RangeExec(n.start, n.end, n.step)
         if isinstance(n, L.LogicalProject):
@@ -452,11 +593,51 @@ class Overrides:
             return BroadcastNestedLoopJoinExec(
                 JoinType.CROSS if not n.left_keys else n.join_type,
                 ch[0], BroadcastExchangeExec(ch[1]), condition=n.condition)
-        # broadcast the build side (right); shuffled-hash selection by size
-        # statistics arrives with the CBO round
-        return HashJoinExec(n.left_keys, n.right_keys, n.join_type,
-                            ch[0], BroadcastExchangeExec(ch[1]),
-                            condition=n.condition)
+        from ..config import BROADCAST_THRESHOLD, JOIN_MAX_BUILD_ROWS
+        threshold = self.conf.get(BROADCAST_THRESHOLD.key)
+        max_build = self.conf.get(JOIN_MAX_BUILD_ROWS.key)
+        build_bytes = estimate_bytes(n.children[1])
+        stream_bytes = estimate_bytes(n.children[0])
+
+        left_keys, right_keys = list(n.left_keys), list(n.right_keys)
+        l, r = ch[0], ch[1]
+        swapped = False
+        # build-side selection: INNER is symmetric, so put the smaller side
+        # on the build (right) when the estimate says left is smaller
+        # (reference: GpuShuffledHashJoinExec.scala:85 buildSide logic)
+        if n.join_type is JoinType.INNER and n.condition is None and \
+                build_bytes is not None and stream_bytes is not None and \
+                stream_bytes < build_bytes:
+            l, r = r, l
+            left_keys, right_keys = right_keys, left_keys
+            build_bytes, stream_bytes = stream_bytes, build_bytes
+            swapped = True
+
+        if build_bytes is not None and build_bytes <= threshold:
+            join: Exec = HashJoinExec(
+                left_keys, right_keys, n.join_type, l,
+                BroadcastExchangeExec(r), condition=n.condition,
+                max_build_rows=max_build)
+        else:
+            # shuffled hash join: co-partition both sides on the join keys
+            # (large or unknown-size build must NOT be replicated)
+            parts = self._shuffle_partitions()
+            join = HashJoinExec(
+                left_keys, right_keys, n.join_type,
+                self._exchange(HashPartitioning(left_keys, parts), l),
+                self._exchange(HashPartitioning(right_keys, parts), r),
+                condition=n.condition, broadcast_build=False,
+                max_build_rows=max_build)
+        if swapped:
+            # restore the user-facing column order (left cols, right cols)
+            nl = len(ch[0].output_schema.fields)
+            nr = len(ch[1].output_schema.fields)
+            refs = [EB.BoundReference(nr + i, f.dtype, f.nullable, f.name)
+                    for i, f in enumerate(ch[0].output_schema.fields)]
+            refs += [EB.BoundReference(i, f.dtype, f.nullable, f.name)
+                     for i, f in enumerate(ch[1].output_schema.fields)]
+            join = ProjectExec(refs, join)
+        return join
 
 
 def plan_query(logical: L.LogicalPlan,

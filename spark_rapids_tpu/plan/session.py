@@ -45,8 +45,27 @@ class Session:
             # CPU-topped plan: stay on the host (no device round-trip for
             # the final island — required for device-unsupported types)
             return plan.interpret()
+        from ..config import SHUFFLE_MODE
+        if str(self.conf.get(SHUFFLE_MODE.key)).upper() == "ICI":
+            # ICI shuffle mode: fuse the planned query onto ONE SPMD mesh
+            # program (exchanges → XLA collectives); unsupported plan
+            # shapes keep the host-mediated exchanges
+            from ..parallel.lowering import try_lower_to_mesh
+            lowered = try_lower_to_mesh(plan, self._mesh())
+            if lowered is not None:
+                plan = lowered
+                self.last_plan = plan
         from ..exec.base import collect as collect_exec
         return collect_exec(plan)
+
+    def _mesh(self):
+        """1-axis data-parallel mesh over the visible devices."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        from ..config import MESH_DEVICES
+        n = self.conf.get(MESH_DEVICES.key) or len(jax.devices())
+        return Mesh(np.array(jax.devices()[:n]), ("data",))
 
     def cache(self, df: DataFrame) -> DataFrame:
         """Materialize as parquet-compressed cached partitions (reference:

@@ -23,6 +23,30 @@ _LOCK = threading.Lock()
 
 _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 _SO = os.path.join(_ROOT, "native", "librtpu_native.so")
+_STAMP = _SO + ".srchash"
+
+
+def _source_hash() -> str:
+    import hashlib
+    h = hashlib.sha256()
+    src_dir = os.path.join(_ROOT, "native", "src")
+    for name in sorted(os.listdir(src_dir)):
+        with open(os.path.join(src_dir, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _needs_build() -> bool:
+    """Rebuild when the .so is missing OR the C++ source changed since the
+    last build (the build is keyed on a source hash so a stale binary is
+    never silently loaded)."""
+    if not os.path.exists(_SO):
+        return True
+    try:
+        with open(_STAMP) as f:
+            return f.read().strip() != _source_hash()
+    except OSError:
+        return True
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -32,10 +56,12 @@ def _load() -> Optional[ctypes.CDLL]:
             return _LIB
         _TRIED = True
         try:
-            if not os.path.exists(_SO):
+            if _needs_build():
                 subprocess.run(["sh", os.path.join(_ROOT, "native",
                                                    "build.sh")],
                                check=True, capture_output=True, timeout=120)
+                with open(_STAMP, "w") as f:
+                    f.write(_source_hash())
             lib = ctypes.CDLL(_SO)
             lib.rtpu_lz4_compress.restype = ctypes.c_int64
             lib.rtpu_lz4_compress.argtypes = [

@@ -47,3 +47,16 @@ def test_collect_matches_cpu_oracle():
     assert_tpu_and_cpu_are_equal_collect(
         lambda: table(CT).group_by("k")
         .agg(CollectList(col("v")).alias("vs"), Count().alias("n")))
+
+
+def test_collect_list_overflow_raises():
+    """A group exceeding the fixed device budget must fail loud at the host
+    boundary, never silently truncate (ADVICE r1)."""
+    from spark_rapids_tpu.batch import CapacityError
+    from spark_rapids_tpu.exec import (AggregateMode, HashAggregateExec,
+                                       InMemoryScanExec, collect)
+    plan = HashAggregateExec(
+        [col("k")], [CollectList(col("v"), max_elems=8).alias("vs")],
+        InMemoryScanExec(CT), AggregateMode.COMPLETE)
+    with pytest.raises(CapacityError):
+        collect(plan)

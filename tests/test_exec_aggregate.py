@@ -213,3 +213,52 @@ def test_two_stage_bool_min_max():
     exp = oracle_groupby(t.column("k").to_pylist(), t.column("b").to_pylist(),
                          [o_min, o_max])
     assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_ooc_sort_based_aggregation():
+    """Partial results exceeding max_result_rows must flow through the
+    sort-based OOC fallback (reference: aggregate.scala sort fallback) and
+    still produce exact results — high-cardinality keys so windowed
+    pre-merging cannot shrink the partials."""
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=5000,
+                                    null_prob=0.05)),
+                   ("v", LongGen(min_val=-1000, max_val=1000))],
+                  n=4000, seed=91)
+    plan = HashAggregateExec(
+        [col("k")],
+        [Sum(col("v")).alias("s"), Count(col("v")).alias("c"),
+         Min(col("v")).alias("mn"), Max(col("v")).alias("mx")],
+        scan(t, batch_rows=256), AggregateMode.COMPLETE,
+        max_result_rows=512)
+    got = rows_of(collect(plan))
+    ks = t.column("k").to_pylist()
+    vs = t.column("v").to_pylist()
+    exp = oracle_groupby(
+        ks, vs,
+        [lambda xs: (sum(x for x in xs if x is not None)
+                     if any(x is not None for x in xs) else None),
+         lambda xs: sum(1 for x in xs if x is not None),
+         lambda xs: min((x for x in xs if x is not None), default=None),
+         lambda xs: max((x for x in xs if x is not None), default=None)])
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_windowed_merge_low_cardinality():
+    """Low-cardinality keys shrink through windowed pre-merge passes without
+    the sort fallback; results must still be exact under a small window."""
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=20)),
+                   ("v", LongGen(min_val=-50, max_val=50))],
+                  n=4000, seed=92)
+    plan = HashAggregateExec(
+        [col("k")], [Sum(col("v")).alias("s"), Count().alias("c")],
+        scan(t, batch_rows=128), AggregateMode.COMPLETE,
+        max_result_rows=512)
+    got = rows_of(collect(plan))
+    ks = t.column("k").to_pylist()
+    vs = t.column("v").to_pylist()
+    exp = oracle_groupby(
+        ks, vs,
+        [lambda xs: (sum(x for x in xs if x is not None)
+                     if any(x is not None for x in xs) else None),
+         lambda xs: len(xs)])
+    assert_rows_equal(got, exp, ignore_order=True)

@@ -183,3 +183,87 @@ def test_existence_join_through_planner():
     assert_tpu_and_cpu_are_equal_collect(
         lambda: table(lt).join(table(rt), ["k"], ["k2"],
                                JoinType.EXISTENCE))
+
+
+@pytest.mark.parametrize("jt", [JoinType.RIGHT_OUTER, JoinType.FULL_OUTER])
+def test_outer_join_multi_partition_stream(jt):
+    """Regression: with a replicated build side and a MULTI-partition stream
+    child, the unmatched-build tail must be emitted exactly once with global
+    matched state — not once per partition."""
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=30)),
+                    ("x", LongGen())], n=300, seed=60)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=40)),
+                    ("y", LongGen())], n=200, seed=61)
+    plan = HashJoinExec([col("k")], [col("k2")], jt,
+                        InMemoryScanExec(lt, batch_rows=64, num_slices=4),
+                        scan(rt, batch_rows=100))
+    got = rows_of(collect(plan))
+    lrows = list(zip(lt.column("k").to_pylist(), lt.column("x").to_pylist()))
+    rrows = list(zip(rt.column("k2").to_pylist(), rt.column("y").to_pylist()))
+    exp = oracle_join(lrows, rrows, [0], [0], HOW[jt])
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+@pytest.mark.parametrize("jt", [JoinType.INNER, JoinType.LEFT_OUTER,
+                                JoinType.RIGHT_OUTER, JoinType.FULL_OUTER,
+                                JoinType.LEFT_SEMI, JoinType.LEFT_ANTI])
+def test_grace_hash_sub_partitioned_join(jt):
+    """Build side over max_build_rows grace-hash splits both sides into
+    key-hash buckets; every join type must stay exact (reference:
+    GpuHashJoin.scala:811 oversized-build sub-partitioning)."""
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=80)),
+                    ("x", LongGen())], n=500, seed=70)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=90)),
+                    ("y", LongGen())], n=400, seed=71)
+    plan = HashJoinExec([col("k")], [col("k2")], jt,
+                        scan(lt, batch_rows=128), scan(rt, batch_rows=128),
+                        max_build_rows=100)   # forces ~4 buckets
+    got = rows_of(collect(plan))
+    lrows = list(zip(lt.column("k").to_pylist(), lt.column("x").to_pylist()))
+    rrows = list(zip(rt.column("k2").to_pylist(), rt.column("y").to_pylist()))
+    exp = oracle_join(lrows, rrows, [0], [0], HOW[jt])
+    assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_shuffled_hash_join_via_planner():
+    """A build side above the broadcast threshold must take the
+    shuffle-both-sides path: two hash exchanges, NO broadcast exchange."""
+    from spark_rapids_tpu.plan import Session, table
+    from harness.asserts import assert_tables_equal
+    lt = gen_table([("k", IntegerGen(min_val=0, max_val=50)),
+                    ("x", LongGen())], n=600, seed=72)
+    rt = gen_table([("k2", IntegerGen(min_val=0, max_val=50)),
+                    ("y", LongGen())], n=500, seed=73)
+
+    def q():
+        return table(lt).join(table(rt), ["k"], ["k2"], JoinType.INNER)
+
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False})
+    tpu = Session({"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 64})
+    expected = cpu.collect(q())
+    actual = tpu.collect(q())
+    assert_tables_equal(actual, expected, ignore_order=True)
+    names = tpu.executed_exec_names()
+    assert names.count("ShuffleExchangeExec") >= 2, names
+    assert "BroadcastExchangeExec" not in names, names
+
+
+def test_build_side_swap_inner_join():
+    """INNER join with a smaller LEFT side swaps children so the smaller
+    side builds; output column order must be restored."""
+    from spark_rapids_tpu.plan import Session, table
+    from harness.asserts import assert_tables_equal
+    small = gen_table([("k", IntegerGen(min_val=0, max_val=20)),
+                       ("x", LongGen())], n=40, seed=74)
+    big = gen_table([("k2", IntegerGen(min_val=0, max_val=20)),
+                     ("y", LongGen())], n=800, seed=75)
+
+    def q():
+        return table(small).join(table(big), ["k"], ["k2"], JoinType.INNER)
+
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False})
+    tpu = Session()
+    expected = cpu.collect(q())
+    actual = tpu.collect(q())
+    assert actual.column_names == expected.column_names
+    assert_tables_equal(actual, expected, ignore_order=True)

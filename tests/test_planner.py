@@ -135,3 +135,64 @@ def test_explainonly_mode_runs_cpu_but_plans():
 def test_expand_and_sample():
     assert_tpu_and_cpu_are_equal_collect(
         lambda: table(T1).select(col("k"), col("v")).limit(100))
+
+
+def test_float64_agg_incompat_gating():
+    """Sum/avg over float64 is incompat on f64-emulating backends
+    (docs/tpu_compat.md): CPU fallback unless incompatibleOps is enabled."""
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    from harness.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                                 assert_tpu_fallback_collect)
+    from harness.data_gen import DoubleGen, IntegerGen, gen_table
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=5)),
+                   ("d", DoubleGen(no_nans=True))], n=200, seed=77)
+    assert_tpu_fallback_collect(
+        lambda: table(t).group_by("k").agg(Sum(col("d")).alias("s")),
+        "CpuFallback")
+    ses = Session({"spark.rapids.tpu.sql.incompatibleOps.enabled": True})
+    ses.collect(table(t).group_by("k").agg(Sum(col("d")).alias("s")))
+    assert not ses.fell_back(), ses.executed_exec_names()
+
+
+def test_decimal_sum_wide_falls_back():
+    """sum(decimal) whose Spark result precision exceeds DECIMAL64 is
+    planner-gated (ADVICE r1: int64 buffers would silently wrap)."""
+    import pyarrow as pa
+    import decimal as d
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    from harness.asserts import assert_tpu_fallback_collect
+    t = pa.table({"k": pa.array([0, 0, 1]),
+                  "x": pa.array([d.Decimal("12345678.90")] * 3,
+                                pa.decimal128(10, 2))})
+    assert_tpu_fallback_collect(
+        lambda: table(t).group_by("k").agg(Sum(col("x")).alias("s")),
+        "CpuFallback")
+
+
+def test_coalesce_transition_inserted():
+    """Filters feeding aggregates get CoalesceBatchesExec inserted by the
+    transition pass (reference: GpuTransitionOverrides.scala:41), so many
+    tiny post-filter batches merge before the aggregate kernel."""
+    from spark_rapids_tpu.exec.coalesce import CoalesceBatchesExec
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    from harness.data_gen import IntegerGen, LongGen, gen_table
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=5)),
+                   ("v", LongGen())], n=2000, seed=95)
+    ses = Session()
+    got = ses.collect(table(t, num_slices=1, batch_rows=100)
+                      .where(col("v") > lit(0))
+                      .group_by("k").agg(Sum(col("v")).alias("s")))
+    names = ses.executed_exec_names()
+    assert "CoalesceBatchesExec" in names, names
+
+    def walk(e):
+        yield e
+        for c in e.children:
+            yield from walk(c)
+    co = next(e for e in walk(ses.last_plan)
+              if isinstance(e, CoalesceBatchesExec))
+    # 20 hundred-row input batches must have merged into one device batch
+    assert co.metrics["numInputBatches"].value >= 20, \
+        co.metrics["numInputBatches"].value
+    assert co.metrics["numOutputBatches"].value == 1, \
+        co.metrics["numOutputBatches"].value

@@ -80,3 +80,25 @@ def test_fuzz_against_python_re():
             RLike(col("s"), pat).alias("m"))))
         exp = [re.search(pat, s) is not None for s in subjects]
         assert [r[0] for r in got] == exp, pat
+
+
+UNICODE_SUBJECTS = pa.table({"s": pa.array(
+    ["aéb", "ab", "aééb", "é", "café", "x中y", "\U0001F600ok", "a\nb",
+     "", "naïve", "αβγ", "a中", None, "ASCII only"])})
+
+
+@pytest.mark.parametrize("pat", ["a.b", r"\D+", "[^a]b", "^.$", "^...$",
+                                 r"a.{2}b", r"\S+", "(?s).", r"\w+",
+                                 "caf.", "[^x]+"])
+def test_rlike_utf8_char_units(pat):
+    """'.'/negated classes must treat one multi-byte UTF-8 char as ONE unit
+    (ADVICE r1: byte-level _ALL gave false negatives over non-ASCII)."""
+    expr = RLike(col("s"), pat)
+    got = rows_of(Session().collect(table(UNICODE_SUBJECTS).select(
+        expr.alias("m"))))
+    subjects = UNICODE_SUBJECTS.column("s").to_pylist()
+    # re.ASCII mirrors Java: \w\d\s are ASCII-only, while their negations
+    # (and '.'/negated classes) still match non-ASCII characters
+    exp = [None if s is None else (re.search(pat, s, re.ASCII) is not None)
+           for s in subjects]
+    assert [r[0] for r in got] == exp, pat

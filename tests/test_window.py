@@ -107,3 +107,23 @@ def test_window_then_filter():
     _q(lambda: table(WT).window(
         over(RowNumber(), [col("k")], [asc(col("o")), asc(col("v"))])
         .alias("rn")).where(col("rn") <= lit(3)))
+
+
+def test_unsupported_frame_falls_back():
+    """Bounded RANGE / bounded-end-unbounded-start frames are planner-tagged
+    for CPU fallback (reference: GpuWindowExecMeta), not runtime errors."""
+    from harness.asserts import assert_tpu_fallback_collect
+    assert_tpu_fallback_collect(
+        lambda: table(WT).window(
+            over(WindowAgg(Sum(col("v"))), partition_by=[col("k")],
+                 order_by=[asc(col("o"))],
+                 frame=WindowFrame(is_rows=False, start=-5, end=5))
+            .alias("s")),
+        "CpuFallback")
+    assert_tpu_fallback_collect(
+        lambda: table(WT).window(
+            over(WindowAgg(Min(col("v"))), partition_by=[col("k")],
+                 order_by=[asc(col("o"))],
+                 frame=WindowFrame(is_rows=True, start=None, end=2))
+            .alias("m")),
+        "CpuFallback")
