@@ -101,8 +101,12 @@ class DeviceColumn:
 
     data: jax.Array                 # [cap] or [cap, max_len] uint8 for strings
     validity: jax.Array             # bool[cap]; False beyond num_rows
-    lengths: Optional[jax.Array] = None   # int32[cap], strings only
+    lengths: Optional[jax.Array] = None   # int32[cap], strings/arrays/maps
     dtype: SqlType = flax.struct.field(pytree_node=False, default=T.INT32)
+    # maps only: the VALUES matrix [cap, max_elems] (``data`` holds keys).
+    # A map column is two zipped fixed-budget arrays sharing one lengths
+    # vector — the TPU answer to cudf's LIST<STRUCT<K,V>> layout.
+    data2: Optional[jax.Array] = None
 
     @property
     def capacity(self) -> int:
@@ -115,6 +119,8 @@ class DeviceColumn:
         n = self.data.size * self.data.dtype.itemsize + self.validity.size
         if self.lengths is not None:
             n += self.lengths.size * 4
+        if self.data2 is not None:
+            n += self.data2.size * self.data2.dtype.itemsize
         return n
 
 
@@ -150,7 +156,8 @@ class ColumnarBatch:
 # ---------------------------------------------------------------------------
 
 def make_column(values: np.ndarray, validity: np.ndarray, dtype: SqlType,
-                capacity: int, lengths: Optional[np.ndarray] = None) -> DeviceColumn:
+                capacity: int, lengths: Optional[np.ndarray] = None,
+                values2: Optional[np.ndarray] = None) -> DeviceColumn:
     """Pad host arrays to capacity and move to device.
 
     For strings, pass the exact byte ``lengths``; deriving them from the
@@ -169,6 +176,21 @@ def make_column(values: np.ndarray, validity: np.ndarray, dtype: SqlType,
         val[:n] = validity
         return DeviceColumn(jnp.asarray(padded), jnp.asarray(val),
                             jnp.asarray(plen), dtype)
+    if dtype.kind in (TypeKind.ARRAY, TypeKind.MAP):
+        me = values.shape[1]
+        padded = np.zeros((capacity, me), dtype=values.dtype)
+        padded[:n] = values
+        plen = np.zeros(capacity, dtype=np.int32)
+        plen[:n] = lengths
+        val = np.zeros(capacity, dtype=bool)
+        val[:n] = validity
+        p2 = None
+        if values2 is not None:
+            p2 = np.zeros((capacity, me), dtype=values2.dtype)
+            p2[:n] = values2
+            p2 = jnp.asarray(p2)
+        return DeviceColumn(jnp.asarray(padded), jnp.asarray(val),
+                            jnp.asarray(plen), dtype, p2)
     padded = np.zeros(capacity, dtype=T.numpy_dtype(dtype))
     padded[:n] = values
     val = np.zeros(capacity, dtype=bool)
@@ -250,6 +272,76 @@ def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
     if dtype.kind is TypeKind.STRING:
         mat, lengths = _strings_to_matrix(arr, dtype.max_len, truncate_strings)
         return make_column(mat, validity, dtype, capacity, lengths)
+
+    if dtype.kind is TypeKind.ARRAY:
+        # list column → fixed-budget matrix data[cap, max_elems] + lengths,
+        # the same layout collect_list produces on-device (docstring at top).
+        elem_t = dtype.children[0]
+        if elem_t.kind in (TypeKind.STRING, TypeKind.ARRAY, TypeKind.STRUCT,
+                           TypeKind.MAP):
+            raise TypeError(
+                f"array<{elem_t}> device layout is fixed-width scalars only; "
+                f"the planner must fall back to CPU")
+        me = dtype.max_len
+        offsets = np.asarray(arr.offsets)
+        counts = np.diff(offsets).astype(np.int32)
+        counts = np.where(validity, counts, 0)
+        if counts.size and int(counts.max()) > me:
+            raise CapacityError(
+                f"list of {int(counts.max())} elements exceeds the device "
+                f"array budget of {me}; raise max_elems in the scan schema "
+                f"or fall back to CPU")
+        values = arr.values
+        if values.null_count:
+            raise TypeError(
+                "arrays with null elements are outside the device subset "
+                "(fixed-budget arrays hold non-null elements; CPU fallback)")
+        flat = np.asarray(values.to_numpy(zero_copy_only=False),
+                          dtype=T.numpy_dtype(elem_t))
+        mat = np.zeros((n, me), dtype=flat.dtype)
+        col_idx = np.arange(me)[None, :]
+        mask = col_idx < counts[:, None]
+        # rows are laid out consecutively in the flat values buffer; the
+        # masked scatter below is the inverse of to_arrow's masked gather
+        start = offsets[:-1]
+        src_idx = (start[:, None] + col_idx)[mask]
+        mat[mask] = flat[src_idx]
+        return make_column(mat, validity, dtype, capacity,
+                           counts.astype(np.int32))
+
+    if dtype.kind is TypeKind.MAP:
+        key_t, val_t = dtype.children
+        for t in (key_t, val_t):
+            if t.kind in (TypeKind.STRING, TypeKind.ARRAY, TypeKind.STRUCT,
+                          TypeKind.MAP):
+                raise TypeError(
+                    f"map<{key_t},{val_t}> device layout is fixed-width "
+                    f"scalars only; the planner must fall back to CPU")
+        me = dtype.max_len
+        offsets = np.asarray(arr.offsets)
+        counts = np.diff(offsets).astype(np.int32)
+        counts = np.where(validity, counts, 0)
+        if counts.size and int(counts.max()) > me:
+            raise CapacityError(
+                f"map of {int(counts.max())} entries exceeds the device "
+                f"budget of {me}")
+        if arr.keys.null_count or arr.items.null_count:
+            raise TypeError(
+                "maps with null keys/values are outside the device subset "
+                "(fixed-budget matrices hold non-null entries; CPU fallback)")
+        keys = np.asarray(arr.keys.to_numpy(zero_copy_only=False),
+                          dtype=T.numpy_dtype(key_t))
+        items = np.asarray(arr.items.to_numpy(zero_copy_only=False),
+                           dtype=T.numpy_dtype(val_t))
+        kmat = np.zeros((n, me), dtype=keys.dtype)
+        vmat = np.zeros((n, me), dtype=items.dtype)
+        col_idx = np.arange(me)[None, :]
+        mask = col_idx < counts[:, None]
+        src_idx = (offsets[:-1][:, None] + col_idx)[mask]
+        kmat[mask] = keys[src_idx]
+        vmat[mask] = items[src_idx]
+        return make_column(kmat, validity, dtype, capacity,
+                           counts.astype(np.int32), values2=vmat)
 
     if dtype.kind is TypeKind.DECIMAL:
         if dtype.precision > 18:
@@ -362,6 +454,26 @@ def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
                                for v, ok in zip(pl, validity)],
                               type=pa.list_(elem_t))
             arrays.append(la)
+            continue
+        if f.dtype.kind is TypeKind.MAP:
+            kmat = np.asarray(col.data[:n])
+            vmat = np.asarray(col.data2[:n])
+            counts = np.where(validity, np.asarray(col.lengths[:n]), 0)
+            mask2 = np.arange(kmat.shape[1])[None, :] < counts[:, None]
+            offsets = np.zeros(n + 1, np.int32)
+            np.cumsum(counts, out=offsets[1:])
+            key_t, val_t = f.dtype.children
+            ma = pa.MapArray.from_arrays(
+                pa.array(offsets, pa.int32()),
+                pa.array(kmat[mask2], type=T.to_arrow(key_t)),
+                pa.array(vmat[mask2], type=T.to_arrow(val_t)))
+            if not validity.all():
+                pl = ma.to_pylist()
+                ma = pa.array([v if ok else None
+                               for v, ok in zip(pl, validity)],
+                              type=pa.map_(T.to_arrow(key_t),
+                                           T.to_arrow(val_t)))
+            arrays.append(ma)
             continue
         data = np.asarray(col.data[:n])
         if f.dtype.kind is TypeKind.DECIMAL:

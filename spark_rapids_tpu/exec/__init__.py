@@ -12,5 +12,6 @@ from .aggregate import HashAggregateExec, AggregateMode
 from .sort import SortExec, SortOrder, TakeOrderedAndProjectExec
 from .join import (HashJoinExec, BroadcastNestedLoopJoinExec, JoinType)
 from .coalesce import CoalesceBatchesExec, TargetSize, RequireSingleBatch
+from .generate import GenerateExec
 
 __all__ = [n for n in dir() if not n.startswith("_")]

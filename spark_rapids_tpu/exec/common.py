@@ -42,9 +42,10 @@ def gather_column(col: DeviceColumn, indices: jax.Array,
     data = jnp.take(col.data, idx, axis=0)
     validity = jnp.take(col.validity, idx, axis=0)
     lengths = jnp.take(col.lengths, idx, axis=0) if col.lengths is not None else None
+    data2 = jnp.take(col.data2, idx, axis=0) if col.data2 is not None else None
     if row_valid is not None:
         validity = validity & row_valid
-    return DeviceColumn(data, validity, lengths, col.dtype)
+    return DeviceColumn(data, validity, lengths, col.dtype, data2)
 
 
 def gather(batch: ColumnarBatch, indices: jax.Array, num_rows: jax.Array,
@@ -83,13 +84,17 @@ def concat_columns(cols: Sequence[DeviceColumn], counts: Sequence[jax.Array],
     piece. Counts are traced, so offsets are traced too.
     """
     first = cols[0]
-    is_str = first.lengths is not None
-    if is_str:
-        data = jnp.zeros((capacity, first.data.shape[1]), first.data.dtype)
-        lengths = jnp.zeros(capacity, jnp.int32)
+    is_var = first.lengths is not None     # strings / arrays / maps
+    if first.data.ndim > 1:
+        data = jnp.zeros((capacity,) + first.data.shape[1:],
+                         first.data.dtype)
     else:
         data = jnp.zeros(capacity, first.data.dtype)
-        lengths = None
+    lengths = jnp.zeros(capacity, jnp.int32) if is_var else None
+    data2 = None
+    if first.data2 is not None:
+        data2 = jnp.zeros((capacity,) + first.data2.shape[1:],
+                          first.data2.dtype)
     validity = jnp.zeros(capacity, bool)
     offset = jnp.asarray(0, jnp.int32)
     for col, n in zip(cols, counts):
@@ -99,10 +104,12 @@ def concat_columns(cols: Sequence[DeviceColumn], counts: Sequence[jax.Array],
         dest = jnp.where(live, src + offset, capacity)
         data = data.at[dest].set(col.data, mode="drop")
         validity = validity.at[dest].set(col.validity, mode="drop")
-        if is_str:
+        if is_var:
             lengths = lengths.at[dest].set(col.lengths, mode="drop")
+        if data2 is not None:
+            data2 = data2.at[dest].set(col.data2, mode="drop")
         offset = offset + jnp.asarray(n, jnp.int32)
-    return DeviceColumn(data, validity, lengths, first.dtype)
+    return DeviceColumn(data, validity, lengths, first.dtype, data2)
 
 
 def concat_batches(batches: Sequence[ColumnarBatch], capacity: int) -> ColumnarBatch:

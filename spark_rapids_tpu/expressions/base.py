@@ -83,6 +83,13 @@ class Expression:
     def dtype(self) -> SqlType:
         raise NotImplementedError(type(self).__name__)
 
+    def device_unsupported_reason(self) -> Optional[str]:
+        """Called on the BOUND tree by the planner's tagger: a non-None
+        reason marks the node CPU-only (device-layout limits the TypeSig
+        algebra can't express — nullable array elements, unroll budgets).
+        The CPU interpreter ignores this, so fallback islands still bind."""
+        return None
+
     @property
     def nullable(self) -> bool:
         return any(c.nullable for c in self.children) if self.children else True
@@ -308,10 +315,20 @@ class Literal(Expression):
         return f"lit({self.value!r})"
 
 
+_NP_LIT_TYPES = {np.dtype(np.int8): T.INT8, np.dtype(np.int16): T.INT16,
+                 np.dtype(np.int32): T.INT32, np.dtype(np.int64): T.INT64,
+                 np.dtype(np.float32): T.FLOAT32,
+                 np.dtype(np.float64): T.FLOAT64}
+
+
 def _infer_literal_type(v: Any) -> SqlType:
     import datetime as dt
     if v is None:
         return T.NULL
+    if isinstance(v, np.bool_):
+        return T.BOOLEAN
+    if isinstance(v, np.generic) and v.dtype in _NP_LIT_TYPES:
+        return _NP_LIT_TYPES[v.dtype]
     if isinstance(v, bool):
         return T.BOOLEAN
     if isinstance(v, int):

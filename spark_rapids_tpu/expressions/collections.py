@@ -86,6 +86,15 @@ class CreateArray(Expression):
     def nullable(self):
         return False
 
+    def device_unsupported_reason(self):
+        if self.elems and self.elems[0].resolved:
+            if self.dtype.children[0].kind is TypeKind.STRING:
+                return "array() over strings has no device layout"
+            if any(e.nullable for e in self.elems):
+                return ("array() with nullable elements: fixed-budget "
+                        "arrays hold non-null elements only")
+        return None
+
     def eval(self, batch, ctx=EvalContext()):
         cols = [e.eval(batch, ctx) for e in self.elems]
         if any(c.lengths is not None for c in cols):
@@ -114,7 +123,8 @@ class Size(Expression):
 
     @property
     def dtype(self):
-        _require_array(self.child, "size")
+        if self.child.dtype.kind not in (TypeKind.ARRAY, TypeKind.MAP):
+            raise TypeError(f"size expects array/map, got {self.child.dtype}")
         return T.INT32
 
     @property
@@ -175,6 +185,10 @@ class ElementAt(Expression):
     @property
     def dtype(self):
         return _require_array(self.arr, "element_at")
+
+    @property
+    def nullable(self):
+        return True     # out-of-bounds access yields null
 
     def eval(self, batch, ctx=EvalContext()):
         a = self.arr.eval(batch, ctx)
@@ -256,6 +270,10 @@ class _MinMaxArray(Expression):
     @property
     def dtype(self):
         return _require_array(self.child, type(self).__name__)
+
+    @property
+    def nullable(self):
+        return True     # empty array yields null
 
     def eval(self, batch, ctx=EvalContext()):
         a = self.child.eval(batch, ctx)
@@ -453,11 +471,13 @@ class TransformArray(_HofBase):
         bound = TransformArray(self.arr.bind(schema), self.var,
                                self.body.bind(schema))
         bound._check()
-        if bound.body.nullable:
-            raise CollectionUnsupported(
-                "transform body may produce null elements; fixed-budget "
-                "arrays cannot store them (CPU fallback)")
         return bound
+
+    def device_unsupported_reason(self):
+        if self.body.resolved and self.body.nullable:
+            return ("transform body may produce null elements; "
+                    "fixed-budget arrays cannot store them")
+        return None
 
     @property
     def dtype(self):
@@ -584,11 +604,13 @@ class AggregateArray(Expression):
                                self.acc_var, self.elem_var,
                                self.merge.bind(schema))
         _require_array(bound.arr, "aggregate")
-        me = bound.arr.dtype.max_len
-        if me > 64:
-            raise CollectionUnsupported(
-                f"aggregate() unrolls the element budget; {me} > 64")
         return bound
+
+    def device_unsupported_reason(self):
+        me = self.arr.dtype.max_len if self.arr.resolved else 0
+        if me > 64:
+            return f"aggregate() unrolls the element budget; {me} > 64"
+        return None
 
     @property
     def dtype(self):
@@ -619,3 +641,166 @@ class AggregateArray(Expression):
                 None, acc.dtype)
         validity = acc.validity & a.validity
         return DeviceColumn(acc.data, validity, None, self.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Maps (reference: collectionOperations.scala GpuMapKeys/GpuMapValues,
+# complexTypeExtractors.scala GpuGetMapValue, GpuCreateMap). Device layout:
+# keys matrix in ``data``, values matrix in ``data2``, shared ``lengths``.
+# ---------------------------------------------------------------------------
+
+def _require_map(e: Expression, who: str):
+    if e.dtype.kind is not TypeKind.MAP:
+        raise TypeError(f"{who} expects a map, got {e.dtype}")
+    return e.dtype.children
+
+
+@dataclass(frozen=True, eq=False)
+class MapKeys(Expression):
+    """map_keys(m) — zero-copy: the keys matrix IS an array column."""
+
+    child: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return MapKeys(c[0])
+
+    @property
+    def dtype(self):
+        k, _ = _require_map(self.child, "map_keys")
+        return T.array(k, self.child.dtype.max_len)
+
+    def eval(self, batch, ctx=EvalContext()):
+        m = self.child.eval(batch, ctx)
+        return DeviceColumn(m.data, m.validity, m.lengths, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class MapValues(MapKeys):
+    """map_values(m) — the values matrix as an array column."""
+
+    def with_children(self, c):
+        return MapValues(c[0])
+
+    @property
+    def dtype(self):
+        _, v = _require_map(self.child, "map_values")
+        return T.array(v, self.child.dtype.max_len)
+
+    def eval(self, batch, ctx=EvalContext()):
+        m = self.child.eval(batch, ctx)
+        return DeviceColumn(m.data2, m.validity, m.lengths, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class GetMapValue(Expression):
+    """m[key] / element_at(m, key): LAST matching entry wins (Spark's
+    LAST_WIN dedup policy for reads); missing key → null."""
+
+    map: Optional[Expression] = None
+    key: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.map, self.key)
+
+    def with_children(self, c):
+        return GetMapValue(c[0], c[1])
+
+    @property
+    def dtype(self):
+        k, v = _require_map(self.map, "GetMapValue")
+        if self.key.dtype != k:
+            raise TypeError(f"map key {self.key.dtype} vs {k}")
+        return v
+
+    @property
+    def nullable(self):
+        return True     # missing key yields null
+
+    def eval(self, batch, ctx=EvalContext()):
+        m = self.map.eval(batch, ctx)
+        k = self.key.eval(batch, ctx)
+        me = m.data.shape[1]
+        live = _elem_mask(m)
+        hit = live & (m.data == k.data[:, None])
+        # last win: highest matching slot index
+        slot = jnp.arange(me, dtype=jnp.int32)[None, :]
+        best = jnp.max(jnp.where(hit, slot, jnp.int32(-1)), axis=1)
+        found = best >= 0
+        safe = jnp.clip(best, 0, me - 1)
+        data = jnp.take_along_axis(m.data2, safe[:, None], axis=1)[:, 0]
+        ok = m.validity & k.validity & found
+        return DeviceColumn(jnp.where(ok, data, jnp.zeros((), data.dtype)),
+                            ok, None, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class MapContainsKey(Expression):
+    """map_contains_key(m, key)."""
+
+    map: Optional[Expression] = None
+    key: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.map, self.key)
+
+    def with_children(self, c):
+        return MapContainsKey(c[0], c[1])
+
+    @property
+    def dtype(self):
+        _require_map(self.map, "map_contains_key")
+        return T.BOOLEAN
+
+    def eval(self, batch, ctx=EvalContext()):
+        m = self.map.eval(batch, ctx)
+        k = self.key.eval(batch, ctx)
+        hit = jnp.any(_elem_mask(m) & (m.data == k.data[:, None]), axis=1)
+        return DeviceColumn(hit, m.validity & k.validity, None, T.BOOLEAN)
+
+
+@dataclass(frozen=True, eq=False)
+class MapFromArrays(Expression):
+    """map_from_arrays(keys, values). Spark's EXCEPTION dedup policy cannot
+    raise per-row inside a traced kernel; duplicate keys are preserved and
+    reads resolve them LAST_WIN (GetMapValue). Length mismatch reports
+    through the ANSI error channel and nulls the row otherwise."""
+
+    keys: Optional[Expression] = None
+    values: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.keys, self.values)
+
+    def with_children(self, c):
+        return MapFromArrays(c[0], c[1])
+
+    @property
+    def dtype(self):
+        kt = _require_array(self.keys, "map_from_arrays keys")
+        vt = _require_array(self.values, "map_from_arrays values")
+        return T.map_(kt, vt, max(self.keys.dtype.max_len,
+                                  self.values.dtype.max_len))
+
+    def eval(self, batch, ctx=EvalContext()):
+        ka = self.keys.eval(batch, ctx)
+        va = self.values.eval(batch, ctx)
+        me = self.dtype.max_len
+        cap = batch.capacity
+
+        def widen(x, width):
+            pad = width - x.shape[1]
+            return x if pad == 0 else jnp.pad(x, ((0, 0), (0, pad)))
+
+        kd, vd = widen(ka.data, me), widen(va.data, me)
+        mismatch = ka.validity & va.validity & (ka.lengths != va.lengths)
+        ctx.report(mismatch, "MAP_KEY_VALUE_LENGTH_MISMATCH")
+        ok = ka.validity & va.validity & ~mismatch
+        return DeviceColumn(kd, ok, jnp.where(ok, ka.lengths, 0),
+                            self.dtype, vd)

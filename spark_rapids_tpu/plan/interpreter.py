@@ -600,6 +600,163 @@ class RowEvaluator:
         dts = [c.dtype for c in e.exprs]
         return spark_hash_row(vals, dts, e.seed)
 
+    # ---- collections (arrays as python lists) ----
+    def _eval_CreateArray(self, e, row):
+        return [self.eval(c, row) for c in e.elems]
+
+    def _eval_Size(self, e, row):
+        v = self.eval(e.child, row)
+        return -1 if v is None else len(v)
+
+    def _eval_ArrayContains(self, e, row):
+        a = self.eval(e.arr, row)
+        v = self.eval(e.value, row)
+        if a is None or v is None:
+            return None
+        if any(x == v for x in a if x is not None):
+            return True
+        # Spark 3VL: not found + null element present → NULL
+        return None if any(x is None for x in a) else False
+
+    def _eval_ElementAt(self, e, row):
+        a = self.eval(e.arr, row)
+        i = self.eval(e.index, row)
+        if a is None or i is None:
+            return None
+        pos = i - 1 if i > 0 else len(a) + i
+        return a[pos] if 0 <= pos < len(a) else None
+
+    def _eval_GetArrayItem(self, e, row):
+        a = self.eval(e.arr, row)
+        i = self.eval(e.index, row)
+        if a is None or i is None:
+            return None
+        return a[i] if 0 <= i < len(a) else None
+
+    def _eval_SortArray(self, e, row):
+        a = self.eval(e.child, row)
+        if a is None:
+            return None
+        # Spark: nulls first ascending, nulls last descending
+        nulls = [x for x in a if x is None]
+        vals = sorted((x for x in a if x is not None),
+                      reverse=not e.ascending)
+        return nulls + vals if e.ascending else vals + nulls
+
+    def _eval_ArrayMin(self, e, row):
+        a = self.eval(e.child, row)
+        if a is None:
+            return None
+        vals = [x for x in a if x is not None]   # min/max skip nulls
+        return min(vals) if vals else None
+
+    def _eval_ArrayMax(self, e, row):
+        a = self.eval(e.child, row)
+        if a is None:
+            return None
+        vals = [x for x in a if x is not None]
+        return max(vals) if vals else None
+
+    def _eval_GetStructField(self, e, row):
+        from ..expressions.collections import CreateStruct
+        if isinstance(e.child, CreateStruct):
+            return self.eval(e.child.elems[e.ordinal], row)
+        v = self.eval(e.child, row)
+        if v is None:
+            return None
+        if isinstance(v, dict):     # arrow struct rows arrive as dicts
+            return list(v.values())[e.ordinal]
+        return v[e.ordinal]
+
+    def _eval_LambdaVariable(self, e, row):
+        return self._lambda_bindings[id(e)]
+
+    def _with_bindings(self, bindings, expr, row):
+        old = getattr(self, "_lambda_bindings", {})
+        self._lambda_bindings = {**old, **bindings}
+        try:
+            return self.eval(expr, row)
+        finally:
+            self._lambda_bindings = old
+
+    def _hof_lambda(self, e, row, elem):
+        # interpreter path: substitute the element value directly
+        return self._with_bindings({id(e.var): elem}, e.body, row)
+
+    def _eval_TransformArray(self, e, row):
+        a = self.eval(e.arr, row)
+        if a is None:
+            return None
+        return [self._hof_lambda(e, row, x) for x in a]
+
+    def _eval_FilterArray(self, e, row):
+        a = self.eval(e.arr, row)
+        if a is None:
+            return None
+        return [x for x in a if self._hof_lambda(e, row, x)]
+
+    def _eval_ExistsArray(self, e, row):
+        a = self.eval(e.arr, row)
+        if a is None:
+            return None
+        return any(bool(self._hof_lambda(e, row, x)) for x in a)
+
+    def _eval_ForallArray(self, e, row):
+        a = self.eval(e.arr, row)
+        if a is None:
+            return None
+        return all(bool(self._hof_lambda(e, row, x)) for x in a)
+
+    # ---- maps (arrow map rows arrive as [(k, v), ...] pair lists) ----
+    @staticmethod
+    def _map_pairs(m):
+        return list(m.items()) if isinstance(m, dict) else list(m)
+
+    def _eval_MapKeys(self, e, row):
+        m = self.eval(e.child, row)
+        return None if m is None else [k for k, _ in self._map_pairs(m)]
+
+    def _eval_MapValues(self, e, row):
+        m = self.eval(e.child, row)
+        return None if m is None else [v for _, v in self._map_pairs(m)]
+
+    def _eval_GetMapValue(self, e, row):
+        m = self.eval(e.map, row)
+        k = self.eval(e.key, row)
+        if m is None or k is None:
+            return None
+        out = None
+        for pk, pv in self._map_pairs(m):   # last win
+            if pk == k:
+                out = pv
+        return out
+
+    def _eval_MapContainsKey(self, e, row):
+        m = self.eval(e.map, row)
+        k = self.eval(e.key, row)
+        if m is None or k is None:
+            return None
+        return any(pk == k for pk, _ in self._map_pairs(m))
+
+    def _eval_MapFromArrays(self, e, row):
+        ks = self.eval(e.keys, row)
+        vs = self.eval(e.values, row)
+        if ks is None or vs is None:
+            return None
+        if len(ks) != len(vs):
+            return None   # device path nulls the row (ANSI reports)
+        return list(zip(ks, vs))
+
+    def _eval_AggregateArray(self, e, row):
+        a = self.eval(e.arr, row)
+        acc = self.eval(e.zero, row)
+        if a is None:
+            return None
+        for x in a:
+            acc = self._with_bindings(
+                {id(e.acc_var): acc, id(e.elem_var): x}, e.merge, row)
+        return acc
+
 
 def _spark_string_of(v, src_type: SqlType) -> str:
     if isinstance(v, bool):
@@ -695,6 +852,32 @@ class Interpreter:
         for proj in p.projections:
             bound = [e.bind(schema) for e in proj]
             out.extend(tuple(ev.eval(e, r) for e in bound) for r in rows)
+        return out
+
+    def _exec_LogicalGenerate(self, p):
+        from ..types import TypeKind
+        child = p.children[0]
+        rows = self._exec(child)
+        schema = child.schema()
+        ev = RowEvaluator(schema, self.ansi)
+        g = p.generator.bind(schema)
+        is_map = g.dtype.kind is TypeKind.MAP
+        pad = (None, None) if is_map else (None,)
+        out = []
+        for r in rows:
+            arr = ev.eval(g, r)
+            if arr is None or len(arr) == 0:
+                if p.outer:     # Spark explode_outer: null pos/key/value
+                    out.append(r + (None,) + pad if p.pos else r + pad)
+                continue
+            if is_map:
+                pairs = (list(arr.items()) if isinstance(arr, dict)
+                         else list(arr))
+                for i, (k, v) in enumerate(pairs):
+                    out.append(r + (i, k, v) if p.pos else r + (k, v))
+            else:
+                for i, v in enumerate(arr):
+                    out.append(r + (i, v) if p.pos else r + (v,))
         return out
 
     def _exec_LogicalSort(self, p):

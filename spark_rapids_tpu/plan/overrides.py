@@ -123,6 +123,18 @@ def _expr_rules() -> Dict[str, ExprRule]:
       note="float sums reassociate; parity kept by f64 accumulation")
     for n in ("StddevSamp", "StddevPop", "VarianceSamp", "VariancePop"):
         r(n, TS.FP)
+    # collections + HOFs (reference: collectionOperations.scala,
+    # higherOrderFunctions.scala; device layout = fixed-budget matrices)
+    for n in ("CreateArray", "Size", "ArrayContains", "ElementAt",
+              "GetArrayItem", "SortArray", "ArrayMin", "ArrayMax",
+              "CreateStruct", "GetStructField", "LambdaVariable",
+              "TransformArray", "FilterArray", "ExistsArray", "ForallArray",
+              "AggregateArray"):
+        r(n, TS.ALL_BASIC + TS.ARRAY)
+    # maps: zipped fixed-budget key/value matrices
+    for n in ("MapKeys", "MapValues", "GetMapValue", "MapContainsKey",
+              "MapFromArrays"):
+        r(n, TS.ALL_BASIC + TS.ARRAY + TS.MAP)
     return rules
 
 
@@ -178,6 +190,8 @@ class PlanMeta:
             return [o.child for o in n.orders]
         if isinstance(n, L.LogicalExpand):
             return [e for p in n.projections for e in p]
+        if isinstance(n, L.LogicalGenerate):
+            return [n.generator]
         if isinstance(n, L.LogicalWindow):
             return list(n.window_exprs)
         return []
@@ -205,6 +219,44 @@ class PlanMeta:
         """Per-node-type tagging beyond TypeSig — the reference's per-meta
         tagForGpu overrides (GpuWindowExecMeta, agg metas)."""
         n = self.node
+        if isinstance(n, (L.LogicalSort, L.LogicalJoin, L.LogicalAggregate)):
+            # arrays/maps ride through sort/join/agg as PAYLOAD; as KEYS
+            # they have no orderable/hashable scalar encoding on device
+            from ..types import TypeKind
+            if isinstance(n, L.LogicalSort):
+                keys = [o.child for o in n.orders]
+            elif isinstance(n, L.LogicalAggregate):
+                keys = list(n.group_exprs)
+            else:
+                keys = list(n.left_keys) + list(n.right_keys)
+            schemas = [c.schema() for c in n.children]
+            for k in keys:
+                for sch in schemas:
+                    try:
+                        kd = k.bind(sch).dtype
+                    except Exception:
+                        continue
+                    if kd.kind in (TypeKind.ARRAY, TypeKind.MAP,
+                                   TypeKind.STRUCT):
+                        self.will_not_work(
+                            f"{kd} cannot be a sort/join key on device "
+                            f"(no scalar ordering/hash encoding)")
+                    break
+        if isinstance(n, L.LogicalGenerate):
+            from ..types import TypeKind
+            try:
+                g = n.generator.bind(n.children[0].schema())
+                if g.dtype.kind not in (TypeKind.ARRAY, TypeKind.MAP):
+                    self.will_not_work(
+                        f"generator over {g.dtype} is not an array/map")
+                elif any(c.kind in (TypeKind.STRING, TypeKind.ARRAY,
+                                    TypeKind.STRUCT, TypeKind.MAP)
+                         for c in g.dtype.children):
+                    self.will_not_work(
+                        f"explode of {g.dtype} needs variable-width "
+                        f"elements; device layout is fixed-width scalars")
+            except Exception as ex:
+                self.will_not_work(f"generator does not bind: {ex}")
         if isinstance(n, L.LogicalWindow):
             from ..expressions.window import (WindowAgg, WindowExpression,
                                               unsupported_frame_reason)
@@ -236,15 +288,25 @@ class PlanMeta:
             child_schema = n.children[0].schema()
         except Exception:
             return
+        from ..expressions.collections import CollectionUnsupported
         for e in self._expressions():
             try:
                 bound = e.bind(child_schema)
+            except CollectionUnsupported as ex:
+                # device-layout limits (nullable elements, stored structs)
+                # surface at bind time → clean CPU fallback, not a runtime
+                # error in the kernel
+                self.will_not_work(str(ex))
+                continue
             except Exception:
                 continue   # join right-keys etc. bind elsewhere
             self._check_dtype_tree(bound, TypeKind)
 
     def _check_dtype_tree(self, e: Expression, TypeKind) -> None:
         name = type(e).__name__
+        reason = e.device_unsupported_reason()
+        if reason:
+            self.will_not_work(reason)
         child = e.children[0] if e.children else None
         if child is not None:
             kind = child.dtype.kind
@@ -307,18 +369,19 @@ def _walk(meta: PlanMeta):
 
 
 EXEC_SIGS: Dict[str, TypeSig] = {
-    "Scan": TS.ALL_BASIC,
-    "Project": TS.ALL_BASIC,
-    "Filter": TS.ALL_BASIC,
-    "Aggregate": TS.GROUPABLE + TS.NESTED,
-    "Join": TS.ALL_BASIC,
-    "Sort": TS.ORDERABLE,
-    "Limit": TS.ALL_BASIC,
-    "Union": TS.ALL_BASIC,
+    "Scan": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+    "Project": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+    "Filter": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+    "Aggregate": TS.GROUPABLE + TS.ARRAY + TS.MAP,
+    "Join": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+    "Sort": TS.ORDERABLE + TS.ARRAY + TS.MAP,   # arrays/maps ride as payload
+    "Limit": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+    "Union": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
     "Range": TS.ALL_BASIC,
-    "Expand": TS.ALL_BASIC,
-    "Sample": TS.ALL_BASIC,
+    "Expand": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+    "Sample": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
     "Window": TS.ALL_BASIC,
+    "Generate": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
 }
 
 
@@ -531,6 +594,12 @@ class Overrides:
             return SampleExec(n.fraction, n.seed, ch[0])
         if isinstance(n, L.LogicalExpand):
             return ExpandExec(n.projections, ch[0])
+        if isinstance(n, L.LogicalGenerate):
+            from ..exec.generate import GenerateExec
+            return GenerateExec(n.generator, ch[0], outer=n.outer,
+                                pos=n.pos, elem_name=n.elem_name,
+                                pos_name=n.pos_name,
+                                value_name=n.value_name, ctx=self._ctx())
         if isinstance(n, L.LogicalSort):
             return SortExec(n.orders, ch[0], global_sort=n.global_sort)
         if isinstance(n, L.LogicalWindow):

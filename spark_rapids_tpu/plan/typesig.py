@@ -39,6 +39,14 @@ class TypeSig:
         if t.kind is TypeKind.STRING and t.max_len > self.max_string_bytes:
             return (f"string max_len {t.max_len} exceeds device budget "
                     f"{self.max_string_bytes}")
+        if t.kind in (TypeKind.ARRAY, TypeKind.MAP):
+            # device arrays/maps are fixed-budget matrices of fixed-width
+            # scalars; variable-width or nested elements have no layout
+            for c in t.children:
+                if c.kind in (TypeKind.STRING, TypeKind.ARRAY,
+                              TypeKind.STRUCT, TypeKind.MAP):
+                    return (f"{t} needs variable-width elements; the "
+                            f"device layout is fixed-width scalars")
         for c in t.children:
             r = self.supports(c)
             if r:
@@ -61,5 +69,7 @@ NULL = _sig(TypeKind.NULL)
 ALL_BASIC = NUMERIC + BOOLEAN + STRING + DATETIME + NULL
 ORDERABLE = ALL_BASIC       # everything basic sorts via key normalization
 GROUPABLE = ALL_BASIC
+ARRAY = _sig(TypeKind.ARRAY)          # fixed-budget scalar-element arrays
+MAP = _sig(TypeKind.MAP)              # zipped key/value fixed-budget arrays
 NESTED = _sig(TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP)
 NONE = TypeSig()

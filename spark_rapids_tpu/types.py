@@ -120,6 +120,8 @@ class SqlType:
             return f"string[{self.max_len}]"
         if self.kind is TypeKind.ARRAY:
             return f"array<{self.children[0]}>"
+        if self.kind is TypeKind.MAP:
+            return f"map<{self.children[0]},{self.children[1]}>"
         return self.kind.value
 
 
@@ -157,6 +159,12 @@ def array(elem: SqlType, max_elems: int = 256) -> SqlType:
 
 def struct(*fields: SqlType) -> SqlType:
     return SqlType(TypeKind.STRUCT, children=tuple(fields))
+
+
+def map_(key: SqlType, value: SqlType, max_elems: int = 256) -> SqlType:
+    """map<key,value> with a static entry budget — stored on device as two
+    zipped fixed-budget matrices (keys, values) sharing one lengths vector."""
+    return SqlType(TypeKind.MAP, max_len=max_elems, children=(key, value))
 
 
 # ---- numeric promotion (Spark's findTightestCommonType subset) ------
@@ -220,6 +228,9 @@ def from_arrow(arrow_type: Any, max_len: int = 64) -> SqlType:
         return DATE
     if pa.types.is_timestamp(arrow_type):
         return TIMESTAMP
+    if pa.types.is_map(arrow_type):
+        return map_(from_arrow(arrow_type.key_type, max_len),
+                    from_arrow(arrow_type.item_type, max_len))
     if pa.types.is_list(arrow_type):
         return array(from_arrow(arrow_type.value_type, max_len))
     if pa.types.is_struct(arrow_type):
@@ -249,6 +260,8 @@ def to_arrow(t: SqlType):
         return pa.decimal128(t.precision, t.scale)
     if t.kind is TypeKind.ARRAY:
         return pa.list_(to_arrow(t.children[0]))
+    if t.kind is TypeKind.MAP:
+        return pa.map_(to_arrow(t.children[0]), to_arrow(t.children[1]))
     return m[t.kind]
 
 

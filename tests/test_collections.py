@@ -1,0 +1,258 @@
+"""Array/map expression + HOF differential tests (reference coverage:
+collection_ops_test.py, map_test.py in integration_tests)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import from_arrow, to_arrow
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.collections import (
+    AggregateArray, ArrayContains, ArrayMax, ArrayMin, CreateArray,
+    CreateStruct, ElementAt, ExistsArray, FilterArray, ForallArray,
+    GetArrayItem, GetMapValue, GetStructField, MapContainsKey,
+    MapFromArrays, MapKeys, MapValues, Size, SortArray, TransformArray,
+    hof_var)
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+def arr_table(seed=23, n=80):
+    rng = np.random.default_rng(seed)
+    lists = []
+    for i in range(n):
+        if i % 11 == 0:
+            lists.append(None)
+        else:
+            lists.append([int(v) for v in
+                          rng.integers(-30, 30, int(rng.integers(0, 6)))])
+    # k/x marked non-nullable so CreateArray/map_from_arrays stay on device
+    schema = pa.schema([pa.field("k", pa.int32(), nullable=False),
+                        pa.field("x", pa.int64(), nullable=False),
+                        pa.field("vs", pa.list_(pa.int64()))])
+    return pa.table([
+        pa.array(rng.integers(0, 5, n).astype(np.int32)),
+        pa.array(rng.integers(-5, 5, n).astype(np.int64)),
+        pa.array(lists, pa.list_(pa.int64())),
+    ], schema=schema)
+
+
+def map_table(seed=31, n=60):
+    rng = np.random.default_rng(seed)
+    maps = []
+    for i in range(n):
+        if i % 9 == 0:
+            maps.append(None)
+        else:
+            ks = rng.choice(20, size=int(rng.integers(0, 5)), replace=False)
+            maps.append([(int(k), int(rng.integers(-50, 50))) for k in ks])
+    return pa.table({
+        "q": pa.array(rng.integers(0, 20, n).astype(np.int32)),
+        "m": pa.array(maps, pa.map_(pa.int32(), pa.int64())),
+    })
+
+
+# ---------------------------------------------------------------------------
+# basic array ops
+# ---------------------------------------------------------------------------
+
+def test_size():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            "k", Size(col("vs")).alias("n")))
+
+
+def test_array_contains():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            ArrayContains(col("vs"), col("x")).alias("has"),
+            ArrayContains(col("vs"), lit(np.int64(3))).alias("has3")))
+
+
+def test_element_at_and_subscript():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            ElementAt(col("vs"), lit(1)).alias("first"),
+            ElementAt(col("vs"), lit(-1)).alias("last"),
+            ElementAt(col("vs"), lit(9)).alias("oob"),
+            GetArrayItem(col("vs"), lit(0)).alias("sub0"),
+            GetArrayItem(col("vs"), lit(2)).alias("sub2")))
+
+
+def test_sort_array_minmax():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            SortArray(col("vs")).alias("asc"),
+            SortArray(col("vs"), ascending=False).alias("desc"),
+            ArrayMin(col("vs")).alias("mn"),
+            ArrayMax(col("vs")).alias("mx")))
+
+
+def test_create_array():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            CreateArray((col("x"), col("x") * lit(np.int64(2)))).alias("a")))
+
+
+def test_struct_fold():
+    s = CreateStruct((col("x"), col("k")), ("x", "k"))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            GetStructField(s, 0).alias("sx"),
+            GetStructField(s, 1).alias("sk")))
+
+
+# ---------------------------------------------------------------------------
+# higher-order functions
+# ---------------------------------------------------------------------------
+
+def test_transform():
+    v = hof_var(T.INT64)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            TransformArray(col("vs"), v, v * lit(np.int64(3))).alias("t")))
+
+
+def test_filter_hof():
+    v = hof_var(T.INT64)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            FilterArray(col("vs"), v, v > lit(np.int64(0))).alias("f")))
+
+
+def test_exists_forall():
+    v = hof_var(T.INT64)
+    w = hof_var(T.INT64)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            ExistsArray(col("vs"), v, v > lit(np.int64(10))).alias("ex"),
+            ForallArray(col("vs"), w, w > lit(np.int64(-25))).alias("fa")))
+
+
+def test_aggregate_hof():
+    acc = hof_var(T.INT64, "acc")
+    x = hof_var(T.INT64, "x")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            AggregateArray(col("vs"), lit(np.int64(0)), acc, x,
+                           acc + x).alias("s")))
+
+
+def test_hof_uses_outer_column():
+    v = hof_var(T.INT64)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            TransformArray(col("vs"), v, v + col("x")).alias("t")))
+
+
+# ---------------------------------------------------------------------------
+# maps
+# ---------------------------------------------------------------------------
+
+def test_map_h2d_roundtrip():
+    t = map_table()
+    batch, schema = from_arrow(t)
+    back = to_arrow(batch, schema)
+    assert back.column("m").to_pylist() == t.column("m").to_pylist()
+
+
+def test_map_keys_values():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(map_table()).select(
+            MapKeys(col("m")).alias("ks"),
+            MapValues(col("m")).alias("vs"),
+            Size(col("m")).alias("n")))
+
+
+def test_get_map_value():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(map_table()).select(
+            GetMapValue(col("m"), col("q")).alias("at_q"),
+            GetMapValue(col("m"), lit(np.int32(7))).alias("at7"),
+            MapContainsKey(col("m"), col("q")).alias("has_q")))
+
+
+def test_map_from_arrays():
+    v = hof_var(T.INT64)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(arr_table()).select(
+            GetMapValue(
+                MapFromArrays(col("vs"),
+                              TransformArray(col("vs"), v,
+                                             v * lit(np.int64(2)))),
+                lit(np.int64(4))).alias("doubled4")))
+
+
+def test_map_scan_runs_on_tpu():
+    s = Session()
+    s.collect(table(map_table()).select(MapKeys(col("m")).alias("ks")))
+    assert not s.fell_back()
+
+
+# ---------------------------------------------------------------------------
+# review-finding regressions
+# ---------------------------------------------------------------------------
+
+def test_explode_map():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(map_table()).explode("m"))
+
+
+def test_explode_map_outer_pos():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(map_table()).explode("m", outer=True, pos=True))
+
+
+def test_explode_non_array_raises():
+    with pytest.raises(TypeError, match="array or map"):
+        table(map_table()).explode("q")
+
+
+def test_array_sort_key_falls_back():
+    """Array-typed sort keys have no device ordering → clean CPU fallback,
+    not a trace-time crash."""
+    from harness.asserts import assert_tpu_fallback_collect
+    assert_tpu_fallback_collect(
+        lambda: table(arr_table()).order_by("vs"), "Sort")
+
+
+def test_null_element_arrays_on_cpu():
+    """Arrays with null elements are outside the device subset; the CPU
+    interpreter must evaluate them with Spark null semantics."""
+    t = pa.table({"vs": pa.array([[3, None, 1], [None], [], None],
+                                 pa.list_(pa.int64()))})
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False})
+    out = cpu.collect(table(t).select(
+        SortArray(col("vs")).alias("s"),
+        SortArray(col("vs"), ascending=False).alias("sd"),
+        ArrayMin(col("vs")).alias("mn"),
+        ArrayMax(col("vs")).alias("mx"),
+        ArrayContains(col("vs"), lit(np.int64(7))).alias("has7"),
+        ArrayContains(col("vs"), lit(np.int64(3))).alias("has3")))
+    assert out.column("s").to_pylist() == [[None, 1, 3], [None], [], None]
+    assert out.column("sd").to_pylist() == [[3, 1, None], [None], [], None]
+    assert out.column("mn").to_pylist() == [1, None, None, None]
+    assert out.column("mx").to_pylist() == [3, None, None, None]
+    assert out.column("has7").to_pylist() == [None, None, False, None]
+    assert out.column("has3").to_pylist() == [True, None, False, None]
+
+
+def test_map_null_value_rejected_at_h2d():
+    from spark_rapids_tpu.batch import from_arrow as f2a
+    t = pa.table({"m": pa.array([[(1, 10), (2, None)]],
+                                pa.map_(pa.int32(), pa.int64()))})
+    with pytest.raises(TypeError, match="null keys/values"):
+        f2a(t)
+
+
+def test_get_map_value_nullable_gates_create_array():
+    """GetMapValue is nullable (missing keys); CreateArray over it must
+    fall back instead of silently storing 0 (review finding)."""
+    from harness.asserts import assert_tpu_fallback_collect
+    assert_tpu_fallback_collect(
+        lambda: table(map_table()).select(
+            CreateArray((GetMapValue(col("m"), lit(np.int32(99))),)
+                        ).alias("a")),
+        "Project")

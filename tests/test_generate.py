@@ -1,0 +1,88 @@
+"""GenerateExec (explode/posexplode) tests — differential vs the CPU
+interpreter, including the explode→groupby round trip (reference:
+GpuGenerateExec.scala coverage in generate_expr_test.py)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import from_arrow, to_arrow
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Count, Sum
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import (assert_rows_equal,
+                             assert_tpu_and_cpu_are_equal_collect, rows_of)
+
+
+def list_table(seed=11, n=60, with_null=True, with_empty=True):
+    rng = np.random.default_rng(seed)
+    lists, ks = [], []
+    for i in range(n):
+        ks.append(int(rng.integers(0, 5)))
+        ln = int(rng.integers(0, 6))
+        if with_null and i % 13 == 0:
+            lists.append(None)
+        elif with_empty and i % 7 == 0:
+            lists.append([])
+        else:
+            lists.append([int(v) for v in rng.integers(-50, 50, ln)])
+    return pa.table({
+        "k": pa.array(ks, pa.int32()),
+        "vs": pa.array(lists, pa.list_(pa.int64())),
+    })
+
+
+def test_array_h2d_roundtrip():
+    t = list_table()
+    batch, schema = from_arrow(t)
+    back = to_arrow(batch, schema)
+    # null lists survive; empty lists survive as empty
+    assert back.column("vs").to_pylist() == t.column("vs").to_pylist()
+    assert back.column("k").to_pylist() == t.column("k").to_pylist()
+
+
+def test_explode_basic():
+    t = list_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).explode("vs", alias="v"))
+
+
+def test_explode_outer():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(list_table()).explode("vs", alias="v", outer=True))
+
+
+def test_posexplode():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(list_table()).explode("vs", alias="v", pos=True))
+
+
+def test_posexplode_outer():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(list_table()).explode("vs", alias="v", outer=True,
+                                            pos=True))
+
+
+def test_explode_groupby_roundtrip():
+    """explode → filter → group-by: the VERDICT r1 acceptance shape."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(list_table())
+        .explode("vs", alias="v")
+        .where(col("v") > lit(-20))
+        .group_by("k")
+        .agg(Sum(col("v")).alias("sv"), Count().alias("c")))
+
+
+def test_explode_runs_on_tpu():
+    s = Session()
+    s.collect(table(list_table()).explode("vs", alias="v"))
+    assert any("Generate" in n for n in s.executed_exec_names())
+    assert not s.fell_back()
+
+
+def test_explode_multi_partition():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(list_table(n=120), num_slices=3)
+        .explode("vs", alias="v", outer=True))
