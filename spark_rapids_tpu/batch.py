@@ -177,8 +177,7 @@ def make_column(values: np.ndarray, validity: np.ndarray, dtype: SqlType,
         return DeviceColumn(jnp.asarray(padded), jnp.asarray(val),
                             jnp.asarray(plen), dtype)
     if dtype.kind in (TypeKind.ARRAY, TypeKind.MAP):
-        me = values.shape[1]
-        padded = np.zeros((capacity, me), dtype=values.dtype)
+        padded = np.zeros((capacity,) + values.shape[1:], dtype=values.dtype)
         padded[:n] = values
         plen = np.zeros(capacity, dtype=np.int32)
         plen[:n] = lengths
@@ -186,7 +185,8 @@ def make_column(values: np.ndarray, validity: np.ndarray, dtype: SqlType,
         val[:n] = validity
         p2 = None
         if values2 is not None:
-            p2 = np.zeros((capacity, me), dtype=values2.dtype)
+            p2 = np.zeros((capacity,) + values2.shape[1:],
+                          dtype=values2.dtype)
             p2[:n] = values2
             p2 = jnp.asarray(p2)
         return DeviceColumn(jnp.asarray(padded), jnp.asarray(val),
@@ -260,6 +260,40 @@ def _strings_to_matrix(arr: pa.Array, max_len: int,
     return out, lengths
 
 
+def _scalar_storage(arr: pa.Array, dtype: SqlType,
+                    validity: np.ndarray) -> np.ndarray:
+    """Arrow scalar array → numpy storage values (the device encoding):
+    decimal → unscaled int64, date → epoch days, timestamp → epoch micros,
+    numerics/bools pass through. Shared by top-level columns and
+    array/map ELEMENT buffers so nested data gets identical encoding."""
+    n = len(arr)
+    if dtype.kind is TypeKind.DECIMAL:
+        if dtype.precision > 18:
+            raise TypeError(
+                f"decimal({dtype.precision},{dtype.scale}) exceeds DECIMAL64 "
+                f"device storage; the planner must fall back to CPU")
+        return np.array([int(v.scaleb(dtype.scale)) if v is not None else 0
+                         for v in arr.to_pylist()], dtype=np.int64)
+    if dtype.kind is TypeKind.TIMESTAMP:
+        np_vals = np.zeros(n, dtype=np.int64)
+        tmp = arr.cast(pa.timestamp("us")).to_numpy(zero_copy_only=False)
+        np_vals[validity] = tmp[validity].astype(
+            "datetime64[us]").astype(np.int64)
+        return np_vals
+    if dtype.kind is TypeKind.DATE:
+        np_vals = np.zeros(n, dtype=np.int32)
+        tmp = arr.to_numpy(zero_copy_only=False)
+        np_vals[validity] = np.asarray(
+            tmp[validity], dtype="datetime64[D]").astype(np.int32)
+        return np_vals
+    # Null slots become 0 in the payload (validity carries nullness);
+    # keeps integer dtypes intact and avoids NaN poisoning reductions.
+    filled = arr.fill_null(False) if dtype.kind is TypeKind.BOOLEAN \
+        else arr.fill_null(0) if arr.null_count else arr
+    return np.asarray(filled.to_numpy(zero_copy_only=False),
+                      dtype=T.numpy_dtype(dtype))
+
+
 def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
                       truncate_strings: bool = False) -> DeviceColumn:
     arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
@@ -276,11 +310,12 @@ def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
     if dtype.kind is TypeKind.ARRAY:
         # list column → fixed-budget matrix data[cap, max_elems] + lengths,
         # the same layout collect_list produces on-device (docstring at top).
+        # String elements use a 3D byte tensor with per-element byte lengths
+        # in data2 (split()'s output layout).
         elem_t = dtype.children[0]
-        if elem_t.kind in (TypeKind.STRING, TypeKind.ARRAY, TypeKind.STRUCT,
-                           TypeKind.MAP):
+        if elem_t.kind in (TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP):
             raise TypeError(
-                f"array<{elem_t}> device layout is fixed-width scalars only; "
+                f"array<{elem_t}> nested elements have no device layout; "
                 f"the planner must fall back to CPU")
         me = dtype.max_len
         offsets = np.asarray(arr.offsets)
@@ -296,15 +331,24 @@ def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
             raise TypeError(
                 "arrays with null elements are outside the device subset "
                 "(fixed-budget arrays hold non-null elements; CPU fallback)")
-        flat = np.asarray(values.to_numpy(zero_copy_only=False),
-                          dtype=T.numpy_dtype(elem_t))
-        mat = np.zeros((n, me), dtype=flat.dtype)
         col_idx = np.arange(me)[None, :]
         mask = col_idx < counts[:, None]
-        # rows are laid out consecutively in the flat values buffer; the
-        # masked scatter below is the inverse of to_arrow's masked gather
         start = offsets[:-1]
         src_idx = (start[:, None] + col_idx)[mask]
+        if elem_t.kind is TypeKind.STRING:
+            smat, slens = _strings_to_matrix(values, elem_t.max_len,
+                                             truncate_strings)
+            mat = np.zeros((n, me, elem_t.max_len), np.uint8)
+            el_lens = np.zeros((n, me), np.int32)
+            mat[mask] = smat[src_idx]
+            el_lens[mask] = slens[src_idx]
+            return make_column(mat, validity, dtype, capacity,
+                               counts.astype(np.int32), values2=el_lens)
+        flat = _scalar_storage(values, elem_t,
+                               np.ones(len(values), dtype=bool))
+        mat = np.zeros((n, me), dtype=flat.dtype)
+        # rows are laid out consecutively in the flat values buffer; the
+        # masked scatter below is the inverse of to_arrow's masked gather
         mat[mask] = flat[src_idx]
         return make_column(mat, validity, dtype, capacity,
                            counts.astype(np.int32))
@@ -329,10 +373,10 @@ def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
             raise TypeError(
                 "maps with null keys/values are outside the device subset "
                 "(fixed-budget matrices hold non-null entries; CPU fallback)")
-        keys = np.asarray(arr.keys.to_numpy(zero_copy_only=False),
-                          dtype=T.numpy_dtype(key_t))
-        items = np.asarray(arr.items.to_numpy(zero_copy_only=False),
-                           dtype=T.numpy_dtype(val_t))
+        keys = _scalar_storage(arr.keys, key_t,
+                               np.ones(len(arr.keys), dtype=bool))
+        items = _scalar_storage(arr.items, val_t,
+                                np.ones(len(arr.items), dtype=bool))
         kmat = np.zeros((n, me), dtype=keys.dtype)
         vmat = np.zeros((n, me), dtype=items.dtype)
         col_idx = np.arange(me)[None, :]
@@ -343,32 +387,8 @@ def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
         return make_column(kmat, validity, dtype, capacity,
                            counts.astype(np.int32), values2=vmat)
 
-    if dtype.kind is TypeKind.DECIMAL:
-        if dtype.precision > 18:
-            raise TypeError(
-                f"decimal({dtype.precision},{dtype.scale}) exceeds DECIMAL64 "
-                f"device storage; the planner must fall back to CPU")
-        # store unscaled int64 (DECIMAL64)
-        np_vals = np.array([int(v.scaleb(dtype.scale)) if v is not None else 0
-                            for v in arr.to_pylist()], dtype=np.int64)
-    elif dtype.kind is TypeKind.TIMESTAMP:
-        np_vals = np.zeros(n, dtype=np.int64)
-        tmp = arr.cast(pa.timestamp("us")).to_numpy(zero_copy_only=False)
-        np_vals[validity] = tmp[validity].astype("datetime64[us]").astype(np.int64)
-    elif dtype.kind is TypeKind.DATE:
-        np_vals = np.zeros(n, dtype=np.int32)
-        tmp = arr.to_numpy(zero_copy_only=False)
-        good = validity
-        np_vals[good] = np.asarray(tmp[good], dtype="datetime64[D]").astype(np.int32)
-    else:
-        # Null slots become 0 in the payload (validity carries nullness);
-        # keeps integer dtypes intact and avoids NaN poisoning reductions.
-        filled = arr.fill_null(False) if dtype.kind is TypeKind.BOOLEAN \
-            else arr.fill_null(0) if arr.null_count else arr
-        np_vals = np.asarray(filled.to_numpy(zero_copy_only=False),
-                             dtype=T.numpy_dtype(dtype))
-
-    return make_column(np_vals, validity, dtype, capacity)
+    return make_column(_scalar_storage(arr, dtype, validity), validity,
+                       dtype, capacity)
 
 
 def schema_from_arrow(schema: pa.Schema, string_max_len: int = 64) -> Schema:
@@ -407,6 +427,21 @@ def empty_batch(schema: Schema, capacity: int = MIN_CAPACITY) -> ColumnarBatch:
 # Device -> host (the C2R / collect boundary)
 # ---------------------------------------------------------------------------
 
+def _storage_to_arrow(flat: np.ndarray, dtype: SqlType) -> pa.Array:
+    """Inverse of _scalar_storage for non-null element buffers."""
+    import decimal as pydec
+    if dtype.kind is TypeKind.DECIMAL:
+        return pa.array([pydec.Decimal(int(v)).scaleb(-dtype.scale)
+                         for v in flat], type=T.to_arrow(dtype))
+    if dtype.kind is TypeKind.TIMESTAMP:
+        return pa.array(flat.astype("datetime64[us]"),
+                        type=T.to_arrow(dtype))
+    if dtype.kind is TypeKind.DATE:
+        return pa.array(flat.astype("datetime64[D]"),
+                        type=T.to_arrow(dtype))
+    return pa.array(flat, type=T.to_arrow(dtype))
+
+
 def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
     n = int(batch.num_rows)
     arrays = []
@@ -437,11 +472,26 @@ def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
                     f"{mat.shape[1]}; raise max_elems (collect_list/set) or "
                     f"fall back to CPU")
             mask2 = np.arange(mat.shape[1])[None, :] < counts[:, None]
-            flat = mat[mask2]
             offsets = np.zeros(n + 1, np.int32)
             np.cumsum(counts, out=offsets[1:])
             elem_t = T.to_arrow(f.dtype.children[0])
-            values = pa.array(flat, type=elem_t)
+            if f.dtype.children[0].kind is TypeKind.STRING:
+                # 3D byte tensor [n, me, max_len]; per-element byte lengths
+                # ride in data2
+                el_lens = np.asarray(col.data2[:n])
+                live_el = mat[mask2]                     # [k, max_len]
+                live_lens = el_lens[mask2]
+                bmask = np.arange(mat.shape[2])[None, :] < live_lens[:, None]
+                str_offsets = np.zeros(len(live_lens) + 1, np.int32)
+                np.cumsum(live_lens, out=str_offsets[1:])
+                values = pa.StringArray.from_buffers(
+                    len(live_lens),
+                    pa.py_buffer(str_offsets.tobytes()),
+                    pa.py_buffer(np.ascontiguousarray(live_el)[bmask]
+                                 .tobytes()))
+            else:
+                values = _storage_to_arrow(mat[mask2],
+                                           f.dtype.children[0])
             la = pa.ListArray.from_arrays(pa.array(offsets, pa.int32()),
                                           values)
             if not validity.all():
@@ -465,8 +515,8 @@ def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
             key_t, val_t = f.dtype.children
             ma = pa.MapArray.from_arrays(
                 pa.array(offsets, pa.int32()),
-                pa.array(kmat[mask2], type=T.to_arrow(key_t)),
-                pa.array(vmat[mask2], type=T.to_arrow(val_t)))
+                _storage_to_arrow(kmat[mask2], key_t),
+                _storage_to_arrow(vmat[mask2], val_t))
             if not validity.all():
                 pl = ma.to_pylist()
                 ma = pa.array([v if ok else None

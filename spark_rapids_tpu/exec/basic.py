@@ -103,11 +103,11 @@ class ProjectExec(UnaryExec):
         self._schema = schema_of(self.exprs)
 
         def kernel(batch: ColumnarBatch):
-            ctx = EvalContext(self.ctx.ansi, {}) if self.ctx.ansi \
-                else self.ctx
+            # errors dict is always live: ANSI rows report conditionally,
+            # CAPACITY_* budget overflows report unconditionally
+            ctx = EvalContext(self.ctx.ansi, {})
             cols = tuple(e.eval(batch, ctx) for e in self.exprs)
-            errs = _sum_errors(ctx) if self.ctx.ansi else {}
-            return ColumnarBatch(cols, batch.num_rows), errs
+            return ColumnarBatch(cols, batch.num_rows), _sum_errors(ctx)
 
         self._kernel = jax.jit(kernel)
 
@@ -131,8 +131,13 @@ def _sum_errors(ctx) -> dict:
 
 
 def _raise_ansi(errs: dict) -> None:
+    from ..batch import CapacityError
     for kind, count in errs.items():
         if int(count) > 0:
+            if kind.startswith("CAPACITY"):
+                raise CapacityError(
+                    f"[{kind}] {int(count)} row(s) exceeded a fixed device "
+                    f"budget; raise the budget or fall back to CPU")
             raise ArithmeticException(
                 f"[{kind}] {int(count)} row(s) failed (ANSI mode)")
 
@@ -153,12 +158,10 @@ class FilterExec(UnaryExec):
                             f"{self.condition.dtype}")
 
         def kernel(batch: ColumnarBatch):
-            ctx = EvalContext(self.ctx.ansi, {}) if self.ctx.ansi \
-                else self.ctx
+            ctx = EvalContext(self.ctx.ansi, {})
             c = self.condition.eval(batch, ctx)
             keep = c.data & c.validity
-            errs = _sum_errors(ctx) if self.ctx.ansi else {}
-            return compact(batch, keep), errs
+            return compact(batch, keep), _sum_errors(ctx)
 
         self._kernel = jax.jit(kernel)
 

@@ -62,15 +62,22 @@ class GenerateExec(UnaryExec):
         else:
             fields.append(Field(elem_name, gt.children[0], outer))
         self._schema = Schema(fields)
-        self._kernel = jax.jit(self._explode_kernel)
+
+        def kernel(batch):
+            from .basic import _sum_errors
+            kctx = EvalContext(self.ctx.ansi, {})
+            return self._explode_kernel(batch, kctx), _sum_errors(kctx)
+
+        self._kernel = jax.jit(kernel)
 
     @property
     def output_schema(self) -> Schema:
         return self._schema
 
-    def _explode_kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
-        arr = self.generator.eval(batch, self.ctx)
-        cap, me = arr.data.shape
+    def _explode_kernel(self, batch: ColumnarBatch,
+                        ctx: EvalContext) -> ColumnarBatch:
+        arr = self.generator.eval(batch, ctx)
+        cap, me = arr.data.shape[:2]     # array<string> data is 3D
         out_cap = cap * me
         slot = jnp.arange(me, dtype=jnp.int32)[None, :]        # [1, me]
         row_live = batch.row_mask()
@@ -106,9 +113,19 @@ class GenerateExec(UnaryExec):
             cols.append(DeviceColumn(pos_data, elem_valid.reshape(out_cap),
                                      None, T.INT32))
         gt = self.generator.dtype
-        cols.append(DeviceColumn(arr.data.reshape(out_cap),
-                                 elem_valid.reshape(out_cap), None,
-                                 gt.children[0]))
+        if not self.is_map and arr.data.ndim == 3:
+            # array<string>: elements flatten to a [cap*me, max_len] byte
+            # matrix with per-element lengths from data2
+            el = jnp.where(elem_valid.reshape(out_cap)[:, None],
+                           arr.data.reshape(out_cap, arr.data.shape[2]), 0)
+            el_lens = jnp.where(elem_valid.reshape(out_cap),
+                                arr.data2.reshape(out_cap), 0)
+            cols.append(DeviceColumn(el, elem_valid.reshape(out_cap),
+                                     el_lens, gt.children[0]))
+        else:
+            cols.append(DeviceColumn(arr.data.reshape(out_cap),
+                                     elem_valid.reshape(out_cap), None,
+                                     gt.children[0]))
         if self.is_map:
             cols.append(DeviceColumn(arr.data2.reshape(out_cap),
                                      elem_valid.reshape(out_cap), None,
@@ -119,5 +136,8 @@ class GenerateExec(UnaryExec):
         return compact(flat, keep.reshape(out_cap))
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        from .basic import _raise_ansi
         for batch in self.child.execute_partition(p):
-            yield self._kernel(batch)
+            out, errs = self._kernel(batch)
+            _raise_ansi(errs)
+            yield out

@@ -42,9 +42,13 @@ class EvalContext:
     ansi: bool = False
     errors: object = None    # Optional[dict[str, list]]; trace-time collector
 
-    def report(self, bad, kind: str = "ARITHMETIC_OVERFLOW") -> None:
-        """bad: bool array of rows that must error under ANSI."""
-        if self.ansi and self.errors is not None:
+    def report(self, bad, kind: str = "ARITHMETIC_OVERFLOW",
+               always: bool = False) -> None:
+        """bad: bool array of rows that must error under ANSI.
+        ``always=True`` reports regardless of the ANSI flag — used for
+        device-budget overflows (CAPACITY_*), which must fail loud in any
+        mode rather than silently truncate."""
+        if (self.ansi or always) and self.errors is not None:
             import jax.numpy as jnp
             self.errors.setdefault(kind, []).append(
                 jnp.sum(bad.astype(jnp.int32)))

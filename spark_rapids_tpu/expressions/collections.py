@@ -46,6 +46,17 @@ def _require_array(e: Expression, who: str) -> SqlType:
     return e.dtype.children[0]
 
 
+def _scalar_elems_reason(e: Expression, who: str):
+    """device_unsupported_reason helper: ops with no string-element kernel
+    (array<string> = 3D byte tensors; only size/element access/explode
+    handle them)."""
+    if e is not None and e.resolved and \
+            e.dtype.kind is TypeKind.ARRAY and \
+            e.dtype.children[0].kind is TypeKind.STRING:
+        return f"{who} over array<string> has no device kernel"
+    return None
+
+
 def _elem_mask(col: DeviceColumn) -> jnp.ndarray:
     """bool[cap, me] — which slots hold real elements."""
     me = col.data.shape[1]
@@ -160,6 +171,9 @@ class ArrayContains(Expression):
                             f"element {et}")
         return T.BOOLEAN
 
+    def device_unsupported_reason(self):
+        return _scalar_elems_reason(self.arr, "array_contains")
+
     def eval(self, batch, ctx=EvalContext()):
         a = self.arr.eval(batch, ctx)
         v = self.value.eval(batch, ctx)
@@ -180,7 +194,7 @@ class ElementAt(Expression):
         return (self.arr, self.index)
 
     def with_children(self, c):
-        return ElementAt(c[0], c[1])
+        return type(self)(c[0], c[1])   # GetArrayItem subclasses this
 
     @property
     def dtype(self):
@@ -190,6 +204,22 @@ class ElementAt(Expression):
     def nullable(self):
         return True     # out-of-bounds access yields null
 
+    def _take_elem(self, a: DeviceColumn, pos, ok):
+        """Extract element at pos per row; handles string elements (3D
+        byte tensor + data2 lengths) and scalar elements (2D matrix)."""
+        safe = jnp.clip(pos, 0, a.data.shape[1] - 1)
+        if a.data.ndim == 3:       # array<string>
+            data = jnp.take_along_axis(
+                a.data, safe[:, None, None], axis=1)[:, 0]
+            lens = jnp.take_along_axis(a.data2, safe[:, None], axis=1)[:, 0]
+            lens = jnp.where(ok, lens, 0)
+            data = jnp.where(
+                (jnp.arange(data.shape[1])[None, :] < lens[:, None]) &
+                ok[:, None], data, 0)
+            return DeviceColumn(data, ok, lens, self.dtype)
+        data = jnp.take_along_axis(a.data, safe[:, None], axis=1)[:, 0]
+        return DeviceColumn(data, ok, None, self.dtype)
+
     def eval(self, batch, ctx=EvalContext()):
         a = self.arr.eval(batch, ctx)
         i = self.index.eval(batch, ctx)
@@ -197,9 +227,7 @@ class ElementAt(Expression):
         n = a.lengths
         pos = jnp.where(idx > 0, idx - 1, n + idx)      # 1-based / from-end
         ok = a.validity & i.validity & (pos >= 0) & (pos < n)
-        safe = jnp.clip(pos, 0, a.data.shape[1] - 1)
-        data = jnp.take_along_axis(a.data, safe[:, None], axis=1)[:, 0]
-        return DeviceColumn(data, ok, None, self.dtype)
+        return self._take_elem(a, pos, ok)
 
 
 @dataclass(frozen=True, eq=False)
@@ -211,9 +239,7 @@ class GetArrayItem(ElementAt):
         i = self.index.eval(batch, ctx)
         pos = i.data.astype(jnp.int32)
         ok = a.validity & i.validity & (pos >= 0) & (pos < a.lengths)
-        safe = jnp.clip(pos, 0, a.data.shape[1] - 1)
-        data = jnp.take_along_axis(a.data, safe[:, None], axis=1)[:, 0]
-        return DeviceColumn(data, ok, None, self.dtype)
+        return self._take_elem(a, pos, ok)
 
 
 @dataclass(frozen=True, eq=False)
@@ -235,6 +261,9 @@ class SortArray(Expression):
     def dtype(self):
         _require_array(self.child, "sort_array")
         return self.child.dtype
+
+    def device_unsupported_reason(self):
+        return _scalar_elems_reason(self.child, "sort_array")
 
     def eval(self, batch, ctx=EvalContext()):
         a = self.child.eval(batch, ctx)
@@ -274,6 +303,9 @@ class _MinMaxArray(Expression):
     @property
     def nullable(self):
         return True     # empty array yields null
+
+    def device_unsupported_reason(self):
+        return _scalar_elems_reason(self.child, type(self).__name__)
 
     def eval(self, batch, ctx=EvalContext()):
         a = self.child.eval(batch, ctx)
@@ -430,6 +462,9 @@ class _HofBase(Expression):
     @property
     def children(self):
         return (self.arr,)
+
+    def device_unsupported_reason(self):
+        return _scalar_elems_reason(self.arr, type(self).__name__)
 
     def _check(self):
         et = _require_array(self.arr, type(self).__name__)

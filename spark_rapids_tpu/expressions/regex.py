@@ -582,3 +582,684 @@ class Like(Expression):
 
     def __repr__(self):
         return f"{self.child!r} LIKE {self.pattern!r}"
+
+
+# ---------------------------------------------------------------------------
+# Match spans + capture groups (regexp_extract / regexp_replace / split)
+#
+# Reference: GpuRegExpExtract/GpuRegExpReplace/GpuStringSplit lower onto
+# cudf's backtracking regex engine. The TPU engine instead computes exact
+# Java-greedy spans WITHOUT backtracking, by decomposing the pattern into a
+# top-level concatenation of SEGMENTS (quantified atoms / groups) and
+# resolving each segment's greedy end with a suffix-feasibility machine:
+#
+#   Java's backtracking order for greedy concatenations picks, left to
+#   right, the LONGEST prefix for each segment such that the rest of the
+#   pattern can still match. That is literally computed here: for segment i
+#   at position p, end_i = max q where (seg_i matches [p,q)) AND
+#   (suffix i+1 is feasible from q). Each test is one vectorized DFA scan.
+#
+# Subset: concatenations of quantified character classes and groups.
+# Alternation ('|') and lazy quantifiers change Java's search order in ways
+# a longest-feasible rule cannot reproduce -> RegexUnsupported (CPU
+# fallback), same policy as the reference's transpiler whitelist.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Segment:
+    src: str                    # pattern source for this segment
+    compiled: "CompiledRegex"   # anchored-start machine for the segment
+
+
+@dataclass
+class SpanProgram:
+    """Compiled form for span/group queries."""
+
+    segments: List[_Segment]
+    suffixes: List["CompiledRegex"]      # machine for segments[i:] per i
+    group_bounds: Dict[int, Tuple[int, int]]  # group -> (first_seg, last_seg_excl)
+    n_groups: int
+    anchored_start: bool
+    anchored_end: bool
+
+
+def _compile_anchored(pattern: str) -> CompiledRegex:
+    """Compile with NO unanchored-find start loop (machine starts exactly
+    at its activation position)."""
+    return compile_regex("^" + pattern if not pattern.startswith("^")
+                         else pattern)
+
+
+class _SegmentParser:
+    """Source-level splitter: top-level concatenation -> segment sources.
+
+    Groups: an unquantified group is flattened into its inner segments
+    (capturing groups record which segment range they cover, so nesting of
+    unquantified captures is fine). A QUANTIFIED group must have a
+    fixed-shape body (plain unit sequence) because its greedy repetition
+    is then longest-feasible, which matches Java.
+    """
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.group_bounds: Dict[int, Tuple[int, int]] = {}
+        self.n_groups = 0
+        self.anchored_start = False
+        self.anchored_end = False
+
+    def parse(self) -> List[str]:
+        if self.p.startswith("(?s)"):
+            self.i = 4
+        if self._peek() == "^":
+            self.i += 1
+            self.anchored_start = True
+        segs = self._concat(top=True)
+        if self.i < len(self.p):
+            raise RegexUnsupported(
+                f"spans: trailing input at {self.i}: {self.p}")
+        return segs
+
+    def _peek(self):
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def _concat(self, top: bool) -> List[str]:
+        segs: List[str] = []
+        while True:
+            c = self._peek()
+            if c is None or c == ")":
+                return segs
+            if c == "|":
+                raise RegexUnsupported(
+                    "spans: alternation changes Java's search order; "
+                    "longest-feasible cannot reproduce it")
+            if c == "$":
+                nxt = self.i + 1
+                if top and nxt == len(self.p):
+                    self.anchored_end = True
+                    self.i += 1
+                    return segs
+                raise RegexUnsupported("spans: inner $")
+            if c == "(":
+                segs.extend(self._group())
+            else:
+                segs.append(self._unit_with_quant())
+                self._advance_counter(1)
+
+    def _unit_src(self) -> str:
+        """One class/escape/char/dot unit; returns its source slice."""
+        start = self.i
+        c = self.p[self.i]
+        self.i += 1
+        if c == "\\":
+            if self.i >= len(self.p):
+                raise RegexUnsupported("spans: trailing backslash")
+            self.i += 1
+        elif c == "[":
+            if self._peek() == "^":
+                self.i += 1
+            first = True
+            while True:
+                cc = self._peek()
+                if cc is None:
+                    raise RegexUnsupported("spans: unterminated class")
+                if cc == "]" and not first:
+                    self.i += 1
+                    break
+                first = False
+                if cc == "\\":
+                    self.i += 1
+                self.i += 1
+        elif c in "*+?{}()|^$":
+            raise RegexUnsupported(f"spans: unexpected metachar {c!r}")
+        return self.p[start:self.i]
+
+    def _quant_src(self) -> str:
+        c = self._peek()
+        if c in ("*", "+", "?"):
+            self.i += 1
+            if self._peek() == "?":
+                raise RegexUnsupported("lazy quantifiers")
+            return c
+        if c == "{":
+            start = self.i
+            while self._peek() not in (None, "}"):
+                self.i += 1
+            if self._peek() != "}":
+                raise RegexUnsupported("spans: malformed {m,n}")
+            self.i += 1
+            if self._peek() == "?":
+                raise RegexUnsupported("lazy quantifiers")
+            return self.p[start:self.i]
+        return ""
+
+    def _unit_with_quant(self) -> str:
+        u = self._unit_src()
+        return u + self._quant_src()
+
+    def _fixed_body(self, body: str) -> bool:
+        """True if body is a plain unit sequence (no quantifiers, groups,
+        alternation) — safe under an outer quantifier."""
+        sub = _SegmentParser(body)
+        try:
+            segs = sub._concat(top=False)
+        except RegexUnsupported:
+            return False
+        if sub.i < len(body) or sub.group_bounds:
+            return False
+        return all(not s or s[-1] not in "*+?}" for s in segs)
+
+    def _group(self) -> List[str]:
+        self.i += 1                      # consume '('
+        capturing = True
+        if self._peek() == "?":
+            self.i += 1
+            if self._peek() != ":":
+                raise RegexUnsupported("lookaround / named groups")
+            self.i += 1
+            capturing = False
+        gidx = 0
+        if capturing:
+            self.n_groups += 1
+            gidx = self.n_groups
+        body_start = self.i
+        depth = 1
+        while depth:
+            c = self._peek()
+            if c is None:
+                raise RegexUnsupported("spans: unbalanced group")
+            if c == "\\":
+                self.i += 2
+                continue
+            if c == "[":
+                self._skip_class()
+                continue
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+            self.i += 1
+        body = self.p[body_start:self.i - 1]
+        quant = self._quant_src()
+        if quant:
+            if capturing:
+                # Java binds a quantified capture group to its LAST
+                # iteration; a segment span covers all of them
+                raise RegexUnsupported(
+                    "spans: quantified capturing group binds the last "
+                    "iteration in Java")
+            if not self._fixed_body(body):
+                raise RegexUnsupported(
+                    "spans: quantified group with variable-shape body")
+            seg = f"(?:{body}){quant}"
+            first = self._seg_counter()
+            out = [seg]
+            if capturing:
+                self.group_bounds[gidx] = (first, first + 1)
+            self._advance_counter(1)
+            return out
+        # unquantified group: flatten body into segments
+        sub = _SegmentParser(body)
+        inner = sub._concat(top=False)
+        if sub.i < len(body):
+            raise RegexUnsupported("spans: bad group body")
+        first = self._seg_counter()
+        # renumber nested groups relative to ours
+        for g, (a, b) in sub.group_bounds.items():
+            self.group_bounds[self.n_groups + g] = (first + a, first + b)
+        self.n_groups += sub.n_groups
+        if capturing:
+            self.group_bounds[gidx] = (first, first + len(inner))
+        self._advance_counter(len(inner))
+        return inner
+
+    def _skip_class(self):
+        assert self.p[self.i] == "["
+        self.i += 1
+        if self._peek() == "^":
+            self.i += 1
+        first = True
+        while True:
+            c = self._peek()
+            if c is None:
+                raise RegexUnsupported("spans: unterminated class")
+            if c == "]" and not first:
+                self.i += 1
+                return
+            first = False
+            if c == "\\":
+                self.i += 1
+            self.i += 1
+
+    # segment counters so nested parsers can map group -> absolute segment
+    def _seg_counter(self) -> int:
+        return getattr(self, "_segs_emitted", 0)
+
+    def _advance_counter(self, k: int):
+        self._segs_emitted = self._seg_counter() + k
+
+
+def compile_spans(pattern: str) -> SpanProgram:
+    """Compile for span/group queries; RegexUnsupported → CPU fallback."""
+    sp = _SegmentParser(pattern)
+    seg_srcs = sp.parse()
+    if not seg_srcs:
+        raise RegexUnsupported("spans: empty pattern")
+    segments = [_Segment(s, _compile_anchored(s)) for s in seg_srcs]
+    suffixes = []
+    for i in range(len(seg_srcs) + 1):
+        rest = "".join(seg_srcs[i:])
+        suffixes.append(_compile_anchored(rest) if rest else None)
+    return SpanProgram(segments, suffixes, sp.group_bounds, sp.n_groups,
+                       sp.anchored_start, sp.anchored_end)
+
+
+def _str_classes(col: DeviceColumn, rx: CompiledRegex):
+    import jax.numpy as jnp
+    data = col.data
+    ml = data.shape[1]
+    cls = jnp.asarray(rx.byte_class)[data.astype(jnp.int32)]
+    in_str = jnp.arange(ml)[None, :] < col.lengths[:, None]
+    return cls, in_str
+
+
+def feasible_starts(col: DeviceColumn, rx: Optional[CompiledRegex],
+                    anchored_end: bool):
+    """bool [n, ml+1]: can ``rx`` (anchored at q) match starting at byte
+    position q? One parallel-machine scan: machine q sits in the start
+    state until step q, then consumes. ``rx=None`` = the empty suffix."""
+    import jax
+    import jax.numpy as jnp
+    n, ml = col.data.shape
+    lengths = col.lengths
+    q_idx = jnp.arange(ml + 1, dtype=jnp.int32)[None, :]
+    live = q_idx <= lengths[:, None]
+    if rx is None:
+        if anchored_end:
+            return live & (q_idx == lengths[:, None])
+        return live
+    table = jnp.asarray(rx.table)
+    acc = jnp.asarray(rx.accepting)
+    cls, _ = _str_classes(col, rx)
+
+    start_hit = bool(rx.accepting[rx.start_state])
+    ever = jnp.zeros((n, ml + 1), bool)
+    if start_hit:
+        e0 = live
+        if anchored_end:
+            e0 = e0 & (q_idx == lengths[:, None])
+        ever = e0
+
+    def body(carry, j):
+        state, ever = carry
+        can = (q_idx <= j) & (j < lengths[:, None])
+        nxt = table[state, cls[:, j][:, None]]
+        state = jnp.where(can, nxt, state)
+        hit = acc[state] & can
+        if anchored_end:
+            hit = hit & ((j + 1) == lengths[:, None])
+        ever = ever | (hit & live)
+        return (state, ever), None
+
+    state0 = jnp.full((n, ml + 1), rx.start_state, jnp.int32)
+    (_, ever), _ = jax.lax.scan(body, (state0, ever),
+                                jnp.arange(ml, dtype=jnp.int32))
+    return ever
+
+
+def greedy_seg_ends(col: DeviceColumn, seg: CompiledRegex, p, feas_next):
+    """Greedy end per machine: max q such that ``seg`` matches [p, q) AND
+    the remaining pattern is feasible at q. ``p`` is int32 [n, S] (S start
+    hypotheses; S=1 for first-match queries); returns int32 [n, S], -1 if
+    the segment cannot match under feasibility."""
+    import jax
+    import jax.numpy as jnp
+    n, ml = col.data.shape
+    lengths = col.lengths
+    table = jnp.asarray(seg.table)
+    acc = jnp.asarray(seg.accepting)
+    cls, _ = _str_classes(col, seg)
+    S = p.shape[1]
+
+    alive = p >= 0
+    safe_p = jnp.clip(p, 0, ml)
+    # empty-segment match at p itself
+    best = jnp.where(alive & bool(seg.accepting[seg.start_state]) &
+                     jnp.take_along_axis(feas_next, safe_p, axis=1),
+                     safe_p, jnp.int32(-1))
+
+    def body(carry, j):
+        state, best = carry
+        can = alive & (safe_p <= j) & (j < lengths[:, None])
+        nxt = table[state, cls[:, j][:, None]]
+        state = jnp.where(can, nxt, state)
+        hit = acc[state] & can & feas_next[:, j + 1][:, None]
+        best = jnp.where(hit, j + 1, best)
+        return (state, best), None
+
+    state0 = jnp.full((n, S), seg.start_state, jnp.int32)
+    (_, best), _ = jax.lax.scan(body, (state0, best),
+                                jnp.arange(ml, dtype=jnp.int32))
+    return best
+
+
+def first_match_bounds(col: DeviceColumn, prog: SpanProgram):
+    """Left-most match, Java-greedy. Returns (matched: bool[n],
+    bounds: int32[n, k+1]) — bounds[:, i] is the byte position where
+    segment i starts (bounds[:, k] = match end)."""
+    import jax.numpy as jnp
+    n, ml = col.data.shape
+    feas = [feasible_starts(col, prog.suffixes[i], prog.anchored_end)
+            for i in range(len(prog.segments) + 1)]
+    f0 = feas[0]
+    if prog.anchored_start:
+        matched = f0[:, 0]
+        start = jnp.zeros(n, jnp.int32)
+    else:
+        matched = jnp.any(f0, axis=1)
+        start = jnp.argmax(f0, axis=1).astype(jnp.int32)
+    p = jnp.where(matched, start, -1)[:, None]
+    bounds = [p]
+    for i, seg in enumerate(prog.segments):
+        p = greedy_seg_ends(col, seg.compiled, p, feas[i + 1])
+        bounds.append(p)
+    return matched, jnp.concatenate(bounds, axis=1)
+
+
+def all_match_spans(col: DeviceColumn, prog: SpanProgram):
+    """All non-overlapping Java-greedy matches (replaceAll/split order).
+    Returns (sel_start: bool[n, ml+1], match_end: int32[n, ml+1])."""
+    import jax
+    import jax.numpy as jnp
+    n, ml = col.data.shape
+    feas = [feasible_starts(col, prog.suffixes[i], prog.anchored_end)
+            for i in range(len(prog.segments) + 1)]
+    q_idx = jnp.arange(ml + 1, dtype=jnp.int32)[None, :]
+    p = jnp.where(feas[0], q_idx, -1)          # every feasible start
+    for i, seg in enumerate(prog.segments):
+        p = greedy_seg_ends(col, seg.compiled, p, feas[i + 1])
+    end_q = p                                   # [n, ml+1]; -1 = no match
+    if prog.anchored_start:
+        end_q = end_q.at[:, 1:].set(-1)
+
+    # leftmost non-overlapping selection (Matcher.find loop): next search
+    # resumes at the match end, +1 after a zero-length match
+    def body(nxt, s):
+        can = (end_q[:, s] >= 0) & (s >= nxt) & \
+              (s <= col.lengths)
+        e = end_q[:, s]
+        nxt = jnp.where(can, jnp.where(e > s, e, s + 1), nxt)
+        return nxt, can
+
+    nxt0 = jnp.zeros(n, jnp.int32)
+    _, sel = jax.lax.scan(body, nxt0, jnp.arange(ml + 1, dtype=jnp.int32))
+    return sel.T, end_q
+
+
+# ---------------------------------------------------------------------------
+# regexp_extract / regexp_replace / split expressions
+# (reference: GpuRegExpExtract / GpuRegExpReplace / GpuStringSplit in
+# stringFunctions.scala — there lowered onto cudf's backtracking engine;
+# here onto the span program above. Unsupported patterns tag the plan for
+# CPU fallback via device_unsupported_reason instead of raising.)
+# ---------------------------------------------------------------------------
+
+def _try_compile_spans(pattern: str):
+    try:
+        return compile_spans(pattern), None
+    except RegexUnsupported as ex:
+        return None, str(ex)
+
+
+def extract_group_device(col: DeviceColumn, prog: SpanProgram, idx: int):
+    """(bytes [n, ml], lengths [n]) for capture group ``idx`` of the first
+    match (idx 0 = whole match); no match → empty string (Spark)."""
+    import jax.numpy as jnp
+    n, ml = col.data.shape
+    matched, bounds = first_match_bounds(col, prog)
+    if idx == 0:
+        a, b = 0, bounds.shape[1] - 1
+    else:
+        a, b = prog.group_bounds[idx]
+    s = jnp.where(matched, bounds[:, a], 0)
+    e = jnp.where(matched, bounds[:, b], 0)
+    glen = jnp.maximum(e - s, 0)
+    src = jnp.clip(s[:, None] + jnp.arange(ml, dtype=jnp.int32)[None, :],
+                   0, ml - 1)
+    data = jnp.take_along_axis(col.data, src, axis=1)
+    mask = jnp.arange(ml)[None, :] < glen[:, None]
+    return jnp.where(mask, data, 0), glen
+
+
+def replace_all_device(col: DeviceColumn, prog: SpanProgram,
+                       repl: bytes):
+    """Java replaceAll with a literal replacement. Returns
+    (bytes [n, out_ml], lengths [n])."""
+    import jax.numpy as jnp
+    n, ml = col.data.shape
+    R = len(repl)
+    out_ml = ml + R * (ml + 1)
+    sel, endq = all_match_spans(col, prog)          # [n, ml+1]
+    pos = jnp.arange(ml + 1, dtype=jnp.int32)[None, :]
+    nonzero = sel & (endq > pos)
+
+    # coverage of matched (nonzero-length) spans → dropped bytes
+    r_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    delta = jnp.zeros((n, ml + 2), jnp.int32)
+    delta = delta.at[:, :-1].add(nonzero.astype(jnp.int32))
+    safe_end = jnp.clip(jnp.where(nonzero, endq, ml + 1), 0, ml + 1)
+    delta = delta.at[r_idx, safe_end].add(
+        -nonzero.astype(jnp.int32))
+    coverage = jnp.cumsum(delta, axis=1)[:, :ml] > 0
+    in_str = jnp.arange(ml)[None, :] < col.lengths[:, None]
+    keep = in_str & ~coverage
+
+    ins_incl = jnp.cumsum(sel.astype(jnp.int32), axis=1)     # [n, ml+1]
+    kept_incl = jnp.cumsum(keep.astype(jnp.int32), axis=1)   # [n, ml]
+    kept_excl = kept_incl - keep.astype(jnp.int32)
+    kept_excl_ext = jnp.concatenate(
+        [kept_excl, kept_incl[:, -1:]], axis=1)              # [n, ml+1]
+
+    out = jnp.zeros((n, out_ml), jnp.uint8)
+    # kept bytes
+    tgt = kept_excl + R * ins_incl[:, :ml]
+    flat_tgt = jnp.where(keep, r_idx * out_ml + tgt, n * out_ml)
+    out = out.reshape(-1).at[flat_tgt.reshape(-1)].set(
+        col.data.reshape(-1), mode="drop").reshape(n, out_ml)
+    # replacement bytes
+    if R:
+        base = kept_excl_ext + R * (ins_incl - 1)
+        for r, byte in enumerate(repl):
+            ftgt = jnp.where(sel, r_idx * out_ml + base + r, n * out_ml)
+            out = out.reshape(-1).at[ftgt.reshape(-1)].set(
+                jnp.uint8(byte), mode="drop").reshape(n, out_ml)
+    new_len = kept_incl[:, -1] + R * ins_incl[:, -1]
+    return out, new_len
+
+
+@dataclass(frozen=True, eq=False)
+class RegexpExtract(Expression):
+    """regexp_extract(str, pattern, idx): capture group of the first
+    Java-greedy match; '' when there is no match (Spark semantics)."""
+
+    child: "Expression" = None
+    pattern: str = ""
+    idx: int = 1
+
+    def __post_init__(self):
+        prog, reason = _try_compile_spans(self.pattern)
+        if prog is not None and self.idx > prog.n_groups:
+            prog, reason = None, (f"group {self.idx} > "
+                                  f"{prog.n_groups} groups")
+        object.__setattr__(self, "_prog", prog)
+        object.__setattr__(self, "_reason", reason)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return RegexpExtract(c[0], self.pattern, self.idx)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def device_unsupported_reason(self):
+        return self._reason and f"regexp_extract: {self._reason}"
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .strings import _string_column
+        if self._prog is None:
+            raise RegexUnsupported(self._reason)
+        c = self.child.eval(batch, ctx)
+        data, lengths = extract_group_device(c, self._prog, self.idx)
+        return _string_column(data, lengths, c.validity,
+                              self.child.dtype.max_len)
+
+
+@dataclass(frozen=True, eq=False)
+class RegexpReplace(Expression):
+    """regexp_replace(str, pattern, replacement): Java replaceAll with a
+    LITERAL replacement ($n backrefs → CPU fallback)."""
+
+    child: "Expression" = None
+    pattern: str = ""
+    replacement: str = ""
+
+    def __post_init__(self):
+        prog, reason = _try_compile_spans(self.pattern)
+        if "$" in self.replacement or "\\" in self.replacement:
+            prog, reason = None, "replacement backrefs"
+        try:
+            self.replacement.encode("ascii")
+        except UnicodeEncodeError:
+            prog, reason = None, "non-ASCII replacement"
+        object.__setattr__(self, "_prog", prog)
+        object.__setattr__(self, "_reason", reason)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return RegexpReplace(c[0], self.pattern, self.replacement)
+
+    @property
+    def dtype(self):
+        ml = self.child.dtype.max_len
+        return T.string(ml + len(self.replacement) * (ml + 1))
+
+    def device_unsupported_reason(self):
+        return self._reason and f"regexp_replace: {self._reason}"
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .strings import _string_column
+        if self._prog is None:
+            raise RegexUnsupported(self._reason)
+        c = self.child.eval(batch, ctx)
+        data, lengths = replace_all_device(c, self._prog,
+                                           self.replacement.encode())
+        return _string_column(data, lengths, c.validity,
+                              self.dtype.max_len)
+
+
+def split_device(col: DeviceColumn, prog: SpanProgram, limit: int,
+                 max_elems: int):
+    """Java String.split on the span program. Returns (pieces
+    uint8 [n, me, ml], piece_lengths int32 [n, me], counts int32 [n],
+    overflow bool [n] — rows with more pieces than the budget).
+    Empty-matching patterns are gated at compile (device_unsupported)."""
+    import jax
+    import jax.numpy as jnp
+    n, ml = col.data.shape
+    me = max_elems
+    sel, endq = all_match_spans(col, prog)          # [n, ml+1]
+    if limit > 0:
+        # keep only the first limit-1 separator matches per row
+        rank = jnp.cumsum(sel.astype(jnp.int32), axis=1)
+        sel = sel & (rank <= limit - 1)
+    # piece k = [prev_end_k, start_k); collect up to me-1 separators
+    r_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    rank = jnp.cumsum(sel.astype(jnp.int32), axis=1) - sel.astype(jnp.int32)
+    q_pos = jnp.arange(ml + 1, dtype=jnp.int32)[None, :]
+    # scatter match k's (start, end) into [n, me] tables
+    slot = jnp.where(sel & (rank < me - 1), rank, me)
+    starts = jnp.full((n, me + 1), ml + 1, jnp.int32).at[
+        r_idx, slot].set(jnp.where(sel, q_pos, 0), mode="drop")[:, :me]
+    ends = jnp.full((n, me + 1), ml + 1, jnp.int32).at[
+        r_idx, slot].set(jnp.where(sel, endq, 0), mode="drop")[:, :me]
+    n_sep_true = jnp.sum(sel.astype(jnp.int32), axis=1)
+    n_sep = jnp.minimum(n_sep_true, me - 1)
+    counts = n_sep + 1      # clamped: overflow raises via the error channel
+    # piece boundaries
+    piece_start = jnp.concatenate(
+        [jnp.zeros((n, 1), jnp.int32), ends[:, :me - 1]], axis=1)
+    piece_end = jnp.where(
+        jnp.arange(me, dtype=jnp.int32)[None, :] < n_sep[:, None],
+        starts, col.lengths[:, None])
+    plen = jnp.maximum(piece_end - piece_start, 0)
+    live = jnp.arange(me, dtype=jnp.int32)[None, :] < counts[:, None]
+    plen = jnp.where(live, plen, 0)
+    # gather piece bytes: [n, me, ml]
+    src = jnp.clip(piece_start[:, :, None] +
+                   jnp.arange(ml, dtype=jnp.int32)[None, None, :], 0, ml - 1)
+    pieces = jnp.take_along_axis(col.data[:, None, :].repeat(me, axis=1),
+                                 src, axis=2)
+    mask = jnp.arange(ml, dtype=jnp.int32)[None, None, :] < plen[:, :, None]
+    pieces = jnp.where(mask, pieces, 0)
+    return pieces, plen, counts, n_sep_true > (me - 1)
+
+
+@dataclass(frozen=True, eq=False)
+class StringSplit(Expression):
+    """split(str, pattern, limit): array<string> — stored on device as a
+    3D byte tensor [cap, max_elems, max_len] with per-element lengths in
+    ``data2``. limit==0 (drop trailing empties) needs a host-side trim and
+    is CPU-only."""
+
+    child: "Expression" = None
+    pattern: str = ""
+    limit: int = -1
+    max_elems: int = 16
+
+    def __post_init__(self):
+        prog, reason = _try_compile_spans(self.pattern)
+        if prog is not None:
+            # empty-matching separators hit Java's zero-width corner cases
+            if bool(prog.suffixes[0].accepting[prog.suffixes[0].start_state]):
+                prog, reason = None, "empty-matching split pattern"
+        if self.limit == 0:
+            prog, reason = None, "split limit 0 trims trailing empties"
+        object.__setattr__(self, "_prog", prog)
+        object.__setattr__(self, "_reason", reason)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return StringSplit(c[0], self.pattern, self.limit, self.max_elems)
+
+    @property
+    def dtype(self):
+        return T.array(self.child.dtype, self.max_elems)
+
+    def device_unsupported_reason(self):
+        return self._reason and f"split: {self._reason}"
+
+    def eval(self, batch, ctx=EvalContext()):
+        import jax.numpy as jnp
+        if self._prog is None:
+            raise RegexUnsupported(self._reason)
+        c = self.child.eval(batch, ctx)
+        pieces, plen, counts, overflow = split_device(
+            c, self._prog, self.limit, self.max_elems)
+        # budget overflow fails loud through the exec error channel in any
+        # mode — device consumers (element_at/explode) otherwise see a
+        # silently truncated array
+        ctx.report(overflow & c.validity, "CAPACITY_split_max_elems",
+                   always=True)
+        counts = jnp.where(c.validity, counts, 0)
+        return DeviceColumn(pieces, c.validity, counts, self.dtype, plen)

@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import types as T
 from ..batch import ColumnarBatch, DeviceColumn
@@ -569,3 +570,212 @@ def startswith(e, pat):
 def endswith(e, pat):
     from .base import lit_if_needed
     return StringPredicate(e, lit_if_needed(pat), "endswith")
+
+
+@dataclass(frozen=True, eq=False)
+class Translate(Expression):
+    """translate(str, from, to): per-byte substitution via one 256-entry
+    lookup table built at bind time (the cudf translate table, but as a
+    gather instead of per-char dispatch). Bytes mapped to "delete" (from
+    chars beyond len(to)) are compacted out. ASCII from/to only — a
+    non-ASCII mapping would need char-level re-encoding → CPU fallback."""
+
+    child: Expression = None
+    from_str: str = ""
+    to_str: str = ""
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Translate(c[0], self.from_str, self.to_str)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def device_unsupported_reason(self):
+        try:
+            self.from_str.encode("ascii")
+            self.to_str.encode("ascii")
+        except UnicodeEncodeError:
+            return "translate: non-ASCII mapping needs char re-encoding"
+        return None
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        table = np.arange(256, dtype=np.uint8)
+        delete = np.zeros(256, bool)
+        seen = set()
+        for i, ch in enumerate(self.from_str):
+            b = ord(ch)
+            if b in seen:       # Spark: first occurrence wins
+                continue
+            seen.add(b)
+            if i < len(self.to_str):
+                table[b] = ord(self.to_str[i])
+            else:
+                delete[b] = True
+        mapped = jnp.asarray(table)[c.data.astype(jnp.int32)]
+        in_str = jnp.arange(c.data.shape[1])[None, :] < c.lengths[:, None]
+        keep = in_str & ~jnp.asarray(delete)[c.data.astype(jnp.int32)]
+        out, lengths = _compact_bytes(mapped, keep)
+        return _string_column(out, lengths, c.validity, c.dtype.max_len)
+
+
+@dataclass(frozen=True, eq=False)
+class InitCap(Expression):
+    """initcap(str): first letter of each whitespace-separated word upper,
+    the rest lower. ASCII case mapping (the Upper/Lower policy)."""
+
+    child: Expression = None
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return InitCap(c[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        d = c.data
+        is_up = (d >= ord("A")) & (d <= ord("Z"))
+        lowered = jnp.where(is_up, d + 32, d)
+        # word start = position 0 or previous byte is a space
+        prev_space = jnp.concatenate(
+            [jnp.ones((d.shape[0], 1), bool),
+             d[:, :-1] == ord(" ")], axis=1)
+        is_lo = (lowered >= ord("a")) & (lowered <= ord("z"))
+        out = jnp.where(prev_space & is_lo, lowered - 32, lowered)
+        return DeviceColumn(out, c.validity, c.lengths, c.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class FormatNumber(Expression):
+    """format_number(x, d): fixed decimals + thousands separators.
+    Digit extraction is pure integer math on the device: round to 10^d,
+    emit digits most-significant-first, insert ',' every 3 integer digits.
+    Doubles round HALF_UP on the scaled value like Spark."""
+
+    child: Expression = None
+    decimals: int = 2
+
+    _MAX_DIGITS = 19     # int64 decimal digits
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return FormatNumber(c[0], self.decimals)
+
+    @property
+    def dtype(self):
+        # digits + separators + sign + point + decimals
+        n = self._MAX_DIGITS
+        return T.string(n + (n - 1) // 3 + 2 + max(self.decimals, 0))
+
+    def device_unsupported_reason(self):
+        if self.decimals < 0:
+            return "format_number: negative d"
+        if self.decimals > 9:
+            return "format_number: d > 9 overflows the int64 scaling"
+        from ..types import TypeKind
+        if self.child.resolved and \
+                self.child.dtype.kind in (TypeKind.FLOAT32,
+                                          TypeKind.FLOAT64):
+            return ("format_number over floats: exact HALF_UP on the "
+                    "decimal expansion needs arbitrary precision")
+        return None
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        d = self.decimals
+        kind = self.child.dtype.kind
+        x = c.data
+        from ..types import TypeKind
+        # compute (integer magnitude, fraction value scaled to d digits)
+        # WITHOUT up-scaling the whole value — x * 10**d overflows int64
+        # for large longs
+        if kind is TypeKind.DECIMAL:
+            scale = self.child.dtype.scale
+            v = x.astype(jnp.int64)
+            if scale > d:
+                # rescale to d decimals, HALF_EVEN (DecimalFormat default);
+                # floor division toward -inf keeps r in [0, div)
+                div = 10 ** (scale - d)
+                q = v // div
+                r = v - q * div
+                up = (2 * r > div) | ((2 * r == div) & (q % 2 != 0))
+                v = q + up.astype(jnp.int64)
+                mag = jnp.abs(v)
+                int_mag = mag // (10 ** d)
+                frac_val = mag % (10 ** d) if d else jnp.zeros_like(mag)
+            else:
+                mag = jnp.abs(v)
+                int_mag = mag // (10 ** scale) if scale else mag
+                frac_val = (mag % (10 ** scale)) * (10 ** (d - scale)) \
+                    if scale else jnp.zeros_like(mag)
+        else:   # integral kinds: fraction digits are exactly zero
+            int_mag = jnp.abs(x.astype(jnp.int64))
+            frac_val = jnp.zeros_like(int_mag)
+
+        neg = x < 0      # original sign: -0.004 formats as "-0.00" (Java)
+        # integer digits, most significant first, over the fixed budget.
+        # uint64 digit math: |INT64_MIN| only exists unsigned
+        nd = self._MAX_DIGITS
+        powers = jnp.asarray([10 ** i for i in range(nd - 1, -1, -1)],
+                             jnp.uint64)
+        int_digits_mat = ((int_mag.astype(jnp.uint64)[:, None] //
+                           powers[None, :]) % 10).astype(jnp.int64)
+        n_int = jnp.maximum(
+            nd - jnp.argmax(int_digits_mat > 0, axis=1)
+            - (jnp.max(int_digits_mat, axis=1) == 0) * (nd - 1),
+            1)
+        # build output right-to-left into a fixed buffer
+        out_ml = self.dtype.max_len
+        n = x.shape[0]
+        buf = jnp.zeros((n, out_ml), jnp.uint8)
+        # layout: [sign][int digits with commas][.][frac digits]
+        n_commas = (n_int - 1) // 3
+        total = neg.astype(jnp.int32) + n_int + n_commas + \
+            (1 + d if d > 0 else 0)
+        # position helpers: write each character class via scatter
+        r_idx = jnp.arange(n)[:, None]
+        # fraction digits: positions total-d .. total-1
+        if d > 0:
+            fpowers = jnp.asarray([10 ** i for i in range(d - 1, -1, -1)],
+                                  jnp.int64)
+            frac = (frac_val[:, None] // fpowers[None, :]) % 10
+            fpos = (total - d)[:, None] + jnp.arange(d)[None, :]
+            buf = buf.at[r_idx, fpos].set(
+                (frac + ord("0")).astype(jnp.uint8), mode="drop")
+            dot = (total - d - 1)[:, None]
+            buf = buf.at[r_idx, dot].set(jnp.uint8(ord(".")), mode="drop")
+        # integer digits with commas, right to left
+        int_end = total - (1 + d if d > 0 else 0)   # one past last int char
+        for k in range(nd):
+            # k-th integer digit from the right
+            dig = int_digits_mat[:, nd - 1 - k]
+            # its output position: k digits + commas passed so far
+            pos = int_end - 1 - k - (k // 3) - \
+                jnp.zeros_like(int_end)
+            write = k < n_int
+            buf = buf.at[r_idx, jnp.where(write, pos, out_ml)[:, None]].set(
+                (dig + ord("0")).astype(jnp.uint8)[:, None], mode="drop")
+            if (k + 1) % 3 == 0:
+                cpos = pos - 1
+                cwrite = (k + 1) < n_int
+                buf = buf.at[r_idx,
+                             jnp.where(cwrite, cpos, out_ml)[:, None]].set(
+                    jnp.uint8(ord(",")), mode="drop")
+        sign_pos = jnp.where(neg, 0, out_ml)
+        buf = buf.at[r_idx, sign_pos[:, None]].set(jnp.uint8(ord("-")),
+                                                   mode="drop")
+        return _string_column(buf, total, c.validity, out_ml)

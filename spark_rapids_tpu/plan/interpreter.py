@@ -600,6 +600,113 @@ class RowEvaluator:
         dts = [c.dtype for c in e.exprs]
         return spark_hash_row(vals, dts, e.seed)
 
+    def _eval_Translate(self, e, row):
+        s = self.eval(e.child, row)
+        if s is None:
+            return None
+        mapping = {}
+        for i, ch in enumerate(e.from_str):
+            if ch in mapping:
+                continue        # first occurrence wins (Spark)
+            mapping[ch] = e.to_str[i] if i < len(e.to_str) else None
+        return "".join(mapping.get(ch, ch) for ch in s
+                       if mapping.get(ch, ch) is not None)
+
+    def _eval_InitCap(self, e, row):
+        s = self.eval(e.child, row)
+        if s is None:
+            return None
+        out, prev_space = [], True
+        for ch in s:
+            out.append(ch.upper() if prev_space else ch.lower())
+            prev_space = ch == " "
+        return "".join(out)
+
+    def _eval_FormatNumber(self, e, row):
+        import decimal as pydec
+        v = self.eval(e.child, row)
+        if v is None:
+            return None
+        d = e.decimals
+        if d < 0:
+            return None
+        dec = v if isinstance(v, pydec.Decimal) else \
+            pydec.Decimal(repr(v)) if isinstance(v, float) else \
+            pydec.Decimal(int(v))
+        q = dec.quantize(pydec.Decimal(1).scaleb(-d),
+                         rounding=pydec.ROUND_HALF_EVEN)
+        return f"{q:,.{d}f}"
+
+    def _eval_RegexpExtract(self, e, row):
+        import re
+        s = self.eval(e.child, row)
+        if s is None:
+            return None
+        m = re.search(e.pattern, s)
+        if m is None:
+            return ""
+        g = m.group(e.idx)
+        return g if g is not None else ""
+
+    def _eval_RegexpReplace(self, e, row):
+        import re
+        s = self.eval(e.child, row)
+        if s is None:
+            return None
+
+        def expand(m):
+            # Java appendReplacement: $N group refs (longest valid group
+            # number wins), backslash escapes the next char, null → ""
+            out, i, r = [], 0, e.replacement
+            while i < len(r):
+                ch = r[i]
+                if ch == "\\" and i + 1 < len(r):
+                    out.append(r[i + 1])
+                    i += 2
+                    continue
+                if ch == "$" and i + 1 < len(r) and r[i + 1].isdigit():
+                    j, num, best, bj = i + 1, 0, None, i + 1
+                    while j < len(r) and r[j].isdigit():
+                        num = num * 10 + int(r[j])
+                        j += 1
+                        if num <= m.re.groups:
+                            best, bj = num, j
+                    if best is None:
+                        raise IndexError(
+                            f"No group {num} in replacement")
+                    out.append(m.group(best) or "")
+                    i = bj
+                    continue
+                out.append(ch)
+                i += 1
+            return "".join(out)
+
+        return re.sub(e.pattern, expand, s)
+
+    def _eval_StringSplit(self, e, row):
+        import re
+        s = self.eval(e.child, row)
+        if s is None:
+            return None
+        # Java Pattern.split semantics (Spark's contract): a zero-width
+        # match AT THE START is skipped; limit>0 caps pieces; limit==0
+        # drops trailing empty strings
+        pieces, index, count = [], 0, 0
+        for m in re.finditer(e.pattern, s):
+            if e.limit > 0 and count >= e.limit - 1:
+                break
+            a, b = m.span()
+            if a == b and a == 0 and index == 0:
+                continue
+            pieces.append(s[index:a])
+            index = b
+            count += 1
+        pieces.append(s[index:])
+        if e.limit == 0:
+            while pieces and pieces[-1] == "":
+                pieces.pop()
+        return pieces
+
     # ---- collections (arrays as python lists) ----
     def _eval_CreateArray(self, e, row):
         return [self.eval(c, row) for c in e.elems]

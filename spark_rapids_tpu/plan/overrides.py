@@ -98,7 +98,8 @@ def _expr_rules() -> Dict[str, ExprRule]:
     # strings
     for n in ("Length", "Upper", "Lower", "Substring", "Concat",
               "StringPredicate", "StringLocate", "StringTrim", "StringPad",
-              "StringRepeat", "StringReplace"):
+              "StringRepeat", "StringReplace", "Translate", "InitCap",
+              "FormatNumber"):
         r(n, TS.ALL_BASIC)
     # datetime
     for n in ("ExtractDatePart", "DateAddSub", "DateDiff", "AddMonths",
@@ -108,6 +109,10 @@ def _expr_rules() -> Dict[str, ExprRule]:
     r("RLike", TS.ALL_BASIC,
       note="DFA subset; unsupported constructs raise at plan build")
     r("Like", TS.ALL_BASIC)
+    # span-program regex (segment decomposition; unsupported patterns tag
+    # CPU fallback via device_unsupported_reason)
+    for n in ("RegexpExtract", "RegexpReplace", "StringSplit"):
+        r(n, TS.ALL_BASIC + TS.ARRAY)
     # window
     for n in ("WindowExpression", "RowNumber", "Rank", "NTile", "LagLead",
               "WindowAgg"):
@@ -249,12 +254,18 @@ class PlanMeta:
                 if g.dtype.kind not in (TypeKind.ARRAY, TypeKind.MAP):
                     self.will_not_work(
                         f"generator over {g.dtype} is not an array/map")
-                elif any(c.kind in (TypeKind.STRING, TypeKind.ARRAY,
-                                    TypeKind.STRUCT, TypeKind.MAP)
-                         for c in g.dtype.children):
-                    self.will_not_work(
-                        f"explode of {g.dtype} needs variable-width "
-                        f"elements; device layout is fixed-width scalars")
+                else:
+                    nested = (TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP)
+                    bad = any(c.kind in nested for c in g.dtype.children)
+                    # map entries must be scalars; array elements may also
+                    # be strings (3D byte tensor layout)
+                    if g.dtype.kind is TypeKind.MAP:
+                        bad = bad or any(c.kind is TypeKind.STRING
+                                         for c in g.dtype.children)
+                    if bad:
+                        self.will_not_work(
+                            f"explode of {g.dtype}: no device layout for "
+                            f"its element type")
             except Exception as ex:
                 self.will_not_work(f"generator does not bind: {ex}")
         if isinstance(n, L.LogicalWindow):

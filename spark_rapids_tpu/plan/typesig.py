@@ -39,14 +39,18 @@ class TypeSig:
         if t.kind is TypeKind.STRING and t.max_len > self.max_string_bytes:
             return (f"string max_len {t.max_len} exceeds device budget "
                     f"{self.max_string_bytes}")
-        if t.kind in (TypeKind.ARRAY, TypeKind.MAP):
-            # device arrays/maps are fixed-budget matrices of fixed-width
-            # scalars; variable-width or nested elements have no layout
+        if t.kind is TypeKind.ARRAY:
+            # scalar elements → 2D matrix; string elements → 3D byte
+            # tensor (split()'s layout); nested elements have no layout
+            c = t.children[0]
+            if c.kind in (TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP):
+                return (f"{t} nested elements have no device layout")
+        if t.kind is TypeKind.MAP:
             for c in t.children:
                 if c.kind in (TypeKind.STRING, TypeKind.ARRAY,
                               TypeKind.STRUCT, TypeKind.MAP):
-                    return (f"{t} needs variable-width elements; the "
-                            f"device layout is fixed-width scalars")
+                    return (f"{t} needs variable-width entries; the "
+                            f"device map layout is fixed-width scalars")
         for c in t.children:
             r = self.supports(c)
             if r:

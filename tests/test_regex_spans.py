@@ -1,0 +1,188 @@
+"""regexp_extract / regexp_replace / split / translate / initcap /
+format_number — differential vs the CPU interpreter (which uses Python
+`re`; within the supported subset Python and Java regex agree).
+
+Reference coverage: string_test.py + regexp_test.py in integration_tests.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.regex import (RegexpExtract, RegexpReplace,
+                                                StringSplit)
+from spark_rapids_tpu.expressions.strings import (FormatNumber, InitCap,
+                                                  Translate)
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                             assert_tpu_fallback_collect)
+
+STRS = ["abc123def45", "", "no digits", "7", "a1b2c3", "x-42-y-7",
+        "user@example.com", "  padded  ", "1,234.5", "aab", "ab-12",
+        "UPPER lower MiXeD", "one two  three", "tab\tsep", "0.5",
+        "12345.6789", "-42", None, "end9"]
+
+
+def str_table():
+    return pa.table({"s": pa.array(STRS, pa.string()),
+                     "x": pa.array(
+                         [None if s is None else len(s) * 7 - 20
+                          for s in STRS], pa.int64()),
+                     "dec": pa.array(
+                         [None if s is None else
+                          __import__("decimal").Decimal(len(s) * 997)
+                          .scaleb(-2) for s in STRS],
+                         pa.decimal128(12, 2))})
+
+
+def test_regexp_extract_groups():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table()).select(
+            RegexpExtract(col("s"), r"([0-9]+)", 1).alias("num"),
+            RegexpExtract(col("s"), r"([a-z]+)([0-9]*)", 2).alias("tail"),
+            RegexpExtract(col("s"), r"(\w+)@(\w+)", 2).alias("host"),
+            RegexpExtract(col("s"), r"[a-z]+", 0).alias("whole")))
+
+
+def test_regexp_extract_runs_on_tpu():
+    s = Session()
+    s.collect(table(str_table()).select(
+        RegexpExtract(col("s"), r"([0-9]+)", 1).alias("n")))
+    assert not s.fell_back()
+
+
+def test_regexp_extract_unsupported_falls_back():
+    assert_tpu_fallback_collect(
+        lambda: table(str_table()).select(
+            RegexpExtract(col("s"), r"(a|bb)x?", 1).alias("n")),
+        "Project")
+
+
+def test_regexp_replace():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table()).select(
+            RegexpReplace(col("s"), r"[0-9]+", "#").alias("r1"),
+            RegexpReplace(col("s"), r"\s+", "_").alias("r2"),
+            RegexpReplace(col("s"), r"[aeiou]", "").alias("r3")))
+
+
+def test_regexp_replace_empty_matches():
+    # zero-width matches insert at every position (Java replaceAll)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(pa.table({"s": pa.array(["bc", "", "b"])})).select(
+            RegexpReplace(col("s"), r"a*", "X").alias("r")))
+
+
+def test_split():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table()).select(
+            StringSplit(col("s"), r"-").alias("parts"),
+            StringSplit(col("s"), r"[0-9]+").alias("by_num"),
+            StringSplit(col("s"), r" +", limit=2).alias("two")))
+
+
+def test_split_explode_roundtrip():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table())
+        .select(col("s"), StringSplit(col("s"), r"[-@ ]").alias("p"))
+        .explode("p", alias="piece"))
+
+
+def test_split_element_at():
+    from spark_rapids_tpu.expressions.collections import GetArrayItem, Size
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table()).select(
+            GetArrayItem(StringSplit(col("s"), r"-"), lit(0)).alias("first"),
+            Size(StringSplit(col("s"), r"-")).alias("n")))
+
+
+def test_translate():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table()).select(
+            Translate(col("s"), "abc-", "xyz").alias("t"),
+            Translate(col("s"), "0123456789", "##########").alias("masked")))
+
+
+def test_initcap():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table()).select(InitCap(col("s")).alias("ic")))
+
+
+def test_format_number_long():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table()).select(
+            FormatNumber(col("x") * lit(np.int64(98765)), 2).alias("f2"),
+            FormatNumber(col("x"), 0).alias("f0")))
+
+
+def test_format_number_decimal():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(str_table()).select(
+            FormatNumber(col("dec"), 1).alias("d1"),
+            FormatNumber(col("dec"), 4).alias("d4")))
+
+
+def test_format_number_double_falls_back():
+    assert_tpu_fallback_collect(
+        lambda: table(pa.table({"f": pa.array([1.25, -0.004, 1e8])})).select(
+            FormatNumber(col("f"), 2).alias("ff")),
+        "Project")
+
+
+# ---------------------------------------------------------------------------
+# review-finding regressions
+# ---------------------------------------------------------------------------
+
+def test_quantified_capture_group_falls_back():
+    """Java binds (ab)+ group 1 to the LAST iteration; the span engine
+    cannot reproduce that → CPU fallback, which must agree with Java."""
+    assert_tpu_fallback_collect(
+        lambda: table(pa.table({"s": pa.array(["ababab", "xx", "ab"])}))
+        .select(RegexpExtract(col("s"), r"(ab)+", 1).alias("g")),
+        "Project")
+
+
+def test_replace_backref_falls_back_and_expands():
+    df = lambda: table(pa.table({"s": pa.array(["ab", "xy ab"])})).select(
+        RegexpReplace(col("s"), r"(a)(b)", "$2$1").alias("r"))
+    assert_tpu_fallback_collect(df, "Project")
+    out = Session().collect(df())
+    assert out.column("r").to_pylist() == ["ba", "xy ba"]
+
+
+def test_cpu_split_zero_width():
+    """Java Pattern.split: 'abc'.split('x*') → pieces per char."""
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False})
+    out = cpu.collect(table(pa.table({"s": pa.array(["abc", ""])})).select(
+        StringSplit(col("s"), r"x*").alias("p"),
+        StringSplit(col("s"), r"x*", limit=0).alias("p0")))
+    assert out.column("p").to_pylist() == [["a", "b", "c", ""], [""]]
+    assert out.column("p0").to_pylist() == [["a", "b", "c"], []]
+
+
+def test_format_number_huge_long():
+    big = 9_100_000_000_000_000_000
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(pa.table({"v": pa.array([big, -big, 2**63 - 1,
+                                               -(2**63)], pa.int64())}))
+        .select(FormatNumber(col("v"), 2).alias("f")))
+
+
+def test_array_decimal_roundtrip():
+    import decimal as pydec
+    from spark_rapids_tpu.batch import from_arrow as f2a, to_arrow as t2a
+    vals = [[pydec.Decimal("1.23"), pydec.Decimal("-4.50")], [], None]
+    t = pa.table({"a": pa.array(vals, pa.list_(pa.decimal128(5, 2)))})
+    b, sch = f2a(t)
+    assert t2a(b, sch).column("a").to_pylist() == vals
+
+
+def test_split_overflow_raises():
+    from spark_rapids_tpu.batch import CapacityError
+    s = Session()
+    df = table(pa.table({"s": pa.array(["a,b,c,d,e", "x"])})).select(
+        StringSplit(col("s"), r",", max_elems=3).alias("p"))
+    with pytest.raises(CapacityError, match="split_max_elems"):
+        s.collect(df)
