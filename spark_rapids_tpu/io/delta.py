@@ -258,6 +258,23 @@ class DeltaTable:
             self._commit(snap.version + 1, actions, "UPDATE")
         return updated
 
+    def merge(self, source: pa.Table,
+              on: "Tuple[List[str], List[str]]",
+              matched: "List[MergeClause]" = (),
+              not_matched: "List[MergeClause]" = (),
+              not_matched_by_source: "List[MergeClause]" = (),
+              session=None) -> Dict[str, int]:
+        """MERGE INTO this table USING ``source`` ON equi-keys
+        (reference: GpuMergeIntoCommand.scala — touched-file detection,
+        cardinality check, per-file copy-on-write rewrite). ``on`` is
+        (target_key_names, source_key_names). Clause helpers:
+        when_matched_update / when_matched_delete /
+        when_not_matched_insert; clause expressions reference target
+        columns by name and source columns via ``src_col``."""
+        return _merge_impl(self, source, on, list(matched),
+                           list(not_matched), list(not_matched_by_source),
+                           session)
+
     def history(self) -> List[Dict[str, Any]]:
         out = []
         for v in range(self.latest_version() + 1):
@@ -285,3 +302,226 @@ def _json_safe(v):
                                                  float("-inf"))):
         return str(v)
     return v
+
+
+# ---------------------------------------------------------------------------
+# MERGE INTO
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MergeClause:
+    """One WHEN clause. ``assignments=None`` on update/insert means the
+    Spark ``*`` shorthand (SET/INSERT every column from the same-named
+    source column). In clause conditions/assignments, reference target
+    columns by name and source columns via ``src_col("name")``."""
+
+    kind: str                                       # update | delete | insert
+    condition: Optional[Expression] = None
+    assignments: Optional[Dict[str, Expression]] = None
+
+
+def src_col(name: str) -> Expression:
+    """Reference a SOURCE column inside a merge clause expression."""
+    from ..expressions.base import col
+    return col(_SRC_PREFIX + name)
+
+
+_SRC_PREFIX = "__src__"
+
+
+class MergeCardinalityError(ValueError):
+    """A target row matched multiple source rows while update/delete
+    clauses exist (Delta's deterministic-merge requirement)."""
+
+
+def when_matched_update(assignments=None, condition=None) -> MergeClause:
+    return MergeClause("update", condition, assignments)
+
+
+def when_matched_delete(condition=None) -> MergeClause:
+    return MergeClause("delete", condition, None)
+
+
+def when_not_matched_insert(assignments=None, condition=None) -> MergeClause:
+    return MergeClause("insert", condition, assignments)
+
+
+def _merge_impl(table_obj: "DeltaTable", source: pa.Table,
+                on: "Tuple[List[str], List[str]]",
+                matched: "List[MergeClause]",
+                not_matched: "List[MergeClause]",
+                not_matched_by_source: "List[MergeClause]",
+                session) -> Dict[str, int]:
+    """Copy-on-write MERGE (reference: GpuMergeIntoCommand.scala — there a
+    two-pass touched-file detection + per-file rewrite; same shape here,
+    with the join/clause evaluation running through the engine planner).
+    """
+    from ..expressions.base import col, lit
+    from ..expressions.comparison import IsNotNull, Not
+    from ..expressions.boolean import And
+    from ..expressions.conditional import Coalesce, If
+    from ..exec.join import JoinType
+    from ..plan import Session, table as df_table
+
+    ses = session or Session()
+    tgt_keys, source_keys = on
+    snap = table_obj.snapshot()
+
+    # source with prefixed columns (so clause expressions can address both
+    # sides without ambiguity)
+    src = source.rename_columns([_SRC_PREFIX + c
+                                 for c in source.column_names])
+    src_keys = [_SRC_PREFIX + k for k in source_keys]
+
+    has_update_delete = bool(matched) or bool(not_matched_by_source)
+    tgt_names: Optional[List[str]] = None
+
+    # ---- pass 1: touched files + cardinality check. Reads KEY COLUMNS
+    # only, once — the key tables are reused for the insert anti-join;
+    # insert-only merges skip the per-file join entirely
+    touched: List[str] = []
+    key_tables: List[pa.Table] = []
+    import numpy as np
+    if snap.files:
+        tgt_names = pq.read_schema(snap.files[0]).names
+    for f in snap.files:
+        if not (has_update_delete or not_matched):
+            break
+        keys_t = pq.read_table(f, columns=tgt_keys)
+        if not_matched:
+            key_tables.append(keys_t)
+        if not has_update_delete:
+            continue    # insert-only merges never rewrite target files
+        pairs = ses.collect(
+            df_table(keys_t.append_column(
+                "__pos", pa.array(np.arange(keys_t.num_rows,
+                                            dtype=np.int64))))
+            .join(df_table(src.select(src_keys)),
+                  tgt_keys, src_keys, JoinType.INNER))
+        if pairs.num_rows:
+            touched.append(f)
+            pos = pairs.column("__pos").to_pylist()
+            if len(set(pos)) != len(pos):
+                raise MergeCardinalityError(
+                    "a target row matched multiple source rows; MERGE "
+                    "with update/delete clauses requires a unique match")
+
+    actions: List[Dict[str, Any]] = []
+    stats = {"updated": 0, "deleted": 0, "inserted": 0}
+    if tgt_names is None:
+        tgt_names = [c for c in source.column_names]
+
+    def matched_flag():
+        # after the left join, a non-null source key marks a match
+        m = IsNotNull(col(src_keys[0]))
+        for k in src_keys[1:]:
+            m = And(m, IsNotNull(col(k)))
+        return m
+
+    def apply_clauses(is_matched_expr, clauses, star_from_source: bool):
+        """Build (keep_cond, per-column value exprs, updated_cond) over the
+        joined frame for one clause family. First-true-wins: fold REVERSED
+        so earlier clauses override later ones in the nested Ifs. All
+        conditions are null-safe (null → clause does not fire)."""
+        keep = lit(True)
+        updated = lit(False)
+        values = {c: col(c) for c in tgt_names}
+        for cl in reversed(clauses):
+            cond = is_matched_expr
+            if cl.condition is not None:
+                cond = And(cond, cl.condition)
+            cond = Coalesce((cond, lit(False)))
+            if cl.kind == "delete":
+                keep = If(cond, lit(False), keep)
+                updated = If(cond, lit(False), updated)
+            elif cl.kind == "update":
+                if cl.assignments is not None:
+                    assigns = cl.assignments
+                elif star_from_source:      # UPDATE SET * shorthand
+                    assigns = {c: src_col(c) for c in tgt_names}
+                else:
+                    assigns = {}
+                for c in tgt_names:
+                    if c in assigns:
+                        values[c] = If(cond, assigns[c], values[c])
+                keep = If(cond, lit(True), keep)
+                updated = If(cond, lit(True), updated)
+        return keep, values, updated
+
+    # ---- pass 2: rewrite touched files
+    needs_rewrite = bool(matched) or bool(not_matched_by_source)
+    if needs_rewrite:
+        rewrite_files = touched if not not_matched_by_source else \
+            list(snap.files)
+        for f in rewrite_files:
+            t = pq.read_table(f)
+            joined_df = df_table(t).join(df_table(src), tgt_keys, src_keys,
+                                         JoinType.LEFT_OUTER)
+            m = matched_flag()
+            keep, values, upd = apply_clauses(m, matched, True)
+            if not_matched_by_source:
+                nm = Coalesce((Not(m), lit(True)))
+                keep2, values2, upd2 = apply_clauses(
+                    nm, not_matched_by_source, False)
+                # compose: matched rows take the matched family, others nmbs
+                for c in tgt_names:
+                    values[c] = If(m, values[c], values2[c])
+                keep = If(m, keep, keep2)
+                upd = If(m, upd, upd2)
+            # ONE pass: the update flag rides along as an extra column and
+            # is counted host-side (re-collecting the join would double the
+            # most expensive work of the merge)
+            out = ses.collect(
+                joined_df.where(keep)
+                .select(*([values[c].alias(c) for c in tgt_names] +
+                          [Coalesce((upd, lit(False))).alias("__upd")])))
+            stats["updated"] += sum(
+                1 for u in out.column("__upd").to_pylist() if u)
+            out = out.drop_columns(["__upd"])
+            before = t.num_rows
+            # row accounting: deletes shrink, updates keep count
+            stats["deleted"] += max(0, before - out.num_rows)
+            actions.append({"remove": {
+                "path": os.path.relpath(f, table_obj.path),
+                "dataChange": True}})
+            if out.num_rows:
+                actions.append(table_obj._write_data_file(
+                    out.cast(t.schema)))
+
+    # ---- inserts: source rows matched by NO target row (global anti join)
+    if not_matched:
+        whole = pa.concat_tables(key_tables) if key_tables else None
+        if whole is None:
+            unmatched = src
+        else:
+            unmatched = ses.collect(
+                df_table(src).join(df_table(whole), src_keys, tgt_keys,
+                                   JoinType.LEFT_ANTI))
+        if unmatched.num_rows:
+            udf = df_table(unmatched)
+            keep = lit(False)
+            values = {}
+            for cl in reversed(not_matched):
+                cond = lit(True) if cl.condition is None else cl.condition
+                assigns = cl.assignments or \
+                    {c: src_col(c) for c in tgt_names}
+                for c in tgt_names:
+                    if c not in values:
+                        values[c] = lit(None)
+                    if c in assigns:
+                        values[c] = If(cond, assigns[c], values[c])
+                keep = If(cond, lit(True), keep)
+            ins = ses.collect(udf.where(keep).select(
+                *[values[c].alias(c) for c in tgt_names]))
+            if ins.num_rows:
+                # align insert dtypes with the target schema
+                tgt_schema = pq.read_schema(snap.files[0]) \
+                    if snap.files else None
+                if tgt_schema is not None:
+                    ins = ins.cast(tgt_schema)
+                stats["inserted"] = ins.num_rows
+                actions.append(table_obj._write_data_file(ins))
+
+    if actions:
+        table_obj._commit(snap.version + 1, actions, "MERGE")
+    return stats
