@@ -191,6 +191,13 @@ def make_column(values: np.ndarray, validity: np.ndarray, dtype: SqlType,
             p2 = jnp.asarray(p2)
         return DeviceColumn(jnp.asarray(padded), jnp.asarray(val),
                             jnp.asarray(plen), dtype, p2)
+    if values.ndim > 1:     # decimal128 limb matrices
+        padded = np.zeros((capacity,) + values.shape[1:], dtype=values.dtype)
+        padded[:n] = values
+        val = np.zeros(capacity, dtype=bool)
+        val[:n] = validity
+        return DeviceColumn(jnp.asarray(padded), jnp.asarray(val), None,
+                            dtype)
     padded = np.zeros(capacity, dtype=T.numpy_dtype(dtype))
     padded[:n] = values
     val = np.zeros(capacity, dtype=bool)
@@ -268,12 +275,18 @@ def _scalar_storage(arr: pa.Array, dtype: SqlType,
     array/map ELEMENT buffers so nested data gets identical encoding."""
     n = len(arr)
     if dtype.kind is TypeKind.DECIMAL:
+        import decimal as pydec
+        # the default decimal context (28 digits) ROUNDS scaleb on wide
+        # values — widen it for the exact unscaled-int conversion
+        with pydec.localcontext() as lctx:
+            lctx.prec = 60
+            ints = [int(v.scaleb(dtype.scale)) if v is not None else 0
+                    for v in arr.to_pylist()]
         if dtype.precision > 18:
-            raise TypeError(
-                f"decimal({dtype.precision},{dtype.scale}) exceeds DECIMAL64 "
-                f"device storage; the planner must fall back to CPU")
-        return np.array([int(v.scaleb(dtype.scale)) if v is not None else 0
-                         for v in arr.to_pylist()], dtype=np.int64)
+            # DECIMAL128: 4×32-bit limbs in int64 lanes (decimal128.py)
+            from .expressions.decimal128 import to_limbs_np
+            return to_limbs_np(ints)
+        return np.array(ints, dtype=np.int64)
     if dtype.kind is TypeKind.TIMESTAMP:
         np_vals = np.zeros(n, dtype=np.int64)
         tmp = arr.cast(pa.timestamp("us")).to_numpy(zero_copy_only=False)
@@ -528,8 +541,18 @@ def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
         data = np.asarray(col.data[:n])
         if f.dtype.kind is TypeKind.DECIMAL:
             import decimal as pydec
-            vals = [pydec.Decimal(int(v)).scaleb(-f.dtype.scale)
-                    if ok else None for v, ok in zip(data, validity)]
+            with pydec.localcontext() as lctx:
+                lctx.prec = 60       # exact: default context rounds at 28
+                if f.dtype.precision > 18:
+                    from .expressions.decimal128 import from_limbs_np
+                    ints = from_limbs_np(data)
+                    vals = [pydec.Decimal(v).scaleb(-f.dtype.scale)
+                            if ok else None
+                            for v, ok in zip(ints, validity)]
+                else:
+                    vals = [pydec.Decimal(int(v)).scaleb(-f.dtype.scale)
+                            if ok else None
+                            for v, ok in zip(data, validity)]
             arrays.append(pa.array(vals, type=T.to_arrow(f.dtype)))
             continue
         if f.dtype.kind is TypeKind.TIMESTAMP:

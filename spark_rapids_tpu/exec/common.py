@@ -168,6 +168,9 @@ def orderable_words(col: DeviceColumn) -> List[jax.Array]:
             words.append(word)
         return words
     data = col.data
+    if k is TypeKind.DECIMAL and d.precision > 18:
+        from ..expressions.decimal128 import orderable_words128
+        return orderable_words128(data)
     if k is TypeKind.BOOLEAN:
         return [data.astype(jnp.uint8)]
     if k in (TypeKind.FLOAT32,):
@@ -225,6 +228,8 @@ def adjacent_equal(cols: Sequence[DeviceColumn]) -> jax.Array:
         if c.lengths is not None:
             same = jnp.all(c.data[1:] == c.data[:-1], axis=1) & \
                 (c.lengths[1:] == c.lengths[:-1])
+        elif c.data.ndim > 1:   # decimal128 limb matrices
+            same = jnp.all(c.data[1:] == c.data[:-1], axis=1)
         else:
             same = c.data[1:] == c.data[:-1]
         vsame = c.validity[1:] == c.validity[:-1]

@@ -107,11 +107,35 @@ class Sum(AggregateFunction):
             return T.decimal(min(d.precision + 10, 38), d.scale)
         return T.INT64
 
+    @property
+    def _is_dec128(self):
+        return self.dtype.kind is TypeKind.DECIMAL and \
+            self.dtype.precision > 18
+
     def buffer_types(self):
+        if self._is_dec128:
+            # running limb sum, non-null count, overflow flag (Spark nulls
+            # an overflowing decimal sum in non-ANSI mode)
+            return [self.dtype, T.INT64, T.BOOLEAN]
         return [self.dtype, T.INT64]   # running sum, non-null count
 
     def update(self, inputs, seg, live, cap):
         col = inputs[0]
+        if self._is_dec128:
+            from .decimal128 import exceeds_digits, lift64, seg_sum128
+            data = col.data if col.data.ndim > 1 else lift64(col.data)
+            ok = col.validity & live
+            s, ovf = seg_sum128(data, ok, seg, cap)
+            if col.data.ndim == 1:
+                # dec64 inputs widened to limbs: ≤ 2^31 rows × 10^18 stays
+                # far below 2^127, overflow is impossible
+                ovf = jnp.zeros(cap, bool)
+            # Spark's precision cap nulls before the 128-bit range does
+            ovf = ovf | exceeds_digits(s, self.dtype.precision)
+            n = _seg_sum(ok.astype(jnp.int64), seg, cap)
+            return [DeviceColumn(s, n > 0, None, self.dtype),
+                    DeviceColumn(n, jnp.ones(cap, bool), None, T.INT64),
+                    DeviceColumn(ovf, jnp.ones(cap, bool), None, T.BOOLEAN)]
         acc_dtype = self.dtype.storage_dtype
         x, ok = _masked(col, live, jnp.zeros((), col.data.dtype))
         s = _seg_sum(x.astype(acc_dtype), seg, cap)
@@ -120,6 +144,17 @@ class Sum(AggregateFunction):
                 DeviceColumn(n, jnp.ones(cap, bool), None, T.INT64)]
 
     def merge(self, buffers, seg, live, cap):
+        if self._is_dec128:
+            from .decimal128 import exceeds_digits, seg_sum128
+            ok = buffers[0].validity & live
+            ms, movf = seg_sum128(buffers[0].data, ok, seg, cap)
+            mn = _seg_sum(jnp.where(live, buffers[1].data, 0), seg, cap)
+            ovf = movf | exceeds_digits(ms, self.dtype.precision) | \
+                (_seg_sum((live & buffers[2].data)
+                          .astype(jnp.int32), seg, cap) > 0)
+            return [DeviceColumn(ms, mn > 0, None, self.dtype),
+                    DeviceColumn(mn, jnp.ones(cap, bool), None, T.INT64),
+                    DeviceColumn(ovf, jnp.ones(cap, bool), None, T.BOOLEAN)]
         s, ok = _masked(buffers[0], live, jnp.zeros((), buffers[0].data.dtype))
         n = jnp.where(live, buffers[1].data, 0)
         ms = _seg_sum(s, seg, cap)
@@ -128,8 +163,10 @@ class Sum(AggregateFunction):
                 DeviceColumn(mn, jnp.ones(cap, bool), None, T.INT64)]
 
     def evaluate(self, buffers, group_live):
-        return DeviceColumn(buffers[0].data,
-                            buffers[0].validity & group_live, None, self.dtype)
+        valid = buffers[0].validity & group_live
+        if self._is_dec128:
+            valid = valid & ~buffers[2].data
+        return DeviceColumn(buffers[0].data, valid, None, self.dtype)
 
 
 class Count(AggregateFunction):
@@ -187,6 +224,13 @@ class _MinMax(AggregateFunction):
         col = inputs[0]
         if col.lengths is not None:
             return self._update_string(col, seg, live, cap)
+        if col.data.ndim > 1:     # decimal128 limbs
+            from .decimal128 import seg_minmax128
+            ok = col.validity & live
+            m = seg_minmax128(col.data, ok, seg, cap, self._is_min)
+            valid = _seg_sum(ok.astype(jnp.int32), seg, cap) > 0
+            return [DeviceColumn(jnp.where(valid[:, None], m, 0), valid,
+                                 None, self.dtype)]
         x, ok = _masked(col, live, self._fill(col.data.dtype))
         if col.data.dtype == jnp.bool_:
             x = x.astype(jnp.uint8)
@@ -517,7 +561,9 @@ class First(AggregateFunction):
         data = jnp.take(col.data, g, axis=0)
         validity = jnp.take(col.validity, g, axis=0) & has
         lengths = jnp.take(col.lengths, g, axis=0) if col.lengths is not None else None
-        return [DeviceColumn(data, validity, lengths, self.dtype),
+        data2 = jnp.take(col.data2, g, axis=0) if col.data2 is not None \
+            else None
+        return [DeviceColumn(data, validity, lengths, self.dtype, data2),
                 DeviceColumn(has, jnp.ones(cap, bool), None, T.BOOLEAN)]
 
     def merge(self, buffers, seg, live, cap):

@@ -296,6 +296,9 @@ class Literal(Expression):
                 return DeviceColumn(jnp.zeros((cap, d.max_len), jnp.uint8),
                                     jnp.zeros(cap, bool),
                                     jnp.zeros(cap, jnp.int32), d)
+            if d.kind is TypeKind.DECIMAL and d.precision > 18:
+                return DeviceColumn(jnp.zeros((cap, 4), jnp.int64),
+                                    jnp.zeros(cap, bool), None, d)
             return DeviceColumn(jnp.zeros(cap, d.storage_dtype),
                                 jnp.zeros(cap, bool), None, d)
         if d.kind is TypeKind.STRING:
@@ -311,7 +314,14 @@ class Literal(Expression):
         v = self.value
         if d.kind is TypeKind.DECIMAL:
             import decimal as pydec
-            v = int(pydec.Decimal(str(v)).scaleb(d.scale))
+            with pydec.localcontext() as lctx:
+                lctx.prec = 60   # exact: default context rounds at 28
+                v = int(pydec.Decimal(str(v)).scaleb(d.scale))
+            if d.precision > 18:
+                from .decimal128 import to_limbs_np
+                limbs = jnp.asarray(to_limbs_np([v])[0])
+                data = jnp.broadcast_to(limbs, (cap, 4))
+                return DeviceColumn(data, batch.row_mask(), None, d)
         data = jnp.full(cap, v, d.storage_dtype)
         return DeviceColumn(data, batch.row_mask(), None, d)
 

@@ -28,6 +28,30 @@ def _compare_data(lc: DeviceColumn, rc: DeviceColumn, op: str):
             return eq
         lt = string_compare_lt(lc, rc)
         return {"lt": lt, "le": lt | eq, "gt": ~(lt | eq), "ge": ~lt}[op]
+    if lc.data.ndim > 1 or rc.data.ndim > 1:    # decimal128 limbs
+        from .decimal128 import compare, lift64, rescale_up
+        ld = lc.data if lc.data.ndim > 1 else lift64(lc.data)
+        rd = rc.data if rc.data.ndim > 1 else lift64(rc.data)
+        # align scales before comparing unscaled values; the planner gates
+        # scale gaps > 9 (decimal_cmp_unsupported_reason)
+        ls, rs = lc.dtype.scale, rc.dtype.scale
+        if ls < rs:
+            ld = rescale_up(ld, 10 ** (rs - ls))
+        elif rs < ls:
+            rd = rescale_up(rd, 10 ** (ls - rs))
+        lt, eq = compare(ld, rd)
+        return {"eq": eq, "lt": lt, "le": lt | eq,
+                "gt": ~(lt | eq), "ge": ~lt}[op]
+    if lc.dtype.kind is TypeKind.DECIMAL and \
+            rc.dtype.kind is TypeKind.DECIMAL and \
+            lc.dtype.scale != rc.dtype.scale:
+        # dec64 pair with different scales: align in int64 (the planner
+        # gates combinations that could overflow)
+        ls, rs = lc.dtype.scale, rc.dtype.scale
+        l = lc.data * (10 ** max(0, rs - ls))
+        r = rc.data * (10 ** max(0, ls - rs))
+        return {"eq": l == r, "lt": l < r, "le": l <= r,
+                "gt": l > r, "ge": l >= r}[op]
     # promote to a common dtype for mixed-width comparisons
     if lc.data.dtype != rc.data.dtype:
         d = jnp.promote_types(lc.data.dtype, rc.data.dtype)
@@ -36,6 +60,28 @@ def _compare_data(lc: DeviceColumn, rc: DeviceColumn, op: str):
         l, r = lc.data, rc.data
     return {"eq": l == r, "lt": l < r, "le": l <= r,
             "gt": l > r, "ge": l >= r}[op]
+
+
+def decimal_cmp_unsupported_reason(lt, rt):
+    """Mismatched-scale decimal comparison needs a device rescale; gate
+    combinations whose rescaled unscaled value could overflow its storage."""
+    if lt.kind is not TypeKind.DECIMAL or rt.kind is not TypeKind.DECIMAL:
+        return None
+    if lt.scale == rt.scale:
+        return None
+    diff = abs(lt.scale - rt.scale)
+    small, big = (lt, rt) if lt.scale < rt.scale else (rt, lt)
+    if small.precision <= 18 and big.precision <= 18:
+        if small.precision + diff > 18:
+            return (f"comparing {small} to {big} rescales past the int64 "
+                    f"unscaled range")
+        return None
+    if diff > 9:
+        return (f"comparing {small} to {big}: scale gap {diff} exceeds the "
+                f"limb rescale budget (10^9)")
+    if small.precision + diff > 38:
+        return f"comparing {small} to {big} rescales past 38 digits"
+    return None
 
 
 @dataclass(frozen=True, eq=False)
@@ -54,6 +100,12 @@ class BinaryComparison(Expression):
     @property
     def dtype(self):
         return T.BOOLEAN
+
+    def device_unsupported_reason(self):
+        if self.left.resolved and self.right.resolved:
+            return decimal_cmp_unsupported_reason(self.left.dtype,
+                                                  self.right.dtype)
+        return None
 
     def eval(self, batch, ctx=EvalContext()):
         lc = self.left.eval(batch, ctx)

@@ -1,0 +1,189 @@
+"""DECIMAL128 device support: 4×32-bit limb arithmetic in int64 lanes.
+
+Reference: the reference runs DECIMAL128 end-to-end on cudf's native
+__int128 columns (GpuCast.scala, DecimalUtil.scala). XLA has no 128-bit
+integer type, so precision 19-38 stores as ``int64[cap, 4]`` — four 32-bit
+two's-complement limbs (l0 = least significant) each held in an int64
+lane. The headroom above each limb makes segment SUMS safe without carry
+handling until a single final normalization pass: 2^31 rows × (2^32-1)
+per-limb still fits int64. Ordering/comparison collapses the limbs to an
+(hi, lo) int64 key pair whose lexicographic order is the 128-bit order.
+
+Scope: storage, comparisons, sort/group ordering, sum/min/max/first/last,
+add/subtract/negate/abs, and small rescales (≤10^9). Multiplication,
+division and wide rescales stay planner-gated to the CPU interpreter.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import SqlType, TypeKind
+
+MASK32 = (1 << 32) - 1
+
+
+def is_dec128(t: SqlType) -> bool:
+    return t.kind is TypeKind.DECIMAL and t.precision > 18
+
+
+def to_limbs_np(unscaled: List[int]) -> np.ndarray:
+    """Python ints (possibly >64 bits, signed) → int64[n, 4] limbs."""
+    out = np.zeros((len(unscaled), 4), np.int64)
+    for i, v in enumerate(unscaled):
+        u = v & ((1 << 128) - 1)          # two's complement mod 2^128
+        for j in range(4):
+            out[i, j] = (u >> (32 * j)) & MASK32
+    return out
+
+
+def from_limbs_np(mat: np.ndarray) -> List[int]:
+    out = []
+    for row in mat:
+        u = 0
+        for j in range(4):
+            u |= (int(row[j]) & MASK32) << (32 * j)
+        if u >= 1 << 127:
+            u -= 1 << 128
+        out.append(u)
+    return out
+
+
+def normalize(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Carry-propagate limb lanes back into [0, 2^32); result is the value
+    mod 2^128 (two's complement semantics preserved)."""
+    out = []
+    carry = jnp.zeros(limbs.shape[:-1], jnp.int64)
+    for j in range(4):
+        v = limbs[..., j] + carry
+        out.append(v & MASK32)
+        carry = v >> 32       # arithmetic shift: correct for negative lanes
+    return jnp.stack(out, axis=-1)
+
+
+def order_key_pair(data: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(hi, lo) int64 pair whose lexicographic signed-then-ordered order is
+    the 128-bit numeric order. hi = signed top half; lo = bottom half with
+    the sign bit flipped so int64 compare matches unsigned order."""
+    l0, l1, l2, l3 = (data[..., j] for j in range(4))
+    hi = ((l3 << 32) | l2)                # l3 carries the 128-bit sign:
+    # stored limbs are in [0, 2^32); (l3 << 32) overflows into the int64
+    # sign bit exactly when the 128-bit value is negative
+    lo = (((l1 - (1 << 31)) << 32) | l0)  # bias flip = unsigned order
+    return hi, lo
+
+
+def orderable_words128(data: jnp.ndarray) -> List[jnp.ndarray]:
+    """uint64 word operands for lax.sort (ascending 128-bit order)."""
+    hi, lo = order_key_pair(data)
+    sign = jnp.uint64(1) << jnp.uint64(63)
+    return [hi.astype(jnp.uint64) ^ sign, lo.astype(jnp.uint64) ^ sign]
+
+
+def compare(a: jnp.ndarray, b: jnp.ndarray):
+    """(lt, eq) bool arrays for two limb tensors."""
+    ah, al = order_key_pair(a)
+    bh, bl = order_key_pair(b)
+    lt = (ah < bh) | ((ah == bh) & (al < bl))
+    eq = (ah == bh) & (al == bl)
+    return lt, eq
+
+
+def seg_sum128(data: jnp.ndarray, live: jnp.ndarray, seg: jnp.ndarray,
+               cap: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum limbs [cap, 4], overflow bool [cap]).
+
+    Overflow detection: each input is encoded mod 2^128, so the lane sum
+    decodes correctly iff the dropped carry-out equals the adjustment the
+    encoding implies: with N = #negative inputs and C = carry out of the
+    top lane, the true sum is U + 2^128·(C − N); it fits signed 128 bits
+    iff (C − N, top bit of U) is (0, 0) or (−1, 1). Spark nulls the sum on
+    overflow (non-ANSI)."""
+    x = jnp.where(live[:, None], data, 0)
+    s = jax.ops.segment_sum(x, seg, num_segments=cap,
+                            indices_are_sorted=True)
+    neg = live & (data[..., 3] >= (1 << 31))
+    n_neg = jax.ops.segment_sum(neg.astype(jnp.int64), seg,
+                                num_segments=cap, indices_are_sorted=True)
+    out = []
+    carry = jnp.zeros(s.shape[:-1], jnp.int64)
+    for j in range(4):
+        v = s[..., j] + carry
+        out.append(v & MASK32)
+        carry = v >> 32
+    limbs = jnp.stack(out, axis=-1)
+    d = carry - n_neg
+    u_top = limbs[..., 3] >= (1 << 31)
+    ok = ((d == 0) & ~u_top) | ((d == -1) & u_top)
+    return limbs, ~ok
+
+
+def seg_minmax128(data: jnp.ndarray, live: jnp.ndarray, seg: jnp.ndarray,
+                  cap: int, take_min: bool) -> jnp.ndarray:
+    """Two-pass lexicographic segment min/max over the (hi, lo) keys."""
+    hi, lo = order_key_pair(data)
+    # hi/lo span the FULL int64 range (l3 << 32 wraps), so sentinels must
+    # be the true extremes; empty groups yield sentinel limbs that the
+    # caller masks out via validity
+    info = jnp.iinfo(jnp.int64)
+    big = jnp.int64(info.max if take_min else info.min)
+    op = jax.ops.segment_min if take_min else jax.ops.segment_max
+    h = op(jnp.where(live, hi, big), seg, num_segments=cap,
+           indices_are_sorted=True)
+    at_best = live & (hi == h[seg])
+    l = op(jnp.where(at_best, lo, big), seg, num_segments=cap,
+           indices_are_sorted=True)
+    # reconstruct limbs from the winning (hi, lo) pair
+    l3 = (h >> 32) & MASK32
+    l2 = h & MASK32
+    l1 = ((l >> 32) + (1 << 31)) & MASK32
+    l0 = l & MASK32
+    return jnp.stack([l0, l1, l2, l3], axis=-1)
+
+
+def lift64(x: jnp.ndarray) -> jnp.ndarray:
+    """int64 unscaled values → limb tensor (sign-extended)."""
+    l0 = x & MASK32
+    l1 = (x >> 32) & MASK32
+    ext = jnp.where(x < 0, jnp.int64(MASK32), jnp.int64(0))
+    return jnp.stack([l0, l1, ext, ext], axis=-1)
+
+
+def exceeds_digits(data: jnp.ndarray, digits: int = 38) -> jnp.ndarray:
+    """|value| >= 10^digits — Spark's precision-overflow test (nulls the
+    result even though the value still fits 128 bits)."""
+    limit = jnp.asarray(to_limbs_np([10 ** digits])[0])
+    mag = abs128(data)
+    # |-2^127| wraps back to itself; its (impossible for abs) sign bit
+    # marks it as exceeding any decimal precision
+    still_neg = mag[..., 3] >= (1 << 31)
+    lt, _ = compare(mag, jnp.broadcast_to(limit, mag.shape))
+    return still_neg | ~lt
+
+
+def add128(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return normalize(a + b)
+
+
+def neg128(data: jnp.ndarray) -> jnp.ndarray:
+    # two's complement: ~x + 1 limb-wise
+    inv = (~data) & MASK32
+    one = jnp.zeros_like(data).at[..., 0].set(1)
+    return normalize(inv + one)
+
+
+def abs128(data: jnp.ndarray) -> jnp.ndarray:
+    neg = (data[..., 3] >> 31) & 1
+    return jnp.where(neg[..., None] == 1, neg128(data), data)
+
+
+def rescale_up(data: jnp.ndarray, factor: int) -> jnp.ndarray:
+    """data × factor for factor ≤ 10^9 (scale alignment): per-limb multiply
+    stays under int64 (2^32 × 10^9 < 2^62), then one carry pass. Carries
+    can exceed 32 bits, so normalize twice."""
+    assert factor <= 10 ** 9
+    return normalize(normalize(data * jnp.int64(factor)))

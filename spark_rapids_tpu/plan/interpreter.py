@@ -1057,11 +1057,18 @@ class Interpreter:
         if name == "Sum":
             if not nn:
                 return None
-            s = sum(nn)
             if a.dtype.kind is TypeKind.DECIMAL:
                 import decimal as _d
-                q = _d.Decimal(1).scaleb(-a.dtype.scale)
-                return _d.Decimal(s).quantize(q)
+                # default context (28 digits) truncates DECIMAL128 sums
+                with _d.localcontext() as lctx:
+                    lctx.prec = 60
+                    s = sum(nn)
+                    if abs(int(s.scaleb(a.dtype.scale))) >= \
+                            10 ** a.dtype.precision:
+                        return None   # Spark: decimal sum overflow → null
+                    q = _d.Decimal(1).scaleb(-a.dtype.scale)
+                    return _d.Decimal(s).quantize(q)
+            s = sum(nn)
             if a.dtype.kind in _INT_BITS:
                 return _wrap(int(s), 64)
             return float(s)

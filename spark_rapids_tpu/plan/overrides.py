@@ -69,8 +69,9 @@ def _expr_rules() -> Dict[str, ExprRule]:
     def r(name, sig, incompat=False, note=""):
         rules[name] = ExprRule(name, sig, incompat, note)
 
+    # passthroughs admit every type that has a device layout
     for n in ("BoundReference", "UnresolvedColumn", "Literal", "Alias"):
-        r(n, TS.ALL_BASIC)
+        r(n, TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP)
     for n in ("Add", "Subtract", "Multiply", "UnaryMinus", "Abs"):
         r(n, TS.NUMERIC)
     for n in ("Divide", "IntegralDivide", "Remainder", "Pmod"):
@@ -78,12 +79,15 @@ def _expr_rules() -> Dict[str, ExprRule]:
     for n in ("BitwiseOp", "BitwiseNot"):
         r(n, TS.INTEGRAL)
     for n in ("EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual",
-              "GreaterThan", "GreaterThanOrEqual", "In"):
-        r(n, TS.ALL_BASIC)
+              "GreaterThan", "GreaterThanOrEqual"):
+        r(n, TS.ALL_BASIC + TS.DECIMAL_128)
+    r("In", TS.ALL_BASIC)
     for n in ("Not", "And", "Or"):
         r(n, TS.BOOLEAN + TS.ALL_BASIC)
-    for n in ("IsNull", "IsNotNull", "IsNaN"):
-        r(n, TS.ALL_BASIC)
+    # validity-only kernels are type-agnostic: every device layout passes
+    for n in ("IsNull", "IsNotNull"):
+        r(n, TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP)
+    r("IsNaN", TS.ALL_BASIC)
     for n in ("If", "CaseWhen", "Coalesce", "LeastGreatest"):
         r(n, TS.ALL_BASIC)
     r("Cast", TS.ALL_BASIC)
@@ -118,9 +122,13 @@ def _expr_rules() -> Dict[str, ExprRule]:
               "WindowAgg"):
         r(n, TS.ALL_BASIC)
     # aggregates
-    for n in ("Count", "Min", "Max", "First", "Last"):
-        r(n, TS.ALL_BASIC)
-    r("Sum", TS.NUMERIC, incompat=False)
+    r("Count", TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP)
+    for n in ("Min", "Max"):
+        r(n, TS.ALL_BASIC + TS.DECIMAL_128)
+    # first/last are pure gathers; any layout rides through
+    for n in ("First", "Last"):
+        r(n, TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP)
+    r("Sum", TS.NUMERIC + TS.DECIMAL_128, incompat=False)
     r("Percentile", TS.NUMERIC + TS.DATETIME)
     for n in ("CollectList", "CollectSet"):
         r(n, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
@@ -130,7 +138,8 @@ def _expr_rules() -> Dict[str, ExprRule]:
         r(n, TS.FP)
     # collections + HOFs (reference: collectionOperations.scala,
     # higherOrderFunctions.scala; device layout = fixed-budget matrices)
-    for n in ("CreateArray", "Size", "ArrayContains", "ElementAt",
+    r("Size", TS.ALL_BASIC + TS.ARRAY + TS.MAP)
+    for n in ("CreateArray", "ArrayContains", "ElementAt",
               "GetArrayItem", "SortArray", "ArrayMin", "ArrayMax",
               "CreateStruct", "GetStructField", "LambdaVariable",
               "TransformArray", "FilterArray", "ExistsArray", "ForallArray",
@@ -235,6 +244,7 @@ class PlanMeta:
             else:
                 keys = list(n.left_keys) + list(n.right_keys)
             schemas = [c.schema() for c in n.children]
+            hash_routed = isinstance(n, (L.LogicalJoin, L.LogicalAggregate))
             for k in keys:
                 for sch in schemas:
                     try:
@@ -246,6 +256,13 @@ class PlanMeta:
                         self.will_not_work(
                             f"{kd} cannot be a sort/join key on device "
                             f"(no scalar ordering/hash encoding)")
+                    elif hash_routed and kd.kind is TypeKind.DECIMAL and \
+                            kd.precision > 18:
+                        # dec128 sorts (limb order keys) but has no
+                        # murmur3/hash-exchange encoding yet
+                        self.will_not_work(
+                            f"{kd} join/group keys need a 128-bit hash "
+                            f"path; only dec128 VALUES run on device")
                     break
         if isinstance(n, L.LogicalGenerate):
             from ..types import TypeKind
@@ -318,16 +335,25 @@ class PlanMeta:
         reason = e.device_unsupported_reason()
         if reason:
             self.will_not_work(reason)
+        # INPUT-type gating against the expression's TypeSig (the
+        # reference's TypeChecks input sigs): an op whose rule does not
+        # admit a child's dtype has no device kernel for it — e.g.
+        # arithmetic/hash over DECIMAL128 limbs
+        rule = EXPR_RULES.get(name)
+        if rule is not None:
+            for c in e.children:
+                try:
+                    cd = c.dtype
+                except Exception:
+                    continue
+                r = rule.sig.supports(cd)
+                if r:
+                    self.will_not_work(f"{name} input: {r}")
         child = e.children[0] if e.children else None
         if child is not None:
             kind = child.dtype.kind
-            if name == "Sum" and kind is TypeKind.DECIMAL:
-                p, s = child.dtype.precision, child.dtype.scale
-                if p + 10 > 18:
-                    self.will_not_work(
-                        f"sum over decimal({p},{s}) widens to Spark result "
-                        f"precision {min(p + 10, 38)} > device DECIMAL64 "
-                        f"limit 18")
+            # sum over decimal widens to min(p+10, 38); DECIMAL128 limb
+            # storage (expressions/decimal128.py) covers the whole range
             if name == "Average" and kind is TypeKind.DECIMAL:
                 p, s = child.dtype.precision, child.dtype.scale
                 self.will_not_work(
@@ -380,14 +406,14 @@ def _walk(meta: PlanMeta):
 
 
 EXEC_SIGS: Dict[str, TypeSig] = {
-    "Scan": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
-    "Project": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
-    "Filter": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
-    "Aggregate": TS.GROUPABLE + TS.ARRAY + TS.MAP,
-    "Join": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
-    "Sort": TS.ORDERABLE + TS.ARRAY + TS.MAP,   # arrays/maps ride as payload
-    "Limit": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
-    "Union": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+    "Scan": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    "Project": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    "Filter": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    "Aggregate": TS.GROUPABLE + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    "Join": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    "Sort": TS.ORDERABLE + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    "Limit": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    "Union": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
     "Range": TS.ALL_BASIC,
     "Expand": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
     "Sample": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
@@ -668,11 +694,16 @@ class Overrides:
             child = self._exchange(SinglePartitioning(), child)
         return WindowExec(n.window_exprs, child)
 
+    def _broadcast(self, child: Exec) -> Exec:
+        from ..config import BROADCAST_LIMIT
+        return BroadcastExchangeExec(
+            child, max_bytes=self.conf.get(BROADCAST_LIMIT.key))
+
     def _convert_join(self, n: L.LogicalJoin, ch: List[Exec]) -> Exec:
         if n.join_type is JoinType.CROSS or not n.left_keys:
             return BroadcastNestedLoopJoinExec(
                 JoinType.CROSS if not n.left_keys else n.join_type,
-                ch[0], BroadcastExchangeExec(ch[1]), condition=n.condition)
+                ch[0], self._broadcast(ch[1]), condition=n.condition)
         from ..config import BROADCAST_THRESHOLD, JOIN_MAX_BUILD_ROWS
         threshold = self.conf.get(BROADCAST_THRESHOLD.key)
         max_build = self.conf.get(JOIN_MAX_BUILD_ROWS.key)
@@ -696,7 +727,7 @@ class Overrides:
         if build_bytes is not None and build_bytes <= threshold:
             join: Exec = HashJoinExec(
                 left_keys, right_keys, n.join_type, l,
-                BroadcastExchangeExec(r), condition=n.condition,
+                self._broadcast(r), condition=n.condition,
                 max_build_rows=max_build)
         else:
             # shuffled hash join: co-partition both sides on the join keys

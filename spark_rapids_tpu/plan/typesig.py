@@ -75,5 +75,9 @@ ORDERABLE = ALL_BASIC       # everything basic sorts via key normalization
 GROUPABLE = ALL_BASIC
 ARRAY = _sig(TypeKind.ARRAY)          # fixed-budget scalar-element arrays
 MAP = _sig(TypeKind.MAP)              # zipped key/value fixed-budget arrays
+# DECIMAL128: 4×32-bit limb storage (expressions/decimal128.py). Adding
+# this sig raises a rule's decimal ceiling from DECIMAL64 to 38 digits.
+DECIMAL_128 = TypeSig(frozenset({TypeKind.DECIMAL}),
+                      max_decimal_precision=38)
 NESTED = _sig(TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP)
 NONE = TypeSig()

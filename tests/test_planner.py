@@ -154,19 +154,22 @@ def test_float64_agg_incompat_gating():
     assert not ses.fell_back(), ses.executed_exec_names()
 
 
-def test_decimal_sum_wide_falls_back():
-    """sum(decimal) whose Spark result precision exceeds DECIMAL64 is
-    planner-gated (ADVICE r1: int64 buffers would silently wrap)."""
+def test_decimal_sum_wide_runs_on_device():
+    """sum(decimal) whose Spark result precision exceeds DECIMAL64 now
+    widens into DECIMAL128 limb accumulators on device (round 1 gated
+    this to CPU; expressions/decimal128.py lifts the gate)."""
     import pyarrow as pa
     import decimal as d
     from spark_rapids_tpu.expressions.aggregates import Sum
-    from harness.asserts import assert_tpu_fallback_collect
     t = pa.table({"k": pa.array([0, 0, 1]),
                   "x": pa.array([d.Decimal("12345678.90")] * 3,
                                 pa.decimal128(10, 2))})
-    assert_tpu_fallback_collect(
-        lambda: table(t).group_by("k").agg(Sum(col("x")).alias("s")),
-        "CpuFallback")
+    s = Session()
+    got = s.collect(table(t).group_by("k").agg(Sum(col("x")).alias("s")))
+    assert not s.fell_back(), s.fell_back()
+    assert sorted(zip(got.column("k").to_pylist(),
+                      got.column("s").to_pylist())) == \
+        [(0, d.Decimal("24691357.80")), (1, d.Decimal("12345678.90"))]
 
 
 def test_coalesce_transition_inserted():
