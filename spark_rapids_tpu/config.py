@@ -145,6 +145,11 @@ HOST_SPILL_LIMIT = conf("spark.rapids.tpu.memory.host.spillStorageSize").doc(
 SPILL_DIR = conf("spark.rapids.tpu.memory.spillDir").doc(
     "Directory for disk-tier spill files.").text("/tmp/rapids_tpu_spill")
 
+BROADCAST_LIMIT = conf("spark.rapids.tpu.broadcast.maxBytes").doc(
+    "Maximum device bytes for one broadcast relation; larger builds must "
+    "shuffle instead (reference: Spark's 8GB broadcast hard limit / "
+    "spark.sql.autoBroadcastJoinThreshold escalation).").bytes_(1 << 30)
+
 METRICS_LEVEL = conf("spark.rapids.tpu.sql.metrics.level").doc(
     "ESSENTIAL, MODERATE or DEBUG metric collection (reference: "
     "spark.rapids.sql.metrics.level).").text("MODERATE")

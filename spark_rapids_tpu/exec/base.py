@@ -84,6 +84,17 @@ class Exec:
             self.metrics["numOutputBatches"].add(1)
             yield batch
 
+    def close(self) -> None:
+        """Release catalog-registered resources after the query finishes
+        (the reference's closeOnExcept/TaskCompletion hooks). Subclasses
+        override do_close(); the tree walk happens here."""
+        for c in self.children:
+            c.close()
+        self.do_close()
+
+    def do_close(self) -> None:
+        pass
+
     # ---- debugging / explain ----
     @property
     def name(self) -> str:

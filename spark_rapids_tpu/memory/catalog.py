@@ -129,6 +129,8 @@ class BufferCatalog:
             host[f"v{i}"] = np.asarray(jax.device_get(c.validity))
             if c.lengths is not None:
                 host[f"l{i}"] = np.asarray(jax.device_get(c.lengths))
+            if c.data2 is not None:     # map values / string-array lengths
+                host[f"m{i}"] = np.asarray(jax.device_get(c.data2))
         host["n"] = np.asarray(jax.device_get(e.batch.num_rows))
         e.host = host
         e.batch = None
@@ -190,9 +192,11 @@ class BufferCatalog:
         for i, f in enumerate(e.schema):
             lengths = jnp.asarray(e.host[f"l{i}"]) if f"l{i}" in e.host \
                 else None
+            data2 = jnp.asarray(e.host[f"m{i}"]) if f"m{i}" in e.host \
+                else None
             cols.append(DeviceColumn(jnp.asarray(e.host[f"d{i}"]),
                                      jnp.asarray(e.host[f"v{i}"]),
-                                     lengths, f.dtype))
+                                     lengths, f.dtype, data2))
         return ColumnarBatch(tuple(cols),
                              jnp.asarray(e.host["n"], jnp.int32))
 

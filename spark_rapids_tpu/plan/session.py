@@ -56,7 +56,10 @@ class Session:
                 plan = lowered
                 self.last_plan = plan
         from ..exec.base import collect as collect_exec
-        return collect_exec(plan)
+        try:
+            return collect_exec(plan)
+        finally:
+            plan.close()    # free catalog-registered exchange/broadcast state
 
     def _mesh(self):
         """1-axis data-parallel mesh over the visible devices."""

@@ -13,40 +13,61 @@ shuffle manager.
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..batch import ColumnarBatch, Schema, bucket_capacity
 from ..exec.base import Exec, UnaryExec
-from ..exec.common import compact, concat_batches
+from ..exec.common import compact, concat_batches, slice_batch
 from ..expressions.base import EvalContext
+from ..memory.catalog import BufferCatalog, SpillableBatch
 from .partitioning import Partitioning, RangePartitioning, SinglePartitioning
 
 
 class ShuffleExchangeExec(UnaryExec):
-    """All-to-all redistribution of rows by a partitioning."""
+    """All-to-all redistribution of rows by a partitioning.
+
+    Spill discipline (reference: RapidsShuffleIterator/ShuffleBufferCatalog):
+    every materialized partition piece is SHRUNK to its row-count bucket and
+    registered with the buffer catalog, so a shuffle larger than the device
+    budget spills to host/disk instead of accumulating unbudgeted device
+    lists; pieces are acquired per read partition and freed after that
+    partition is consumed.
+    """
 
     def __init__(self, partitioning: Partitioning, child: Exec,
                  ctx: Optional[EvalContext] = None, adaptive: bool = False,
-                 target_rows: int = 1 << 20):
+                 target_rows: int = 1 << 20,
+                 catalog: Optional[BufferCatalog] = None):
         super().__init__(child, ctx)
         self.partitioning = partitioning.bind(child.output_schema)
-        self._materialized: Optional[List[List[ColumnarBatch]]] = None
+        self._materialized: Optional[
+            List[List[Tuple[SpillableBatch, int]]]] = None
         # AQE (reference: GpuCustomShuffleReaderExec): after the stage
         # materializes, adjacent small output partitions coalesce into one
         # reader partition using real row counts.
         self.adaptive = adaptive
         self.target_rows = target_rows
         self._groups: Optional[List[List[int]]] = None
+        self._catalog = catalog
 
         def slice_kernel(batch: ColumnarBatch, pids, p: int) -> ColumnarBatch:
             return compact(batch, pids == p)
 
         self._slice_jit = jax.jit(slice_kernel, static_argnums=2)
+        self._shrink_jit = jax.jit(
+            lambda b, cap: slice_batch(b, 0, b.num_rows, cap),
+            static_argnums=1)
         self._pids_jit = jax.jit(
             lambda b: self.partitioning.partition_ids(b, self.ctx))
+
+    def _cat(self) -> BufferCatalog:
+        if self._catalog is None:
+            from ..memory.catalog import device_budget
+            self._catalog = device_budget()
+        return self._catalog
 
     @property
     def output_schema(self) -> Schema:
@@ -64,7 +85,7 @@ class ShuffleExchangeExec(UnaryExec):
         if self._groups is not None:
             return self._groups
         parts = self._materialize()
-        counts = [sum(int(b.num_rows) for b in pieces) for pieces in parts]
+        counts = [sum(rows for _, rows in pieces) for pieces in parts]
         groups: List[List[int]] = []
         cur: List[int] = []
         cur_rows = 0
@@ -111,51 +132,102 @@ class ShuffleExchangeExec(UnaryExec):
         bound_cols = jax.jit(bounds_kernel)(allk)
         part.set_bounds(bound_cols, n - 1)
 
-    def _materialize(self) -> List[List[ColumnarBatch]]:
+    def _register(self, out, p: int, piece: ColumnarBatch) -> None:
+        """Shrink a partition piece to its row-count bucket and hand it to
+        the spill catalog (padding at full input capacity would multiply
+        device residency by the partition count)."""
+        rows = int(piece.num_rows)
+        if rows == 0:
+            return
+        cap = bucket_capacity(rows)
+        if cap < piece.capacity:
+            piece = self._shrink_jit(piece, cap)
+        # registration leaves the entry unpinned → spillable under pressure
+        sb = SpillableBatch(self._cat(), piece, self.output_schema)
+        out[p].append((sb, rows))
+
+    def _materialize(self) -> List[List[Tuple[SpillableBatch, int]]]:
         if self._materialized is not None:
             return self._materialized
         n = self.partitioning.num_partitions   # write-side nominal count
-        out: List[List[ColumnarBatch]] = [[] for _ in range(n)]
-        batches = [b for cp in range(self.child.num_partitions)
-                   for b in self.child.execute_partition(cp)]
-        if isinstance(self.partitioning, RangePartitioning) and batches:
-            self._sample_range_bounds(batches)
-        for batch in batches:
+        out: List[List[Tuple[SpillableBatch, int]]] = [[] for _ in range(n)]
+        range_part = isinstance(self.partitioning, RangePartitioning)
+        if range_part:
+            # bounds need the whole input; sampling keeps only key columns
+            batches = [b for cp in range(self.child.num_partitions)
+                       for b in self.child.execute_partition(cp)]
+            if batches:
+                self._sample_range_bounds(batches)
+            stream = iter(batches)
+        else:
+            # STREAM the child: one input batch on device at a time; its
+            # pieces go straight into the catalog
+            stream = (b for cp in range(self.child.num_partitions)
+                      for b in self.child.execute_partition(cp))
+        for batch in stream:
             if n == 1:
-                out[0].append(batch)
+                self._register(out, 0, batch)
                 continue
             pids = self._pids_jit(batch)
             for p in range(n):
-                piece = self._slice_jit(batch, pids, p)
-                out[p].append(piece)
+                self._register(out, p, self._slice_jit(batch, pids, p))
         self._materialized = out
         return out
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         if self.adaptive:
             group = self._partition_groups()[p]
-            pieces = [b for op_ in group for b in self._materialize()[op_]]
+            entries = [e for op_ in group for e in self._materialize()[op_]]
         else:
-            pieces = self._materialize()[p]
-        pieces = [b for b in pieces if int(b.num_rows) > 0]
-        if not pieces:
+            entries = self._materialize()[p]
+        if not entries:
             return
         # shuffle-read coalesce (reference: GpuShuffleCoalesceExec)
-        cap = bucket_capacity(max(sum(int(b.num_rows) for b in pieces), 1))
-        if len(pieces) == 1:
-            yield pieces[0]
-        else:
-            yield concat_batches(pieces, cap)
+        cap = bucket_capacity(max(sum(rows for _, rows in entries), 1))
+        try:
+            if len(entries) == 1:
+                yield entries[0][0].get()
+            else:
+                yield concat_batches([sb.get() for sb, _ in entries], cap)
+        finally:
+            # each read partition is consumed once; free its pieces
+            for sb, _ in entries:
+                sb.close()
+
+    def do_close(self) -> None:
+        # partitions the consumer never read (limits, early exit) still
+        # hold catalog entries
+        if self._materialized is not None:
+            for pieces in self._materialized:
+                for sb, _ in pieces:
+                    sb.close()
+            self._materialized = None
+
+
+class BroadcastTooLargeError(MemoryError):
+    """The broadcast relation exceeds spark.rapids.tpu.broadcast.maxBytes
+    (Spark's 8GB broadcast hard limit analogue) — the planner should have
+    chosen a shuffled join for this build side."""
 
 
 class BroadcastExchangeExec(UnaryExec):
     """Replicate the child's full output as one batch (reference:
     GpuBroadcastExchangeExec — host-serialized concat batches rebuilt on
-    device per executor; single-process here, so it is a concat + cache)."""
+    device per executor; single-process here, so it is a concat + cache).
 
-    def __init__(self, child: Exec, ctx: Optional[EvalContext] = None):
+    The cached relation is catalog-registered (spillable between reads)
+    and bounded by spark.rapids.tpu.broadcast.maxBytes."""
+
+    def __init__(self, child: Exec, ctx: Optional[EvalContext] = None,
+                 max_bytes: Optional[int] = None,
+                 catalog: Optional[BufferCatalog] = None):
         super().__init__(child, ctx)
-        self._cached: Optional[ColumnarBatch] = None
+        self._sb: Optional[SpillableBatch] = None
+        if max_bytes is None:
+            from ..config import BROADCAST_LIMIT, RapidsTpuConf
+            max_bytes = RapidsTpuConf().get(BROADCAST_LIMIT.key)
+        self.max_bytes = max_bytes
+        self._catalog = catalog
 
     @property
     def output_schema(self) -> Schema:
@@ -166,15 +238,34 @@ class BroadcastExchangeExec(UnaryExec):
         return 1
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        if self._cached is None:
+        if self._sb is None:
             batches = [b for cp in range(self.child.num_partitions)
                        for b in self.child.execute_partition(cp)]
             if not batches:
                 from ..batch import empty_batch
-                self._cached = empty_batch(self.output_schema)
+                cached = empty_batch(self.output_schema)
             elif len(batches) == 1:
-                self._cached = batches[0]
+                cached = batches[0]
             else:
                 cap = bucket_capacity(sum(b.capacity for b in batches))
-                self._cached = concat_batches(batches, cap)
-        yield self._cached
+                cached = concat_batches(batches, cap)
+            if cached.size_bytes() > self.max_bytes:
+                raise BroadcastTooLargeError(
+                    f"broadcast relation is {cached.size_bytes()}b > "
+                    f"spark.rapids.tpu.broadcast.maxBytes={self.max_bytes}; "
+                    f"use a shuffled join for this build side")
+            if self._catalog is None:
+                from ..memory.catalog import device_budget
+                self._catalog = device_budget()
+            self._sb = SpillableBatch(self._catalog, cached,
+                                      self.output_schema)
+        batch = self._sb.get()
+        try:
+            yield batch
+        finally:
+            self._sb.done_with()    # spillable again between reads
+
+    def do_close(self) -> None:
+        if self._sb is not None:
+            self._sb.close()
+            self._sb = None

@@ -108,6 +108,8 @@ def serialize_batch(batch: ColumnarBatch, schema: Schema) -> bytes:
         arrays[f"v{i}"] = np.asarray(jax.device_get(c.validity))
         if c.lengths is not None:
             arrays[f"l{i}"] = np.asarray(jax.device_get(c.lengths))
+        if c.data2 is not None:     # map values / string-array lengths
+            arrays[f"m{i}"] = np.asarray(jax.device_get(c.data2))
     return serialize_host(arrays, int(batch.num_rows))
 
 
@@ -117,7 +119,8 @@ def deserialize_batch(data: bytes, schema: Schema) -> ColumnarBatch:
     cols: List[DeviceColumn] = []
     for i, f in enumerate(schema):
         lengths = jnp.asarray(arrays[f"l{i}"]) if f"l{i}" in arrays else None
+        data2 = jnp.asarray(arrays[f"m{i}"]) if f"m{i}" in arrays else None
         cols.append(DeviceColumn(jnp.asarray(arrays[f"d{i}"]),
                                  jnp.asarray(arrays[f"v{i}"]),
-                                 lengths, f.dtype))
+                                 lengths, f.dtype, data2))
     return ColumnarBatch(tuple(cols), jnp.asarray(num_rows, jnp.int32))

@@ -1,0 +1,130 @@
+"""Exchange spill discipline (VERDICT r1 weak #4): shuffles larger than
+the device budget must ride the buffer catalog (spill to host/disk), and
+broadcasts are bounded + spillable between reads."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.batch import from_arrow
+from spark_rapids_tpu.exec import InMemoryScanExec, collect
+from spark_rapids_tpu.expressions import col
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.shuffle import (BroadcastExchangeExec,
+                                      HashPartitioning, ShuffleExchangeExec)
+from spark_rapids_tpu.shuffle.exchange import BroadcastTooLargeError
+
+from harness.asserts import assert_rows_equal, rows_of
+
+
+def big_table(n=40_000, seed=5):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 1000, n).astype(np.int64),
+        "v": rng.integers(-50, 50, n).astype(np.int64),
+    })
+
+
+def test_shuffle_spills_under_small_budget(tmp_path):
+    """Shuffle several times the device budget; the catalog must spill and
+    the result must still be exact."""
+    t = big_table()
+    # input batch ≈ 40k rows × 17B ≈ 0.7MB; budget far below materialized
+    cat = BufferCatalog(device_limit=200_000, host_limit=150_000,
+                        spill_dir=str(tmp_path))
+    ex = ShuffleExchangeExec(
+        HashPartitioning([col("k")], 8),
+        InMemoryScanExec(t, num_slices=4, batch_rows=5000),
+        catalog=cat)
+    seen = []
+    for p in range(ex.num_partitions):
+        for b in ex.execute_partition(p):
+            tb = __import__("spark_rapids_tpu.batch",
+                            fromlist=["b"]).to_arrow(b, ex.output_schema)
+            seen.extend(zip(tb.column("k").to_pylist(),
+                            tb.column("v").to_pylist()))
+    expect = list(zip(t.column("k").to_pylist(), t.column("v").to_pylist()))
+    assert sorted(seen) == sorted(expect)
+    assert cat.spilled_to_host > 0, "budget never forced a spill"
+    assert cat.spilled_to_disk > 0, "host limit never forced disk overflow"
+    # all pieces freed after reads
+    assert not cat._entries, cat.dump_state()
+
+
+def test_partition_routing_consistent(tmp_path):
+    """Same key → same output partition, across input batches."""
+    t = big_table(5000)
+    cat = BufferCatalog(device_limit=64 << 20, spill_dir=str(tmp_path))
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 4),
+                             InMemoryScanExec(t, batch_rows=1000),
+                             catalog=cat)
+    from spark_rapids_tpu.batch import to_arrow
+    key_part = {}
+    for p in range(4):
+        for b in ex.execute_partition(p):
+            for k in to_arrow(b, ex.output_schema).column("k").to_pylist():
+                assert key_part.setdefault(k, p) == p
+
+
+def test_broadcast_bounded(tmp_path):
+    t = big_table(20_000)
+    cat = BufferCatalog(device_limit=64 << 20, spill_dir=str(tmp_path))
+    ex = BroadcastExchangeExec(InMemoryScanExec(t), max_bytes=1000,
+                               catalog=cat)
+    with pytest.raises(BroadcastTooLargeError):
+        list(ex.execute_partition(0))
+
+
+def test_broadcast_spillable_between_reads(tmp_path):
+    t = big_table(2000)
+    cat = BufferCatalog(device_limit=4 << 20, spill_dir=str(tmp_path))
+    ex = BroadcastExchangeExec(InMemoryScanExec(t), max_bytes=64 << 20,
+                               catalog=cat)
+    a = next(iter(ex.execute_partition(0)))
+    n1 = int(a.num_rows)
+    # force pressure: the cached broadcast must spill and come back
+    cat.synchronous_spill(cat.device_used)
+    b = next(iter(ex.execute_partition(0)))
+    assert int(b.num_rows) == n1 == 2000
+
+
+def test_broadcast_closed_after_collect():
+    """Planner-built broadcasts must not leak catalog entries after the
+    query (review finding: the singleton catalog grew per query)."""
+    from spark_rapids_tpu.expressions.aggregates import Count
+    from spark_rapids_tpu.memory.catalog import device_budget
+    from spark_rapids_tpu.plan import Session, table
+    from spark_rapids_tpu.exec.join import JoinType
+    t = big_table(2000)
+    d = pa.table({"dk": np.arange(1000, dtype=np.int64)})
+    cat = device_budget()
+    before = len(cat._entries)
+    s = Session()
+    s.collect(table(t).join(table(d), ["k"], ["dk"], JoinType.INNER)
+              .group_by("k").agg(Count().alias("c")))
+    assert len(cat._entries) == before, cat.dump_state()
+
+
+def test_broadcast_limit_honors_session_conf():
+    from spark_rapids_tpu.expressions.aggregates import Count
+    from spark_rapids_tpu.plan import Session, table
+    from spark_rapids_tpu.exec.join import JoinType
+    t = big_table(500)
+    d = pa.table({"dk": np.arange(400, dtype=np.int64)})
+    s = Session({"spark.rapids.tpu.broadcast.maxBytes": 64})
+    with pytest.raises(BroadcastTooLargeError):
+        s.collect(table(t).join(table(d), ["k"], ["dk"], JoinType.INNER))
+
+
+def test_first_last_of_arrays_on_device():
+    from spark_rapids_tpu.expressions.aggregates import Count, First
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.plan import Session, table
+    t = pa.table({"k": pa.array([0, 0, 1], pa.int32()),
+                  "vs": pa.array([[1, 2], [3], []], pa.list_(pa.int64()))})
+    s = Session()
+    out = s.collect(table(t).group_by("k").agg(
+        First(col("vs")).alias("f"), Count(col("vs")).alias("c")))
+    assert not s.fell_back(), s.fell_back()
+    got = dict(zip(out.column("k").to_pylist(), out.column("f").to_pylist()))
+    assert got == {0: [1, 2], 1: []}
