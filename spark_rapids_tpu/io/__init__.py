@@ -16,6 +16,9 @@ from .source import FileSource
 from .parquet import ParquetSource, write_parquet
 from .csv import CsvSource, write_csv
 from .json import JsonSource
-from .scan import FileSourceScanExec, read_csv, read_json, read_parquet
+from .avro import AvroSource, read_avro_file, write_avro_file
+from .iceberg import IcebergSource, IcebergTable, read_iceberg
+from .scan import (FileSourceScanExec, read_avro, read_csv, read_json,
+                   read_parquet)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,343 @@
+"""Iceberg table reads: metadata JSON → manifest list → manifests → scan.
+
+Reference: sql-plugin/src/main/java/com/nvidia/spark/rapids/iceberg/
+(~5.9k LoC — Spark/Iceberg glue + a GPU parquet reader bridge). The
+metadata layer here is implemented directly against the Iceberg spec
+(v1/v2): the table directory holds `metadata/v<N>.metadata.json` (plus
+`version-hint.text`), each snapshot points to an Avro manifest LIST,
+each manifest is an Avro file of data/delete file entries, and data
+files are parquet read through the existing multi-file scan framework.
+
+Supported: snapshot selection (current / by id / as-of timestamp — time
+travel), identity-transform partition pruning against the scan
+predicate, v2 POSITIONAL delete files, and v2 EQUALITY delete files
+(anti-join semantics applied per data file at read time). Nested table
+schemas fall back like every other scan (TypeSig gates them).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from .avro import read_avro_records
+from .source import FileSource, rewrite_path
+
+
+class IcebergError(ValueError):
+    pass
+
+
+_ICE_TO_ARROW = {
+    "boolean": pa.bool_(), "int": pa.int32(), "long": pa.int64(),
+    "float": pa.float32(), "double": pa.float64(), "date": pa.date32(),
+    "string": pa.string(), "binary": pa.binary(),
+    "timestamp": pa.timestamp("us"),
+    "timestamptz": pa.timestamp("us", tz="UTC"),
+}
+
+
+def _ice_type_to_arrow(t: Any) -> pa.DataType:
+    if isinstance(t, str):
+        if t in _ICE_TO_ARROW:
+            return _ICE_TO_ARROW[t]
+        if t.startswith("decimal("):
+            p, s = t[len("decimal("):-1].split(",")
+            return pa.decimal128(int(p), int(s))
+        raise IcebergError(f"unsupported iceberg type {t!r}")
+    if isinstance(t, dict):
+        k = t.get("type")
+        if k == "list":
+            return pa.list_(_ice_type_to_arrow(t["element"]))
+        if k == "map":
+            return pa.map_(_ice_type_to_arrow(t["key"]),
+                           _ice_type_to_arrow(t["value"]))
+        if k == "struct":
+            return pa.struct([
+                pa.field(f["name"], _ice_type_to_arrow(f["type"]),
+                         not f.get("required", False))
+                for f in t["fields"]])
+    raise IcebergError(f"unsupported iceberg type {t!r}")
+
+
+class IcebergTable:
+    """Reader for an Iceberg table directory."""
+
+    def __init__(self, path: str):
+        self.path = rewrite_path(path)
+        self.meta = self._load_metadata()
+
+    # ---- metadata resolution ----
+    def _load_metadata(self) -> dict:
+        mdir = os.path.join(self.path, "metadata")
+        hint = os.path.join(mdir, "version-hint.text")
+        meta_file = None
+        if os.path.exists(hint):
+            with open(hint) as f:
+                v = f.read().strip()
+            for pat in (f"v{v}.metadata.json", f"{v}.metadata.json"):
+                cand = os.path.join(mdir, pat)
+                if os.path.exists(cand):
+                    meta_file = cand
+                    break
+        if meta_file is None:
+            cands = [f for f in os.listdir(mdir)
+                     if f.endswith(".metadata.json")]
+            if not cands:
+                raise IcebergError(f"no metadata.json under {mdir}")
+            # highest version number wins
+            def ver(name):
+                head = name.split(".")[0].lstrip("v")
+                try:
+                    return int(head.split("-")[0])
+                except ValueError:
+                    return -1
+            meta_file = os.path.join(mdir, max(cands, key=ver))
+        with open(meta_file) as f:
+            return json.load(f)
+
+    def schema_json(self) -> dict:
+        m = self.meta
+        if "schemas" in m:
+            sid = m.get("current-schema-id", 0)
+            for s in m["schemas"]:
+                if s.get("schema-id") == sid:
+                    return s
+            return m["schemas"][0]
+        return m["schema"]
+
+    def arrow_schema(self) -> pa.Schema:
+        return pa.schema([
+            pa.field(f["name"], _ice_type_to_arrow(f["type"]),
+                     not f.get("required", False))
+            for f in self.schema_json()["fields"]])
+
+    def partition_field_names(self) -> List[str]:
+        """Identity-transform partition source column names."""
+        specs = self.meta.get("partition-specs") or []
+        spec_id = self.meta.get("default-spec-id", 0)
+        fields = []
+        for s in specs:
+            if s.get("spec-id") == spec_id:
+                fields = s.get("fields", [])
+        id_to_name = {f["id"]: f["name"]
+                      for f in self.schema_json()["fields"]}
+        return [id_to_name.get(f["source-id"], f.get("name"))
+                for f in fields if f.get("transform") == "identity"]
+
+    # ---- snapshots ----
+    def snapshots(self) -> List[dict]:
+        return self.meta.get("snapshots", [])
+
+    def snapshot(self, snapshot_id: Optional[int] = None,
+                 as_of_timestamp_ms: Optional[int] = None) -> dict:
+        snaps = self.snapshots()
+        if not snaps:
+            raise IcebergError("table has no snapshots")
+        if snapshot_id is not None:
+            for s in snaps:
+                if s["snapshot-id"] == snapshot_id:
+                    return s
+            raise IcebergError(f"snapshot {snapshot_id} not found")
+        if as_of_timestamp_ms is not None:
+            eligible = [s for s in snaps
+                        if s["timestamp-ms"] <= as_of_timestamp_ms]
+            if not eligible:
+                raise IcebergError(
+                    f"no snapshot at or before {as_of_timestamp_ms}")
+            return max(eligible, key=lambda s: s["timestamp-ms"])
+        cur = self.meta.get("current-snapshot-id")
+        for s in snaps:
+            if s["snapshot-id"] == cur:
+                return s
+        return snaps[-1]
+
+    def _resolve(self, p: str) -> str:
+        """Manifest/data paths may be absolute or table-relative."""
+        if os.path.exists(p):
+            return p
+        tail = p.split(self.path.rstrip("/").split("/")[-1] + "/", 1)
+        if len(tail) == 2:
+            return os.path.join(self.path, tail[1])
+        return os.path.join(self.path, p.lstrip("/"))
+
+    def _manifests(self, snap: dict) -> List[dict]:
+        if "manifest-list" in snap:
+            return read_avro_records(self._resolve(snap["manifest-list"]))
+        # v1 inline manifests list
+        return [{"manifest_path": m, "content": 0}
+                for m in snap.get("manifests", [])]
+
+    def plan_files(self, snapshot_id: Optional[int] = None,
+                   as_of_timestamp_ms: Optional[int] = None,
+                   prune: Optional[Dict[str, Any]] = None
+                   ) -> Tuple[List[dict], List[dict]]:
+        """(data file entries, delete file entries) for a snapshot, with
+        identity-partition pruning against `prune` ({col: required value}).
+        """
+        snap = self.snapshot(snapshot_id, as_of_timestamp_ms)
+        part_names = self.partition_field_names()
+        data: List[dict] = []
+        deletes: List[dict] = []
+        for m in self._manifests(snap):
+            entries = read_avro_records(self._resolve(m["manifest_path"]))
+            for e in entries:
+                if e.get("status") == 2:        # DELETED entry
+                    continue
+                df = dict(e["data_file"])
+                # v2 delete scoping: a delete file applies only to data
+                # files with a lower (equality) / not-higher (positional)
+                # data sequence number
+                df["_seq"] = e.get("sequence_number") or \
+                    m.get("sequence_number") or 0
+                content = df.get("content", 0)
+                part = df.get("partition") or {}
+                if content == 0 and prune:
+                    skip = False
+                    for name in part_names:
+                        if name in prune and part.get(name) is not None \
+                                and part[name] != prune[name]:
+                            skip = True
+                            break
+                    if skip:
+                        continue
+                (data if content == 0 else deletes).append(df)
+        return data, deletes
+
+    # ---- scan ----
+    def to_dataframe(self, columns=None, predicate=None,
+                     snapshot_id: Optional[int] = None,
+                     as_of_timestamp_ms: Optional[int] = None,
+                     num_slices: int = 1):
+        from ..plan.logical import DataFrame, LogicalScan
+        prune = _identity_equalities(predicate)
+        data, deletes = self.plan_files(snapshot_id, as_of_timestamp_ms,
+                                        prune)
+        if not data:
+            raise IcebergError("snapshot selects no data files")
+        src = IcebergSource(
+            [self._resolve(d["file_path"]) for d in data],
+            table=self, delete_entries=deletes,
+            data_seqs={self._resolve(d["file_path"]): d["_seq"]
+                       for d in data},
+            columns=columns, predicate=predicate)
+        return DataFrame(LogicalScan((), source=src, _schema=src.schema(),
+                                     num_slices=num_slices))
+
+
+def _identity_equalities(predicate) -> Dict[str, Any]:
+    """col == literal conjuncts usable for partition pruning."""
+    out: Dict[str, Any] = {}
+    if predicate is None:
+        return out
+    from ..expressions.base import Literal, UnresolvedColumn
+    from ..expressions.boolean import And
+    from ..expressions.comparison import EqualTo
+
+    def walk(e):
+        if isinstance(e, And):
+            walk(e.children[0])
+            walk(e.children[1])
+        elif isinstance(e, EqualTo):
+            l, r = e.left, e.right
+            if isinstance(l, UnresolvedColumn) and isinstance(r, Literal):
+                out[l.name] = r.value
+            elif isinstance(r, UnresolvedColumn) and isinstance(l, Literal):
+                out[r.name] = l.value
+    walk(predicate)
+    return out
+
+
+class IcebergSource(FileSource):
+    """Parquet data files + row-level deletes applied at read time."""
+
+    format_name = "iceberg"
+
+    def __init__(self, paths, table: IcebergTable,
+                 delete_entries: List[dict],
+                 data_seqs: Optional[Dict[str, int]] = None, **kw):
+        self.table = table
+        self.delete_entries = delete_entries
+        self.data_seqs = data_seqs or {}
+        self._pos_deletes: Optional[Dict[str, List[Tuple[int, int]]]] = None
+        self._eq_deletes: Optional[
+            List[Tuple[int, List[str], pa.Table]]] = None
+        self._del_lock = threading.Lock()
+        super().__init__(paths, **kw)
+
+    def infer_arrow_schema(self) -> pa.Schema:
+        return self.table.arrow_schema()
+
+    def _load_deletes(self) -> None:
+        # the multithreaded reader calls read_file concurrently
+        with self._del_lock:
+            if self._pos_deletes is not None:
+                return
+            pos: Dict[str, List[Tuple[int, int]]] = {}
+            eq: List[Tuple[int, List[str], pa.Table]] = []
+            id_to_name = {f["id"]: f["name"]
+                          for f in self.table.schema_json()["fields"]}
+            for d in self.delete_entries:
+                p = self.table._resolve(d["file_path"])
+                t = pq.read_table(p)
+                seq = d.get("_seq", 0)
+                if d.get("content", 1) == 1:      # positional
+                    for fp, r in zip(t.column("file_path").to_pylist(),
+                                     t.column("pos").to_pylist()):
+                        # key on the RESOLVED path — basenames collide
+                        # across partition directories
+                        pos.setdefault(self.table._resolve(fp),
+                                       []).append((seq, r))
+                else:                              # equality
+                    names = [id_to_name[i] for i in d["equality_ids"]]
+                    eq.append((seq, names, t.select(names)))
+            self._eq_deletes = eq
+            self._pos_deletes = pos
+
+    def read_file(self, path: str) -> pa.Table:
+        import numpy as np
+        self._load_deletes()
+        t = pq.read_table(path)
+        my_seq = self.data_seqs.get(path, 0)
+        # positional deletes target this file at a not-lower sequence
+        drops = [r for seq, r in self._pos_deletes.get(path, [])
+                 if seq >= my_seq]
+        if drops:
+            keep = np.ones(t.num_rows, bool)
+            keep[drops] = False
+            t = t.filter(pa.array(keep))
+        # equality deletes: anti-join, STRICTLY newer than this data file
+        # (a row re-inserted after the delete must survive — v2 scoping)
+        for seq, names, dt in self._eq_deletes:
+            if dt.num_rows == 0 or seq <= my_seq:
+                continue
+            key = set(map(tuple, zip(*[dt.column(n).to_pylist()
+                                       for n in names])))
+            rows = list(zip(*[t.column(n).to_pylist() for n in names]))
+            keep = np.array([r not in key for r in rows], bool) \
+                if rows else np.ones(0, bool)
+            t = t.filter(pa.array(keep))
+        if self.predicate is not None:
+            # filter BEFORE projecting: the predicate may reference
+            # non-projected columns
+            from .parquet import expression_to_arrow_filter
+            filt = expression_to_arrow_filter(self.predicate)
+            if filt is not None:
+                t = t.filter(filt)
+        if self.columns:
+            t = t.select(self.columns)
+        return t
+
+
+def read_iceberg(path, columns=None, predicate=None,
+                 snapshot_id: Optional[int] = None,
+                 as_of_timestamp_ms: Optional[int] = None,
+                 num_slices: int = 1):
+    return IcebergTable(path).to_dataframe(
+        columns=columns, predicate=predicate, snapshot_id=snapshot_id,
+        as_of_timestamp_ms=as_of_timestamp_ms, num_slices=num_slices)

@@ -72,3 +72,12 @@ def read_json(paths, schema=None, num_slices: int = 1, **kw):
     src = JsonSource(paths, schema=schema, **kw)
     return DataFrame(LogicalScan((), source=src, _schema=src.schema(),
                                  num_slices=num_slices))
+
+
+def read_avro(paths, columns=None, predicate=None, num_slices: int = 1,
+              **kw):
+    from ..plan.logical import DataFrame, LogicalScan
+    from .avro import AvroSource
+    src = AvroSource(paths, columns=columns, predicate=predicate, **kw)
+    return DataFrame(LogicalScan((), source=src, _schema=src.schema(),
+                                 num_slices=num_slices))

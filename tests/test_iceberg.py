@@ -1,0 +1,257 @@
+"""Iceberg read tests over a hand-built spec-conformant table: metadata
+JSON, Avro manifest list + manifests (nested records via the generic
+codec), identity partition pruning, positional + equality deletes, and
+snapshot time travel."""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Count, Sum
+from spark_rapids_tpu.io.avro import write_avro_records
+from spark_rapids_tpu.io.iceberg import IcebergTable, read_iceberg
+from spark_rapids_tpu.plan import Session
+
+
+MANIFEST_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "sequence_number", "type": ["null", "long"]},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "data_file", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "record", "name": "part", "fields": [
+                        {"name": "p", "type": ["null", "int"]}]}},
+                {"name": "record_count", "type": "long"},
+                {"name": "equality_ids",
+                 "type": ["null", {"type": "array", "items": "int"}]},
+            ]}},
+    ]}
+
+MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "content", "type": "int"},
+    ]}
+
+
+def build_table(root) -> str:
+    """Two data files partitioned by p (identity), one positional delete,
+    one equality delete, two snapshots (v1: data only, v2: + deletes)."""
+    path = os.path.join(str(root), "ice")
+    os.makedirs(os.path.join(path, "data"))
+    os.makedirs(os.path.join(path, "metadata"))
+
+    d0 = pa.table({"id": pa.array([1, 2, 3], pa.int64()),
+                   "v": pa.array([10, 20, 30], pa.int64()),
+                   "p": pa.array([0, 0, 0], pa.int32())})
+    d1 = pa.table({"id": pa.array([4, 5, 6], pa.int64()),
+                   "v": pa.array([40, 50, 60], pa.int64()),
+                   "p": pa.array([1, 1, 1], pa.int32())})
+    f0 = os.path.join(path, "data", "d0.parquet")
+    f1 = os.path.join(path, "data", "d1.parquet")
+    pq.write_table(d0, f0)
+    pq.write_table(d1, f1)
+
+    # positional delete: drop row 1 of d0 (id=2)
+    pdel = os.path.join(path, "data", "pos-del.parquet")
+    pq.write_table(pa.table({"file_path": pa.array([f0], pa.string()),
+                             "pos": pa.array([1], pa.int64())}), pdel)
+    # equality delete on id: drop id=5
+    edel = os.path.join(path, "data", "eq-del.parquet")
+    pq.write_table(pa.table({"id": pa.array([5], pa.int64())}), edel)
+
+    def entry(fp, part, content=0, eq_ids=None, seq=1):
+        return {"status": 1, "sequence_number": seq, "data_file": {
+            "content": content, "file_path": fp, "file_format": "PARQUET",
+            "partition": {"p": part}, "record_count": 3,
+            "equality_ids": eq_ids}}
+
+    m1 = os.path.join(path, "metadata", "m1.avro")
+    write_avro_records(m1, MANIFEST_SCHEMA,
+                       [entry(f0, 0), entry(f1, 1)], codec="deflate")
+    m2 = os.path.join(path, "metadata", "m2.avro")
+    write_avro_records(m2, MANIFEST_SCHEMA,
+                       [entry(pdel, None, content=1, seq=2),
+                        entry(edel, None, content=2, eq_ids=[1], seq=2)])
+    ml1 = os.path.join(path, "metadata", "snap-1.avro")
+    write_avro_records(ml1, MANIFEST_LIST_SCHEMA,
+                       [{"manifest_path": m1, "content": 0}])
+    ml2 = os.path.join(path, "metadata", "snap-2.avro")
+    write_avro_records(ml2, MANIFEST_LIST_SCHEMA,
+                       [{"manifest_path": m1, "content": 0},
+                        {"manifest_path": m2, "content": 1}])
+
+    meta = {
+        "format-version": 2,
+        "table-uuid": "0000-test",
+        "location": path,
+        "current-schema-id": 0,
+        "schemas": [{"schema-id": 0, "type": "struct", "fields": [
+            {"id": 1, "name": "id", "type": "long", "required": True},
+            {"id": 2, "name": "v", "type": "long", "required": False},
+            {"id": 3, "name": "p", "type": "int", "required": False},
+        ]}],
+        "default-spec-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": [
+            {"name": "p", "transform": "identity", "source-id": 3,
+             "field-id": 1000}]}],
+        "current-snapshot-id": 2,
+        "snapshots": [
+            {"snapshot-id": 1, "timestamp-ms": 1000, "manifest-list": ml1},
+            {"snapshot-id": 2, "timestamp-ms": 2000, "manifest-list": ml2},
+        ],
+    }
+    with open(os.path.join(path, "metadata", "v2.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(path, "metadata", "version-hint.text"), "w") as f:
+        f.write("2")
+    return path
+
+
+def test_current_snapshot_with_deletes(tmp_path):
+    path = build_table(tmp_path)
+    s = Session()
+    out = s.collect(read_iceberg(path))
+    rows = sorted(zip(out.column("id").to_pylist(),
+                      out.column("v").to_pylist()))
+    # id=2 dropped by positional delete, id=5 by equality delete
+    assert rows == [(1, 10), (3, 30), (4, 40), (6, 60)]
+
+
+def test_time_travel(tmp_path):
+    path = build_table(tmp_path)
+    s = Session()
+    old = s.collect(read_iceberg(path, snapshot_id=1))
+    assert sorted(old.column("id").to_pylist()) == [1, 2, 3, 4, 5, 6]
+    ts = s.collect(read_iceberg(path, as_of_timestamp_ms=1500))
+    assert sorted(ts.column("id").to_pylist()) == [1, 2, 3, 4, 5, 6]
+
+
+def test_partition_pruning(tmp_path):
+    path = build_table(tmp_path)
+    t = IcebergTable(path)
+    data, dels = t.plan_files(prune={"p": 1})
+    assert len(data) == 1 and data[0]["file_path"].endswith("d1.parquet")
+    # engine-level: predicate prunes AND filters
+    s = Session()
+    out = s.collect(read_iceberg(
+        path, predicate=(col("p") == lit(np.int32(1)))))
+    assert sorted(out.column("id").to_pylist()) == [4, 6]
+
+
+def test_aggregate_over_iceberg(tmp_path):
+    path = build_table(tmp_path)
+    s = Session()
+    out = s.collect(read_iceberg(path).group_by("p").agg(
+        Sum(col("v")).alias("sv"), Count().alias("c")))
+    assert not s.fell_back()
+    got = sorted(zip(*[c.to_pylist() for c in out.columns]))
+    assert got == [(0, 40, 2), (1, 100, 2)]
+
+
+def test_columns_with_predicate_on_dropped_column(tmp_path):
+    """Predicate references a column that is projected away (review
+    finding: filter must run before select)."""
+    path = build_table(tmp_path)
+    s = Session()
+    out = s.collect(read_iceberg(path, columns=["id"],
+                                 predicate=(col("p") == lit(np.int32(1)))))
+    assert sorted(out.column("id").to_pylist()) == [4, 6]
+
+
+def test_equality_delete_scoped_by_sequence(tmp_path):
+    """A row RE-INSERTED after an equality delete must survive (v2
+    sequence-number scoping — review finding)."""
+    path = build_table(tmp_path)
+    # add a third data file re-inserting id=5 at seq 3 and a new snapshot
+    f2 = os.path.join(path, "data", "d2.parquet")
+    pq.write_table(pa.table({"id": pa.array([5], pa.int64()),
+                             "v": pa.array([555], pa.int64()),
+                             "p": pa.array([1], pa.int32())}), f2)
+    m3 = os.path.join(path, "metadata", "m3.avro")
+    write_avro_records(m3, MANIFEST_SCHEMA, [
+        {"status": 1, "sequence_number": 3, "data_file": {
+            "content": 0, "file_path": f2, "file_format": "PARQUET",
+            "partition": {"p": 1}, "record_count": 1,
+            "equality_ids": None}}])
+    ml3 = os.path.join(path, "metadata", "snap-3.avro")
+    meta_path = os.path.join(path, "metadata", "v2.metadata.json")
+    meta = json.load(open(meta_path))
+    old_manifests = [
+        {"manifest_path": os.path.join(path, "metadata", "m1.avro"),
+         "content": 0},
+        {"manifest_path": os.path.join(path, "metadata", "m2.avro"),
+         "content": 1},
+        {"manifest_path": m3, "content": 0}]
+    write_avro_records(ml3, MANIFEST_LIST_SCHEMA, old_manifests)
+    meta["snapshots"].append(
+        {"snapshot-id": 3, "timestamp-ms": 3000, "manifest-list": ml3})
+    meta["current-snapshot-id"] = 3
+    json.dump(meta, open(meta_path, "w"))
+
+    s = Session()
+    out = s.collect(read_iceberg(path))
+    rows = sorted(zip(out.column("id").to_pylist(),
+                      out.column("v").to_pylist()))
+    # original id=5 (seq 1) deleted by eq-delete (seq 2); re-inserted id=5
+    # (seq 3) survives
+    assert rows == [(1, 10), (3, 30), (4, 40), (5, 555), (6, 60)]
+
+
+def test_positional_delete_keys_on_full_path(tmp_path):
+    """Basename collisions across partition dirs must not cross-delete
+    (review finding)."""
+    path = os.path.join(str(tmp_path), "ice2")
+    os.makedirs(os.path.join(path, "data", "p=0"))
+    os.makedirs(os.path.join(path, "data", "p=1"))
+    os.makedirs(os.path.join(path, "metadata"))
+    f0 = os.path.join(path, "data", "p=0", "part-0.parquet")
+    f1 = os.path.join(path, "data", "p=1", "part-0.parquet")
+    pq.write_table(pa.table({"id": pa.array([1, 2], pa.int64())}), f0)
+    pq.write_table(pa.table({"id": pa.array([3, 4], pa.int64())}), f1)
+    pdel = os.path.join(path, "data", "pos.parquet")
+    pq.write_table(pa.table({"file_path": pa.array([f0]),
+                             "pos": pa.array([1], pa.int64())}), pdel)
+    schema_noeq = MANIFEST_SCHEMA
+    m = os.path.join(path, "metadata", "m.avro")
+    write_avro_records(m, schema_noeq, [
+        {"status": 1, "sequence_number": 1, "data_file": {
+            "content": 0, "file_path": f0, "file_format": "PARQUET",
+            "partition": {"p": None}, "record_count": 2,
+            "equality_ids": None}},
+        {"status": 1, "sequence_number": 1, "data_file": {
+            "content": 0, "file_path": f1, "file_format": "PARQUET",
+            "partition": {"p": None}, "record_count": 2,
+            "equality_ids": None}},
+        {"status": 1, "sequence_number": 2, "data_file": {
+            "content": 1, "file_path": pdel, "file_format": "PARQUET",
+            "partition": {"p": None}, "record_count": 1,
+            "equality_ids": None}}])
+    ml = os.path.join(path, "metadata", "snap.avro")
+    write_avro_records(ml, MANIFEST_LIST_SCHEMA,
+                       [{"manifest_path": m, "content": 0}])
+    meta = {"format-version": 2, "current-schema-id": 0,
+            "schemas": [{"schema-id": 0, "type": "struct", "fields": [
+                {"id": 1, "name": "id", "type": "long",
+                 "required": True}]}],
+            "default-spec-id": 0, "partition-specs": [],
+            "current-snapshot-id": 1,
+            "snapshots": [{"snapshot-id": 1, "timestamp-ms": 1,
+                           "manifest-list": ml}]}
+    json.dump(meta, open(os.path.join(path, "metadata",
+                                      "v1.metadata.json"), "w"))
+    open(os.path.join(path, "metadata", "version-hint.text"),
+         "w").write("1")
+    s = Session()
+    out = s.collect(read_iceberg(path))
+    # row 1 of p=0's file dropped; p=1's same-named file untouched
+    assert sorted(out.column("id").to_pylist()) == [1, 3, 4]
