@@ -32,9 +32,22 @@ class Metric:
     name: str
     level: int = MODERATE
     value: int = 0
+    _lazy: list = field(default_factory=list)
 
     def add(self, v) -> None:
         self.value += int(v)
+
+    def add_lazy(self, device_scalar) -> None:
+        """Accumulate a traced/device scalar WITHOUT forcing a sync; it is
+        resolved when the metric is read (reference: GPU-side metric
+        accumulation flushed at task end)."""
+        self._lazy.append(device_scalar)
+
+    def total(self) -> int:
+        if self._lazy:
+            self.value += sum(int(x) for x in self._lazy)
+            self._lazy.clear()
+        return self.value
 
 
 class Exec:
@@ -75,14 +88,41 @@ class Exec:
         yield from self.do_execute()
 
     def execute(self) -> Iterator[ColumnarBatch]:
-        for batch in self.do_execute():
-            self.metrics["numOutputBatches"].add(1)
-            yield batch
+        for p in range(self.num_partitions):
+            yield from self.execute_partition(p)
 
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        for batch in self.do_execute_partition(p):
+        """Iterate one partition, maintaining the op's metrics: batch and
+        row counts plus opTime (ns spent INSIDE this operator's iterator,
+        including its children — the reference's NS_TIMING convention)."""
+        it = self.do_execute_partition(p)
+        while True:
+            t0 = time.perf_counter_ns()
+            try:
+                batch = next(it)
+            except StopIteration:
+                self.metrics["opTime"].add(time.perf_counter_ns() - t0)
+                return
+            self.metrics["opTime"].add(time.perf_counter_ns() - t0)
             self.metrics["numOutputBatches"].add(1)
+            self.metrics["numOutputRows"].add_lazy(batch.num_rows)
             yield batch
+
+    def collect_metrics(self, max_level: int = DEBUG) -> Dict[str, int]:
+        """Aggregate this subtree's metrics up to a level (the
+        SQLMetrics→driver roll-up; level filter = metricsLevel conf)."""
+        out: Dict[str, int] = {}
+
+        def walk(e: "Exec"):
+            for name, m in e.metrics.items():
+                v = m.total()
+                if m.level <= max_level and v:
+                    out[f"{e.name}.{name}"] = \
+                        out.get(f"{e.name}.{name}", 0) + v
+            for c in e.children:
+                walk(c)
+        walk(self)
+        return out
 
     def close(self) -> None:
         """Release catalog-registered resources after the query finishes

@@ -461,6 +461,26 @@ class Percentile(AggregateFunction):
                             T.FLOAT64)
 
 
+class ApproxPercentile(Percentile):
+    """approx_percentile(col, q[, accuracy]): answered EXACTLY.
+
+    The reference builds t-digest sketches (GpuApproximatePercentile.scala)
+    because a cudf hash aggregate cannot afford a global sort; the TPU
+    aggregate already runs on fully sorted segments, so the exact quantile
+    is one gather — and an exact answer satisfies any accuracy contract.
+    The accuracy argument is accepted and ignored."""
+
+    def __init__(self, child=None, percentage: float = 0.5,
+                 accuracy: int = 10000):
+        object.__setattr__(self, "child", child)
+        object.__setattr__(self, "percentage", percentage)
+        object.__setattr__(self, "accuracy", accuracy)
+
+    def with_children(self, c):
+        return ApproxPercentile(c[0] if c else None, self.percentage,
+                                self.accuracy)
+
+
 @dataclass(frozen=True, eq=False)
 class CollectList(AggregateFunction):
     """collect_list(x): nulls skipped (Spark), elements in value-sorted

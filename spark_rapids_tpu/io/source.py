@@ -85,12 +85,16 @@ class FileSource:
 
     format_name = "file"
 
+    #: synthetic column name for Spark's input_file_name() expression
+    FILE_NAME_COL = "_input_file_name"
+
     def __init__(self, paths, schema: Optional[Schema] = None,
                  columns: Optional[List[str]] = None,
                  predicate: Optional[Expression] = None,
                  reader_type: ReaderType = ReaderType.AUTO,
                  batch_rows: int = 1 << 20,
-                 num_threads: int = 8):
+                 num_threads: int = 8,
+                 with_file_name: bool = False):
         self.files = expand_paths(paths)
         if not self.files:
             raise FileNotFoundError(f"no files match {paths}")
@@ -99,7 +103,17 @@ class FileSource:
         self.reader_type = reader_type
         self.batch_rows = batch_rows
         self.num_threads = num_threads
+        self.with_file_name = with_file_name
         self._schema = schema
+
+    def _decorate(self, t: pa.Table, path: str) -> pa.Table:
+        """Attach the source path column (input_file_name() parity —
+        reference: GpuInputFileName resolved from the task's split)."""
+        if self.with_file_name:
+            t = t.append_column(
+                self.FILE_NAME_COL,
+                pa.array([path] * t.num_rows, pa.string()))
+        return t
 
     def estimated_bytes(self) -> Optional[int]:
         """On-disk size (planner build-side selection input)."""
@@ -122,6 +136,18 @@ class FileSource:
             s = self.infer_arrow_schema()
             if self.columns:
                 s = pa.schema([s.field(c) for c in self.columns])
+            if self.with_file_name:
+                # widen ONLY the synthetic path column, not every string
+                from .. import types as T
+                from ..batch import Field
+                ml = max((len(f.encode()) for f in self.files), default=64)
+                base = schema_from_arrow(s)
+                from ..batch import Schema as _Schema
+                self._schema = _Schema(
+                    list(base.fields) +
+                    [Field(self.FILE_NAME_COL, T.string(max(ml, 64)),
+                           False)])
+                return self._schema
             self._schema = schema_from_arrow(s)
         return self._schema
 
@@ -134,7 +160,8 @@ class FileSource:
             else ReaderType.COALESCING
 
     def read_all(self) -> pa.Table:
-        tables = [self.read_file(f) for f in self.files]
+        tables = [self._decorate(self.read_file(f), f)
+                  for f in self.files]
         return pa.concat_tables(tables) if tables else None
 
     def read_split(self, files: Sequence[str]) -> Iterator[pa.Table]:
@@ -142,11 +169,11 @@ class FileSource:
         mode = self.effective_reader()
         if mode is ReaderType.PERFILE:
             for f in files:
-                yield self.read_file(f)
+                yield self._decorate(self.read_file(f), f)
         elif mode is ReaderType.COALESCING:
             # decode all files of the split, concat, re-chunk to batch_rows
             # (reference: coalescing reader assembles row groups before H2D)
-            tabs = [self.read_file(f) for f in files]
+            tabs = [self._decorate(self.read_file(f), f) for f in files]
             if not tabs:
                 return
             t = pa.concat_tables(tabs)
@@ -156,9 +183,9 @@ class FileSource:
                     break
         else:  # MULTITHREADED: pipelined background decode
             pool = reader_pool(self.num_threads)
-            futures = [pool.submit(self.read_file, f) for f in files]
-            for fut in futures:
-                t = fut.result()
+            futures = [(f, pool.submit(self.read_file, f)) for f in files]
+            for f, fut in futures:
+                t = self._decorate(fut.result(), f)
                 for off in range(0, max(t.num_rows, 1), self.batch_rows):
                     yield t.slice(off, self.batch_rows)
                     if t.num_rows == 0:

@@ -1101,7 +1101,7 @@ class Interpreter:
                         out.append(x)
                 xs = out
             return xs
-        if name == "Percentile":
+        if name in ("Percentile", "ApproxPercentile"):
             xs = sorted(nn)
             if not xs:
                 return None

@@ -130,6 +130,8 @@ def _expr_rules() -> Dict[str, ExprRule]:
         r(n, TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP)
     r("Sum", TS.NUMERIC + TS.DECIMAL_128, incompat=False)
     r("Percentile", TS.NUMERIC + TS.DATETIME)
+    r("ApproxPercentile", TS.NUMERIC + TS.DATETIME,
+      note="answered exactly; sorted segments make exact as cheap as the sketch")
     for n in ("CollectList", "CollectSet"):
         r(n, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
     r("Average", TS.NUMERIC,

@@ -56,6 +56,8 @@ class Session:
                 plan = lowered
                 self.last_plan = plan
         from ..exec.base import collect as collect_exec
+        from ..exec.python_exec import _python_semaphore
+        self._sem_wait0 = _python_semaphore.wait_time_ns
         try:
             return collect_exec(plan)
         finally:
@@ -81,22 +83,40 @@ class Session:
         return DataFrame(LogicalScan((), source=cached,
                                      _schema=cached.schema))
 
+    def write(self, df: DataFrame, path: str, format: str = "parquet",
+              partition_by=None, bucket_by=None, compression="snappy",
+              header: bool = True):
+        """Execute and write TASK-BY-TASK — each plan partition streams
+        its batches into its own part files; no driver-side collect
+        (reference: GpuInsertIntoHadoopFsRelationCommand +
+        GpuFileFormatDataWriter). ``bucket_by=(cols, n)`` routes rows with
+        the shuffle's bit-exact murmur3-pmod. Returns WriteStats."""
+        from ..io.writer import write_plan
+        plan = self._physical_plan(df)
+        return write_plan(plan, path, fmt=format,
+                          compression=compression,
+                          partition_by=partition_by or (),
+                          bucket_by=bucket_by, header=header)
+
+    def _physical_plan(self, df: DataFrame):
+        if not self.conf.sql_enabled:
+            from ..exec import InMemoryScanExec
+            return InMemoryScanExec(
+                Interpreter(ansi=self.conf.ansi).execute(df.plan))
+        plan = Overrides(self.conf).plan(df.plan)
+        self.last_plan = plan
+        return plan
+
     def write_parquet(self, df: DataFrame, path: str,
-                      partition_by=None, **kw) -> None:
-        """Execute and write (reference: GpuParquetFileFormat via
-        GpuInsertIntoHadoopFsRelationCommand)."""
-        from ..io.parquet import write_parquet
-        write_parquet(self.collect(df), path, partition_by=partition_by,
-                      **kw)
+                      partition_by=None, **kw):
+        return self.write(df, path, "parquet",
+                          partition_by=partition_by, **kw)
 
-    def write_csv(self, df: DataFrame, path: str, header: bool = True
-                  ) -> None:
-        from ..io.csv import write_csv
-        write_csv(self.collect(df), path, header=header)
+    def write_csv(self, df: DataFrame, path: str, **kw):
+        return self.write(df, path, "csv", **kw)
 
-    def write_orc(self, df: DataFrame, path: str) -> None:
-        from ..io.orc import write_orc
-        write_orc(self.collect(df), path)
+    def write_orc(self, df: DataFrame, path: str, **kw):
+        return self.write(df, path, "orc", **kw)
 
     def write_delta(self, df: DataFrame, path: str, mode: str = "append",
                     **kw):
@@ -108,6 +128,27 @@ class Session:
         return Overrides(self.conf).explain(df.plan, mode)
 
     # ---- plan capture assertions (test support) ----
+    def metrics(self) -> dict:
+        """Aggregated operator metrics of the last executed plan, filtered
+        by spark.rapids.tpu.sql.metrics.level (reference: the SQLMetrics
+        the plugin posts to the Spark UI)."""
+        if self.last_plan is None:
+            return {}
+        from ..config import METRICS_LEVEL
+        from ..exec.base import DEBUG, ESSENTIAL, MODERATE
+        level = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE,
+                 "DEBUG": DEBUG}.get(
+            str(self.conf.get(METRICS_LEVEL.key)).upper(), MODERATE)
+        out = self.last_plan.collect_metrics(level)
+        from ..exec.python_exec import _python_semaphore
+        # delta since this session's last collect — the semaphore counter
+        # is process-global
+        wait = _python_semaphore.wait_time_ns - \
+            getattr(self, "_sem_wait0", _python_semaphore.wait_time_ns)
+        if wait > 0:
+            out["python.semaphoreWaitTime"] = wait
+        return out
+
     def executed_exec_names(self) -> List[str]:
         names = []
 

@@ -164,6 +164,8 @@ class ShuffleExchangeExec(UnaryExec):
             # pieces go straight into the catalog
             stream = (b for cp in range(self.child.num_partitions)
                       for b in self.child.execute_partition(cp))
+        cat = self._cat()
+        spill0 = cat.spilled_to_host + cat.spilled_to_disk
         for batch in stream:
             if n == 1:
                 self._register(out, 0, batch)
@@ -171,6 +173,10 @@ class ShuffleExchangeExec(UnaryExec):
             pids = self._pids_jit(batch)
             for p in range(n):
                 self._register(out, p, self._slice_jit(batch, pids, p))
+        from ..exec.base import DEBUG, Metric
+        self.metrics.setdefault(
+            "spillBytes", Metric("spillBytes", DEBUG)).add(
+            cat.spilled_to_host + cat.spilled_to_disk - spill0)
         self._materialized = out
         return out
 

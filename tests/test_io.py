@@ -162,3 +162,20 @@ def test_hive_text_scan(tmp_path):
                      Field("d", T.FLOAT64)])
     got = rows_of(Session().collect(read_hive_text(path, schema)))
     assert got == [(1, "alpha", 2.5), (2, None, 3.5), (3, "gamma", None)]
+
+
+def test_input_file_name_column(tmp_path):
+    """input_file_name() parity: scans can attach the source path column
+    (reference: GpuInputFileName / InputFileBlockRule)."""
+    import pyarrow.parquet as pq
+    t1 = pa.table({"x": pa.array([1, 2], pa.int64())})
+    t2 = pa.table({"x": pa.array([3], pa.int64())})
+    p1, p2 = str(tmp_path / "a.parquet"), str(tmp_path / "b.parquet")
+    pq.write_table(t1, p1)
+    pq.write_table(t2, p2)
+    from spark_rapids_tpu.plan import Session
+    s = Session()
+    out = s.collect(read_parquet([p1, p2], with_file_name=True))
+    got = sorted(zip(out.column("x").to_pylist(),
+                     out.column("_input_file_name").to_pylist()))
+    assert got == [(1, p1), (2, p1), (3, p2)]

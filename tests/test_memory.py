@@ -138,3 +138,26 @@ def test_semaphore_reentrant():
     sem.release_if_held()
     sem.acquire_if_necessary()   # fully released: can re-acquire
     sem.release_if_held()
+
+
+def test_metrics_collection():
+    """Operator metrics: counts + timing, level-filtered (reference:
+    GpuExec metric levels / GpuWriteJobStatsTracker)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.aggregates import Count
+    from spark_rapids_tpu.plan import Session, table
+    t = pa.table({"k": pa.array([1, 2, 3, 4] * 50),
+                  "v": pa.array(range(200))})
+    s = Session()
+    s.collect(table(t).where(col("v") > lit(10)).group_by("k")
+              .agg(Count().alias("c")))
+    m = s.metrics()
+    assert m.get("FilterExec.numOutputRows") == 189, m
+    assert any(k.endswith("opTime") for k in m), m
+    # ESSENTIAL level hides opTime (MODERATE)
+    s2 = Session({"spark.rapids.tpu.sql.metrics.level": "ESSENTIAL"})
+    s2.collect(table(t).where(col("v") > lit(10)))
+    m2 = s2.metrics()
+    assert not any(k.endswith("opTime") for k in m2), m2
+    assert any(k.endswith("numOutputRows") for k in m2), m2

@@ -32,3 +32,21 @@ def test_percentile_alongside_decomposable_aggs():
         lambda: table(PT, num_slices=2).group_by("k")
         .agg(Percentile(col("v"), 0.5).alias("med"),
              Sum(col("v")).alias("s"), Count().alias("n")))
+
+
+def test_approx_percentile_exact_answers():
+    """approx_percentile is answered EXACTLY on the sorted-segment layout
+    (an exact answer satisfies any accuracy contract)."""
+    from spark_rapids_tpu.expressions.aggregates import ApproxPercentile
+    from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+    import numpy as np
+    import pyarrow as pa
+    rng = np.random.default_rng(8)
+    t = pa.table({"k": rng.integers(0, 4, 300).astype(np.int32),
+                  "v": rng.integers(-50, 50, 300).astype(np.int64)})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).group_by("k").agg(
+            ApproxPercentile(col("v"), 0.5, 1000).alias("med")))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).group_by("k").agg(
+            ApproxPercentile(col("v"), 0.95).alias("p95")))

@@ -53,3 +53,73 @@ def test_map_in_batch():
     got = rows_of(collect(plan))
     exp = [(a, a // 2) for a in t.column("a").to_pylist() if a % 2 == 0]
     assert_rows_equal(got, exp)
+
+
+def test_aggregate_in_pandas():
+    from spark_rapids_tpu.exec.python_exec import AggregateInPandasExec
+    t = pa.table({"k": pa.array([0, 1, 0, 1, 2], pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0])})
+    plan = AggregateInPandasExec(
+        ["k"], lambda v: float(v.mean()), ["v"],
+        [Field("avg_v", T.FLOAT64, True)],
+        InMemoryScanExec(t, batch_rows=2))
+    out = collect(plan)
+    got = dict(zip(out.column("k").to_pylist(),
+                   out.column("avg_v").to_pylist()))
+    assert got == {0: 2.0, 1: 3.0, 2: 5.0}
+
+
+def test_flat_map_groups_in_pandas():
+    from spark_rapids_tpu.exec.python_exec import FlatMapGroupsInPandasExec
+    t = pa.table({"k": pa.array([0, 1, 0], pa.int64()),
+                  "v": pa.array([1, 2, 3], pa.int64())})
+    schema = Schema([Field("k", T.INT64, False),
+                     Field("total", T.INT64, False)])
+
+    def f(df):
+        import pandas as pd
+        return pd.DataFrame({"k": [df["k"].iloc[0]],
+                             "total": [df["v"].sum()]})
+
+    out = collect(FlatMapGroupsInPandasExec(["k"], f, schema,
+                                            InMemoryScanExec(t)))
+    got = dict(zip(out.column("k").to_pylist(),
+                   out.column("total").to_pylist()))
+    assert got == {0: 4, 1: 2}
+
+
+def test_cogroup_in_pandas():
+    from spark_rapids_tpu.exec.python_exec import CoGroupInPandasExec
+    left = pa.table({"k": pa.array([0, 1], pa.int64()),
+                     "a": pa.array([10, 20], pa.int64())})
+    right = pa.table({"q": pa.array([1, 2], pa.int64()),
+                      "b": pa.array([200, 300], pa.int64())})
+    schema = Schema([Field("k", T.INT64, False),
+                     Field("n_left", T.INT64, False),
+                     Field("n_right", T.INT64, False)])
+
+    def f(l, r):
+        import pandas as pd
+        key = l["k"].iloc[0] if len(l) else r["q"].iloc[0]
+        return pd.DataFrame({"k": [key], "n_left": [len(l)],
+                             "n_right": [len(r)]})
+
+    out = collect(CoGroupInPandasExec(
+        ["k"], ["q"], f, schema,
+        InMemoryScanExec(left), InMemoryScanExec(right)))
+    rows = sorted(zip(*[c.to_pylist() for c in out.columns]))
+    assert rows == [(0, 1, 0), (1, 1, 1), (2, 0, 1)]
+
+
+def test_window_in_pandas():
+    from spark_rapids_tpu.exec.python_exec import WindowInPandasExec
+    t = pa.table({"k": pa.array([0, 1, 0, 1], pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0])})
+    plan = WindowInPandasExec(
+        ["k"], lambda v: v - v.mean(), ["v"],
+        [Field("centered", T.FLOAT64, True)],
+        InMemoryScanExec(t, batch_rows=2))
+    out = collect(plan)
+    # original row order preserved; per-group mean subtracted
+    assert out.column("centered").to_pylist() == [-1.0, -1.0, 1.0, 1.0]
+    assert out.column("v").to_pylist() == [1.0, 2.0, 3.0, 4.0]
