@@ -176,7 +176,14 @@ def orderable_words(col: DeviceColumn) -> List[jax.Array]:
     if k in (TypeKind.FLOAT32,):
         return [_float_orderable(data, jnp.zeros((), jnp.uint32))]
     if k in (TypeKind.FLOAT64,):
-        return [_float_orderable(data, jnp.zeros((), jnp.uint64))]
+        # NO f64→u64 bitcast: TPU emulates f64 (f32 pairs) and XLA's x64
+        # rewriter cannot lower 64-bit bitcast_convert. Sort on a native
+        # float operand instead, with a leading nan-flag word so NaN ranks
+        # greatest (Spark total order). sort_operands negates float words
+        # for descending order (bitwise NOT is uint-only).
+        nan = jnp.isnan(data)
+        return [nan.astype(jnp.uint8),
+                jnp.where(nan, jnp.zeros((), data.dtype), data)]
     # integral / date / timestamp / decimal: flip the sign bit
     u = data.astype({1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32,
                      8: jnp.uint64}[data.dtype.itemsize])
@@ -197,7 +204,12 @@ def sort_operands(cols: Sequence[DeviceColumn], descending: Sequence[bool],
                               jnp.uint8(0) if nf else jnp.uint8(2))
         ops.append(jnp.where(live, null_rank, jnp.uint8(3)))
         for w in orderable_words(col):
-            ops.append(~w if desc else w)
+            if not desc:
+                ops.append(w)
+            elif jnp.issubdtype(w.dtype, jnp.floating):
+                ops.append(-w)      # float words flip by negation
+            else:
+                ops.append(~w)
     return ops
 
 

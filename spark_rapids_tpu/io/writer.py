@@ -149,20 +149,44 @@ class ColumnarWriteTask:
         if not self.partition_by and buckets is None:
             self._writer((), None, out_table.schema).write(out_table)
             return
-        # split host-side by (partition values, bucket id)
-        if self.partition_by:
-            pcols = [table.column(c).to_pylist()
-                     for c in self.partition_by]
-        else:
-            pcols = []
-        keys: Dict[Tuple, List[int]] = {}
-        for i in range(table.num_rows):
-            pk = tuple(pc[i] for pc in pcols)
-            bk = int(buckets[i]) if buckets is not None else None
-            keys.setdefault((pk, bk), []).append(i)
-        for (pk, bk), idxs in keys.items():
+        # split by (partition values, bucket id) with vectorized key
+        # codes — a per-row Python loop would serialize the write path
+        codes = np.zeros(table.num_rows, np.int64)
+        uniques: List[np.ndarray] = []
+        for c in self.partition_by:
+            vals = np.asarray(table.column(c).to_pandas())
+            u, inv = np.unique(vals, return_inverse=True)
+            codes = codes * (len(u) + 1) + inv
+            uniques.append(u)
+        if buckets is not None:
+            codes = codes * (int(buckets.max(initial=0)) + 2) + buckets
+        order = np.argsort(codes, kind="stable")
+        sorted_codes = codes[order]
+        starts = np.flatnonzero(
+            np.r_[True, sorted_codes[1:] != sorted_codes[:-1]])
+        bounds = np.r_[starts, len(sorted_codes)]
+        pcols = [table.column(c).to_pylist() for c in self.partition_by]
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            idxs = order[a:b]
+            i0 = int(idxs[0])
+            pk = tuple(pc[i0] for pc in pcols)
+            bk = int(buckets[i0]) if buckets is not None else None
             piece = out_table.take(pa.array(idxs, pa.int64()))
             self._writer(pk, bk, piece.schema).write(piece)
+
+    def abort(self) -> None:
+        """Close and delete this task's partial outputs after a failure
+        (footer-less files would poison readers of the directory)."""
+        for w in self._writers.values():
+            try:
+                w.close()
+            except Exception:
+                pass
+            try:
+                os.remove(w.path)
+            except OSError:
+                pass
+        self._writers.clear()
 
     def close(self, stats: WriteStats) -> None:
         for (pk, _), w in self._writers.items():
@@ -186,6 +210,7 @@ def write_plan(plan, path: str, fmt: str = "parquet",
     stats = WriteStats()
     schema = plan.output_schema
     os.makedirs(path, exist_ok=True)
+    task = None
     try:
         for p in range(plan.num_partitions):
             task = ColumnarWriteTask(p, path, fmt, compression, schema,
@@ -193,6 +218,9 @@ def write_plan(plan, path: str, fmt: str = "parquet",
             for batch in plan.execute_partition(p):
                 task.write_batch(batch)
             task.close(stats)
+            task = None
     finally:
+        if task is not None:        # a batch raised mid-task: close the
+            task.abort()            # open writers, drop partial files
         plan.close()
     return stats

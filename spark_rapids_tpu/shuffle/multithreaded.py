@@ -55,7 +55,8 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
                  shuffle_dir: Optional[str] = None,
                  num_threads: int = 8,
                  max_bytes_in_flight: int = 512 << 20,
-                 ctx: Optional[EvalContext] = None):
+                 ctx: Optional[EvalContext] = None,
+                 transport=None):
         super().__init__(child, ctx)
         self.partitioning = partitioning.bind(child.output_schema)
         self.shuffle_dir = shuffle_dir or os.path.join(
@@ -64,7 +65,18 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         self.limiter = BytesInFlightLimiter(max_bytes_in_flight)
         self._written = False
         self._write_lock = threading.Lock()
-        self._files: List[List[str]] = []
+        # blocks ride a pluggable transport (reference:
+        # RapidsShuffleTransport); default = shared-filesystem blocks
+        if transport is None:
+            from .transport import LocalFsTransport
+            transport = LocalFsTransport(self.shuffle_dir)
+            self._owns_transport = True
+        else:
+            self._owns_transport = False
+        self.transport = transport
+        # random 63-bit id: per-process counters COLLIDE when two
+        # processes share one transport root (cross-process mode)
+        self.shuffle_id = uuid.uuid4().int & ((1 << 63) - 1)
 
         def slice_kernel(batch, pids, p: int):
             return compact(batch, pids == p)
@@ -89,10 +101,8 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         with self._write_lock:
             if self._written:
                 return
-            os.makedirs(self.shuffle_dir, exist_ok=True)
             n = self.num_partitions
             schema = self.output_schema
-            self._files = [[] for _ in range(n)]
             pool = cf.ThreadPoolExecutor(self.num_threads,
                                          thread_name_prefix="shuffle-write")
             futures = []
@@ -104,11 +114,8 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
                         piece = self._slice_jit(batch, pids, p)
                         if int(piece.num_rows) == 0:
                             continue
-                        path = os.path.join(self.shuffle_dir,
-                                            f"m{seq}-r{p}.rtpu")
-                        self._files[p].append(path)
                         futures.append(pool.submit(
-                            self._write_piece, piece, schema, path))
+                            self._write_piece, piece, schema, seq, p))
                         seq += 1
             for f in futures:
                 f.result()
@@ -116,12 +123,12 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
             self._written = True
 
     def _write_piece(self, piece: ColumnarBatch, schema: Schema,
-                     path: str) -> None:
+                     map_id: int, reduce_id: int) -> None:
         data = serialize_batch(piece, schema)   # D2H + frame + compress
         self.limiter.acquire(len(data))
         try:
-            with open(path, "wb") as f:
-                f.write(data)
+            self.transport.publish(self.shuffle_id, map_id, reduce_id,
+                                   data)
         finally:
             self.limiter.release(len(data))
 
@@ -131,13 +138,14 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         self._write_all()
-        paths = self._files[p]
-        if not paths:
+        blocks = self.transport.list_blocks(self.shuffle_id, p)
+        if not blocks:
             return
         schema = self.output_schema
         pool = cf.ThreadPoolExecutor(self.num_threads,
                                      thread_name_prefix="shuffle-read")
-        futures = [pool.submit(self._read_piece, path) for path in paths]
+        futures = [pool.submit(self.transport.fetch, s, m, r)
+                   for s, m, r in blocks]
         batches = [deserialize_batch(f.result(), schema) for f in futures]
         pool.shutdown()
         total = sum(int(b.num_rows) for b in batches)
@@ -148,10 +156,12 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         else:
             yield concat_batches(batches, bucket_capacity(total))
 
-    def _read_piece(self, path: str) -> bytes:
-        with open(path, "rb") as f:
-            return f.read()
-
     def cleanup(self) -> None:
-        import shutil
-        shutil.rmtree(self.shuffle_dir, ignore_errors=True)
+        # always drop this shuffle's blocks; close the transport only if
+        # this exec created it (an injected transport may serve peers)
+        self.transport.remove_shuffle(self.shuffle_id)
+        if self._owns_transport:
+            self.transport.close()
+
+    def do_close(self) -> None:
+        self.cleanup()

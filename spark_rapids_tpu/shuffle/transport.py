@@ -1,0 +1,289 @@
+"""Pluggable cross-process shuffle transport.
+
+Reference: shuffle-plugin/.../RapidsShuffleTransport.scala:303 — the
+trait behind the UCX shuffle: a SERVER publishing this executor's shuffle
+blocks, CLIENTS fetching peers' blocks as framed TRANSACTIONS, and a
+registry mapping (shuffle, map, reduce) to buffers. The reference tests
+the protocol against mocked peers (RapidsShuffleTestHelper.scala); the
+same strategy applies here.
+
+TPU context: INSIDE one process the ICI mesh moves shuffle data as one
+XLA all_to_all — no transport needed. The transport exists for the
+CROSS-PROCESS tier (multi-host DCN without jax.distributed, spill-backed
+elastic shuffles). Two implementations of one interface:
+
+- LocalFsTransport — shared-filesystem blocks (the multithreaded shuffle
+  mode's storage, behind the trait so it is swappable),
+- TcpTransport — a length-prefixed binary protocol over sockets:
+  HELLO version handshake, FETCH(shuffle, map, reduce) → OK payload /
+  MISSING / ERROR, connection-per-request clients with retry.
+
+Every payload is the framed serializer format (serializer.py), so blocks
+are compressed once on publish and device-decoded once on fetch.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_MAGIC = b"RTPU"
+_VERSION = 1
+
+# ops
+_HELLO, _FETCH, _OK, _MISSING, _ERROR, _LIST = 1, 2, 3, 4, 5, 6
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+class BlockId(Tuple):
+    """(shuffle_id, map_id, reduce_id)"""
+
+
+class ShuffleTransport:
+    """The RapidsShuffleTransport role: publish local blocks, fetch any
+    block (local or remote)."""
+
+    def publish(self, shuffle_id: int, map_id: int, reduce_id: int,
+                payload: bytes) -> None:
+        raise NotImplementedError
+
+    def fetch(self, shuffle_id: int, map_id: int, reduce_id: int) -> bytes:
+        raise NotImplementedError
+
+    def list_blocks(self, shuffle_id: int, reduce_id: int
+                    ) -> List[Tuple[int, int, int]]:
+        """All published (shuffle, map, reduce) blocks for a reducer,
+        including remote peers' blocks."""
+        raise NotImplementedError
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        """Drop every local block of one shuffle (end-of-query cleanup)."""
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class LocalFsTransport(ShuffleTransport):
+    """Shared-directory blocks (works across processes on one host or any
+    shared filesystem — the reference's fallback shuffle storage)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, s: int, m: int, r: int) -> str:
+        return os.path.join(self.root, f"s{s}-m{m}-r{r}.rtpu")
+
+    def publish(self, s: int, m: int, r: int, payload: bytes) -> None:
+        tmp = self._path(s, m, r) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, self._path(s, m, r))    # atomic publish
+
+    def fetch(self, s: int, m: int, r: int) -> bytes:
+        try:
+            with open(self._path(s, m, r), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise TransportError(f"missing block s{s}-m{m}-r{r}")
+
+    def list_blocks(self, s: int, r: int):
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith(f"s{s}-") and name.endswith(f"-r{r}.rtpu"):
+                m = int(name.split("-")[1][1:])
+                out.append((s, m, r))
+        return sorted(out)
+
+    def remove_shuffle(self, s: int) -> None:
+        for name in os.listdir(self.root):
+            if name.startswith(f"s{s}-"):
+                try:
+                    os.remove(os.path.join(self.root, name))
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        import shutil
+        shutil.rmtree(self.root, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# TCP transport
+# ---------------------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, op: int, payload: bytes) -> None:
+    sock.sendall(_MAGIC + struct.pack("<BI", op, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    head = _recv_exact(sock, 9)
+    if head[:4] != _MAGIC:
+        raise TransportError("bad magic")
+    op, ln = struct.unpack("<BI", head[4:])
+    return op, _recv_exact(sock, ln)
+
+
+class _BlockServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        store: "TcpTransport" = self.server.transport   # type: ignore
+        try:
+            op, payload = _recv_frame(self.request)
+            if op != _HELLO or struct.unpack("<I", payload)[0] != _VERSION:
+                _send_frame(self.request, _ERROR, b"version mismatch")
+                return
+            _send_frame(self.request, _HELLO, struct.pack("<I", _VERSION))
+            while True:
+                op, payload = _recv_frame(self.request)
+                if op == _LIST:
+                    s, r = struct.unpack("<qq", payload)
+                    maps = [m for (_, m, _) in
+                            store.local_blocks(s, r)]
+                    _send_frame(self.request, _OK,
+                                struct.pack(f"<{len(maps)}q", *maps))
+                    continue
+                if op != _FETCH:
+                    _send_frame(self.request, _ERROR, b"bad op")
+                    return
+                s, m, r = struct.unpack("<qqq", payload)
+                blk = store._local.get((s, m, r))
+                if blk is None:
+                    _send_frame(self.request, _MISSING, b"")
+                else:
+                    _send_frame(self.request, _OK, blk)
+        except (TransportError, ConnectionError, OSError):
+            return
+
+
+class TcpTransport(ShuffleTransport):
+    """Framed TCP block server + fetch clients.
+
+    Transactions mirror the reference's request/response shape
+    (RapidsShuffleTransport's Transaction + BlockIds): one HELLO
+    handshake per connection, then FETCH transactions. ``peers`` maps
+    executor id → (host, port); blocks published locally are served to
+    any peer."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 peers: Optional[Dict[int, Tuple[str, int]]] = None,
+                 retries: int = 3):
+        self._local: Dict[Tuple[int, int, int], bytes] = {}
+        self._index: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
+        self.peers = dict(peers or {})
+        self.retries = retries
+        self._server = _BlockServer((host, port), _Handler)
+        self._server.transport = self       # type: ignore
+        self.address = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._lock = threading.Lock()
+
+    # ---- local publication ----
+    def publish(self, s: int, m: int, r: int, payload: bytes) -> None:
+        with self._lock:
+            self._local[(s, m, r)] = payload
+            self._index.setdefault((s, r), []).append((s, m, r))
+
+    def local_blocks(self, s: int, r: int):
+        with self._lock:
+            return sorted(self._index.get((s, r), []))
+
+    def list_blocks(self, s: int, r: int):
+        """Local blocks UNION every reachable peer's blocks (the shuffle
+        reader must see remote map outputs); unreachable peers raise —
+        a silent partial listing would silently drop their rows."""
+        out = set(self.local_blocks(s, r))
+        for peer_id, addr in self.peers.items():
+            maps = self._retrying(addr, self._list_from, s, r)
+            out.update((s, m, r) for m in maps)
+        return sorted(out)
+
+    def remove_shuffle(self, s: int) -> None:
+        with self._lock:
+            for key in [k for k in self._local if k[0] == s]:
+                del self._local[key]
+            for key in [k for k in self._index if k[0] == s]:
+                del self._index[key]
+
+    def _retrying(self, addr, fn, *args):
+        last: Optional[Exception] = None
+        for _ in range(self.retries):
+            try:
+                return fn(addr, *args)
+            except (TransportError, ConnectionError, OSError) as ex:
+                last = ex
+                if isinstance(ex, TransportError) and \
+                        "missing" in str(ex):
+                    raise
+        raise TransportError(f"peer {addr} unreachable: {last}")
+
+    # ---- fetch (local fast path, else ask each peer) ----
+    def fetch(self, s: int, m: int, r: int) -> bytes:
+        blk = self._local.get((s, m, r))
+        if blk is not None:
+            return blk
+        last: Optional[Exception] = None
+        for peer_id, addr in self.peers.items():
+            try:
+                return self._retrying(addr, self._fetch_from, s, m, r)
+            except TransportError as ex:
+                # missing on this peer or peer dead: try the next peer
+                last = ex
+        raise TransportError(f"block s{s}-m{m}-r{r} not found on any peer"
+                             + (f" (last: {last})" if last else ""))
+
+    def _list_from(self, addr, s: int, r: int) -> List[int]:
+        with socket.create_connection(addr, timeout=30) as sock:
+            _send_frame(sock, _HELLO, struct.pack("<I", _VERSION))
+            op, payload = _recv_frame(sock)
+            if op != _HELLO:
+                raise TransportError(f"handshake failed: {payload!r}")
+            _send_frame(sock, _LIST, struct.pack("<qq", s, r))
+            op, payload = _recv_frame(sock)
+            if op != _OK:
+                raise TransportError(f"list failed: {payload!r}")
+            k = len(payload) // 8
+            return list(struct.unpack(f"<{k}q", payload))
+
+    def _fetch_from(self, addr, s: int, m: int, r: int) -> bytes:
+        with socket.create_connection(addr, timeout=30) as sock:
+            _send_frame(sock, _HELLO, struct.pack("<I", _VERSION))
+            op, payload = _recv_frame(sock)
+            if op != _HELLO:
+                raise TransportError(f"handshake failed: {payload!r}")
+            _send_frame(sock, _FETCH, struct.pack("<qqq", s, m, r))
+            op, payload = _recv_frame(sock)
+            if op == _OK:
+                return payload
+            if op == _MISSING:
+                raise TransportError("missing block")
+            raise TransportError(f"peer error: {payload!r}")
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._local.clear()
