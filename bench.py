@@ -111,7 +111,7 @@ def store_sales_table(n, n_keys):
 # Configs
 # ---------------------------------------------------------------------------
 
-def bench_q1_stage(jax, n=1 << 22, reps=10):
+def bench_q1_stage(jax, n=1 << 22, reps=4):
     import pyarrow.compute as pc
     import __graft_entry__ as g
     from spark_rapids_tpu.batch import from_arrow
@@ -134,7 +134,7 @@ def bench_q1_stage(jax, n=1 << 22, reps=10):
     return n / dt, n / cpu_dt
 
 
-def bench_hash_agg(jax, n=1 << 22, n_keys=1 << 20, reps=10):
+def bench_hash_agg(jax, n=1 << 22, n_keys=1 << 20, reps=4):
     from spark_rapids_tpu.batch import from_arrow
     from spark_rapids_tpu.exec import (AggregateMode, HashAggregateExec,
                                        InMemoryScanExec)
@@ -160,7 +160,7 @@ def bench_hash_agg(jax, n=1 << 22, n_keys=1 << 20, reps=10):
     return n / dt, n / cpu_dt
 
 
-def bench_join_sort(jax, n_stream=1 << 21, n_build=1 << 18, reps=5):
+def bench_join_sort(jax, n_stream=1 << 21, n_build=1 << 18, reps=3):
     """Join + sort over DEVICE-RESIDENT inputs (H2D once): under this
     environment's tunneled device, per-rep H2D would measure the tunnel,
     not the engine — production TPU hosts feed HBM over PCIe/DMA."""
@@ -242,7 +242,7 @@ def bench_parquet_scan(jax, n=1 << 21, n_files=8, reps=3):
     return n / dt, n / cpu_dt
 
 
-def bench_ici_exchange(jax, n=1 << 20, reps=5):
+def bench_ici_exchange(jax, n=1 << 20, reps=3):
     import pyarrow as pa
     from spark_rapids_tpu.exec.join import JoinType
     from spark_rapids_tpu.expressions import col

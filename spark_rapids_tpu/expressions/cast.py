@@ -39,7 +39,18 @@ def cast_supported(src: SqlType, dst: SqlType) -> bool:
     ok = {TypeKind.BOOLEAN, TypeKind.INT8, TypeKind.INT16, TypeKind.INT32,
           TypeKind.INT64, TypeKind.FLOAT32, TypeKind.FLOAT64,
           TypeKind.DATE, TypeKind.TIMESTAMP, TypeKind.DECIMAL}
-    return src.kind in ok and dst.kind in ok
+    if src.kind in ok and dst.kind in ok:
+        return True
+    integral = {TypeKind.INT8, TypeKind.INT16, TypeKind.INT32,
+                TypeKind.INT64}
+    if src.kind is TypeKind.STRING:
+        # device string parsers: integrals and dates (float parsing needs
+        # correctly-rounded strtod → CPU)
+        return dst.kind in integral or dst.kind is TypeKind.DATE
+    if dst.kind is TypeKind.STRING:
+        return (src.kind in integral and dst.max_len >= 20) or \
+            (src.kind is TypeKind.DATE and dst.max_len >= 10)
+    return False
 
 
 @dataclass(frozen=True, eq=False)
@@ -58,11 +69,46 @@ class Cast(Expression):
     def dtype(self):
         return self.to
 
+    def device_unsupported_reason(self):
+        if not self.child.resolved:
+            return None
+        if not cast_supported(self.child.dtype, self.to):
+            return (f"cast {self.child.dtype} → {self.to} has no device "
+                    f"kernel")
+        return None
+
     def eval(self, batch, ctx=EvalContext()):
         c = self.child.eval(batch, ctx)
         src, dst = self.child.dtype, self.to
         if src.kind == dst.kind and src.kind is not TypeKind.DECIMAL:
             return c
+        if src.kind is TypeKind.STRING:
+            if dst.kind is TypeKind.DATE:
+                days, ok = string_to_date(c.data, c.lengths, c.validity)
+                return numeric_column(
+                    jnp.where(ok, days, 0), ok, dst)
+            v, ok = string_to_long(c.data, c.lengths, c.validity)
+            if dst.kind is not TypeKind.INT64:
+                # Spark NULLS out-of-range string casts (UTF8String.toInt
+                # semantics) — never two's-complement wrap
+                lo, hi = _INT_RANGE[dst.kind]
+                ok = ok & (v >= lo) & (v <= hi)
+            if ctx.ansi:
+                ctx.report(c.validity & ~ok, "CAST_INVALID_INPUT")
+            return numeric_column(
+                jnp.where(ok, v, 0).astype(dst.storage_dtype), ok, dst)
+        if dst.kind is TypeKind.STRING:
+            if src.kind is TypeKind.DATE:
+                mat, lengths = date_to_string(c.data, c.validity)
+            else:
+                mat, lengths = long_to_string(
+                    c.data.astype(jnp.int64), c.validity)
+            from .strings import _string_column
+            # pad into the declared max_len budget
+            ml = dst.max_len
+            if mat.shape[1] < ml:
+                mat = jnp.pad(mat, ((0, 0), (0, ml - mat.shape[1])))
+            return _string_column(mat, lengths, c.validity, ml)
         data, validity = _cast_data(c.data, c.validity, src, dst)
         return numeric_column(data, validity, dst)
 
@@ -131,3 +177,164 @@ def _div_half_up(x, divisor: int):
     q, r = jnp.divmod(jnp.abs(x), divisor)
     q = q + (2 * r >= divisor)
     return jnp.sign(x) * q
+
+
+# ---------------------------------------------------------------------------
+# String casts (reference: GpuCast.scala castStringToInt/castToString —
+# the cudf path calls into string kernels; here the padded byte matrix
+# makes both directions rectangular vector ops)
+# ---------------------------------------------------------------------------
+
+_MAX_INT_DIGITS = 19
+
+
+def string_to_long(data, lengths, validity):
+    """Parse [+-]?digits(.digits)? from byte rows (Spark non-ANSI cast
+    string→integral: surrounding whitespace trimmed, fraction truncated,
+    anything else → null). Returns (int64 values, ok mask)."""
+    n, ml = data.shape
+    pos = jnp.arange(ml, dtype=jnp.int32)[None, :]
+    in_str = pos < lengths[:, None]
+    b = jnp.where(in_str, data, jnp.uint8(0))
+    is_space = (b == 32) | (b == 9) | (b == 10) | (b == 13)
+    # trim: first/last non-space positions
+    content = in_str & ~is_space
+    any_content = jnp.any(content, axis=1)
+    first = jnp.argmax(content, axis=1).astype(jnp.int32)
+    last = ml - 1 - jnp.argmax(content[:, ::-1], axis=1).astype(jnp.int32)
+    # interior spaces invalidate
+    interior = (pos >= first[:, None]) & (pos <= last[:, None])
+    ok = any_content & ~jnp.any(interior & is_space, axis=1)
+    # sign
+    first_b = jnp.take_along_axis(b, first[:, None], axis=1)[:, 0]
+    has_sign = (first_b == ord("+")) | (first_b == ord("-"))
+    neg = first_b == ord("-")
+    digits_start = first + has_sign.astype(jnp.int32)
+    # optional single '.': digits after it are validated then ignored
+    is_dot = interior & (b == ord("."))
+    n_dots = jnp.sum(is_dot.astype(jnp.int32), axis=1)
+    dot_pos = jnp.where(n_dots > 0,
+                        jnp.argmax(is_dot, axis=1).astype(jnp.int32),
+                        last + 1)
+    int_end = jnp.minimum(dot_pos - 1, last)       # last integer digit
+    is_digit = (b >= ord("0")) & (b <= ord("9"))
+    # every char in (digits_start..last) must be digit or the single dot
+    span = (pos >= digits_start[:, None]) & (pos <= last[:, None])
+    has_frac_digits = (n_dots == 1) & (dot_pos < last)
+    ok = ok & (n_dots <= 1) & \
+        ~jnp.any(span & ~is_digit & ~is_dot, axis=1) & \
+        ((int_end >= digits_start) | has_frac_digits)    # '.5' → 0
+    # at most 19 integer digits (beyond → overflow → null)
+    n_digits = int_end - digits_start + 1
+    ok = ok & (n_digits <= _MAX_INT_DIGITS)
+    # value: sum digit * 10^(int_end - pos)
+    exp = int_end[:, None] - pos
+    in_int = span & (pos <= int_end[:, None]) & (exp < _MAX_INT_DIGITS)
+    p10 = jnp.asarray([10 ** i for i in range(_MAX_INT_DIGITS)], jnp.int64)
+    weight = jnp.take(p10, jnp.clip(exp, 0, _MAX_INT_DIGITS - 1), axis=0)
+    dig = (b - ord("0")).astype(jnp.int64)
+    v = jnp.sum(jnp.where(in_int, dig * weight, 0), axis=1)
+    # 19-digit magnitudes can exceed int64: the wrapped sum goes negative
+    # exactly then (max 19-digit value < 2^64). Spark nulls out-of-range
+    # string casts; '-9223372036854775808' wraps onto itself and is valid.
+    i64_min = jnp.int64(np.iinfo(np.int64).min)
+    ok = ok & ((v >= 0) | (neg & (v == i64_min)))
+    v = jnp.where(neg, -v, v)
+    return v, ok & validity
+
+
+def long_to_string(x, validity, max_len=20):
+    """int64 → decimal digits + sign, padded byte rows + lengths."""
+    neg = x < 0
+    mag = jnp.abs(x).astype(jnp.uint64)   # |INT64_MIN| needs unsigned
+    nd = _MAX_INT_DIGITS
+    p10 = jnp.asarray([10 ** i for i in range(nd - 1, -1, -1)], jnp.uint64)
+    digits = ((mag[:, None] // p10[None, :]) % 10).astype(jnp.uint8)
+    n_digits = jnp.maximum(
+        nd - jnp.argmax(digits > 0, axis=1)
+        - (jnp.max(digits, axis=1) == 0) * (nd - 1), 1).astype(jnp.int32)
+    total = n_digits + neg.astype(jnp.int32)
+    n = x.shape[0]
+    out = jnp.zeros((n, max_len), jnp.uint8)
+    r_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    # digit k (most significant first) → position sign + k
+    for k in range(nd):
+        dig = digits[:, nd - 1 - k]     # k-th from the RIGHT
+        posn = total - 1 - k
+        write = k < n_digits
+        out = out.at[r_idx, jnp.where(write, posn, max_len)[:, None]].set(
+            (dig + ord("0")).astype(jnp.uint8)[:, None], mode="drop")
+    out = out.at[r_idx, jnp.where(neg, 0, max_len)[:, None]].set(
+        jnp.uint8(ord("-")), mode="drop")
+    return out, jnp.where(validity, total, 0)
+
+
+def string_to_date(data, lengths, validity):
+    """Parse yyyy[-M[-d]] (Spark cast string→date subset; trailing
+    garbage → null). Returns (epoch days int32, ok)."""
+    from .datetime import days_from_civil
+    n, ml = data.shape
+    pos = jnp.arange(ml, dtype=jnp.int32)[None, :]
+    in_str = pos < lengths[:, None]
+    b = jnp.where(in_str, data, jnp.uint8(0))
+    is_digit = (b >= ord("0")) & (b <= ord("9"))
+    is_dash = b == ord("-")
+    ok = validity & (lengths > 0) & \
+        ~jnp.any(in_str & ~is_digit & ~is_dash, axis=1)
+    dash_count = jnp.sum((is_dash & in_str).astype(jnp.int32), axis=1)
+    d1 = jnp.where(dash_count >= 1,
+                   jnp.argmax(is_dash, axis=1).astype(jnp.int32), lengths)
+    after1 = is_dash & (pos > d1[:, None])
+    d2 = jnp.where(dash_count >= 2,
+                   jnp.argmax(after1, axis=1).astype(jnp.int32), lengths)
+
+    def field(start, end):      # digits in [start, end)
+        width = end - start
+        inside = (pos >= start[:, None]) & (pos < end[:, None])
+        exp = end[:, None] - 1 - pos
+        p10 = jnp.asarray([1, 10, 100, 1000, 10000], jnp.int32)
+        w = jnp.take(p10, jnp.clip(exp, 0, 4), axis=0)
+        v = jnp.sum(jnp.where(inside & (exp < 5),
+                              (b - ord("0")).astype(jnp.int32) * w, 0),
+                    axis=1)
+        return v, width
+
+    zero = jnp.zeros_like(lengths)
+    y, yw = field(zero, d1)
+    m, mw = field(d1 + 1, d2)
+    d, dw = field(d2 + 1, lengths)
+    m = jnp.where(dash_count >= 1, m, 1)
+    d = jnp.where(dash_count >= 2, d, 1)
+    ok = ok & (dash_count <= 2) & (yw == 4) & \
+        jnp.where(dash_count >= 1, (mw >= 1) & (mw <= 2), True) & \
+        jnp.where(dash_count >= 2, (dw >= 1) & (dw <= 2), True) & \
+        (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+    days = days_from_civil(y, m, d).astype(jnp.int32)
+    # round-trip validation rejects impossible dates (Feb 31 → Mar 3)
+    from .datetime import civil_from_days
+    y2, m2, d2 = civil_from_days(days.astype(jnp.int64))
+    ok = ok & (y2 == y) & (m2 == m) & (d2 == d)
+    return days, ok
+
+
+def date_to_string(days, validity):
+    """epoch days → 'yyyy-MM-dd' byte rows (max_len 10)."""
+    from .datetime import civil_from_days
+    y, m, d = civil_from_days(days.astype(jnp.int64))
+    n = days.shape[0]
+    out = jnp.zeros((n, 10), jnp.uint8)
+
+    def put(out, col_idx, val):
+        return out.at[:, col_idx].set((val + ord("0")).astype(jnp.uint8))
+
+    out = put(out, 0, (y // 1000) % 10)
+    out = put(out, 1, (y // 100) % 10)
+    out = put(out, 2, (y // 10) % 10)
+    out = put(out, 3, y % 10)
+    out = out.at[:, 4].set(jnp.uint8(ord("-")))
+    out = put(out, 5, (m // 10) % 10)
+    out = put(out, 6, m % 10)
+    out = out.at[:, 7].set(jnp.uint8(ord("-")))
+    out = put(out, 8, (d // 10) % 10)
+    out = put(out, 9, d % 10)
+    return out, jnp.where(validity, jnp.int32(10), 0)

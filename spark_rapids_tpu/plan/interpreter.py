@@ -303,10 +303,19 @@ class RowEvaluator:
                     v = max(min(v, 2 ** 63), -(2 ** 63))
                     return _wrap(int(v), _INT_BITS[k])
                 if isinstance(v, str):
-                    try:
-                        return _wrap(int(v.strip()), _INT_BITS[k])
-                    except ValueError:
+                    import decimal as _dec
+                    s = v.strip()
+                    if "e" in s or "E" in s:    # toInt rejects exponents
                         return None
+                    try:
+                        d = int(_dec.Decimal(s))   # truncates
+                    except (ValueError, _dec.InvalidOperation):
+                        return None
+                    bits = _INT_BITS[k]
+                    # Spark NULLS out-of-range string casts, never wraps
+                    if not -(1 << (bits - 1)) <= d < (1 << (bits - 1)):
+                        return None
+                    return d
                 return _wrap(int(v), _INT_BITS[k])
             if k is TypeKind.FLOAT64:
                 if isinstance(v, str):
@@ -319,6 +328,25 @@ class RowEvaluator:
                 return _to_f32(float(v))
             if k is TypeKind.BOOLEAN:
                 return bool(v)
+            if k is TypeKind.DATE:
+                import datetime as _dt
+                if isinstance(v, _dt.date):
+                    return v
+                if isinstance(v, str):
+                    parts = v.strip().split("-")
+                    # Spark accepts yyyy[-M[-d]]
+                    if not 1 <= len(parts) <= 3 or len(parts[0]) != 4:
+                        return None
+                    try:
+                        y = int(parts[0])
+                        m = int(parts[1]) if len(parts) > 1 else 1
+                        d = int(parts[2]) if len(parts) > 2 else 1
+                        if any(not p.isdigit() for p in parts):
+                            return None
+                        return _dt.date(y, m, d)
+                    except ValueError:
+                        return None
+                return None
             if k is TypeKind.STRING:
                 return _spark_string_of(v, e.children[0].dtype)
         except (ValueError, OverflowError):

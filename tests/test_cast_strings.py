@@ -1,0 +1,72 @@
+"""String↔numeric/date cast kernels (reference: GpuCast.scala
+castStringToInt/castStringToDate/castToString; round 1 gated these to
+CPU entirely)."""
+
+import datetime as dt
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expressions import col
+from spark_rapids_tpu.expressions.cast import Cast
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+INTS = ["42", "-7", "+013", "  88  ", "12.9", "-3.99", "", "abc",
+        "1 2", "9223372036854775807", "-9223372036854775808",
+        "9223372036854775808", "99999999999999999999", "4.", None,
+        "300", "-129", ".5", "-", "+", "12a"]
+
+
+def test_string_to_longs():
+    t = pa.table({"s": pa.array(INTS, pa.string())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).select(
+            Cast(col("s"), T.INT64).alias("l"),
+            Cast(col("s"), T.INT32).alias("i"),
+            Cast(col("s"), T.INT16).alias("h"),
+            Cast(col("s"), T.INT8).alias("b")))
+
+
+def test_string_to_long_runs_on_device():
+    t = pa.table({"s": pa.array(["1", "2"], pa.string())})
+    s = Session()
+    s.collect(table(t).select(Cast(col("s"), T.INT64).alias("l")))
+    assert not s.fell_back()
+
+
+def test_long_to_string():
+    vals = [0, 1, -1, 42, -99999, 2**63 - 1, -(2**63), 10**18, None]
+    t = pa.table({"x": pa.array(vals, pa.int64())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).select(
+            Cast(col("x"), T.string(24)).alias("s")))
+
+
+def test_string_to_date():
+    strs = ["2024-02-29", "2023-02-29", "1999-1-5", "2024", "2024-7",
+            "0001-01-01", "2024-13-01", "2024-00-10", "2024-04-31",
+            "not a date", "", None, "2024-06-15"]
+    t = pa.table({"s": pa.array(strs, pa.string())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).select(Cast(col("s"), T.DATE).alias("d")))
+
+
+def test_date_to_string():
+    dates = [dt.date(2024, 6, 15), dt.date(1970, 1, 1),
+             dt.date(1969, 12, 31), dt.date(2000, 2, 29), None]
+    t = pa.table({"d": pa.array(dates, pa.date32())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).select(Cast(col("d"), T.string(12)).alias("s")))
+
+
+def test_string_to_float_falls_back():
+    from harness.asserts import assert_tpu_fallback_collect
+    t = pa.table({"s": pa.array(["1.5", "bad", None], pa.string())})
+    assert_tpu_fallback_collect(
+        lambda: table(t).select(Cast(col("s"), T.FLOAT64).alias("f")),
+        "Project")
