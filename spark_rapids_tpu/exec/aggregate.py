@@ -166,19 +166,23 @@ class HashAggregateExec(UnaryExec):
 
     def _scatter_keys(self, sorted_keys: List[DeviceColumn], seg, new_group,
                       cap: int) -> List[DeviceColumn]:
-        """Place each segment's first-row key at its group slot."""
-        target = jnp.where(new_group, seg, cap)
+        """Place each segment's first-row key at its group slot — as a
+        stable flag-sort + gather (segments ascend, so the g-th first-row
+        IS group g's key; TPU scatters are ~40x slower than gathers)."""
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        _, perm = jax.lax.sort([(~new_group).astype(jnp.uint8), iota],
+                               num_keys=2)
+        slot_live = iota < jnp.sum(new_group.astype(jnp.int32))
         out = []
         for c in sorted_keys:
-            if c.lengths is not None:
-                data = jnp.zeros_like(c.data).at[target].set(c.data, mode="drop")
-                lengths = jnp.zeros_like(c.lengths).at[target].set(
-                    c.lengths, mode="drop")
-            else:
-                data = jnp.zeros_like(c.data).at[target].set(c.data, mode="drop")
-                lengths = None
-            validity = jnp.zeros(cap, bool).at[target].set(c.validity, mode="drop")
-            out.append(DeviceColumn(data, validity, lengths, c.dtype))
+            data = jnp.take(c.data, perm, axis=0)
+            lengths = jnp.take(c.lengths, perm, axis=0) \
+                if c.lengths is not None else None
+            data2 = jnp.take(c.data2, perm, axis=0) \
+                if c.data2 is not None else None
+            validity = jnp.take(c.validity, perm, axis=0) & slot_live
+            out.append(DeviceColumn(data, validity, lengths, c.dtype,
+                                    data2))
         return out
 
     # ------------------------------------------------------------------

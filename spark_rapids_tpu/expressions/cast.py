@@ -85,6 +85,8 @@ class Cast(Expression):
         if src.kind is TypeKind.STRING:
             if dst.kind is TypeKind.DATE:
                 days, ok = string_to_date(c.data, c.lengths, c.validity)
+                if ctx.ansi:
+                    ctx.report(c.validity & ~ok, "CAST_INVALID_INPUT")
                 return numeric_column(
                     jnp.where(ok, days, 0), ok, dst)
             v, ok = string_to_long(c.data, c.lengths, c.validity)
@@ -188,20 +190,27 @@ def _div_half_up(x, divisor: int):
 _MAX_INT_DIGITS = 19
 
 
-def string_to_long(data, lengths, validity):
-    """Parse [+-]?digits(.digits)? from byte rows (Spark non-ANSI cast
-    string→integral: surrounding whitespace trimmed, fraction truncated,
-    anything else → null). Returns (int64 values, ok mask)."""
+def _trim_bounds(data, lengths):
+    """(first, last, any_content, is_space, b, pos, in_str) for whitespace
+    trimming over byte rows (the UTF8String.trimAll whitespace set)."""
     n, ml = data.shape
     pos = jnp.arange(ml, dtype=jnp.int32)[None, :]
     in_str = pos < lengths[:, None]
     b = jnp.where(in_str, data, jnp.uint8(0))
-    is_space = (b == 32) | (b == 9) | (b == 10) | (b == 13)
-    # trim: first/last non-space positions
+    is_space = (b == 32) | ((b >= 9) & (b <= 13))    # \t \n \v \f \r
     content = in_str & ~is_space
     any_content = jnp.any(content, axis=1)
     first = jnp.argmax(content, axis=1).astype(jnp.int32)
     last = ml - 1 - jnp.argmax(content[:, ::-1], axis=1).astype(jnp.int32)
+    return first, last, any_content, is_space, b, pos, in_str
+
+
+def string_to_long(data, lengths, validity):
+    """Parse [+-]?digits(.digits)? from byte rows (Spark non-ANSI cast
+    string→integral: surrounding whitespace trimmed, fraction truncated,
+    anything else → null). Returns (int64 values, ok mask)."""
+    first, last, any_content, is_space, b, pos, in_str = \
+        _trim_bounds(data, lengths)
     # interior spaces invalidate
     interior = (pos >= first[:, None]) & (pos <= last[:, None])
     ok = any_content & ~jnp.any(interior & is_space, axis=1)
@@ -224,8 +233,14 @@ def string_to_long(data, lengths, validity):
     ok = ok & (n_dots <= 1) & \
         ~jnp.any(span & ~is_digit & ~is_dot, axis=1) & \
         ((int_end >= digits_start) | has_frac_digits)    # '.5' → 0
-    # at most 19 integer digits (beyond → overflow → null)
-    n_digits = int_end - digits_start + 1
+    # at most 19 SIGNIFICANT integer digits (leading zeros don't count:
+    # '0…01' is a valid 1 in Spark's value-based overflow check)
+    in_int_span = (pos >= digits_start[:, None]) & \
+        (pos <= int_end[:, None])
+    nonzero = in_int_span & (b != ord("0"))
+    any_nz = jnp.any(nonzero, axis=1)
+    first_nz = jnp.argmax(nonzero, axis=1).astype(jnp.int32)
+    n_digits = jnp.where(any_nz, int_end - first_nz + 1, 0)
     ok = ok & (n_digits <= _MAX_INT_DIGITS)
     # value: sum digit * 10^(int_end - pos)
     exp = int_end[:, None] - pos
@@ -244,28 +259,26 @@ def string_to_long(data, lengths, validity):
 
 
 def long_to_string(x, validity, max_len=20):
-    """int64 → decimal digits + sign, padded byte rows + lengths."""
+    """int64 → decimal digits + sign, padded byte rows + lengths.
+    Scatter-free: every output byte is a direct formula of its column
+    (TPU scatters are ~40x slower than arithmetic — docs/tpu_compat.md)."""
     neg = x < 0
     mag = jnp.abs(x).astype(jnp.uint64)   # |INT64_MIN| needs unsigned
     nd = _MAX_INT_DIGITS
-    p10 = jnp.asarray([10 ** i for i in range(nd - 1, -1, -1)], jnp.uint64)
-    digits = ((mag[:, None] // p10[None, :]) % 10).astype(jnp.uint8)
-    n_digits = jnp.maximum(
-        nd - jnp.argmax(digits > 0, axis=1)
-        - (jnp.max(digits, axis=1) == 0) * (nd - 1), 1).astype(jnp.int32)
+    p10 = jnp.asarray([10 ** i for i in range(nd)], jnp.uint64)
+    # significant digit count via thresholds (1 for zero)
+    n_digits = jnp.sum((mag[:, None] >= p10[None, :]).astype(jnp.int32),
+                       axis=1)
+    n_digits = jnp.maximum(n_digits, 1)
     total = n_digits + neg.astype(jnp.int32)
-    n = x.shape[0]
-    out = jnp.zeros((n, max_len), jnp.uint8)
-    r_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
-    # digit k (most significant first) → position sign + k
-    for k in range(nd):
-        dig = digits[:, nd - 1 - k]     # k-th from the RIGHT
-        posn = total - 1 - k
-        write = k < n_digits
-        out = out.at[r_idx, jnp.where(write, posn, max_len)[:, None]].set(
-            (dig + ord("0")).astype(jnp.uint8)[:, None], mode="drop")
-    out = out.at[r_idx, jnp.where(neg, 0, max_len)[:, None]].set(
-        jnp.uint8(ord("-")), mode="drop")
+    j = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    # output column j holds the digit with power total-1-j
+    pfr = total[:, None] - 1 - j
+    w = jnp.take(p10, jnp.clip(pfr, 0, nd - 1), axis=0)
+    dig = ((mag[:, None] // w) % 10).astype(jnp.uint8) + ord("0")
+    in_digits = (j >= neg.astype(jnp.int32)[:, None]) & (pfr >= 0)
+    out = jnp.where(in_digits, dig, jnp.uint8(0))
+    out = jnp.where((j == 0) & neg[:, None], jnp.uint8(ord("-")), out)
     return out, jnp.where(validity, total, 0)
 
 
@@ -273,20 +286,23 @@ def string_to_date(data, lengths, validity):
     """Parse yyyy[-M[-d]] (Spark cast string→date subset; trailing
     garbage → null). Returns (epoch days int32, ok)."""
     from .datetime import days_from_civil
-    n, ml = data.shape
-    pos = jnp.arange(ml, dtype=jnp.int32)[None, :]
-    in_str = pos < lengths[:, None]
-    b = jnp.where(in_str, data, jnp.uint8(0))
+    first, last, any_content, is_space, b, pos, in_str = \
+        _trim_bounds(data, lengths)
+    # restrict to the trimmed span (Spark trims date strings too)
+    in_str = in_str & (pos >= first[:, None]) & (pos <= last[:, None])
+    b = jnp.where(in_str, b, jnp.uint8(0))
+    start = first
+    end = last + 1                              # exclusive
     is_digit = (b >= ord("0")) & (b <= ord("9"))
-    is_dash = b == ord("-")
-    ok = validity & (lengths > 0) & \
-        ~jnp.any(in_str & ~is_digit & ~is_dash, axis=1)
-    dash_count = jnp.sum((is_dash & in_str).astype(jnp.int32), axis=1)
+    is_dash = in_str & (b == ord("-")) & (pos > start[:, None])
+    ok = validity & any_content & \
+        ~jnp.any(in_str & ~is_digit & ~(b == ord("-")), axis=1)
+    dash_count = jnp.sum(is_dash.astype(jnp.int32), axis=1)
     d1 = jnp.where(dash_count >= 1,
-                   jnp.argmax(is_dash, axis=1).astype(jnp.int32), lengths)
+                   jnp.argmax(is_dash, axis=1).astype(jnp.int32), end)
     after1 = is_dash & (pos > d1[:, None])
     d2 = jnp.where(dash_count >= 2,
-                   jnp.argmax(after1, axis=1).astype(jnp.int32), lengths)
+                   jnp.argmax(after1, axis=1).astype(jnp.int32), end)
 
     def field(start, end):      # digits in [start, end)
         width = end - start
@@ -299,13 +315,17 @@ def string_to_date(data, lengths, validity):
                     axis=1)
         return v, width
 
-    zero = jnp.zeros_like(lengths)
-    y, yw = field(zero, d1)
+    # every byte must be a digit except the (≤2) separator dashes —
+    # a dash INSIDE a field would otherwise contribute (45-48) mod 256
+    sep = (pos == d1[:, None]) | (pos == d2[:, None])
+    ok = ok & ~jnp.any(in_str & ~is_digit & ~sep, axis=1)
+    y, yw = field(start, d1)
     m, mw = field(d1 + 1, d2)
-    d, dw = field(d2 + 1, lengths)
+    d, dw = field(d2 + 1, end)
     m = jnp.where(dash_count >= 1, m, 1)
     d = jnp.where(dash_count >= 2, d, 1)
-    ok = ok & (dash_count <= 2) & (yw == 4) & \
+    # year 1+ only: the CPU oracle's datetime.date cannot hold year 0
+    ok = ok & (dash_count <= 2) & (yw == 4) & (y >= 1) & \
         jnp.where(dash_count >= 1, (mw >= 1) & (mw <= 2), True) & \
         jnp.where(dash_count >= 2, (dw >= 1) & (dw <= 2), True) & \
         (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)

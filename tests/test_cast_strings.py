@@ -19,7 +19,8 @@ from harness.asserts import assert_tpu_and_cpu_are_equal_collect
 INTS = ["42", "-7", "+013", "  88  ", "12.9", "-3.99", "", "abc",
         "1 2", "9223372036854775807", "-9223372036854775808",
         "9223372036854775808", "99999999999999999999", "4.", None,
-        "300", "-129", ".5", "-", "+", "12a"]
+        "300", "-129", ".5", "-", "+", "12a",
+        "00000000000000000001", "\x0c42", "\t-5\n"]
 
 
 def test_string_to_longs():
@@ -50,7 +51,8 @@ def test_long_to_string():
 def test_string_to_date():
     strs = ["2024-02-29", "2023-02-29", "1999-1-5", "2024", "2024-7",
             "0001-01-01", "2024-13-01", "2024-00-10", "2024-04-31",
-            "not a date", "", None, "2024-06-15"]
+            "not a date", "", None, "2024-06-15", " 2024-06-15 ",
+            "0000-01-01", "-024-01-01", "2024-", "2024-06-15-"]
     t = pa.table({"s": pa.array(strs, pa.string())})
     assert_tpu_and_cpu_are_equal_collect(
         lambda: table(t).select(Cast(col("s"), T.DATE).alias("d")))
