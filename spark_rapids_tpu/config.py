@@ -217,6 +217,19 @@ ADAPTIVE_TARGET_ROWS = conf(
     "Row target when coalescing adjacent small shuffle partitions."
 ).integer(1 << 20)
 
+SKEW_JOIN_ENABLED = conf("spark.rapids.tpu.sql.adaptive.skewJoin.enabled").doc(
+    "Split a skewed stream-side shuffle partition of a co-partitioned join "
+    "into multiple reader partitions, replicating the matching build "
+    "partition (spark.sql.adaptive.skewJoin analogue)."
+).boolean(True)
+
+SKEW_SPLIT_ROWS = conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.splitRows").doc(
+    "Stream-side rows above which one shuffle partition counts as skewed "
+    "and is split (spark.sql.adaptive.skewJoin.skewedPartitionThreshold "
+    "analogue, in rows)."
+).integer(1 << 21)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "
     "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."

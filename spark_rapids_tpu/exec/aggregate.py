@@ -164,8 +164,8 @@ class HashAggregateExec(UnaryExec):
         count = jnp.sum(new_group.astype(jnp.int32))
         return perm, seg, new_group, count, sorted_live
 
-    def _scatter_keys(self, sorted_keys: List[DeviceColumn], seg, new_group,
-                      cap: int) -> List[DeviceColumn]:
+    def _group_first_keys(self, sorted_keys: List[DeviceColumn], new_group,
+                          cap: int) -> List[DeviceColumn]:
         """Place each segment's first-row key at its group slot — as a
         stable flag-sort + gather (segments ascend, so the g-th first-row
         IS group g's key; TPU scatters are ~40x slower than gathers)."""
@@ -205,7 +205,7 @@ class HashAggregateExec(UnaryExec):
             key_cols = [gather_column(c, perm) for c in key_cols]
             input_cols = [[gather_column(c, perm) for c in cols]
                           for cols in input_cols]
-        out_cols = self._scatter_keys(key_cols, seg, new_group, cap)
+        out_cols = self._group_first_keys(key_cols, new_group, cap)
         for agg, cols in zip(self.aggs, input_cols):
             out_cols.extend(agg.update(cols, seg, live, cap))
         group_live = jnp.arange(cap, dtype=jnp.int32) < count
@@ -225,7 +225,7 @@ class HashAggregateExec(UnaryExec):
             cols = [gather_column(c, perm) for c in batch.columns]
         else:
             cols = list(batch.columns)
-        out_cols = self._scatter_keys(cols[:nk], seg, new_group, cap)
+        out_cols = self._group_first_keys(cols[:nk], new_group, cap)
         group_live = jnp.arange(cap, dtype=jnp.int32) < count
         off = nk
         for agg in self.aggs:

@@ -101,8 +101,17 @@ class HashJoinExec(BinaryExec):
                  condition: Optional[Expression] = None,
                  broadcast_build: bool = True,
                  ctx: Optional[EvalContext] = None,
-                 max_build_rows: int = 1 << 22):
+                 max_build_rows: int = 1 << 22,
+                 skew_split_rows: Optional[int] = None):
         super().__init__(left, right, ctx)
+        # AQE skew-join: in the co-partitioned mode, a stream-side reader
+        # partition larger than this is split, replicating the matching
+        # build partition (reference: OptimizeSkewedJoin /
+        # GpuCustomShuffleReaderExec PartialReducerPartitionSpec). None =
+        # off. Coordination also keeps adaptive partition-coalescing
+        # CONSISTENT across the two exchanges — see _maybe_coordinate.
+        self.skew_split_rows = skew_split_rows
+        self._coordinated = False
         # broadcast_build: build side replicated (broadcast hash join).
         # False = co-partitioned inputs (shuffled hash join); requires both
         # children hash-partitioned on the join keys by an exchange.
@@ -270,8 +279,39 @@ class HashJoinExec(BinaryExec):
 
     # ------------------------------------------------------------------
 
+    def _maybe_coordinate(self) -> None:
+        """Co-partitioned mode over two shuffle exchanges: plan BOTH
+        reader layouts jointly (coalesce on combined stats + skew split).
+        Without this, each adaptive exchange would coalesce by its own row
+        counts and reader partition p would hold different keys on the two
+        sides."""
+        if self.broadcast_build or self._coordinated:
+            return
+        self._coordinated = True
+        from ..shuffle.exchange import (ShuffleExchangeExec,
+                                        coordinate_join_reads)
+        l, r = self.left, self.right
+        if not (isinstance(l, ShuffleExchangeExec) and
+                isinstance(r, ShuffleExchangeExec)):
+            return
+        if not (l.adaptive or r.adaptive or self.skew_split_rows):
+            return
+        split = self.skew_split_rows
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            # per-partition build tails stay correct only while each build
+            # row is probed in exactly one reader partition
+            split = None
+        coordinate_join_reads(l, r, l.target_rows, split)
+
+    def do_close(self) -> None:
+        # the exchanges drop their materialization + reader specs on
+        # close; a re-execute must re-coordinate or the two sides would
+        # fall back to inconsistent solo layouts
+        self._coordinated = False
+
     @property
     def num_partitions(self) -> int:
+        self._maybe_coordinate()
         # With a replicated build side, RIGHT/FULL outer needs GLOBAL
         # matched-build state: a per-partition tail would both duplicate
         # unmatched build rows (once per stream partition) and null-pad
@@ -285,6 +325,7 @@ class HashJoinExec(BinaryExec):
         return self.left.num_partitions
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        self._maybe_coordinate()
         if self.broadcast_build:
             build_batches = [b for cp in range(self.right.num_partitions)
                              for b in self.right.execute_partition(cp)]

@@ -295,8 +295,7 @@ def string_to_date(data, lengths, validity):
     end = last + 1                              # exclusive
     is_digit = (b >= ord("0")) & (b <= ord("9"))
     is_dash = in_str & (b == ord("-")) & (pos > start[:, None])
-    ok = validity & any_content & \
-        ~jnp.any(in_str & ~is_digit & ~(b == ord("-")), axis=1)
+    ok = validity & any_content
     dash_count = jnp.sum(is_dash.astype(jnp.int32), axis=1)
     d1 = jnp.where(dash_count >= 1,
                    jnp.argmax(is_dash, axis=1).astype(jnp.int32), end)

@@ -734,13 +734,19 @@ class Overrides:
         else:
             # shuffled hash join: co-partition both sides on the join keys
             # (large or unknown-size build must NOT be replicated)
+            from ..config import (ADAPTIVE_ENABLED, SKEW_JOIN_ENABLED,
+                                  SKEW_SPLIT_ROWS)
+            skew = None
+            if self.conf.get(ADAPTIVE_ENABLED.key) and \
+                    self.conf.get(SKEW_JOIN_ENABLED.key):
+                skew = self.conf.get(SKEW_SPLIT_ROWS.key)
             parts = self._shuffle_partitions()
             join = HashJoinExec(
                 left_keys, right_keys, n.join_type,
                 self._exchange(HashPartitioning(left_keys, parts), l),
                 self._exchange(HashPartitioning(right_keys, parts), r),
                 condition=n.condition, broadcast_build=False,
-                max_build_rows=max_build)
+                max_build_rows=max_build, skew_split_rows=skew)
         if swapped:
             # restore the user-facing column order (left cols, right cols)
             nl = len(ch[0].output_schema.fields)

@@ -25,6 +25,29 @@ from ..expressions.base import EvalContext
 from ..memory.catalog import BufferCatalog, SpillableBatch
 from .partitioning import Partitioning, RangePartitioning, SinglePartitioning
 
+#: One reader partition = a list of (map-output partition, piece_lo, piece_hi)
+#: piece ranges. This is the TPU analogue of Spark AQE's partition specs
+#: (CoalescedPartitionSpec spans whole output partitions,
+#: PartialReducerPartitionSpec takes a slice of one skewed partition).
+ReadSpec = List[Tuple[int, int, int]]
+
+
+def _coalesce_groups(counts: List[int], target_rows: int) -> List[List[int]]:
+    """Greedy adjacent grouping of partitions so each group approaches
+    target_rows (AQE coalesce-partitions)."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_rows = 0
+    for p, c in enumerate(counts):
+        if cur and cur_rows + c > target_rows:
+            groups.append(cur)
+            cur, cur_rows = [], 0
+        cur.append(p)
+        cur_rows += c
+    if cur:
+        groups.append(cur)
+    return groups or [[0]]
+
 
 class ShuffleExchangeExec(UnaryExec):
     """All-to-all redistribution of rows by a partitioning.
@@ -50,7 +73,8 @@ class ShuffleExchangeExec(UnaryExec):
         # reader partition using real row counts.
         self.adaptive = adaptive
         self.target_rows = target_rows
-        self._groups: Optional[List[List[int]]] = None
+        self._specs: Optional[List[ReadSpec]] = None
+        self._use_left: Optional[Dict[Tuple[int, int], int]] = None
         self._catalog = catalog
 
         def slice_kernel(batch: ColumnarBatch, pids, p: int) -> ColumnarBatch:
@@ -75,30 +99,47 @@ class ShuffleExchangeExec(UnaryExec):
 
     @property
     def num_partitions(self) -> int:
+        if self._specs is not None:
+            return len(self._specs)
         if self.adaptive:
-            return len(self._partition_groups())
+            return len(self._reader_specs())
         return self.partitioning.num_partitions
 
-    def _partition_groups(self) -> List[List[int]]:
-        """Greedy adjacent coalesce of small partitions by materialized row
-        counts (AQE coalesce-partitions)."""
-        if self._groups is not None:
-            return self._groups
-        parts = self._materialize()
-        counts = [sum(rows for _, rows in pieces) for pieces in parts]
-        groups: List[List[int]] = []
-        cur: List[int] = []
-        cur_rows = 0
-        for p, c in enumerate(counts):
-            if cur and cur_rows + c > self.target_rows:
-                groups.append(cur)
-                cur, cur_rows = [], 0
-            cur.append(p)
-            cur_rows += c
-        if cur:
-            groups.append(cur)
-        self._groups = groups or [[0]]
-        return self._groups
+    def partition_row_counts(self) -> List[int]:
+        """Materialized row count per map-output partition (the stage
+        statistics AQE reader planning runs on)."""
+        return [sum(rows for _, rows in pieces)
+                for pieces in self._materialize()]
+
+    def piece_row_counts(self, p: int) -> List[int]:
+        return [rows for _, rows in self._materialize()[p]]
+
+    def set_reader_specs(self, specs: List[ReadSpec]) -> None:
+        """Fix the reader-side partition layout. Called either internally
+        (solo adaptive coalesce) or by a join coordinating BOTH of its
+        exchanges onto one layout (coordinate_join_reads below). Pieces
+        referenced by several specs (skew-split build replication) are
+        refcounted and freed after their last read."""
+        self._materialize()
+        use: Dict[Tuple[int, int], int] = {}
+        for spec in specs:
+            for op_, lo, hi in spec:
+                for i in range(lo, hi):
+                    use[(op_, i)] = use.get((op_, i), 0) + 1
+        self._specs = specs
+        self._use_left = use
+
+    def _reader_specs(self) -> List[ReadSpec]:
+        if self._specs is None:
+            parts = self._materialize()
+            if self.adaptive:
+                counts = [sum(rows for _, rows in pieces) for pieces in parts]
+                groups = _coalesce_groups(counts, self.target_rows)
+            else:
+                groups = [[p] for p in range(len(parts))]
+            self.set_reader_specs(
+                [[(p, 0, len(parts[p])) for p in g] for g in groups])
+        return self._specs
 
     def _sample_range_bounds(self, batches: List[ColumnarBatch]) -> None:
         """Compute range bounds from the materialized input (reference:
@@ -181,11 +222,10 @@ class ShuffleExchangeExec(UnaryExec):
         return out
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        if self.adaptive:
-            group = self._partition_groups()[p]
-            entries = [e for op_ in group for e in self._materialize()[op_]]
-        else:
-            entries = self._materialize()[p]
+        spec = self._reader_specs()[p]
+        parts = self._materialize()
+        entries = [parts[op_][i] for op_, lo, hi in spec
+                   for i in range(lo, hi)]
         if not entries:
             return
         # shuffle-read coalesce (reference: GpuShuffleCoalesceExec)
@@ -196,18 +236,83 @@ class ShuffleExchangeExec(UnaryExec):
             else:
                 yield concat_batches([sb.get() for sb, _ in entries], cap)
         finally:
-            # each read partition is consumed once; free its pieces
-            for sb, _ in entries:
-                sb.close()
+            # free a piece after its LAST referencing read partition
+            # (skew-split replicates build pieces across readers). An
+            # abandoned generator (limit early-exit) may be finalized
+            # AFTER do_close() already reset the refcounts — close() is
+            # idempotent, so just close everything in that case.
+            use = self._use_left
+            for op_, lo, hi in spec:
+                for i in range(lo, hi):
+                    sb = parts[op_][i][0]
+                    if use is None:
+                        sb.close()
+                    else:
+                        use[(op_, i)] -= 1
+                        if use[(op_, i)] <= 0:
+                            sb.close()
+                        else:
+                            sb.done_with()
 
     def do_close(self) -> None:
         # partitions the consumer never read (limits, early exit) still
-        # hold catalog entries
+        # hold catalog entries; SpillableBatch.close is idempotent
         if self._materialized is not None:
             for pieces in self._materialized:
                 for sb, _ in pieces:
                     sb.close()
             self._materialized = None
+            self._specs = None
+            self._use_left = None
+
+
+def coordinate_join_reads(stream: "ShuffleExchangeExec",
+                          build: "ShuffleExchangeExec",
+                          target_rows: int,
+                          skew_split_rows: Optional[int] = None) -> int:
+    """Jointly plan the reader partitions of a co-partitioned join's two
+    exchanges (the role of Spark AQE's ShufflePartitionsUtil +
+    OptimizeSkewedJoin): groups are computed once on COMBINED row counts so
+    both sides agree on the layout — independent per-side coalescing would
+    silently break co-partitioning. A skewed map-output partition (stream
+    rows > skew_split_rows) is split into piece-range reader partitions,
+    each paired with a full replica of the matching build partition
+    (PartialReducerPartitionSpec semantics). Returns the number of skew
+    splits performed."""
+    sc = stream.partition_row_counts()
+    bc = build.partition_row_counts()
+    assert len(sc) == len(bc), (len(sc), len(bc))
+    combined = [a + b for a, b in zip(sc, bc)]
+    groups = _coalesce_groups(combined, target_rows)
+    s_specs: List[ReadSpec] = []
+    b_specs: List[ReadSpec] = []
+    n_splits = 0
+    for g in groups:
+        if skew_split_rows and len(g) == 1 and sc[g[0]] > skew_split_rows:
+            p = g[0]
+            rows = stream.piece_row_counts(p)
+            chunks: List[Tuple[int, int]] = []
+            lo, cur = 0, 0
+            for i, r in enumerate(rows):
+                if cur and cur + r > skew_split_rows:
+                    chunks.append((lo, i))
+                    lo, cur = i, 0
+                cur += r
+            chunks.append((lo, len(rows)))
+            np_build = len(build.piece_row_counts(p))
+            if len(chunks) > 1:
+                n_splits += len(chunks) - 1
+            for c_lo, c_hi in chunks:
+                s_specs.append([(p, c_lo, c_hi)])
+                b_specs.append([(p, 0, np_build)])
+        else:
+            s_specs.append([(p, 0, len(stream.piece_row_counts(p)))
+                            for p in g])
+            b_specs.append([(p, 0, len(build.piece_row_counts(p)))
+                            for p in g])
+    stream.set_reader_specs(s_specs)
+    build.set_reader_specs(b_specs)
+    return n_splits
 
 
 class BroadcastTooLargeError(MemoryError):

@@ -58,6 +58,132 @@ def test_query_with_adaptive_enabled_is_correct():
                   100})
 
 
+def test_shuffled_join_adaptive_coordinated():
+    """Co-partitioned join under AQE: the two exchanges must agree on ONE
+    reader layout (independent coalescing broke co-partitioning — round-2
+    regression; reference: ShufflePartitionsUtil coordinates both sides)."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.exec.join import JoinType
+
+    rng = np.random.default_rng(170)
+    # asymmetric sizes: solo coalescing would give the two sides
+    # different reader partition counts
+    left = pa.table({"k": rng.integers(0, 60, 1200).astype(np.int64),
+                     "v": rng.integers(0, 100, 1200).astype(np.int64)})
+    right = pa.table({"rk": rng.integers(0, 60, 150).astype(np.int64),
+                      "w": rng.integers(0, 100, 150).astype(np.int64)})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: (table(left, num_slices=3)
+                 .join(table(right, num_slices=3), ["k"], ["rk"],
+                       JoinType.INNER)
+                 .group_by("k").agg(Count().alias("c"),
+                                    Sum(col("v")).alias("s"))),
+        conf={"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 10,
+              "spark.rapids.tpu.sql.adaptive.enabled": True,
+              "spark.rapids.tpu.sql.adaptive.coalescePartitions.targetRows":
+                  200,
+              "spark.rapids.tpu.shuffle.partitions": 6},
+        ignore_order=True)
+
+
+def _skew_join_execs(join_type, skew_split_rows):
+    import numpy as np
+    from spark_rapids_tpu.exec.join import HashJoinExec, JoinType
+    from spark_rapids_tpu.batch import from_arrow
+    import pyarrow as pa
+
+    rng = np.random.default_rng(171)
+    n = 1500
+    # 70% of stream rows share key 7 → its hash partition is skewed
+    k = np.where(rng.random(n) < 0.7, 7,
+                 rng.integers(0, 40, n)).astype(np.int64)
+    left = pa.table({"k": k, "v": rng.integers(0, 9, n).astype(np.int64)})
+    right = pa.table({"rk": np.arange(40, dtype=np.int64),
+                      "w": rng.integers(0, 9, 40).astype(np.int64)})
+    ls = InMemoryScanExec(left, batch_rows=150)
+    rs = InMemoryScanExec(right, batch_rows=10)
+    lex = ShuffleExchangeExec(HashPartitioning([col("k")], 6), ls,
+                              adaptive=True, target_rows=400)
+    rex = ShuffleExchangeExec(HashPartitioning([col("rk")], 6), rs,
+                              adaptive=True, target_rows=400)
+    join = HashJoinExec([col("k")], [col("rk")], join_type, lex, rex,
+                        broadcast_build=False,
+                        skew_split_rows=skew_split_rows)
+    return join, left, right
+
+
+def _brute_join_rows(left, right, join_type):
+    from spark_rapids_tpu.exec.join import JoinType
+    lk = left.column("k").to_pylist()
+    lv = left.column("v").to_pylist()
+    rk = right.column("rk").to_pylist()
+    rw = right.column("w").to_pylist()
+    out = []
+    matched_r = set()
+    for i, kk in enumerate(lk):
+        hit = False
+        for j, rr in enumerate(rk):
+            if kk == rr:
+                out.append((kk, lv[i], rr, rw[j]))
+                matched_r.add(j)
+                hit = True
+        if not hit and join_type in (JoinType.LEFT_OUTER,
+                                     JoinType.FULL_OUTER):
+            out.append((kk, lv[i], None, None))
+    if join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+        for j, rr in enumerate(rk):
+            if j not in matched_r:
+                out.append((None, None, rr, rw[j]))
+    return sorted(out, key=lambda r: tuple((x is None, x or 0) for x in r))
+
+
+@pytest.mark.parametrize("join_type_name", ["Inner", "LeftOuter"])
+def test_skew_join_splits_and_is_correct(join_type_name):
+    from spark_rapids_tpu.batch import to_arrow
+    from spark_rapids_tpu.exec.join import JoinType
+
+    jt = JoinType(join_type_name)
+    join, left, right = _skew_join_execs(jt, skew_split_rows=300)
+    n_read = join.num_partitions
+    # the skewed partition (~1050 rows of key 7) splits into ≥3 readers
+    lex = join.left
+    singles = [s for s in lex._specs if len(s) == 1]
+    origins = [s[0][0] for s in singles]
+    assert any(origins.count(o) > 1 for o in set(origins)), \
+        f"no skew split happened: specs={lex._specs}"
+    got = []
+    for p in range(n_read):
+        for b in join.execute_partition(p):
+            got.extend(rows_of(to_arrow(b, join.output_schema)))
+    got = sorted(got, key=lambda r: tuple((x is None, x or 0) for x in r))
+    assert got == _brute_join_rows(left, right, jt)
+
+
+@pytest.mark.parametrize("join_type_name", ["RightOuter", "FullOuter"])
+def test_skew_split_suppressed_for_build_tails(join_type_name):
+    """RIGHT/FULL outer emit per-partition build tails; replicating a build
+    partition across skew splits would duplicate them — the join must keep
+    coordination but refuse the split."""
+    from spark_rapids_tpu.batch import to_arrow
+    from spark_rapids_tpu.exec.join import JoinType
+
+    jt = JoinType(join_type_name)
+    join, left, right = _skew_join_execs(jt, skew_split_rows=300)
+    n_read = join.num_partitions
+    lex, rex = join.left, join.right
+    assert len(lex._specs) == len(rex._specs) == n_read
+    # no replicated build partitions
+    b_orig = [op for s in rex._specs for (op, _, _) in s]
+    assert len(b_orig) == len(set(b_orig))
+    got = []
+    for p in range(n_read):
+        for b in join.execute_partition(p):
+            got.extend(rows_of(to_arrow(b, join.output_schema)))
+    got = sorted(got, key=lambda r: tuple((x is None, x or 0) for x in r))
+    assert got == _brute_join_rows(left, right, jt)
+
+
 def test_cbo_keeps_small_scan_on_cpu():
     tiny = gen_table([("v", IntegerGen())], n=10, seed=163)
     ses = Session({"spark.rapids.tpu.sql.optimizer.enabled": True})
