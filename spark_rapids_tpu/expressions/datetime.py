@@ -267,6 +267,470 @@ class UnixTimestampConv(Expression):
                               c.validity, T.TIMESTAMP)
 
 
+# ----------------------------------------------------------------------
+# Pattern-driven format/parse (reference: GpuDateFormatClass /
+# GpuToTimestamp / GpuFromUnixTime in datetimeExpressions.scala +
+# DateUtils.scala tagAndGetCudfFormat — the reference converts Java
+# SimpleDateFormat patterns to a cudf dialect and TAGS unsupported
+# patterns for CPU fallback; here the pattern compiles at plan time into
+# fixed-width field tokens, so both formatting and parsing are static
+# rectangular byte ops, and unsupported directives fall back the same way)
+# ----------------------------------------------------------------------
+
+class DateTimeFormatUnsupported(ValueError):
+    """Pattern uses a directive with no fixed-width device lowering."""
+
+
+#: directive -> (field name, byte width)
+_PATTERN_FIELDS = {
+    "yyyy": ("year", 4), "MM": ("month", 2), "dd": ("day", 2),
+    "HH": ("hour", 2), "mm": ("minute", 2), "ss": ("second", 2),
+    "SSS": ("millis", 3),
+}
+
+
+def compile_pattern(fmt: str):
+    """fmt -> list of ("f", field, width) | ("l", literal_bytes) tokens.
+    Only fixed-width directives are supported — variable-width (single
+    "d"/"M"/"H"), locale text ("E", "a", "z") and week-based fields raise
+    DateTimeFormatUnsupported, which the planner turns into a CPU
+    fallback (the reference's tagAndGetCudfFormat policy)."""
+    toks = []
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "'":
+            j = fmt.find("'", i + 1)
+            if j < 0:
+                raise DateTimeFormatUnsupported(
+                    f"unterminated quote in datetime pattern {fmt!r}")
+            if j == i + 1:      # '' is a literal quote
+                toks.append(("l", b"'"))
+            else:
+                toks.append(("l", fmt[i + 1:j].encode()))
+            i = j + 1
+            continue
+        if ch.isalpha():
+            j = i
+            while j < len(fmt) and fmt[j] == ch:
+                j += 1
+            run = fmt[i:j]
+            if run not in _PATTERN_FIELDS:
+                raise DateTimeFormatUnsupported(
+                    f"datetime pattern directive {run!r} has no fixed-"
+                    f"width device lowering (pattern {fmt!r})")
+            toks.append(("f", *_PATTERN_FIELDS[run]))
+            i = j
+            continue
+        toks.append(("l", ch.encode()))
+        i += 1
+    # merge adjacent literals
+    out = []
+    for t in toks:
+        if t[0] == "l" and out and out[-1][0] == "l":
+            out[-1] = ("l", out[-1][1] + t[1])
+        else:
+            out.append(list(t) if t[0] == "l" else t)
+    return [tuple(t) for t in out]
+
+
+def pattern_width(toks) -> int:
+    return sum(t[2] if t[0] == "f" else len(t[1]) for t in toks)
+
+
+def _civil_fields(col: DeviceColumn):
+    """Decompose a date/timestamp column into int32 civil fields."""
+    days = _days_of(col)
+    y, m, d = civil_from_days(days)
+    if col.dtype.kind is TypeKind.TIMESTAMP:
+        tod = jnp.mod(col.data.astype(jnp.int64), US_PER_DAY)
+        hh = (tod // US_PER_HOUR).astype(jnp.int32)
+        mi = ((tod % US_PER_HOUR) // US_PER_MIN).astype(jnp.int32)
+        ss = ((tod % US_PER_MIN) // US_PER_SEC).astype(jnp.int32)
+        ms = ((tod % US_PER_SEC) // 1000).astype(jnp.int32)
+    else:
+        hh = mi = ss = ms = jnp.zeros_like(y)
+    return {"year": y, "month": m, "day": d, "hour": hh, "minute": mi,
+            "second": ss, "millis": ms}
+
+
+def _safe_width(fmt: str) -> int:
+    """Pattern width for dtype computation; an UNSUPPORTED pattern must
+    not blow up dtype — the planner needs a well-typed node to record the
+    fallback reason (device_unsupported_reason) against."""
+    try:
+        return pattern_width(compile_pattern(fmt))
+    except DateTimeFormatUnsupported:
+        return len(fmt.encode())
+
+
+def _format_reason(fmt: str):
+    try:
+        compile_pattern(fmt)
+    except DateTimeFormatUnsupported as ex:
+        return str(ex)
+    return None
+
+
+@dataclass(frozen=True, eq=False)
+class DateFormat(Expression):
+    """date_format(date/ts, fmt) -> string; every token is a static-width
+    column block, so the whole row formats as one concatenate."""
+
+    child: Expression
+    fmt: str = "yyyy-MM-dd"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return DateFormat(c[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.string(max(_safe_width(self.fmt), 1))
+
+    @property
+    def nullable(self):
+        return True
+
+    def device_unsupported_reason(self):
+        return _format_reason(self.fmt)
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .strings import _string_column
+        c = self.child.eval(batch, ctx)
+        toks = compile_pattern(self.fmt)
+        f = _civil_fields(c)
+        n = c.data.shape[0]
+        blocks = []
+        for t in toks:
+            if t[0] == "l":
+                lit = jnp.asarray(
+                    jnp.frombuffer(t[1], dtype=jnp.uint8).reshape(1, -1))
+                blocks.append(jnp.broadcast_to(lit, (n, len(t[1]))))
+            else:
+                _, name, w = t
+                v = f[name]
+                digs = [(v // (10 ** (w - 1 - i))) % 10
+                        for i in range(w)]
+                blocks.append(jnp.stack(digs, axis=1).astype(jnp.uint8) +
+                              jnp.uint8(ord("0")))
+        data = jnp.concatenate(blocks, axis=1)
+        width = data.shape[1]
+        # years outside 1..9999 have no 4-digit form (and python's
+        # datetime, the host boundary, starts at year 1)
+        ok = c.validity & (f["year"] >= 1) & (f["year"] <= 9999)
+        return _string_column(data, jnp.full(n, width, jnp.int32), ok,
+                              width)
+
+
+@dataclass(frozen=True, eq=False)
+class ParseDateTime(Expression):
+    """to_date / to_timestamp / unix_timestamp(string, fmt): fixed-width
+    pattern means every field sits at a STATIC byte offset — the parse is
+    a handful of masked digit dot-products, no per-row control flow.
+    Rows that fail (wrong length, non-digit, literal mismatch, field out
+    of range) are null, Spark's non-ANSI parse semantics."""
+
+    child: Expression
+    fmt: str = "yyyy-MM-dd"
+    out: str = "date"           # date | timestamp | unix (int64 seconds)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return ParseDateTime(c[0], self.fmt, self.out)
+
+    @property
+    def dtype(self):
+        return {"date": T.DATE, "timestamp": T.TIMESTAMP,
+                "unix": T.INT64}[self.out]
+
+    @property
+    def nullable(self):
+        return True
+
+    def device_unsupported_reason(self):
+        return _format_reason(self.fmt)
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        toks = compile_pattern(self.fmt)
+        total = pattern_width(toks)
+        n, ml = c.data.shape
+        if total > ml:
+            # no stored string can hold the pattern
+            zeros = jnp.zeros(n, jnp.int64 if self.out != "date"
+                              else jnp.int32)
+            return numeric_column(zeros, jnp.zeros(n, bool), self.dtype)
+        ok = c.validity & (c.lengths == total)
+        vals = {"year": jnp.full(n, 1970, jnp.int32),
+                "month": jnp.ones(n, jnp.int32),
+                "day": jnp.ones(n, jnp.int32),
+                "hour": jnp.zeros(n, jnp.int32),
+                "minute": jnp.zeros(n, jnp.int32),
+                "second": jnp.zeros(n, jnp.int32),
+                "millis": jnp.zeros(n, jnp.int32)}
+        off = 0
+        for t in toks:
+            if t[0] == "l":
+                lit = jnp.asarray(jnp.frombuffer(t[1], dtype=jnp.uint8))
+                ok = ok & jnp.all(
+                    c.data[:, off:off + len(t[1])] == lit[None, :], axis=1)
+                off += len(t[1])
+            else:
+                _, name, w = t
+                b = c.data[:, off:off + w]
+                ok = ok & jnp.all((b >= ord("0")) & (b <= ord("9")),
+                                  axis=1)
+                p10 = jnp.asarray([10 ** (w - 1 - i) for i in range(w)],
+                                  jnp.int32)
+                vals[name] = jnp.sum(
+                    (b - ord("0")).astype(jnp.int32) * p10[None, :],
+                    axis=1)
+                off += w
+        y, m, d = vals["year"], vals["month"], vals["day"]
+        # year >= 1: python's datetime.date (the host/oracle boundary)
+        # cannot represent year 0
+        ok = ok & (y >= 1) & (m >= 1) & (m <= 12) & (d >= 1)
+        ok = ok & (d <= _month_len(y, jnp.clip(m, 1, 12)))
+        ok = ok & (vals["hour"] < 24) & (vals["minute"] < 60) & \
+            (vals["second"] < 60)
+        days = days_from_civil(y, jnp.clip(m, 1, 12), d)
+        if self.out == "date":
+            v = jnp.where(ok, days, 0).astype(jnp.int32)
+        else:
+            us = days.astype(jnp.int64) * US_PER_DAY + \
+                vals["hour"].astype(jnp.int64) * US_PER_HOUR + \
+                vals["minute"].astype(jnp.int64) * US_PER_MIN + \
+                vals["second"].astype(jnp.int64) * US_PER_SEC + \
+                vals["millis"].astype(jnp.int64) * 1000
+            if self.out == "unix":
+                v = jnp.where(ok, us // US_PER_SEC, 0)
+            else:
+                v = jnp.where(ok, us, 0)
+        return numeric_column(v, ok, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class FromUnixtime(Expression):
+    """from_unixtime(seconds, fmt) -> string (reference GpuFromUnixTime)."""
+
+    child: Expression
+    fmt: str = "yyyy-MM-dd HH:mm:ss"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return FromUnixtime(c[0], self.fmt)
+
+    @property
+    def dtype(self):
+        return T.string(max(_safe_width(self.fmt), 1))
+
+    @property
+    def nullable(self):
+        return True
+
+    def device_unsupported_reason(self):
+        return _format_reason(self.fmt)
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        ts = numeric_column(c.data.astype(jnp.int64) * US_PER_SEC,
+                            c.validity, T.TIMESTAMP)
+        inner = DateFormat(_Wrapped(ts), self.fmt)
+        return inner.eval(batch, ctx)
+
+
+@dataclass(frozen=True, eq=False)
+class _Wrapped(Expression):
+    """Pre-evaluated column as an expression (internal composition)."""
+
+    col: DeviceColumn
+
+    @property
+    def children(self):
+        return ()
+
+    def with_children(self, c):
+        return self
+
+    @property
+    def dtype(self):
+        return self.col.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        return self.col
+
+
+_TRUNC_DATE_LEVELS = {"year": "year", "yyyy": "year", "yy": "year",
+                      "quarter": "quarter", "month": "month", "mon": "month",
+                      "mm": "month", "week": "week"}
+_TRUNC_TS_LEVELS = dict(_TRUNC_DATE_LEVELS,
+                        day="day", dd="day", hour="hour", minute="minute",
+                        second="second")
+
+
+@dataclass(frozen=True, eq=False)
+class TruncDateTime(Expression):
+    """trunc(date, level) / date_trunc(level, ts). Unrecognized levels
+    yield null (Spark's behavior, not an error)."""
+
+    child: Expression
+    level: str = "month"
+    to_timestamp: bool = False      # date_trunc keeps TimestampType
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return TruncDateTime(c[0], self.level, self.to_timestamp)
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP if self.to_timestamp else T.DATE
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        levels = _TRUNC_TS_LEVELS if self.to_timestamp else \
+            _TRUNC_DATE_LEVELS
+        lvl = levels.get(self.level.lower())
+        if lvl is None:
+            z = jnp.zeros(c.data.shape[0],
+                          jnp.int64 if self.to_timestamp else jnp.int32)
+            return numeric_column(z, jnp.zeros_like(c.validity),
+                                  self.dtype)
+        days = _days_of(c)
+        y, m, d = civil_from_days(days)
+        one = jnp.ones_like(m)
+        if lvl == "year":
+            tdays = days_from_civil(y, one, one)
+        elif lvl == "quarter":
+            qm = ((m - 1) // 3) * 3 + 1
+            tdays = days_from_civil(y, qm, one)
+        elif lvl == "month":
+            tdays = days_from_civil(y, m, one)
+        elif lvl == "week":
+            tdays = (days - jnp.mod(days + 3, 7)).astype(jnp.int32)
+        else:
+            tdays = days.astype(jnp.int32)
+        if not self.to_timestamp:
+            return numeric_column(tdays, c.validity, T.DATE)
+        us = tdays.astype(jnp.int64) * US_PER_DAY
+        if lvl in ("hour", "minute", "second") and \
+                c.dtype.kind is TypeKind.TIMESTAMP:
+            # sub-day truncation only makes sense on real timestamps; a
+            # DATE child stores DAYS, which must not be divided by
+            # microsecond units (it is already at day granularity)
+            unit = {"hour": US_PER_HOUR, "minute": US_PER_MIN,
+                    "second": US_PER_SEC}[lvl]
+            us = (c.data.astype(jnp.int64) // unit) * unit
+        return numeric_column(us, c.validity, T.TIMESTAMP)
+
+
+@dataclass(frozen=True, eq=False)
+class MonthsBetween(Expression):
+    """months_between(end, start[, roundOff]) — Spark's rule: whole-month
+    difference when the days match (or both are month-ends), otherwise
+    fractional by (day+time diff)/31."""
+
+    end: Expression
+    start: Expression
+    round_off: bool = True
+
+    @property
+    def children(self):
+        return (self.end, self.start)
+
+    def with_children(self, c):
+        return MonthsBetween(c[0], c[1], self.round_off)
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.end.eval(batch, ctx)
+        b = self.start.eval(batch, ctx)
+        fa, fb = _civil_fields(a), _civil_fields(b)
+        months = (fa["year"] - fb["year"]).astype(jnp.float64) * 12 + \
+            (fa["month"] - fb["month"]).astype(jnp.float64)
+        la = _month_len(fa["year"], fa["month"])
+        lb = _month_len(fb["year"], fb["month"])
+        both_last = (fa["day"] == la) & (fb["day"] == lb)
+        sec_a = fa["hour"] * 3600 + fa["minute"] * 60 + fa["second"]
+        sec_b = fb["hour"] * 3600 + fb["minute"] * 60 + fb["second"]
+        whole = (fa["day"] == fb["day"]) & (sec_a == sec_b)
+        frac = ((fa["day"] - fb["day"]).astype(jnp.float64) +
+                (sec_a - sec_b).astype(jnp.float64) / 86400.0) / 31.0
+        v = jnp.where(whole | both_last, months, months + frac)
+        if self.round_off:
+            v = jnp.round(v * 1e8) / 1e8
+        return numeric_column(v, a.validity & b.validity, T.FLOAT64)
+
+
+_DAY_NAMES = ["monday", "tuesday", "wednesday", "thursday", "friday",
+              "saturday", "sunday"]
+
+
+@dataclass(frozen=True, eq=False)
+class NextDay(Expression):
+    """next_day(date, dayName): first date strictly after `date` falling
+    on the named weekday; bad names are null (Spark non-ANSI)."""
+
+    child: Expression
+    day_name: str = "monday"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return NextDay(c[0], self.day_name)
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    @property
+    def nullable(self):
+        return True
+
+    def _target(self):
+        s = self.day_name.strip().lower()
+        if len(s) < 2:
+            return None
+        for i, full in enumerate(_DAY_NAMES):
+            if full.startswith(s):
+                return i
+        return None
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        t = self._target()
+        if t is None:
+            return numeric_column(jnp.zeros_like(c.data),
+                                  jnp.zeros_like(c.validity), T.DATE)
+        days = c.data.astype(jnp.int64)
+        w = jnp.mod(days + 3, 7)           # Monday=0 (1970-01-01 is Thu=3)
+        delta = jnp.mod(t - w + 7, 7)
+        delta = jnp.where(delta == 0, 7, delta)
+        return numeric_column((days + delta).astype(jnp.int32),
+                              c.validity, T.DATE)
+
+
 # convenience builders
 def year(e):
     return ExtractDatePart(e, "year")
@@ -325,3 +789,39 @@ def datediff(end, start):
 def add_months(e, months):
     from .base import lit_if_needed
     return AddMonths(e, lit_if_needed(months))
+
+
+def date_format(e, fmt):
+    return DateFormat(e, fmt)
+
+
+def to_date(e, fmt="yyyy-MM-dd"):
+    return ParseDateTime(e, fmt, "date")
+
+
+def to_timestamp(e, fmt="yyyy-MM-dd HH:mm:ss"):
+    return ParseDateTime(e, fmt, "timestamp")
+
+
+def unix_timestamp(e, fmt="yyyy-MM-dd HH:mm:ss"):
+    return ParseDateTime(e, fmt, "unix")
+
+
+def from_unixtime(e, fmt="yyyy-MM-dd HH:mm:ss"):
+    return FromUnixtime(e, fmt)
+
+
+def trunc(e, level):
+    return TruncDateTime(e, level, to_timestamp=False)
+
+
+def date_trunc(level, e):
+    return TruncDateTime(e, level, to_timestamp=True)
+
+
+def months_between(end, start, round_off=True):
+    return MonthsBetween(end, start, round_off)
+
+
+def next_day(e, day_name):
+    return NextDay(e, day_name)

@@ -607,6 +607,236 @@ class RowEvaluator:
             return self._dt_days(v) * 86400
         return dt.datetime(1970, 1, 1) + dt.timedelta(seconds=v)
 
+    # pattern-token helpers shared by format/parse (tokens come from the
+    # plan-time compiler; the per-row field work below is independent
+    # python-datetime logic)
+    @staticmethod
+    def _civil_tuple(v):
+        import datetime as dt
+        if isinstance(v, dt.datetime):
+            return (v.year, v.month, v.day, v.hour, v.minute, v.second,
+                    v.microsecond // 1000)
+        return (v.year, v.month, v.day, 0, 0, 0, 0)
+
+    @classmethod
+    def _format_datetime(cls, v, fmt):
+        """Java SimpleDateFormat-style formatter, implemented directly so
+        the CPU oracle covers MORE patterns than the device path (the
+        whole point of pattern-based fallback: EEEE, variable-width d/M,
+        AM/PM still produce answers on CPU)."""
+        y, m, d, hh, mi, ss, ms = cls._civil_tuple(v)
+        if not (1 <= y <= 9999):
+            return None
+        months = ["January", "February", "March", "April", "May", "June",
+                  "July", "August", "September", "October", "November",
+                  "December"]
+        days = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+                "Saturday", "Sunday"]
+        import datetime as dt
+        wd = (v.date() if isinstance(v, dt.datetime) else v).weekday()
+        doy = (v.date() if isinstance(v, dt.datetime)
+               else v).timetuple().tm_yday
+        out = []
+        i = 0
+        while i < len(fmt):
+            ch = fmt[i]
+            if ch == "'":
+                j = fmt.find("'", i + 1)
+                if j < 0:
+                    return None
+                out.append("'" if j == i + 1 else fmt[i + 1:j])
+                i = j + 1
+                continue
+            if not ch.isalpha():
+                out.append(ch)
+                i += 1
+                continue
+            j = i
+            while j < len(fmt) and fmt[j] == ch:
+                j += 1
+            w = j - i
+            if ch == "y":
+                out.append(str(y % 100).zfill(2) if w == 2
+                           else str(y).zfill(w))
+            elif ch == "M":
+                out.append(months[m - 1] if w >= 4
+                           else months[m - 1][:3] if w == 3
+                           else str(m).zfill(w))
+            elif ch == "d":
+                out.append(str(d).zfill(w))
+            elif ch == "H":
+                out.append(str(hh).zfill(w))
+            elif ch == "h":
+                out.append(str((hh % 12) or 12).zfill(w))
+            elif ch == "m":
+                out.append(str(mi).zfill(w))
+            elif ch == "s":
+                out.append(str(ss).zfill(w))
+            elif ch == "S":
+                out.append(str(ms * 1000).zfill(6)[:w])
+            elif ch == "E":
+                out.append(days[wd] if w >= 4 else days[wd][:3])
+            elif ch == "a":
+                out.append("AM" if hh < 12 else "PM")
+            elif ch == "D":
+                out.append(str(doy).zfill(w))
+            elif ch == "Q":
+                out.append(str((m - 1) // 3 + 1).zfill(w))
+            else:
+                raise NotImplementedError(
+                    f"CPU interpreter: datetime pattern directive "
+                    f"{ch * w!r}")
+            i = j
+        return "".join(out)
+
+    def _eval_DateFormat(self, e, row):
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        return self._format_datetime(v, e.fmt)
+
+    def _eval_ParseDateTime(self, e, row):
+        import calendar
+        import datetime as dt
+        import re
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        # independent regex-based Java-pattern parser: width-1 numeric
+        # directives match 1-2 digits, width>=2 exactly that many (strict
+        # CORRECTED parser widths) — wider than the device's fixed-width
+        # subset on purpose (CPU fallback must still answer)
+        fmt = e.fmt
+        pat = []
+        fields = []
+        i = 0
+        while i < len(fmt):
+            ch = fmt[i]
+            if ch == "'":
+                j = fmt.find("'", i + 1)
+                if j < 0:
+                    return None
+                pat.append(re.escape("'" if j == i + 1 else fmt[i + 1:j]))
+                i = j + 1
+                continue
+            if not ch.isalpha():
+                pat.append(re.escape(ch))
+                i += 1
+                continue
+            j = i
+            while j < len(fmt) and fmt[j] == ch:
+                j += 1
+            w = j - i
+            if ch in "yMdHms":
+                pat.append(r"(\d{1,2})" if w == 1 else r"(\d{%d})" % w)
+                fields.append(ch)
+            elif ch == "S":
+                pat.append(r"(\d{%d})" % w)
+                fields.append(ch)
+            else:
+                raise NotImplementedError(
+                    f"CPU interpreter: datetime parse directive "
+                    f"{ch * w!r}")
+            i = j
+        mt = re.fullmatch("".join(pat), v)
+        if not mt:
+            return None
+        vals = {"y": 1970, "M": 1, "d": 1, "H": 0, "m": 0, "s": 0, "S": 0}
+        for gi, ch in enumerate(fields):
+            vals[ch] = int(mt.group(gi + 1))
+        y, m, d = vals["y"], vals["M"], vals["d"]
+        if y < 1:
+            return None
+        if not (1 <= m <= 12 and 1 <= d <= calendar.monthrange(y, m)[1]):
+            return None
+        if vals["H"] > 23 or vals["m"] > 59 or vals["s"] > 59:
+            return None
+        if e.out == "date":
+            return dt.date(y, m, d)
+        ts = dt.datetime(y, m, d, vals["H"], vals["m"], vals["s"],
+                         vals["S"] * 1000)
+        if e.out == "unix":
+            epoch = dt.datetime(1970, 1, 1)
+            return (ts - epoch) // dt.timedelta(microseconds=1) // 1_000_000
+        return ts
+
+    def _eval_FromUnixtime(self, e, row):
+        import datetime as dt
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        try:
+            ts = dt.datetime(1970, 1, 1) + dt.timedelta(seconds=int(v))
+        except (OverflowError, OSError):
+            return None     # outside year 1-9999: device path nulls too
+        return self._format_datetime(ts, e.fmt)
+
+    def _eval_TruncDateTime(self, e, row):
+        import datetime as dt
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        from ..expressions.datetime import (_TRUNC_DATE_LEVELS,
+                                            _TRUNC_TS_LEVELS)
+        levels = _TRUNC_TS_LEVELS if e.to_timestamp else _TRUNC_DATE_LEVELS
+        lvl = levels.get(e.level.lower())
+        if lvl is None:
+            return None
+        d = v.date() if isinstance(v, dt.datetime) else v
+        if lvl == "year":
+            out = dt.date(d.year, 1, 1)
+        elif lvl == "quarter":
+            out = dt.date(d.year, ((d.month - 1) // 3) * 3 + 1, 1)
+        elif lvl == "month":
+            out = dt.date(d.year, d.month, 1)
+        elif lvl == "week":
+            out = d - dt.timedelta(days=d.weekday())
+        else:
+            out = d
+        if not e.to_timestamp:
+            return out
+        ts = dt.datetime(out.year, out.month, out.day)
+        if lvl in ("hour", "minute", "second") and \
+                isinstance(v, dt.datetime):
+            ts = v.replace(microsecond=0)
+            if lvl in ("hour", "minute"):
+                ts = ts.replace(second=0)
+            if lvl == "hour":
+                ts = ts.replace(minute=0)
+        return ts
+
+    def _eval_MonthsBetween(self, e, row):
+        import calendar
+        a = self.eval(e.end, row)
+        b = self.eval(e.start, row)
+        if a is None or b is None:
+            return None
+        ya, ma, da, ha, mia, sa, _ = self._civil_tuple(a)
+        yb, mb, db, hb, mib, sb, _ = self._civil_tuple(b)
+        months = (ya - yb) * 12 + (ma - mb)
+        la = calendar.monthrange(ya, ma)[1]
+        lb = calendar.monthrange(yb, mb)[1]
+        seca = ha * 3600 + mia * 60 + sa
+        secb = hb * 3600 + mib * 60 + sb
+        if (da == db and seca == secb) or (da == la and db == lb):
+            v = float(months)
+        else:
+            v = months + ((da - db) + (seca - secb) / 86400.0) / 31.0
+        if e.round_off:
+            v = round(v * 1e8) / 1e8
+        return v
+
+    def _eval_NextDay(self, e, row):
+        import datetime as dt
+        v = self.eval(e.children[0], row)
+        if v is None:
+            return None
+        t = e._target()
+        if t is None:
+            return None
+        delta = (t - v.weekday() + 7) % 7
+        return v + dt.timedelta(days=delta or 7)
+
     def _eval_RLike(self, e, row):
         import re
         v = self.eval(e.children[0], row)

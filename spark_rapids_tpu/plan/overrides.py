@@ -107,7 +107,8 @@ def _expr_rules() -> Dict[str, ExprRule]:
         r(n, TS.ALL_BASIC)
     # datetime
     for n in ("ExtractDatePart", "DateAddSub", "DateDiff", "AddMonths",
-              "LastDay", "UnixTimestampConv"):
+              "LastDay", "UnixTimestampConv", "DateFormat", "ParseDateTime",
+              "FromUnixtime", "TruncDateTime", "MonthsBetween", "NextDay"):
         r(n, TS.DATETIME + TS.INTEGRAL)
     r("InterleaveBits", TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
     r("RLike", TS.ALL_BASIC,

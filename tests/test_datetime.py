@@ -69,3 +69,100 @@ def test_date_grouping_pipeline():
     from spark_rapids_tpu.expressions.aggregates import Count
     _q(lambda: table(DT).group_by(year(col("d")).alias("y"))
        .agg(Count().alias("n")))
+
+
+# ---- pattern-driven format/parse (round 3: date_format/to_date family) ----
+
+from spark_rapids_tpu.expressions.datetime import (  # noqa: E402
+    DateFormat, DateTimeFormatUnsupported, FromUnixtime, MonthsBetween,
+    NextDay, ParseDateTime, TruncDateTime, compile_pattern, date_format,
+    date_trunc, from_unixtime, months_between, next_day, to_date,
+    to_timestamp, trunc, unix_timestamp)
+from harness.data_gen import LongGen, StringGen  # noqa: E402
+
+
+@pytest.mark.parametrize("fmt", ["yyyy-MM-dd", "yyyy/MM/dd", "MM-dd-yyyy",
+                                 "yyyyMMdd"])
+def test_date_format_date(fmt):
+    _q(lambda: table(DT).select(date_format(col("d"), fmt).alias("s")))
+
+
+@pytest.mark.parametrize("fmt", ["yyyy-MM-dd HH:mm:ss",
+                                 "dd/MM/yyyy HH:mm:ss.SSS", "HH:mm"])
+def test_date_format_timestamp(fmt):
+    _q(lambda: table(DT).select(date_format(col("t"), fmt).alias("s")))
+
+
+def test_pattern_unsupported_directives():
+    for bad in ("E", "a", "d/M/yyyy", "yyyy-MM-dd'T'HH:mm:ssXXX"):
+        with pytest.raises(DateTimeFormatUnsupported):
+            compile_pattern(bad)
+    # quoted literal is fine
+    assert compile_pattern("yyyy'T'MM") == [
+        ("f", "year", 4), ("l", b"T"), ("f", "month", 2)]
+
+
+def test_unsupported_pattern_falls_back():
+    from harness.asserts import assert_tpu_fallback_collect
+    assert_tpu_fallback_collect(
+        lambda: table(DT).select(date_format(col("d"), "EEEE").alias("s")),
+        "Project")
+
+
+PARSE_GOOD = gen_table(
+    [("s", StringGen(charset="0123456789-", min_len=10, max_len=10))],
+    n=50, seed=113)
+
+
+def test_to_date_round_trip():
+    # format then parse is identity on valid dates
+    _q(lambda: table(DT).select(
+        to_date(date_format(col("d"), "yyyy-MM-dd")).alias("d2")))
+
+
+def test_to_date_rejects_garbage():
+    _q(lambda: table(PARSE_GOOD).select(to_date(col("s")).alias("d")))
+
+
+def test_to_timestamp_and_unix():
+    _q(lambda: table(DT).select(
+        to_timestamp(date_format(col("t"), "yyyy-MM-dd HH:mm:ss")
+                     ).alias("ts"),
+        unix_timestamp(date_format(col("t"), "yyyy-MM-dd HH:mm:ss")
+                       ).alias("u")))
+
+
+def test_from_unixtime():
+    ug = gen_table([("u", LongGen(min_val=-2_000_000_000,
+                                  max_val=4_000_000_000))], n=300, seed=114)
+    _q(lambda: table(ug).select(from_unixtime(col("u")).alias("s"),
+                                from_unixtime(col("u"), "yyyy-MM").alias(
+                                    "ym")))
+
+
+@pytest.mark.parametrize("lvl", ["year", "quarter", "month", "week", "mm",
+                                 "nonsense"])
+def test_trunc_date(lvl):
+    _q(lambda: table(DT).select(trunc(col("d"), lvl).alias("t")))
+
+
+@pytest.mark.parametrize("lvl", ["year", "month", "week", "day", "hour",
+                                 "minute", "second"])
+def test_date_trunc_timestamp(lvl):
+    _q(lambda: table(DT).select(date_trunc(lvl, col("t")).alias("t")))
+
+
+def test_months_between():
+    _q(lambda: table(DT).select(
+        months_between(col("d"), date_add(col("d"), col("n"))).alias("mb")))
+
+
+def test_months_between_timestamps():
+    _q(lambda: table(DT).select(
+        months_between(col("t"), col("d")).alias("mb")))
+
+
+@pytest.mark.parametrize("name", ["mon", "TUESDAY", "we", "th", "Fri",
+                                  "sa", "sunday", "xx"])
+def test_next_day(name):
+    _q(lambda: table(DT).select(next_day(col("d"), name).alias("nd")))
