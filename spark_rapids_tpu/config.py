@@ -230,6 +230,12 @@ SKEW_SPLIT_ROWS = conf(
     "analogue, in rows)."
 ).integer(1 << 21)
 
+WINDOW_BATCH_ROWS = conf("spark.rapids.tpu.sql.window.batchRows").doc(
+    "Row target for key-complete window batches: a window partition's "
+    "rows are re-chunked on group-key boundaries so one batch never holds "
+    "more than ~this many rows (reference: GpuKeyBatchingIterator)."
+).integer(1 << 20)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "
     "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."

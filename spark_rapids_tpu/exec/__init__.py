@@ -13,5 +13,6 @@ from .sort import SortExec, SortOrder, TakeOrderedAndProjectExec
 from .join import (HashJoinExec, BroadcastNestedLoopJoinExec, JoinType)
 from .coalesce import CoalesceBatchesExec, TargetSize, RequireSingleBatch
 from .generate import GenerateExec
+from .key_batching import KeyBatchingExec
 
 __all__ = [n for n in dir() if not n.startswith("_")]

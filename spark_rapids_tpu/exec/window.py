@@ -293,8 +293,16 @@ class WindowExec(UnaryExec):
     # ------------------------------------------------------------------
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        # windows need the whole partition in one batch (the planner hash-
-        # exchanges on partition keys first); concat this stream partition
+        # windows need WHOLE window-partitions per batch. A key-batching
+        # child guarantees that with bounded batch sizes (reference:
+        # GpuKeyBatchingIterator) — process batch-at-a-time; otherwise
+        # concat the stream partition into one batch.
+        guarantee = getattr(self.child, "key_complete_for", None)
+        if guarantee is not None and \
+                guarantee == repr(list(self.spec.partition_keys)):
+            for batch in self.child.execute_partition(p):
+                yield self._kernel(batch)
+            return
         batches = list(self.child.execute_partition(p))
         if not batches:
             return

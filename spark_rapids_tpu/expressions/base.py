@@ -408,13 +408,30 @@ def numeric_column(data: jax.Array, validity: jax.Array,
     return DeviceColumn(jnp.where(validity, data, zero), validity, None, dtype)
 
 
+def _align_string_widths(a: DeviceColumn, b: DeviceColumn):
+    """Zero-pad the narrower byte matrix so two string columns of
+    different max_len compare elementwise (padding bytes are 0x00, which
+    never equals content and sorts below it)."""
+    wa, wb = a.data.shape[1], b.data.shape[1]
+    if wa == wb:
+        return a.data, b.data
+    w = max(wa, wb)
+    da = jnp.pad(a.data, ((0, 0), (0, w - wa))) if wa < w else a.data
+    db = jnp.pad(b.data, ((0, 0), (0, w - wb))) if wb < w else b.data
+    return da, db
+
+
 def string_equal(a: DeviceColumn, b: DeviceColumn) -> jax.Array:
-    same_bytes = jnp.all(a.data == b.data, axis=1)
+    da, db = _align_string_widths(a, b)
+    same_bytes = jnp.all(da == db, axis=1)
     return same_bytes & (a.lengths == b.lengths)
 
 
 def string_compare_lt(a: DeviceColumn, b: DeviceColumn) -> jax.Array:
     """UTF-8 byte-wise lexicographic a < b over padded matrices."""
+    da, db = _align_string_widths(a, b)
+    a = a.replace(data=da)
+    b = b.replace(data=db)
     diff = a.data != b.data
     any_diff = jnp.any(diff, axis=1)
     first = jnp.argmax(diff, axis=1)

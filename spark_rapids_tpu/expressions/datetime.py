@@ -357,11 +357,52 @@ def _civil_fields(col: DeviceColumn):
 def _safe_width(fmt: str) -> int:
     """Pattern width for dtype computation; an UNSUPPORTED pattern must
     not blow up dtype — the planner needs a well-typed node to record the
-    fallback reason (device_unsupported_reason) against."""
+    fallback reason against — AND its width must cover what the CPU
+    fallback can RENDER (EEEE -> "Wednesday"), because the fallback
+    island's output re-imports to the device under this dtype."""
     try:
         return pattern_width(compile_pattern(fmt))
     except DateTimeFormatUnsupported:
-        return len(fmt.encode())
+        pass
+    # per-directive maximum rendered width for the interpreter's wider
+    # SimpleDateFormat subset (see RowEvaluator._format_datetime)
+    width = 0
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "'":
+            j = fmt.find("'", i + 1)
+            if j < 0:
+                return max(len(fmt.encode()), 1)
+            width += 1 if j == i + 1 else len(fmt[i + 1:j].encode())
+            i = j + 1
+            continue
+        if not ch.isalpha():
+            width += len(ch.encode())
+            i += 1
+            continue
+        j = i
+        while j < len(fmt) and fmt[j] == ch:
+            j += 1
+        w = j - i
+        if ch == "y":
+            width += max(w, 4)
+        elif ch == "M":
+            width += 9 if w >= 4 else 3 if w == 3 else 2
+        elif ch == "E":
+            width += 9 if w >= 4 else 3
+        elif ch in "dHhms":
+            width += max(w, 2)
+        elif ch == "S":
+            width += max(w, 1)
+        elif ch == "a":
+            width += 2
+        elif ch == "D":
+            width += max(w, 3)
+        else:
+            width += max(w, 4)      # unknown directive: conservative
+        i = j
+    return max(width, 1)
 
 
 def _format_reason(fmt: str):
@@ -672,7 +713,9 @@ class MonthsBetween(Expression):
         both_last = (fa["day"] == la) & (fb["day"] == lb)
         sec_a = fa["hour"] * 3600 + fa["minute"] * 60 + fa["second"]
         sec_b = fb["hour"] * 3600 + fb["minute"] * 60 + fb["second"]
-        whole = (fa["day"] == fb["day"]) & (sec_a == sec_b)
+        # Spark: matching days-of-month give whole months IGNORING
+        # time-of-day (DateTimeUtils.monthsBetween)
+        whole = fa["day"] == fb["day"]
         frac = ((fa["day"] - fb["day"]).astype(jnp.float64) +
                 (sec_a - sec_b).astype(jnp.float64) / 86400.0) / 31.0
         v = jnp.where(whole | both_last, months, months + frac)
@@ -721,9 +764,10 @@ class NextDay(Expression):
         c = self.child.eval(batch, ctx)
         t = self._target()
         if t is None:
-            return numeric_column(jnp.zeros_like(c.data),
-                                  jnp.zeros_like(c.validity), T.DATE)
-        days = c.data.astype(jnp.int64)
+            return numeric_column(
+                jnp.zeros(c.data.shape[0], jnp.int32),
+                jnp.zeros_like(c.validity), T.DATE)
+        days = _days_of(c)                 # timestamps floor to days
         w = jnp.mod(days + 3, 7)           # Monday=0 (1970-01-01 is Thu=3)
         delta = jnp.mod(t - w + 7, 7)
         delta = jnp.where(delta == 0, 7, delta)

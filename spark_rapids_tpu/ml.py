@@ -1,0 +1,114 @@
+"""Zero-copy export of query results to ML frameworks.
+
+Reference: sql-plugin/.../execution/InternalColumnarRddConverter.scala
+(769 LoC) — the reference hands GPU-resident columnar RDDs to XGBoost
+without a host round-trip. The TPU-native analogue is stronger: a planned
+query's result is ALREADY jax arrays in HBM, so "export" is handing the
+device buffers over — `collect_jax` returns them as-is (zero copy, still
+on the TPU, ready for jit-compiled training steps), `collect_torch`
+bridges through dlpack/numpy for the CPU-torch stack in this image.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .batch import ColumnarBatch, Schema, bucket_capacity
+from .types import TypeKind
+
+
+def collect_device(session, df) -> Tuple[ColumnarBatch, Schema]:
+    """Run a DataFrame and return its result as ONE device-resident
+    ColumnarBatch (concatenated across partitions) — no host transfer.
+    Plans that fell back to CPU (or run interpreted: sql disabled /
+    explain-only mode) are re-imported to the device."""
+    from .exec.common import concat_batches
+    from .plan.interpreter import Interpreter
+    from .batch import from_arrow
+
+    kind, plan = session.prepare(df)
+    if kind == "interpret":
+        table = Interpreter(ansi=session.conf.ansi).execute(df.plan)
+        return from_arrow(table)
+    if kind == "fallback":
+        return from_arrow(plan.interpret())
+    try:
+        batches = [b for p in range(plan.num_partitions)
+                   for b in plan.execute_partition(p)]
+        schema = plan.output_schema
+        if not batches:
+            from .batch import empty_batch
+            return empty_batch(schema), schema
+        if len(batches) == 1:
+            return batches[0], schema
+        cap = bucket_capacity(sum(b.capacity for b in batches))
+        return concat_batches(batches, cap), schema
+    finally:
+        plan.close()
+
+
+_NUMERIC_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32,
+                  TypeKind.INT64, TypeKind.FLOAT32, TypeKind.FLOAT64,
+                  TypeKind.BOOLEAN, TypeKind.DATE, TypeKind.TIMESTAMP)
+
+
+def collect_jax(session, df, compact: bool = True
+                ) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """name -> (values, mask) jax arrays, still on device. `mask[i]` False
+    means NULL (and for padded capacity rows). With compact=True the
+    arrays are trimmed to the bucketed row capacity of the true row count.
+
+    The arrays are the engine's own buffers — feeding them into a jitted
+    training step involves no host transfer at all."""
+    batch, schema = collect_device(session, df)
+    out: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+    live = batch.row_mask()
+    n = int(batch.num_rows)
+    cap = bucket_capacity(max(n, 1)) if compact else batch.capacity
+    for f, c in zip(schema.fields, batch.columns):
+        if f.dtype.kind not in _NUMERIC_KINDS:
+            raise TypeError(
+                f"column {f.name}: {f.dtype} export is numeric-only "
+                f"(strings/arrays have engine-internal layouts); cast or "
+                f"project first")
+        data, mask = c.data, c.validity & live
+        if cap != batch.capacity:
+            data, mask = data[:cap], mask[:cap]
+        out[f.name] = (data, mask)
+    return out
+
+
+def collect_numpy(session, df, nulls_to: Optional[float] = None
+                  ) -> Dict[str, np.ndarray]:
+    """name -> numpy array of exactly num_rows values (one D2H copy).
+    Nulls become `nulls_to` (float columns) or raise if present and
+    nulls_to is None."""
+    batch, schema = collect_device(session, df)
+    n = int(batch.num_rows)
+    out: Dict[str, np.ndarray] = {}
+    live = np.asarray(batch.row_mask())[:n] if n else np.zeros(0, bool)
+    for f, c in zip(schema.fields, batch.columns):
+        if f.dtype.kind not in _NUMERIC_KINDS:
+            raise TypeError(f"column {f.name}: numeric-only export")
+        vals = np.asarray(c.data)[:n]
+        mask = np.asarray(c.validity)[:n] & live
+        if not mask.all():
+            if nulls_to is None:
+                raise ValueError(
+                    f"column {f.name} contains nulls; pass nulls_to=")
+            vals = vals.astype(np.float64, copy=True)
+            vals[~mask] = nulls_to
+        out[f.name] = vals
+    return out
+
+
+def collect_torch(session, df, nulls_to: Optional[float] = None):
+    """name -> torch tensor (via numpy; torch in this image is CPU-only,
+    so the bridge is one host copy — on a GPU/TPU torch build this would
+    ride dlpack device-to-device)."""
+    import torch
+    return {k: torch.from_numpy(np.ascontiguousarray(v))
+            for k, v in collect_numpy(session, df, nulls_to).items()}

@@ -732,7 +732,7 @@ class RowEvaluator:
                 fields.append(ch)
             elif ch == "S":
                 pat.append(r"(\d{%d})" % w)
-                fields.append(ch)
+                fields.append((ch, w))
             else:
                 raise NotImplementedError(
                     f"CPU interpreter: datetime parse directive "
@@ -741,9 +741,16 @@ class RowEvaluator:
         mt = re.fullmatch("".join(pat), v)
         if not mt:
             return None
-        vals = {"y": 1970, "M": 1, "d": 1, "H": 0, "m": 0, "s": 0, "S": 0}
+        vals = {"y": 1970, "M": 1, "d": 1, "H": 0, "m": 0, "s": 0}
+        micros = 0
         for gi, ch in enumerate(fields):
-            vals[ch] = int(mt.group(gi + 1))
+            raw = int(mt.group(gi + 1))
+            if isinstance(ch, tuple):       # ("S", width): a fraction —
+                w = ch[1]                   # scale to microseconds
+                micros = raw * 10 ** (6 - w) if w <= 6 \
+                    else raw // 10 ** (w - 6)
+            else:
+                vals[ch] = raw
         y, m, d = vals["y"], vals["M"], vals["d"]
         if y < 1:
             return None
@@ -754,7 +761,7 @@ class RowEvaluator:
         if e.out == "date":
             return dt.date(y, m, d)
         ts = dt.datetime(y, m, d, vals["H"], vals["m"], vals["s"],
-                         vals["S"] * 1000)
+                         micros)
         if e.out == "unix":
             epoch = dt.datetime(1970, 1, 1)
             return (ts - epoch) // dt.timedelta(microseconds=1) // 1_000_000
@@ -818,7 +825,9 @@ class RowEvaluator:
         lb = calendar.monthrange(yb, mb)[1]
         seca = ha * 3600 + mia * 60 + sa
         secb = hb * 3600 + mib * 60 + sb
-        if (da == db and seca == secb) or (da == la and db == lb):
+        # matching days-of-month -> whole months, time-of-day ignored
+        # (Spark DateTimeUtils.monthsBetween)
+        if da == db or (da == la and db == lb):
             v = float(months)
         else:
             v = months + ((da - db) + (seca - secb) / 86400.0) / 31.0
@@ -834,6 +843,8 @@ class RowEvaluator:
         t = e._target()
         if t is None:
             return None
+        if isinstance(v, dt.datetime):
+            v = v.date()            # result is DATE, like the device path
         delta = (t - v.weekday() + 7) % 7
         return v + dt.timedelta(days=delta or 7)
 

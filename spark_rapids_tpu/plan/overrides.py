@@ -107,9 +107,11 @@ def _expr_rules() -> Dict[str, ExprRule]:
         r(n, TS.ALL_BASIC)
     # datetime
     for n in ("ExtractDatePart", "DateAddSub", "DateDiff", "AddMonths",
-              "LastDay", "UnixTimestampConv", "DateFormat", "ParseDateTime",
-              "FromUnixtime", "TruncDateTime", "MonthsBetween", "NextDay"):
+              "LastDay", "UnixTimestampConv", "DateFormat", "FromUnixtime",
+              "TruncDateTime", "MonthsBetween", "NextDay"):
         r(n, TS.DATETIME + TS.INTEGRAL)
+    # parses STRING input (to_date/to_timestamp/unix_timestamp)
+    r("ParseDateTime", TS.STRING)
     r("InterleaveBits", TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
     r("RLike", TS.ALL_BASIC,
       note="DFA subset; unsupported constructs raise at plan build")
@@ -695,6 +697,13 @@ class Overrides:
                 HashPartitioning(pkeys, self._shuffle_partitions()), child)
         elif child.num_partitions > 1:
             child = self._exchange(SinglePartitioning(), child)
+        if pkeys:
+            # bound device residency: re-chunk into key-complete batches
+            # (reference: GpuKeyBatchingIterator feeding GpuWindowExec)
+            from ..config import WINDOW_BATCH_ROWS
+            from ..exec.key_batching import KeyBatchingExec
+            child = KeyBatchingExec(pkeys, child,
+                                    self.conf.get(WINDOW_BATCH_ROWS.key))
         return WindowExec(n.window_exprs, child)
 
     def _broadcast(self, child: Exec) -> Exec:

@@ -29,22 +29,27 @@ class Session:
         settings.update({k.replace("_", "."): v for k, v in kv.items()})
         return Session(settings)
 
-    def collect(self, df: DataFrame) -> pa.Table:
+    def prepare(self, df: DataFrame):
+        """Shared planning pipeline for every result surface (collect,
+        ml export): applies sql_enabled, explain-only mode, CPU-topped
+        plans and ICI mesh lowering. Returns ("interpret", None) when the
+        query must run on the row interpreter, ("fallback", plan) for a
+        CPU-topped plan, or ("exec", plan) for a device plan."""
         if not self.conf.sql_enabled:
             self.last_plan = None
-            return Interpreter(ansi=self.conf.ansi).execute(df.plan)
+            return "interpret", None
         from ..config import MODE
         if self.conf.get(MODE.key) == "explainonly":
             # plan as if a TPU were present, execute on CPU
             self.last_plan = Overrides(self.conf).plan(df.plan)
-            return Interpreter(ansi=self.conf.ansi).execute(df.plan)
+            return "interpret", None
         plan = Overrides(self.conf).plan(df.plan)
         self.last_plan = plan
         from .overrides import CpuFallbackExec as _CFE
         if isinstance(plan, _CFE):
             # CPU-topped plan: stay on the host (no device round-trip for
             # the final island — required for device-unsupported types)
-            return plan.interpret()
+            return "fallback", plan
         from ..config import SHUFFLE_MODE
         if str(self.conf.get(SHUFFLE_MODE.key)).upper() == "ICI":
             # ICI shuffle mode: fuse the planned query onto ONE SPMD mesh
@@ -55,6 +60,14 @@ class Session:
             if lowered is not None:
                 plan = lowered
                 self.last_plan = plan
+        return "exec", plan
+
+    def collect(self, df: DataFrame) -> pa.Table:
+        kind, plan = self.prepare(df)
+        if kind == "interpret":
+            return Interpreter(ansi=self.conf.ansi).execute(df.plan)
+        if kind == "fallback":
+            return plan.interpret()
         from ..exec.base import collect as collect_exec
         from ..exec.python_exec import _python_semaphore
         self._sem_wait0 = _python_semaphore.wait_time_ns

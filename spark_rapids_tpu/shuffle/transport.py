@@ -189,11 +189,17 @@ class TcpTransport(ShuffleTransport):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
-                 retries: int = 3):
+                 retries: int = 3, liveness=None):
         self._local: Dict[Tuple[int, int, int], bytes] = {}
         self._index: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
         self.peers = dict(peers or {})
         self.retries = retries
+        # liveness: () -> iterable of live peer ids, normally the driver
+        # heartbeat registry's live_executors (reference:
+        # RapidsShuffleHeartbeatManager feeding UCX endpoint setup).
+        # Peers missing from it are skipped WITHOUT paying a socket
+        # timeout; None = treat every configured peer as live.
+        self.liveness = liveness
         self._server = _BlockServer((host, port), _Handler)
         self._server.transport = self       # type: ignore
         self.address = self._server.server_address
@@ -212,12 +218,21 @@ class TcpTransport(ShuffleTransport):
         with self._lock:
             return sorted(self._index.get((s, r), []))
 
+    def _live_peers(self) -> Dict:
+        if self.liveness is None:
+            return self.peers
+        live = set(self.liveness())
+        return {pid: a for pid, a in self.peers.items() if pid in live}
+
     def list_blocks(self, s: int, r: int):
-        """Local blocks UNION every reachable peer's blocks (the shuffle
-        reader must see remote map outputs); unreachable peers raise —
-        a silent partial listing would silently drop their rows."""
+        """Local blocks UNION every LIVE peer's blocks (the shuffle
+        reader must see remote map outputs); a live-but-unreachable peer
+        raises — a silent partial listing would silently drop its rows.
+        Peers the heartbeat registry declares dead are excluded up front
+        (their tasks get rescheduled by the driver, the reference's
+        executor-death story)."""
         out = set(self.local_blocks(s, r))
-        for peer_id, addr in self.peers.items():
+        for peer_id, addr in self._live_peers().items():
             maps = self._retrying(addr, self._list_from, s, r)
             out.update((s, m, r) for m in maps)
         return sorted(out)
@@ -247,7 +262,7 @@ class TcpTransport(ShuffleTransport):
         if blk is not None:
             return blk
         last: Optional[Exception] = None
-        for peer_id, addr in self.peers.items():
+        for peer_id, addr in self._live_peers().items():
             try:
                 return self._retrying(addr, self._fetch_from, s, m, r)
             except TransportError as ex:

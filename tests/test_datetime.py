@@ -166,3 +166,52 @@ def test_months_between_timestamps():
                                   "sa", "sunday", "xx"])
 def test_next_day(name):
     _q(lambda: table(DT).select(next_day(col("d"), name).alias("nd")))
+
+
+def test_to_date_runs_on_device():
+    """Regression: ParseDateTime's TypeSig must admit STRING input or
+    every parse silently falls back and the device parser is dead code."""
+    from spark_rapids_tpu.plan import Session
+    ses = Session()
+    ses.collect(table(DT).select(
+        to_date(date_format(col("d"), "yyyy-MM-dd")).alias("d2")))
+    assert not any("CpuFallback" in n for n in ses.executed_exec_names()), \
+        ses.executed_exec_names()
+
+
+def test_months_between_ignores_time_on_matching_days():
+    import datetime as dt
+    import pyarrow as pa
+    t = pa.table({"a": pa.array([dt.datetime(2020, 2, 15, 12, 0, 0)]),
+                  "b": pa.array([dt.datetime(2020, 1, 15, 0, 0, 0)])})
+    from spark_rapids_tpu.plan import Session
+    for conf in ({}, {"spark.rapids.tpu.sql.enabled": False}):
+        got = Session(conf).collect(table(t).select(
+            months_between(col("a"), col("b")).alias("mb")))
+        assert got.column("mb").to_pylist() == [1.0], (conf, got)
+
+
+def test_next_day_on_timestamp():
+    _q(lambda: table(DT).select(next_day(col("t"), "wednesday").alias("n")))
+
+
+def test_fallback_format_result_reimports_to_device():
+    """EEEE renders 9 bytes on the CPU fallback; the dtype must be wide
+    enough for the island's output to re-import for device consumers."""
+    _q(lambda: table(DT)
+       .select(date_format(col("d"), "EEEE").alias("s"), col("d"))
+       .where(col("s") != lit("Monday")))
+
+
+def test_cpu_parse_micros_fraction():
+    import pyarrow as pa
+    t = pa.table({"s": pa.array(["2020-01-01 00:00:00.123456", "bogus"])})
+    got = __import__("spark_rapids_tpu.plan", fromlist=["Session"]).Session(
+        {"spark.rapids.tpu.sql.enabled": False}).collect(
+        table(t).select(to_timestamp(col("s"),
+                                     "yyyy-MM-dd HH:mm:ss.SSSSSS").alias("t")))
+    import datetime as dt
+    vals = got.column("t").to_pylist()
+    assert vals[1] is None
+    assert vals[0].replace(tzinfo=None) == \
+        dt.datetime(2020, 1, 1, 0, 0, 0, 123456)

@@ -1,0 +1,66 @@
+"""ML export tests (reference: InternalColumnarRddConverter / XGBoost
+zero-copy columnar handoff)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import ml
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.plan import Session, table
+
+from harness.data_gen import DoubleGen, IntegerGen, LongGen, gen_table
+
+T1 = gen_table([("a", IntegerGen(nullable=False)),
+                ("b", DoubleGen(no_nans=True, nullable=False)),
+                ("c", LongGen())], n=300, seed=150)
+
+
+def test_collect_jax_stays_on_device():
+    import jax
+    ses = Session()
+    out = ml.collect_jax(ses, table(T1).select(
+        col("a"), (col("b") * lit(2.0)).alias("b2")))
+    assert set(out) == {"a", "b2"}
+    vals, mask = out["b2"]
+    assert isinstance(vals, jax.Array)
+    n = int(mask.sum())
+    assert n == 300
+    expect = np.asarray(T1.column("b").to_pylist()) * 2.0
+    got = np.asarray(vals)[np.asarray(mask)]
+    assert np.allclose(np.sort(got), np.sort(expect))
+
+
+def test_collect_numpy_exact_rows_and_nulls():
+    ses = Session()
+    out = ml.collect_numpy(ses, table(T1), nulls_to=-1.0)
+    assert out["a"].shape == (300,)
+    have_null = any(v is None for v in T1.column("c").to_pylist())
+    if have_null:
+        assert (out["c"] == -1.0).any()
+    with pytest.raises(ValueError):
+        if have_null:
+            ml.collect_numpy(ses, table(T1))
+        else:
+            raise ValueError("no nulls generated")
+
+
+def test_collect_torch():
+    import torch
+    ses = Session()
+    out = ml.collect_torch(ses, table(T1).select(col("a")))
+    assert isinstance(out["a"], torch.Tensor)
+    assert out["a"].shape[0] == 300
+    assert sorted(out["a"].tolist()) == sorted(T1.column("a").to_pylist())
+
+
+def test_string_export_rejected():
+    from harness.data_gen import StringGen
+    st = gen_table([("s", StringGen())], n=10, seed=151)
+    with pytest.raises(TypeError):
+        ml.collect_jax(Session(), table(st))
+
+
+def test_cpu_session_roundtrips_through_device():
+    ses = Session({"spark.rapids.tpu.sql.enabled": False})
+    out = ml.collect_numpy(ses, table(T1).select(col("a")))
+    assert sorted(out["a"].tolist()) == sorted(T1.column("a").to_pylist())

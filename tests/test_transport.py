@@ -160,3 +160,96 @@ def test_remove_shuffle(tmp_path):
     assert t.list_blocks(1, 0) == []
     assert t.fetch(2, 0, 0) == b"b"
     t.close()
+
+
+# ---- fault injection (reference: RapidsShuffleClientSuite mocked
+# transport failures + heartbeat-driven peer liveness) ----
+
+def test_fetch_fails_over_dead_peer():
+    """First peer in the table is dead (closed socket): fetch must fail
+    over to the live peer holding the block."""
+    dead = TcpTransport()
+    dead_addr = dead.address
+    dead.close()                      # port now refuses connections
+    live = TcpTransport()
+    live.publish(11, 0, 0, b"survivor")
+    client = TcpTransport(peers={1: dead_addr, 2: live.address}, retries=2)
+    try:
+        assert client.fetch(11, 0, 0) == b"survivor"
+    finally:
+        client.close()
+        live.close()
+
+
+def test_liveness_registry_skips_dead_peer():
+    """A peer that stopped heartbeating is skipped by list_blocks instead
+    of raising unreachable — the heartbeat registry is the authority
+    (reference: RapidsShuffleHeartbeatManager)."""
+    from spark_rapids_tpu.plugin import init
+
+    runtime = init()
+    runtime.heartbeat("exec-live")
+    # exec-dead never heartbeats
+    dead = TcpTransport()
+    dead_addr = dead.address
+    dead.close()
+    live = TcpTransport()
+    live.publish(12, 4, 0, b"x")
+    client = TcpTransport(
+        peers={"exec-dead": dead_addr, "exec-live": live.address},
+        retries=1, liveness=runtime.live_executors)
+    try:
+        assert client.list_blocks(12, 0) == [(12, 4, 0)]
+        assert client.fetch(12, 4, 0) == b"x"
+    finally:
+        client.close()
+        live.close()
+
+
+def test_dead_peer_without_liveness_raises_on_list():
+    """Without a liveness source, an unreachable peer must surface as an
+    error (silent partial listings would drop rows)."""
+    dead = TcpTransport()
+    dead_addr = dead.address
+    dead.close()
+    client = TcpTransport(peers={1: dead_addr}, retries=1)
+    try:
+        with pytest.raises(TransportError, match="unreachable"):
+            client.list_blocks(1, 0)
+    finally:
+        client.close()
+
+
+def test_peer_resets_mid_frame():
+    """A peer that accepts the connection then slams it shut mid-protocol
+    must produce a clean TransportError after retries, not a hang."""
+    import socket
+    import threading as th
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    stop = th.Event()
+
+    def evil():
+        while not stop.is_set():
+            try:
+                srv.settimeout(0.2)
+                conn, _ = srv.accept()
+                conn.close()          # mid-handshake reset
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+
+    t = th.Thread(target=evil, daemon=True)
+    t.start()
+    client = TcpTransport(peers={1: srv.getsockname()}, retries=2)
+    try:
+        with pytest.raises(TransportError):
+            client.fetch(5, 0, 0)
+    finally:
+        client.close()
+        stop.set()
+        srv.close()
+        t.join(timeout=5)

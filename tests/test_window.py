@@ -127,3 +127,52 @@ def test_unsupported_frame_falls_back():
                  frame=WindowFrame(is_rows=True, start=None, end=2))
             .alias("m")),
         "CpuFallback")
+
+
+# ---- key batching (reference: GpuKeyBatchingIterator) ----
+
+def test_key_batching_splits_on_group_boundaries():
+    from spark_rapids_tpu.exec import InMemoryScanExec, KeyBatchingExec
+    from spark_rapids_tpu.batch import to_arrow
+
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=40,
+                                    nullable=False)),
+                   ("v", LongGen())], n=900, seed=121)
+    scan = InMemoryScanExec(t, batch_rows=200)
+    kb = KeyBatchingExec([col("k")], scan, target_rows=150)
+    seen_keys = []
+    total = 0
+    n_batches = 0
+    for b in kb.execute_partition(0):
+        at = to_arrow(b, kb.output_schema)
+        ks = set(at.column("k").to_pylist())
+        # whole groups: no key may appear in two batches
+        for prev in seen_keys:
+            assert not (ks & prev), (ks, prev)
+        seen_keys.append(ks)
+        total += at.num_rows
+        n_batches += 1
+    assert total == 900
+    assert n_batches > 1, "target_rows=150 over 900 rows must split"
+
+
+def test_window_with_key_batching_conf():
+    # tiny batch target: the planner's KeyBatchingExec splits the window
+    # partition into several key-complete batches; results must not change
+    _q2 = lambda f: assert_tpu_and_cpu_are_equal_collect(
+        f, conf={"spark.rapids.tpu.sql.window.batchRows": 64})
+    _q2(lambda: table(WT).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")],
+             [asc(col("o")), asc(col("v"))]).alias("rs")))
+    _q2(lambda: table(WT).window(
+        over(RowNumber(), [col("k")], [asc(col("o")), asc(col("v"))])
+        .alias("rn")))
+
+
+def test_window_key_batching_exec_in_plan():
+    from spark_rapids_tpu.plan import Session
+    ses = Session({"spark.rapids.tpu.sql.window.batchRows": 64})
+    ses.collect(table(WT).window(
+        over(Rank(), [col("k")], [asc(col("o"))]).alias("r")))
+    assert any("KeyBatching" in n for n in ses.executed_exec_names()), \
+        ses.executed_exec_names()
