@@ -198,6 +198,13 @@ SHUFFLE_COMPRESSION = conf("spark.rapids.tpu.shuffle.compression.codec").doc(
     "Codec for serialized shuffle/spill batches: none, lz4 or zstd "
     "(reference: nvcomp TableCompressionCodec).").text("lz4")
 
+LEAK_DETECTION = conf("spark.rapids.tpu.memory.leakDetection").doc(
+    "Record the registration site of every buffer-catalog handle and "
+    "report handles that outlive their owner (reference: cudf "
+    "MemoryCleaner refcount leak checks). Small hot-path cost; meant for "
+    "tests and debugging."
+).boolean(False)
+
 OOM_DUMP_DIR = conf("spark.rapids.tpu.memory.oomDumpDir").doc(
     "If set, dump the buffer-catalog state here when an allocation cannot be "
     "satisfied even after spilling (reference: "

@@ -292,9 +292,9 @@ class HashAggregateExec(UnaryExec):
                 part = self._update_jit(batch)
             else:
                 part = batch
-            sb = SpillableBatch(cat, part, buf_schema)
-            sb.done_with()
-            spillables.append((sb, int(part.capacity)))
+            # registered handles start unpinned (spillable)
+            spillables.append((SpillableBatch(cat, part, buf_schema),
+                               int(part.capacity)))
 
         finalize = self.mode in (AggregateMode.FINAL, AggregateMode.COMPLETE)
         if not spillables:
@@ -363,7 +363,6 @@ class HashAggregateExec(UnaryExec):
                     sb.done_with()
                     sb.close()
                 nsb = SpillableBatch(cat, merged, buf_schema)
-                nsb.done_with()
                 new_entries.append((nsb, int(merged.capacity)))
                 shrunk += cap_sum - int(merged.capacity)
             # mutate the caller's list so the finally-close sees live handles

@@ -434,9 +434,8 @@ class HashJoinExec(BinaryExec):
             for s in range(n_buckets):
                 piece = split_stream(batch, s)
                 if int(piece.num_rows) > 0:
-                    sp = SpillableBatch(cat, piece, stream_schema)
-                    sp.done_with()
-                    sub_stream[s].append(sp)
+                    sub_stream[s].append(
+                        SpillableBatch(cat, piece, stream_schema))
 
         for s in range(n_buckets):
             def pieces(bucket=s):

@@ -10,6 +10,13 @@ keys ONCE (one lax.sort — windows need that sort anyway) and the group
 boundary positions come back to the host, which picks cut points on whole
 groups closest to the row target. Each emitted batch is a static-shape
 slice, so downstream kernels compile once per bucket size.
+
+What this bounds: the DOWNSTREAM operator's per-batch working set (window
+scans allocate several columns per expression over the batch). The
+batching sort itself still materializes the partition once — same peak as
+the previous concat-whole-partition behavior, not worse; a spill-aware
+chunked pre-sort (through OutOfCoreSorter) is the refinement if window
+inputs ever exceed HBM on their own.
 """
 
 from __future__ import annotations
@@ -85,14 +92,20 @@ class KeyBatchingExec(UnaryExec):
         if total <= self.target_rows:
             yield srt
             return
-        # group start positions -> host; cut on whole groups
+        # group start positions -> host; cut on whole groups at the LAST
+        # start that keeps the batch <= target_rows (a batch exceeds the
+        # target only when one single group does — the same bound
+        # GpuKeyBatchingIterator guarantees)
         starts = np.flatnonzero(np.asarray(new_group))
         n = int(srt.num_rows)
         cuts: List[int] = [0]
-        for s in starts[1:]:
-            if s - cuts[-1] >= self.target_rows:
-                cuts.append(int(s))
-        cuts.append(n)
+        prev = 0
+        for s in list(starts[1:]) + [n]:
+            if s - cuts[-1] > self.target_rows and prev > cuts[-1]:
+                cuts.append(int(prev))
+            prev = int(s)
+        if cuts[-1] != n:
+            cuts.append(n)
         for lo, hi in zip(cuts, cuts[1:]):
             if hi > lo:
                 yield self._slice_jit(srt, lo, hi - lo,

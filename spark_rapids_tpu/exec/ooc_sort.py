@@ -33,9 +33,8 @@ class _Run:
         self.chunks: List[SpillableBatch] = []
 
     def append(self, batch: ColumnarBatch) -> None:
-        sb = SpillableBatch(self.catalog, batch, self.schema)
-        sb.done_with()
-        self.chunks.append(sb)
+        # register() leaves the handle unpinned (spillable) already
+        self.chunks.append(SpillableBatch(self.catalog, batch, self.schema))
 
     def close(self) -> None:
         for c in self.chunks:

@@ -105,9 +105,8 @@ class SortExec(UnaryExec):
         schema = self.output_schema
         for cp in range(self.child.num_partitions):
             for b in self.child.execute_partition(cp):
-                sb = SpillableBatch(cat, b, schema)
-                sb.done_with()
-                spillables.append(sb)
+                # registered handles start unpinned (spillable)
+                spillables.append(SpillableBatch(cat, b, schema))
         if not spillables:
             return
         try:

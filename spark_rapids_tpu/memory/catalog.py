@@ -51,12 +51,25 @@ class _Entry:
     path: Optional[str] = None                     # DISK
     schema: Optional[Schema] = None
     pinned: int = 0
+    origin: Optional[str] = None                   # leak tracking: creator
+
+
+class LeakError(RuntimeError):
+    """Catalog handles outlived their owner (reference: cudf
+    MemoryCleaner refcount leak checks + RapidsBufferStore double-free
+    asserts)."""
+
+
+class DoubleReleaseError(RuntimeError):
+    """release() on an unpinned handle — an Arm-discipline violation the
+    reference's refcounted buffers turn into a hard assert."""
 
 
 class BufferCatalog:
     def __init__(self, device_limit: int = 8 << 30,
                  host_limit: int = 4 << 30,
-                 spill_dir: str = "/tmp/rapids_tpu_spill"):
+                 spill_dir: str = "/tmp/rapids_tpu_spill",
+                 track_leaks: bool = False):
         self.device_limit = device_limit
         self.host_limit = host_limit
         self.spill_dir = spill_dir
@@ -67,6 +80,10 @@ class BufferCatalog:
         self.host_used = 0
         self.spilled_to_host = 0
         self.spilled_to_disk = 0
+        # leak tracking (reference: MemoryCleaner): record who registered
+        # each handle so leak_check can name the culprit. Off by default —
+        # capturing stacks costs time on the hot path.
+        self.track_leaks = track_leaks
 
     # ------------------------------------------------------------------
     # registration / reservation
@@ -75,12 +92,21 @@ class BufferCatalog:
     def register(self, batch: ColumnarBatch, schema: Schema,
                  priority: int = 0) -> int:
         size = batch.size_bytes()
+        origin = None
+        if self.track_leaks:
+            import traceback
+            # the closest non-catalog frame is the owner
+            for f in reversed(traceback.extract_stack(limit=8)[:-1]):
+                if "memory/catalog" not in f.filename:
+                    origin = f"{f.filename}:{f.lineno} in {f.name}"
+                    break
         with self._lock:
             self.reserve(size)
             hid = self._next
             self._next += 1
             self._entries[hid] = _Entry(hid, StorageTier.DEVICE, size,
-                                        priority, batch=batch, schema=schema)
+                                        priority, batch=batch, schema=schema,
+                                        origin=origin)
             return hid
 
     def reserve(self, nbytes: int) -> None:
@@ -203,7 +229,11 @@ class BufferCatalog:
     def release(self, hid: int) -> None:
         with self._lock:
             e = self._entries[hid]
-            e.pinned = max(0, e.pinned - 1)
+            if e.pinned <= 0:
+                raise DoubleReleaseError(
+                    f"handle #{hid} released while unpinned"
+                    + (f" (registered at {e.origin})" if e.origin else ""))
+            e.pinned -= 1
 
     def remove(self, hid: int) -> None:
         with self._lock:
@@ -222,6 +252,27 @@ class BufferCatalog:
 
     def tier_of(self, hid: int) -> StorageTier:
         return self._entries[hid].tier
+
+    # ------------------------------------------------------------------
+    # leak detection (reference: cudf MemoryCleaner shutdown check +
+    # Plugin.scala shutdown-hook ordering)
+    # ------------------------------------------------------------------
+
+    def leak_check(self) -> List[str]:
+        """Describe every handle still registered — after a query closes
+        its plan, a non-empty result is a leak."""
+        with self._lock:
+            return [
+                f"#{e.handle_id} {e.tier.name} {e.size}b pinned={e.pinned}"
+                + (f" from {e.origin}" if e.origin else "")
+                for e in self._entries.values()]
+
+    def assert_no_leaks(self) -> None:
+        leaks = self.leak_check()
+        if leaks:
+            raise LeakError(
+                f"{len(leaks)} catalog handle(s) leaked:\n  " +
+                "\n  ".join(leaks))
 
     def dump_state(self) -> str:
         """OOM diagnostics (reference: spark.rapids.memory.gpu.oomDumpDir)."""

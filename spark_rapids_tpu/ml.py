@@ -108,7 +108,9 @@ def collect_numpy(session, df, nulls_to: Optional[float] = None
 def collect_torch(session, df, nulls_to: Optional[float] = None):
     """name -> torch tensor (via numpy; torch in this image is CPU-only,
     so the bridge is one host copy — on a GPU/TPU torch build this would
-    ride dlpack device-to-device)."""
+    ride dlpack device-to-device). The copy is deliberate: collect_numpy
+    may return read-only views of the engine's own buffers, and a shared
+    tensor would let in-place torch ops corrupt cached column data."""
     import torch
-    return {k: torch.from_numpy(np.ascontiguousarray(v))
+    return {k: torch.from_numpy(np.array(v))
             for k, v in collect_numpy(session, df, nulls_to).items()}

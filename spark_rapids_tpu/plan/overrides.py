@@ -698,8 +698,9 @@ class Overrides:
         elif child.num_partitions > 1:
             child = self._exchange(SinglePartitioning(), child)
         if pkeys:
-            # bound device residency: re-chunk into key-complete batches
-            # (reference: GpuKeyBatchingIterator feeding GpuWindowExec)
+            # bound the window kernel's per-batch working set by
+            # re-chunking into key-complete batches (reference:
+            # GpuKeyBatchingIterator feeding GpuWindowExec)
             from ..config import WINDOW_BATCH_ROWS
             from ..exec.key_batching import KeyBatchingExec
             child = KeyBatchingExec(pkeys, child,

@@ -111,6 +111,7 @@ class ExecutorRuntime:
     def _init_memory(self):
         """Reference: initializeRmm pool sizing (GpuDeviceManager:192-317) —
         here the reservation budget is sized from real HBM when known."""
+        from .config import LEAK_DETECTION
         from .memory.catalog import BufferCatalog
         frac = self.conf.get(HBM_POOL_FRACTION.key)
         reserve = self.conf.get(HBM_RESERVE.key)
@@ -118,7 +119,8 @@ class ExecutorRuntime:
         limit = max(int(hbm * frac) - reserve, 1 << 30)
         return BufferCatalog(device_limit=limit,
                              host_limit=self.conf.get(HOST_SPILL_LIMIT.key),
-                             spill_dir=self.conf.get(SPILL_DIR.key))
+                             spill_dir=self.conf.get(SPILL_DIR.key),
+                             track_leaks=self.conf.get(LEAK_DETECTION.key))
 
     # ------------------------------------------------------------------
     # failure handling (reference: Plugin.scala:370-392 onTaskFailed)
@@ -172,7 +174,13 @@ class ExecutorRuntime:
                 if now - t <= timeout_s]
 
     def shutdown(self) -> None:
-        pass
+        # the MemoryCleaner-at-shutdown analogue (reference:
+        # Plugin.scala:283-298 shutdown-hook ordering): surviving catalog
+        # handles at engine shutdown are leaks — log them loudly
+        leaks = self.catalog.leak_check()
+        if leaks:
+            log.error("catalog leak check: %d handle(s) still registered "
+                      "at shutdown:\n  %s", len(leaks), "\n  ".join(leaks))
 
 
 def init(conf_dict: Optional[Dict] = None) -> ExecutorRuntime:

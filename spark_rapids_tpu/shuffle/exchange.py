@@ -230,29 +230,39 @@ class ShuffleExchangeExec(UnaryExec):
             return
         # shuffle-read coalesce (reference: GpuShuffleCoalesceExec)
         cap = bucket_capacity(max(sum(rows for _, rows in entries), 1))
+        pinned = set()
         try:
             if len(entries) == 1:
-                yield entries[0][0].get()
+                batch = entries[0][0].get()
+                pinned.add(id(entries[0][0]))
+                yield batch
             else:
-                yield concat_batches([sb.get() for sb, _ in entries], cap)
+                got = []
+                for sb, _ in entries:
+                    got.append(sb.get())
+                    pinned.add(id(sb))
+                yield concat_batches(got, cap)
         finally:
             # free a piece after its LAST referencing read partition
-            # (skew-split replicates build pieces across readers). An
-            # abandoned generator (limit early-exit) may be finalized
-            # AFTER do_close() already reset the refcounts — close() is
-            # idempotent, so just close everything in that case.
+            # (skew-split replicates build pieces across readers). Two
+            # error-path subtleties: an abandoned generator (limit
+            # early-exit) may be finalized AFTER do_close() already reset
+            # the refcounts (use is None -> idempotent close), and a
+            # mid-loop OOM from get() leaves later entries UNPINNED —
+            # only actually-pinned handles get done_with, so the original
+            # error propagates instead of a DoubleReleaseError.
             use = self._use_left
             for op_, lo, hi in spec:
                 for i in range(lo, hi):
                     sb = parts[op_][i][0]
                     if use is None:
                         sb.close()
-                    else:
-                        use[(op_, i)] -= 1
-                        if use[(op_, i)] <= 0:
-                            sb.close()
-                        else:
-                            sb.done_with()
+                        continue
+                    use[(op_, i)] -= 1
+                    if use[(op_, i)] <= 0:
+                        sb.close()
+                    elif id(sb) in pinned:
+                        sb.done_with()
 
     def do_close(self) -> None:
         # partitions the consumer never read (limits, early exit) still

@@ -161,3 +161,67 @@ def test_metrics_collection():
     m2 = s2.metrics()
     assert not any(k.endswith("opTime") for k in m2), m2
     assert any(k.endswith("numOutputRows") for k in m2), m2
+
+
+# ---- leak tracking (reference: cudf MemoryCleaner / refcount asserts) ----
+
+def test_leak_check_names_origin():
+    from spark_rapids_tpu.memory.catalog import BufferCatalog, LeakError
+    from spark_rapids_tpu.batch import from_arrow
+    import pyarrow as pa
+    import pytest
+
+    cat = BufferCatalog(device_limit=1 << 24, track_leaks=True)
+    b, s = from_arrow(pa.table({"x": pa.array([1, 2, 3], pa.int64())}))
+    from spark_rapids_tpu.memory.catalog import SpillableBatch
+    sb = SpillableBatch(cat, b, s)
+    leaks = cat.leak_check()
+    assert len(leaks) == 1 and "test_memory" in leaks[0], leaks
+    with pytest.raises(LeakError, match="leaked"):
+        cat.assert_no_leaks()
+    sb.close()
+    cat.assert_no_leaks()
+
+
+def test_double_release_raises():
+    from spark_rapids_tpu.memory.catalog import (BufferCatalog,
+                                                 DoubleReleaseError,
+                                                 SpillableBatch)
+    from spark_rapids_tpu.batch import from_arrow
+    import pyarrow as pa
+    import pytest
+
+    cat = BufferCatalog(device_limit=1 << 24)
+    b, s = from_arrow(pa.table({"x": pa.array([1], pa.int64())}))
+    sb = SpillableBatch(cat, b, s)
+    sb.get()
+    sb.done_with()
+    with pytest.raises(DoubleReleaseError):
+        sb.done_with()
+    sb.close()
+
+
+def test_query_leaves_no_catalog_leaks():
+    """End-to-end discipline: after collect() closes the plan, the
+    process-wide catalog must hold no entries from the query's exchanges,
+    broadcasts or aggregates."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.exec.join import JoinType
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Count
+    from spark_rapids_tpu.memory.catalog import device_budget
+    from spark_rapids_tpu.plan import Session, table
+
+    cat = device_budget()
+    before = len(cat._entries)
+    rng = np.random.default_rng(0)
+    left = pa.table({"k": rng.integers(0, 30, 800).astype(np.int64),
+                     "v": rng.integers(0, 9, 800).astype(np.int64)})
+    right = pa.table({"rk": np.arange(30, dtype=np.int64)})
+    ses = Session({"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 10,
+                   "spark.rapids.tpu.shuffle.partitions": 4})
+    ses.collect(table(left, num_slices=3)
+                .join(table(right), ["k"], ["rk"], JoinType.INNER)
+                .group_by("k").agg(Count().alias("c")))
+    assert len(cat._entries) == before, cat.leak_check()

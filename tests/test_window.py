@@ -140,6 +140,8 @@ def test_key_batching_splits_on_group_boundaries():
                    ("v", LongGen())], n=900, seed=121)
     scan = InMemoryScanExec(t, batch_rows=200)
     kb = KeyBatchingExec([col("k")], scan, target_rows=150)
+    from collections import Counter
+    biggest_group = max(Counter(t.column("k").to_pylist()).values())
     seen_keys = []
     total = 0
     n_batches = 0
@@ -150,6 +152,9 @@ def test_key_batching_splits_on_group_boundaries():
         for prev in seen_keys:
             assert not (ks & prev), (ks, prev)
         seen_keys.append(ks)
+        # the documented bound: a batch exceeds target_rows only if one
+        # single group does
+        assert at.num_rows <= max(150, biggest_group), at.num_rows
         total += at.num_rows
         n_batches += 1
     assert total == 900
