@@ -136,22 +136,26 @@ class HashAggregateExec(UnaryExec):
     # Shared segment machinery
     # ------------------------------------------------------------------
 
-    def _segments(self, key_cols: List[DeviceColumn], num_rows, cap: int,
+    def _segments(self, key_cols: List[DeviceColumn], live, cap: int,
                   value_cols: List[DeviceColumn] = ()):
         """Sort rows by key (+ optional value columns for sort-sensitive
-        aggregates); return (perm, seg ids, new_group mask, count)."""
-        live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        aggregates); return (perm, seg ids, new_group mask, count,
+        sorted-live mask, live row count). ``live`` may exclude rows a
+        fused upstream filter dropped — they sort last, exactly like
+        padding rows, so no separate compaction pass is needed."""
+        n_live = jnp.sum(live.astype(jnp.int32))
         if not key_cols and not value_cols:
             seg = jnp.where(live, 0, cap)
             new_group = jnp.arange(cap, dtype=jnp.int32) == 0
-            return None, seg, new_group, jnp.asarray(1, jnp.int32), live
+            return None, seg, new_group, jnp.asarray(1, jnp.int32), live, \
+                n_live
         all_cols = list(key_cols) + list(value_cols)
         ops = sort_operands(all_cols, [False] * len(all_cols),
                             [True] * len(all_cols), live)
         iota = jnp.arange(cap, dtype=jnp.int32)
         perm = jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)[-1]
         sorted_keys = [gather_column(c, perm) for c in key_cols]
-        sorted_live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        sorted_live = jnp.arange(cap, dtype=jnp.int32) < n_live
         if key_cols:
             eq = adjacent_equal(sorted_keys)
         else:
@@ -162,17 +166,32 @@ class HashAggregateExec(UnaryExec):
         group_id = jnp.cumsum(new_group.astype(jnp.int32)) - 1
         seg = jnp.where(sorted_live, group_id, cap)
         count = jnp.sum(new_group.astype(jnp.int32))
-        return perm, seg, new_group, count, sorted_live
+        return perm, seg, new_group, count, sorted_live, n_live
 
-    def _group_first_keys(self, sorted_keys: List[DeviceColumn], new_group,
-                          cap: int) -> List[DeviceColumn]:
-        """Place each segment's first-row key at its group slot — as a
-        stable flag-sort + gather (segments ascend, so the g-th first-row
-        IS group g's key; TPU scatters are ~40x slower than gathers)."""
+    def _segment_layout(self, seg, count, num_rows, cap: int):
+        """(starts, ends) row-index bounds per group slot, feeding the
+        aggregates' segmented-scan reductions (segment_bounds context in
+        expressions/aggregates.py) AND first-key placement. One native
+        int32 scatter (`segment_min` of iota) — the flag-sort alternative
+        measured ~3x slower. Dead slots get ends < starts so their
+        reductions resolve to the identity."""
         iota = jnp.arange(cap, dtype=jnp.int32)
-        _, perm = jax.lax.sort([(~new_group).astype(jnp.uint8), iota],
-                               num_keys=2)
-        slot_live = iota < jnp.sum(new_group.astype(jnp.int32))
+        starts = jax.ops.segment_min(iota, seg, num_segments=cap,
+                                     indices_are_sorted=True)
+        nxt = jnp.concatenate([starts[1:], jnp.zeros(1, jnp.int32)])
+        last = jnp.asarray(num_rows, jnp.int32) - 1
+        ends = jnp.where(iota < count - 1, nxt - 1, last)
+        starts = jnp.where(iota < count, starts, jnp.int32(1))
+        ends = jnp.where(iota < count, ends, jnp.int32(0))
+        return starts, ends
+
+    def _group_first_keys(self, sorted_keys: List[DeviceColumn], perm,
+                          count, cap: int) -> List[DeviceColumn]:
+        """Place each segment's first-row key at its group slot — a gather
+        through the slot order (segments ascend, so the g-th first-row IS
+        group g's key; TPU scatters are ~40x slower than gathers)."""
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        slot_live = iota < count
         out = []
         for c in sorted_keys:
             data = jnp.take(c.data, perm, axis=0)
@@ -189,9 +208,16 @@ class HashAggregateExec(UnaryExec):
     # Kernels
     # ------------------------------------------------------------------
 
-    def _update_kernel(self, batch: ColumnarBatch) -> ColumnarBatch:
-        """input rows -> buffer-layout batch (Partial)."""
+    def _update_kernel(self, batch: ColumnarBatch,
+                       mask=None) -> ColumnarBatch:
+        """input rows -> buffer-layout batch (Partial). ``mask`` fuses an
+        upstream filter into the aggregation: masked rows become dead
+        rows of the sort, skipping the separate compaction kernel
+        (reference analogue: AST-fused filters)."""
         cap = batch.capacity
+        in_live = batch.row_mask()
+        if mask is not None:
+            in_live = in_live & mask
         key_cols = [e.eval(batch, self.ctx) for e in self.group_exprs]
         input_cols = [[c.eval(batch, self.ctx) for c in agg.children]
                       for agg in self.aggs]
@@ -199,15 +225,24 @@ class HashAggregateExec(UnaryExec):
         if self.sort_sensitive:
             si = self.aggs.index(self.sort_sensitive[0])
             value_sort = list(input_cols[si])
-        perm, seg, new_group, count, live = self._segments(
-            key_cols, batch.num_rows, cap, value_sort)
+        perm, seg, new_group, count, live, n_live = self._segments(
+            key_cols, in_live, cap, value_sort)
         if perm is not None:
             key_cols = [gather_column(c, perm) for c in key_cols]
             input_cols = [[gather_column(c, perm) for c in cols]
                           for cols in input_cols]
-        out_cols = self._group_first_keys(key_cols, new_group, cap)
-        for agg, cols in zip(self.aggs, input_cols):
-            out_cols.extend(agg.update(cols, seg, live, cap))
+        from ..expressions.aggregates import segment_bounds
+        starts, ends = self._segment_layout(seg, count, n_live, cap)
+        out_cols = self._group_first_keys(key_cols, starts, count, cap)
+        if perm is None:
+            # unsorted (keyless) segments are not contiguous under a
+            # fused mask — the scan path needs runs, use scatters
+            for agg, cols in zip(self.aggs, input_cols):
+                out_cols.extend(agg.update(cols, seg, live, cap))
+        else:
+            with segment_bounds(starts, ends):
+                for agg, cols in zip(self.aggs, input_cols):
+                    out_cols.extend(agg.update(cols, seg, live, cap))
         group_live = jnp.arange(cap, dtype=jnp.int32) < count
         out_cols = [c.replace(validity=c.validity & group_live)
                     if i < len(key_cols) else c
@@ -219,24 +254,27 @@ class HashAggregateExec(UnaryExec):
         cap = batch.capacity
         nk = len(self.key_fields)
         key_cols = [batch.columns[i] for i in range(nk)]
-        perm, seg, new_group, count, live = self._segments(
-            key_cols, batch.num_rows, cap)
+        perm, seg, new_group, count, live, n_live = self._segments(
+            key_cols, batch.row_mask(), cap)
         if perm is not None:
             cols = [gather_column(c, perm) for c in batch.columns]
         else:
             cols = list(batch.columns)
-        out_cols = self._group_first_keys(cols[:nk], new_group, cap)
+        from ..expressions.aggregates import segment_bounds
+        starts, ends = self._segment_layout(seg, count, n_live, cap)
+        out_cols = self._group_first_keys(cols[:nk], starts, count, cap)
         group_live = jnp.arange(cap, dtype=jnp.int32) < count
         off = nk
-        for agg in self.aggs:
-            nb = len(agg.buffer_types())
-            bufs = cols[off:off + nb]
-            merged = agg.merge(bufs, seg, live, cap)
-            if final:
-                out_cols.append(agg.evaluate(merged, group_live))
-            else:
-                out_cols.extend(merged)
-            off += nb
+        with segment_bounds(starts, ends):
+            for agg in self.aggs:
+                nb = len(agg.buffer_types())
+                bufs = cols[off:off + nb]
+                merged = agg.merge(bufs, seg, live, cap)
+                if final:
+                    out_cols.append(agg.evaluate(merged, group_live))
+                else:
+                    out_cols.extend(merged)
+                off += nb
         out_cols = [c.replace(validity=c.validity & group_live)
                     if i < nk else c for i, c in enumerate(out_cols)]
         return ColumnarBatch(tuple(out_cols), count)

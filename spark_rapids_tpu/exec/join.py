@@ -184,8 +184,13 @@ class HashJoinExec(BinaryExec):
         # probe sentinel 0xFFFFFFFE ≠ build null sentinel 0xFFFFFFFF, and
         # both have the top bit real hashes never set
         h = jnp.where(valid, _hash64(keys, valid), ~jnp.uint32(0) - 1)
-        lo = jnp.searchsorted(sorted_h, h, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(sorted_h, h, side="right").astype(jnp.int32)
+        # method="sort": one concat-sort instead of a serialized binary
+        # search (log-n dependent gather rounds) — measured 5.2x faster
+        # at 4M probes on v5e
+        lo = jnp.searchsorted(sorted_h, h, side="left",
+                              method="sort").astype(jnp.int32)
+        hi = jnp.searchsorted(sorted_h, h, side="right",
+                              method="sort").astype(jnp.int32)
         counts = jnp.where(valid, hi - lo, 0)
         offsets = jnp.cumsum(counts)
         # int32 offsets keep the searches native-width; the 64-bit total
@@ -197,7 +202,8 @@ class HashJoinExec(BinaryExec):
         """Candidate pair gather + key verification (+ condition)."""
         j = jnp.arange(out_cap, dtype=jnp.int32)
         total = offsets[-1]
-        probe_row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32)
+        probe_row = jnp.searchsorted(offsets, j, side="right",
+                                     method="sort").astype(jnp.int32)
         probe_row = jnp.clip(probe_row, 0, stream.capacity - 1)
         start = jnp.take(offsets, probe_row) - jnp.take(counts, probe_row)
         ordinal = j - start
@@ -460,21 +466,35 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
                  ctx: Optional[EvalContext] = None,
                  max_tile_rows: int = 1 << 20):
         super().__init__(left, right, ctx)
-        if join_type not in (JoinType.INNER, JoinType.CROSS):
-            raise NotImplementedError(
-                f"nested-loop {join_type} lands with the planner round")
         self.join_type = join_type
         self.max_tile_rows = max_tile_rows
-        self._schema = Schema(list(left.output_schema.fields)
-                              + list(right.output_schema.fields))
-        self.condition = condition.bind(self._schema) if condition else None
+        lf, rf = left.output_schema.fields, right.output_schema.fields
+        pair_schema = Schema(list(lf) + list(rf))
+        l_nullable = join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER)
+        r_nullable = join_type in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER)
+        if join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            self._schema = left.output_schema
+        elif join_type is JoinType.EXISTENCE:
+            self._schema = Schema(list(lf) + [Field("exists", T.BOOLEAN,
+                                                    False)])
+        else:
+            self._schema = Schema(
+                [Field(f.name, f.dtype, f.nullable or l_nullable)
+                 for f in lf] +
+                [Field(f.name, f.dtype, f.nullable or r_nullable)
+                 for f in rf])
+        # the condition sees the (left, right) PAIR row, whatever the
+        # join type projects out (reference: AST closures in
+        # GpuBroadcastNestedLoopJoinExec conditional variants)
+        self.condition = condition.bind(pair_schema) if condition else None
         self._cross_jit = jax.jit(self._cross_kernel)
+        self._count_jit = jax.jit(self._count_kernel)
 
     @property
     def output_schema(self) -> Schema:
         return self._schema
 
-    def _cross_kernel(self, stream: ColumnarBatch, build: ColumnarBatch):
+    def _keep_mask(self, stream: ColumnarBatch, build: ColumnarBatch):
         s_cap, b_cap = stream.capacity, build.capacity
         out_cap = s_cap * b_cap
         j = jnp.arange(out_cap, dtype=jnp.int32)
@@ -482,34 +502,135 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
         live = (si < stream.num_rows) & (bi < build.num_rows)
         s_cols = [gather_column(c, si, live) for c in stream.columns]
         b_cols = [gather_column(c, bi, live) for c in build.columns]
-        # live slots are interleaved (row-major tiles), so always compact
         out = ColumnarBatch(tuple(s_cols + b_cols),
                             jnp.asarray(out_cap, jnp.int32))
         keep = live
         if self.condition is not None:
             c = self.condition.eval(out, self.ctx)
             keep = keep & c.data & c.validity
-        return compact(out, keep)
+        return out, keep, si, bi
+
+    def _matches(self, keep, si, bi, s_cap: int, b_cap: int):
+        # NOT indices_are_sorted: masking drops condition-failing slots to
+        # the sentinel segment BETWEEN ascending si values, so the ids are
+        # no longer monotone and the sorted-scatter lowering would be
+        # unsound
+        seg_s = jnp.where(keep, si, s_cap)
+        s_m = jax.ops.segment_sum(keep.astype(jnp.int32), seg_s,
+                                  num_segments=s_cap + 1)[:s_cap]
+        seg_b = jnp.where(keep, bi, b_cap)
+        b_m = jax.ops.segment_sum(keep.astype(jnp.int32), seg_b,
+                                  num_segments=b_cap + 1)[:b_cap]
+        return s_m, b_m
+
+    def _cross_kernel(self, stream: ColumnarBatch, build: ColumnarBatch):
+        out, keep, si, bi = self._keep_mask(stream, build)
+        if self.join_type in (JoinType.INNER, JoinType.CROSS):
+            # no tails -> no match bookkeeping; keep the kernel lean
+            return compact(out, keep), None, None
+        s_m, b_m = self._matches(keep, si, bi, stream.capacity,
+                                 build.capacity)
+        # live slots are interleaved (row-major tiles), so always compact
+        return compact(out, keep), s_m, b_m
+
+    def _count_kernel(self, stream: ColumnarBatch, build: ColumnarBatch):
+        _, keep, si, bi = self._keep_mask(stream, build)
+        return self._matches(keep, si, bi, stream.capacity, build.capacity)
 
     @property
     def num_partitions(self) -> int:
+        # RIGHT/FULL outer emit the unmatched-build tail exactly once, so
+        # every stream partition folds into one (broadcast build — same
+        # policy as HashJoinExec)
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            return 1
         return self.left.num_partitions
+
+    def _build_tiles(self, build: ColumnarBatch, stream_cap: int):
+        """(offset, piece) tiles of the build side bounded so one
+        expansion stays under max_tile_rows output slots."""
+        from .common import slice_batch
+        if stream_cap * build.capacity <= self.max_tile_rows:
+            yield 0, build
+            return
+        tile = max(self.max_tile_rows // stream_cap, 1)
+        tile_cap = bucket_capacity(tile)
+        n_build = int(build.num_rows)
+        slice_jit = jax.jit(slice_batch, static_argnums=3)
+        for off in range(0, max(n_build, 1), tile_cap):
+            yield off, slice_jit(build, jnp.int32(off),
+                                 jnp.int32(tile_cap), tile_cap)
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         build_batches = [b for cp in range(self.right.num_partitions)
                          for b in self.right.execute_partition(cp)]
-        for stream in self.left.execute_partition(p):
-            for build in build_batches:
-                if stream.capacity * build.capacity > self.max_tile_rows:
-                    # tile the build side
-                    from .common import slice_batch
-                    tile = max(self.max_tile_rows // stream.capacity, 1)
-                    tile_cap = bucket_capacity(tile)
-                    n_build = int(build.num_rows)
-                    for off in range(0, max(n_build, 1), tile_cap):
-                        piece = jax.jit(slice_batch, static_argnums=3)(
-                            build, jnp.int32(off), jnp.int32(tile_cap),
-                            tile_cap)
-                        yield self._cross_jit(stream, piece)
-                else:
-                    yield self._cross_jit(stream, build)
+        if not build_batches:
+            from ..batch import empty_batch
+            build = empty_batch(self.right.output_schema)
+        elif len(build_batches) == 1:
+            build = build_batches[0]
+        else:
+            build = concat_batches(
+                build_batches,
+                bucket_capacity(sum(b.capacity for b in build_batches)))
+
+        if self.num_partitions == 1 and self.left.num_partitions > 1:
+            stream_parts: Sequence[int] = range(self.left.num_partitions)
+        else:
+            stream_parts = (p,)
+        pair_out = self.join_type in (JoinType.INNER, JoinType.CROSS,
+                                      JoinType.LEFT_OUTER,
+                                      JoinType.RIGHT_OUTER,
+                                      JoinType.FULL_OUTER)
+        matched_build = jnp.zeros(build.capacity, jnp.int32)
+        for sp in stream_parts:
+            for stream in self.left.execute_partition(sp):
+                s_matched = jnp.zeros(stream.capacity, jnp.int32)
+                for off, piece in self._build_tiles(build,
+                                                    stream.capacity):
+                    if pair_out:
+                        pairs, s_m, b_m = self._cross_jit(stream, piece)
+                        yield pairs
+                    else:
+                        s_m, b_m = self._count_jit(stream, piece)
+                    if s_m is not None:
+                        s_matched = s_matched + s_m
+                        matched_build = matched_build.at[
+                            off:off + piece.capacity].add(
+                            b_m[:min(piece.capacity,
+                                     build.capacity - off)])
+                yield from self._emit_stream_tail(stream, s_matched)
+
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            unmatched = build.row_mask() & (matched_build == 0)
+            null_left = _null_gather(
+                self._empty_like(self.left.output_schema), build.capacity)
+            tail = ColumnarBatch(tuple(null_left) + build.columns,
+                                 build.num_rows)
+            yield compact(tail, unmatched)
+
+    @staticmethod
+    def _empty_like(schema: Schema) -> ColumnarBatch:
+        from ..batch import empty_batch
+        return empty_batch(schema, 1)
+
+    def _emit_stream_tail(self, stream: ColumnarBatch,
+                          s_matched) -> Iterator[ColumnarBatch]:
+        jt = self.join_type
+        if jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER):
+            unmatched = stream.row_mask() & (s_matched == 0)
+            null_right = _null_gather(
+                self._empty_like(self.right.output_schema),
+                stream.capacity)
+            tail = ColumnarBatch(stream.columns + tuple(null_right),
+                                 stream.num_rows)
+            yield compact(tail, unmatched)
+        elif jt is JoinType.LEFT_SEMI:
+            yield compact(stream, s_matched > 0)
+        elif jt is JoinType.LEFT_ANTI:
+            yield compact(stream, stream.row_mask() & (s_matched == 0))
+        elif jt is JoinType.EXISTENCE:
+            exists = DeviceColumn((s_matched > 0), stream.row_mask(),
+                                  None, T.BOOLEAN)
+            yield ColumnarBatch(stream.columns + (exists,),
+                                stream.num_rows)

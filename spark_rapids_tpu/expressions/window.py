@@ -235,12 +235,29 @@ def over(fn: WindowFunction, partition_by: Sequence[Expression] = (),
 # ---------------------------------------------------------------------------
 
 def segmented_scan(x: jnp.ndarray, head: jnp.ndarray, op, reverse=False):
-    """Inclusive segmented scan: resets at rows where head is True."""
+    """Inclusive segmented scan: resets at rows where head is True.
 
-    def combine(a, b):
-        af, av = a
-        bf, bv = b
-        return af | bf, jnp.where(bf, bv, op(av, bv))
+    Hillis-Steele inside ONE lax.fori_loop — log2(n) passes of
+    roll+where+combine. lax.associative_scan computes the same thing but
+    UNROLLS its ~2*log2(n) stages into HLO, which stalls the remote
+    compiler on multi-million-row batches; the loop body here is traced
+    once (same rationale as the aggregate segmented reductions)."""
+    n = x.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
 
-    f, v = jax.lax.associative_scan(combine, (head, x), reverse=reverse)
+    def body(k, carry):
+        f, v = carry
+        d = jnp.int32(1) << k
+        if reverse:
+            pf, pv = jnp.roll(f, -d), jnp.roll(v, -d, axis=0)
+            valid = idx + d < n
+        else:
+            pf, pv = jnp.roll(f, d), jnp.roll(v, d, axis=0)
+            valid = idx >= d
+        nv = jnp.where(valid & ~f, op(pv, v), v)
+        nf = jnp.where(valid, f | pf, f)
+        return nf, nv
+
+    _, v = jax.lax.fori_loop(0, max(n - 1, 1).bit_length(), body,
+                             (head, x))
     return v

@@ -714,9 +714,13 @@ class Overrides:
 
     def _convert_join(self, n: L.LogicalJoin, ch: List[Exec]) -> Exec:
         if n.join_type is JoinType.CROSS or not n.left_keys:
+            # keyless joins keep their TYPE: a conditional LEFT_OUTER
+            # without equi-keys is an outer nested-loop join, not a cross
+            # product (reference: GpuBroadcastNestedLoopJoinExec join-type
+            # variants)
             return BroadcastNestedLoopJoinExec(
-                JoinType.CROSS if not n.left_keys else n.join_type,
-                ch[0], self._broadcast(ch[1]), condition=n.condition)
+                n.join_type, ch[0], self._broadcast(ch[1]),
+                condition=n.condition)
         from ..config import BROADCAST_THRESHOLD, JOIN_MAX_BUILD_ROWS
         threshold = self.conf.get(BROADCAST_THRESHOLD.key)
         max_build = self.conf.get(JOIN_MAX_BUILD_ROWS.key)
