@@ -569,6 +569,19 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
             build = empty_batch(self.right.output_schema)
         elif len(build_batches) == 1:
             build = build_batches[0]
+        elif self.join_type in (JoinType.INNER, JoinType.CROSS):
+            # no cross-batch match bookkeeping: stream build batches one
+            # at a time instead of materializing a padded concat
+            for sp in (range(self.left.num_partitions)
+                       if self.num_partitions == 1 and
+                       self.left.num_partitions > 1 else (p,)):
+                for stream in self.left.execute_partition(sp):
+                    for b in build_batches:
+                        for _, piece in self._build_tiles(
+                                b, stream.capacity):
+                            pairs, _, _ = self._cross_jit(stream, piece)
+                            yield pairs
+            return
         else:
             build = concat_batches(
                 build_batches,

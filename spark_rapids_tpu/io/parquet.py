@@ -71,8 +71,70 @@ def _convert(e: Expression, pc):
     raise NotImplementedError(type(e).__name__)
 
 
+#: first proleptic-Gregorian day (1582-10-15) as days-since-epoch; values
+#: below this in a legacy-Spark file carry hybrid-Julian calendar labels
+GREGORIAN_CUTOVER_DAYS = -141427
+#: footer key legacy Spark (2.x / 3.x LEGACY writes) stamps on files whose
+#: datetimes use the hybrid calendar
+LEGACY_DATETIME_KEY = b"org.apache.spark.legacyDateTime"
+_US_PER_DAY = 86_400_000_000
+
+
+class DatetimeRebaseError(ValueError):
+    """EXCEPTION rebase mode hit an ancient datetime in a legacy file
+    (Spark's SparkUpgradeException for parquet rebase)."""
+
+
+def _julian_civil_from_days(z):
+    """Julian-calendar (y, m, d) label for days-since-epoch (numpy)."""
+    import numpy as np
+    j = z.astype(np.int64) + 2440588          # julian day number at noon
+    c = j + 32082
+    d = (4 * c + 3) // 1461
+    e = c - (1461 * d) // 4
+    m = (5 * e + 2) // 153
+    day = e - (153 * m + 2) // 5 + 1
+    month = m + 3 - 12 * (m // 10)
+    year = d - 4800 + m // 10
+    return year, month, day
+
+
+def _gregorian_days_from_civil(y, m, d):
+    """Proleptic-Gregorian days-since-epoch for (y, m, d) (numpy; the
+    vectorized Hinnant algorithm, same as expressions/datetime.py)."""
+    import numpy as np
+    y = y - (m <= 2)
+    era = np.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = np.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(np.int64)
+
+
+def rebase_julian_to_gregorian_days(days):
+    """Spark's LEGACY read rebase: keep the CALENDAR LABEL a legacy writer
+    recorded (hybrid-Julian before the cutover) and re-encode it as
+    proleptic-Gregorian days (reference: GpuParquetScan rebase handling /
+    DateTimeRebaseUtils)."""
+    import numpy as np
+    days = np.asarray(days)
+    ancient = days < GREGORIAN_CUTOVER_DAYS
+    if not ancient.any():
+        return days
+    y, m, d = _julian_civil_from_days(days)
+    return np.where(ancient, _gregorian_days_from_civil(y, m, d), days)
+
+
 class ParquetSource(FileSource):
     format_name = "parquet"
+
+    def __init__(self, *a, rebase_mode: str = "EXCEPTION", **kw):
+        # EXCEPTION (Spark's default) | CORRECTED | LEGACY — what to do
+        # with pre-1582 dates/timestamps in files stamped with the legacy
+        # hybrid-calendar footer key
+        super().__init__(*a, **kw)
+        self.rebase_mode = rebase_mode.upper()
 
     def infer_arrow_schema(self) -> pa.Schema:
         return pq.read_schema(self.files[0])
@@ -83,8 +145,75 @@ class ParquetSource(FileSource):
         if filt is not None:
             import pyarrow.dataset as ds
             dataset = ds.dataset(path, format="parquet")
-            return dataset.to_table(columns=self.columns, filter=filt)
-        return pq.read_table(path, columns=self.columns)
+            t = dataset.to_table(columns=self.columns, filter=filt)
+        else:
+            t = pq.read_table(path, columns=self.columns)
+        return self._maybe_rebase(t, path)
+
+    def _maybe_rebase(self, t: pa.Table, path: str) -> pa.Table:
+        if self.rebase_mode == "CORRECTED":
+            return t
+        has_datetime = any(
+            pa.types.is_date(f.type) or pa.types.is_timestamp(f.type)
+            for f in t.schema)
+        if not has_datetime:
+            return t
+        meta = pq.read_schema(path).metadata or {}
+        if LEGACY_DATETIME_KEY not in meta:
+            return t        # modern writer: labels already proleptic
+        import numpy as np
+        import pyarrow.compute as pc
+        cols = []
+        changed = False
+        for i, f in enumerate(t.schema):
+            col = t.column(i)
+            # fill_null BEFORE to_numpy: a nullable chunked array would
+            # otherwise come back as float64, which both fails the cast
+            # back and cannot hold pre-1582 microseconds exactly (> 2^53)
+            if pa.types.is_date(f.type):
+                mask = np.asarray(col.is_null())
+                days = np.asarray(pc.fill_null(
+                    col.cast(pa.int32()).combine_chunks(), 0))
+                ancient = (days < GREGORIAN_CUTOVER_DAYS) & ~mask
+                if ancient.any():
+                    if self.rebase_mode == "EXCEPTION":
+                        raise DatetimeRebaseError(
+                            f"{path}: column {f.name} holds pre-1582 "
+                            f"dates written by a legacy hybrid-calendar "
+                            f"Spark; set rebase_mode to LEGACY (rebase) "
+                            f"or CORRECTED (read as-is)")
+                    days = rebase_julian_to_gregorian_days(days)
+                    col = pa.chunked_array([pa.Array.from_pandas(
+                        days.astype("int32"), mask=mask).cast(f.type)])
+                    changed = True
+            elif pa.types.is_timestamp(f.type):
+                mask = np.asarray(col.is_null())
+                us = np.asarray(pc.fill_null(
+                    col.cast(pa.timestamp("us", tz=f.type.tz))
+                    .cast(pa.int64()).combine_chunks(), 0))
+                day = np.floor_divide(us, _US_PER_DAY)
+                ancient = (day < GREGORIAN_CUTOVER_DAYS) & ~mask
+                if ancient.any():
+                    if self.rebase_mode == "EXCEPTION":
+                        raise DatetimeRebaseError(
+                            f"{path}: column {f.name} holds pre-1582 "
+                            f"timestamps written by a legacy "
+                            f"hybrid-calendar Spark; set rebase_mode to "
+                            f"LEGACY or CORRECTED")
+                    tod = us - day * _US_PER_DAY
+                    day2 = rebase_julian_to_gregorian_days(day)
+                    us = day2 * _US_PER_DAY + tod
+                    # round-trip through us, then back to the ORIGINAL
+                    # field type (tz and unit preserved)
+                    col = pa.chunked_array([pa.Array.from_pandas(
+                        us, mask=mask).cast(pa.timestamp(
+                            "us", tz=f.type.tz)).cast(f.type)])
+                    changed = True
+            cols.append(col)
+        if not changed:
+            return t
+        # untouched columns keep their exact types: reuse the schema
+        return pa.table(cols, schema=t.schema)
 
     def row_group_counts(self, path: str) -> List[int]:
         f = pq.ParquetFile(path)

@@ -1,0 +1,136 @@
+"""Legacy parquet datetime handling: hybrid-calendar rebase + INT96
+(reference: GpuParquetScan rebase handling / DateTimeRebaseUtils,
+parquet_test.py rebase cases)."""
+
+import datetime as dt
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.io.parquet import (DatetimeRebaseError,
+                                         GREGORIAN_CUTOVER_DAYS,
+                                         LEGACY_DATETIME_KEY, ParquetSource,
+                                         rebase_julian_to_gregorian_days)
+
+
+def _legacy_file(tmp_path, table):
+    p = str(tmp_path / "legacy.parquet")
+    meta = dict(table.schema.metadata or {})
+    meta[LEGACY_DATETIME_KEY] = b""
+    pq.write_table(table.replace_schema_metadata(meta), p)
+    return p
+
+
+def test_rebase_known_anchors():
+    """Rebase keeps the CALENDAR LABEL and re-encodes its day number
+    (Spark's rebaseJulianToGregorianDays semantics)."""
+    # the hybrid day before the cutover carries julian label 1582-10-04;
+    # proleptic 1582-10-04 sits 10 days earlier on the day-number line
+    days = np.asarray([GREGORIAN_CUTOVER_DAYS - 1])
+    reb = rebase_julian_to_gregorian_days(days)
+    assert reb[0] == (dt.date(1582, 10, 4) - dt.date(1970, 1, 1)).days
+    # julian 1000-01-01: walk back the julian-calendar day count from
+    # julian 1582-10-04 (582 years, julian leap rule, 1582 not leap,
+    # Jan 1 -> Oct 4 = 276 days)
+    leaps = 1581 // 4 - 999 // 4
+    julian_y1000 = (GREGORIAN_CUTOVER_DAYS - 1) - (582 * 365 + leaps + 276)
+    reb = rebase_julian_to_gregorian_days(np.asarray([julian_y1000]))
+    assert reb[0] == (dt.date(1000, 1, 1) - dt.date(1970, 1, 1)).days
+    # modern days pass through untouched
+    modern = np.asarray([0, 10000, GREGORIAN_CUTOVER_DAYS])
+    assert (rebase_julian_to_gregorian_days(modern) == modern).all()
+
+
+def test_legacy_file_exception_mode(tmp_path):
+    ancient = GREGORIAN_CUTOVER_DAYS - 100
+    t = pa.table({"d": pa.array([ancient, 0], pa.int32()).cast(pa.date32())})
+    p = _legacy_file(tmp_path, t)
+    src = ParquetSource([p])                      # default EXCEPTION
+    with pytest.raises(DatetimeRebaseError, match="pre-1582"):
+        src.read_file(p)
+
+
+def test_legacy_file_corrected_and_legacy_modes(tmp_path):
+    ancient = GREGORIAN_CUTOVER_DAYS - 1
+    t = pa.table({"d": pa.array([ancient, 5], pa.int32()).cast(pa.date32()),
+                  "x": pa.array([1, 2], pa.int64())})
+    p = _legacy_file(tmp_path, t)
+    got = ParquetSource([p], rebase_mode="CORRECTED").read_file(p)
+    assert got.column("d").cast(pa.int32()).to_pylist() == [ancient, 5]
+    got = ParquetSource([p], rebase_mode="LEGACY").read_file(p)
+    expect = (dt.date(1582, 10, 4) - dt.date(1970, 1, 1)).days
+    assert got.column("d").cast(pa.int32()).to_pylist() == [expect, 5]
+    assert got.column("x").to_pylist() == [1, 2]
+
+
+def test_legacy_timestamp_rebase(tmp_path):
+    ancient_day = GREGORIAN_CUTOVER_DAYS - 1
+    us = ancient_day * 86_400_000_000 + 3_600_000_000      # 01:00:00
+    t = pa.table({"ts": pa.array([us, 0], pa.int64())
+                  .cast(pa.timestamp("us"))})
+    p = _legacy_file(tmp_path, t)
+    got = ParquetSource([p], rebase_mode="LEGACY").read_file(p)
+    expect_day = (dt.date(1582, 10, 4) - dt.date(1970, 1, 1)).days
+    vals = got.column("ts").cast(pa.int64()).to_pylist()
+    assert vals == [expect_day * 86_400_000_000 + 3_600_000_000, 0]
+
+
+def test_modern_file_untouched(tmp_path):
+    # no legacy footer key -> no rebase even for ancient values
+    ancient = GREGORIAN_CUTOVER_DAYS - 100
+    t = pa.table({"d": pa.array([ancient], pa.int32()).cast(pa.date32())})
+    p = str(tmp_path / "modern.parquet")
+    pq.write_table(t, p)
+    got = ParquetSource([p]).read_file(p)
+    assert got.column("d").cast(pa.int32()).to_pylist() == [ancient]
+
+
+def test_int96_timestamps_read(tmp_path):
+    ts = [dt.datetime(2020, 1, 1, 12, 0, 0),
+          dt.datetime(1969, 7, 20, 20, 17, 40)]
+    t = pa.table({"ts": pa.array(ts, pa.timestamp("us"))})
+    p = str(tmp_path / "int96.parquet")
+    pq.write_table(t, p, use_deprecated_int96_timestamps=True)
+    f = pq.ParquetFile(p)
+    assert f.schema.column(0).physical_type == "INT96"
+    from spark_rapids_tpu.batch import from_arrow, to_arrow
+    got = ParquetSource([p]).read_file(p)
+    batch, schema = from_arrow(got)
+    back = to_arrow(batch, schema)
+    vals = [v.replace(tzinfo=None) for v in back.column("ts").to_pylist()]
+    assert vals == ts
+
+
+def test_legacy_rebase_with_nulls(tmp_path):
+    """Nullable date/timestamp columns must rebase without the float64
+    to_numpy detour (which cannot hold pre-1582 microseconds exactly)."""
+    ancient = GREGORIAN_CUTOVER_DAYS - 1
+    us = ancient * 86_400_000_000 + 59_000_000
+    t = pa.table({
+        "d": pa.array([ancient, None, 7], pa.int32()).cast(pa.date32()),
+        "ts": pa.array([us, None, 0], pa.int64()).cast(pa.timestamp("us")),
+    })
+    p = _legacy_file(tmp_path, t)
+    got = ParquetSource([p], rebase_mode="LEGACY").read_file(p)
+    expect_day = (dt.date(1582, 10, 4) - dt.date(1970, 1, 1)).days
+    assert got.column("d").cast(pa.int32()).to_pylist() == \
+        [expect_day, None, 7]
+    assert got.column("ts").cast(pa.int64()).to_pylist() == \
+        [expect_day * 86_400_000_000 + 59_000_000, None, 0]
+
+
+def test_legacy_rebase_preserves_tz_and_other_types(tmp_path):
+    """Rebasing one column must not retype the others (tz kept)."""
+    ancient = GREGORIAN_CUTOVER_DAYS - 100
+    t = pa.table({
+        "d": pa.array([ancient], pa.int32()).cast(pa.date32()),
+        "ts_utc": pa.array([0], pa.int64()).cast(pa.timestamp("us",
+                                                              tz="UTC")),
+        "x": pa.array([9], pa.int64()),
+    })
+    p = _legacy_file(tmp_path, t)
+    got = ParquetSource([p], rebase_mode="LEGACY").read_file(p)
+    assert got.schema.field("ts_utc").type == pa.timestamp("us", tz="UTC")
+    assert got.schema.field("x").type == pa.int64()
