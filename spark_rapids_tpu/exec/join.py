@@ -83,6 +83,15 @@ def _keys_equal(a: List[DeviceColumn], b: List[DeviceColumn]) -> jnp.ndarray:
     return eq
 
 
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnums=3)
+def _slice_tile(build, off, count, cap):
+    from .common import slice_batch
+    return slice_batch(build, off, count, cap)
+
+
 def _null_gather(batch: ColumnarBatch, out_cap: int) -> List[DeviceColumn]:
     """All-null columns shaped like ``batch`` at out_cap (outer padding)."""
     zero_idx = jnp.zeros(out_cap, jnp.int32)
@@ -549,17 +558,15 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
     def _build_tiles(self, build: ColumnarBatch, stream_cap: int):
         """(offset, piece) tiles of the build side bounded so one
         expansion stays under max_tile_rows output slots."""
-        from .common import slice_batch
         if stream_cap * build.capacity <= self.max_tile_rows:
             yield 0, build
             return
         tile = max(self.max_tile_rows // stream_cap, 1)
         tile_cap = bucket_capacity(tile)
         n_build = int(build.num_rows)
-        slice_jit = jax.jit(slice_batch, static_argnums=3)
         for off in range(0, max(n_build, 1), tile_cap):
-            yield off, slice_jit(build, jnp.int32(off),
-                                 jnp.int32(tile_cap), tile_cap)
+            yield off, _slice_tile(build, jnp.int32(off),
+                                   jnp.int32(tile_cap), tile_cap)
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         build_batches = [b for cp in range(self.right.num_partitions)
@@ -571,16 +578,13 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
             build = build_batches[0]
         elif self.join_type in (JoinType.INNER, JoinType.CROSS):
             # no cross-batch match bookkeeping: stream build batches one
-            # at a time instead of materializing a padded concat
-            for sp in (range(self.left.num_partitions)
-                       if self.num_partitions == 1 and
-                       self.left.num_partitions > 1 else (p,)):
-                for stream in self.left.execute_partition(sp):
-                    for b in build_batches:
-                        for _, piece in self._build_tiles(
-                                b, stream.capacity):
-                            pairs, _, _ = self._cross_jit(stream, piece)
-                            yield pairs
+            # at a time instead of materializing a padded concat (these
+            # types never fold stream partitions, so read just p)
+            for stream in self.left.execute_partition(p):
+                for b in build_batches:
+                    for _, piece in self._build_tiles(b, stream.capacity):
+                        pairs, _, _ = self._cross_jit(stream, piece)
+                        yield pairs
             return
         else:
             build = concat_batches(

@@ -25,6 +25,14 @@ from typing import Any, Dict, List, Optional, Sequence
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+
+def _read_data_file(path):
+    """Parquet data-file read with the shared legacy-datetime policy
+    (Spark's default EXCEPTION mode): a hybrid-calendar file surfaced
+    through the Delta log must not silently keep Julian labels."""
+    from .parquet import rebase_legacy_datetimes
+    return rebase_legacy_datetimes(pq.read_table(path), "EXCEPTION", path)
+
 from ..batch import Schema
 from ..expressions.base import Expression
 from .. import types as T
@@ -210,7 +218,7 @@ class DeltaTable:
         actions: List[Dict[str, Any]] = []
         deleted = 0
         for f in snap.files:
-            t = pq.read_table(f)
+            t = _read_data_file(f)
             # DELETE removes rows where the predicate is TRUE; false and
             # null-valued rows stay (null OR true short-circuits in Or)
             keep_cond = Not(predicate) | _pred_null(predicate)
@@ -238,7 +246,7 @@ class DeltaTable:
         actions: List[Dict[str, Any]] = []
         updated = 0
         for f in snap.files:
-            t = pq.read_table(f)
+            t = _read_data_file(f)
             matched = ses.collect(df_table(t).where(predicate))
             if matched.num_rows == 0:
                 continue
@@ -387,7 +395,9 @@ def _merge_impl(table_obj: "DeltaTable", source: pa.Table,
     for f in snap.files:
         if not (has_update_delete or not_matched):
             break
-        keys_t = pq.read_table(f, columns=tgt_keys)
+        keys_t = pq.read_table(f, columns=tgt_keys)  # keys only: rebase-neutral unless datetime-keyed
+        from .parquet import rebase_legacy_datetimes
+        keys_t = rebase_legacy_datetimes(keys_t, "EXCEPTION", f)
         if not_matched:
             key_tables.append(keys_t)
         if not has_update_delete:
@@ -454,7 +464,7 @@ def _merge_impl(table_obj: "DeltaTable", source: pa.Table,
         rewrite_files = touched if not not_matched_by_source else \
             list(snap.files)
         for f in rewrite_files:
-            t = pq.read_table(f)
+            t = _read_data_file(f)
             joined_df = df_table(t).join(df_table(src), tgt_keys, src_keys,
                                          JoinType.LEFT_OUTER)
             m = matched_flag()

@@ -284,7 +284,8 @@ class IcebergSource(FileSource):
                           for f in self.table.schema_json()["fields"]}
             for d in self.delete_entries:
                 p = self.table._resolve(d["file_path"])
-                t = pq.read_table(p)
+                from .parquet import rebase_legacy_datetimes
+                t = rebase_legacy_datetimes(pq.read_table(p), "EXCEPTION", p)
                 seq = d.get("_seq", 0)
                 if d.get("content", 1) == 1:      # positional
                     for fp, r in zip(t.column("file_path").to_pylist(),
@@ -302,7 +303,8 @@ class IcebergSource(FileSource):
     def read_file(self, path: str) -> pa.Table:
         import numpy as np
         self._load_deletes()
-        t = pq.read_table(path)
+        from .parquet import rebase_legacy_datetimes
+        t = rebase_legacy_datetimes(pq.read_table(path), "EXCEPTION", path)
         my_seq = self.data_seqs.get(path, 0)
         # positional deletes target this file at a not-lower sequence
         drops = [r for seq, r in self._pos_deletes.get(path, [])

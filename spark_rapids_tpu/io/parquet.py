@@ -135,6 +135,10 @@ class ParquetSource(FileSource):
         # hybrid-calendar footer key
         super().__init__(*a, **kw)
         self.rebase_mode = rebase_mode.upper()
+        if self.rebase_mode not in ("EXCEPTION", "CORRECTED", "LEGACY"):
+            raise ValueError(
+                f"rebase_mode must be EXCEPTION, CORRECTED or LEGACY, "
+                f"got {rebase_mode!r}")
 
     def infer_arrow_schema(self) -> pa.Schema:
         return pq.read_schema(self.files[0])
@@ -148,77 +152,82 @@ class ParquetSource(FileSource):
             t = dataset.to_table(columns=self.columns, filter=filt)
         else:
             t = pq.read_table(path, columns=self.columns)
-        return self._maybe_rebase(t, path)
-
-    def _maybe_rebase(self, t: pa.Table, path: str) -> pa.Table:
-        if self.rebase_mode == "CORRECTED":
-            return t
-        has_datetime = any(
-            pa.types.is_date(f.type) or pa.types.is_timestamp(f.type)
-            for f in t.schema)
-        if not has_datetime:
-            return t
-        meta = pq.read_schema(path).metadata or {}
-        if LEGACY_DATETIME_KEY not in meta:
-            return t        # modern writer: labels already proleptic
-        import numpy as np
-        import pyarrow.compute as pc
-        cols = []
-        changed = False
-        for i, f in enumerate(t.schema):
-            col = t.column(i)
-            # fill_null BEFORE to_numpy: a nullable chunked array would
-            # otherwise come back as float64, which both fails the cast
-            # back and cannot hold pre-1582 microseconds exactly (> 2^53)
-            if pa.types.is_date(f.type):
-                mask = np.asarray(col.is_null())
-                days = np.asarray(pc.fill_null(
-                    col.cast(pa.int32()).combine_chunks(), 0))
-                ancient = (days < GREGORIAN_CUTOVER_DAYS) & ~mask
-                if ancient.any():
-                    if self.rebase_mode == "EXCEPTION":
-                        raise DatetimeRebaseError(
-                            f"{path}: column {f.name} holds pre-1582 "
-                            f"dates written by a legacy hybrid-calendar "
-                            f"Spark; set rebase_mode to LEGACY (rebase) "
-                            f"or CORRECTED (read as-is)")
-                    days = rebase_julian_to_gregorian_days(days)
-                    col = pa.chunked_array([pa.Array.from_pandas(
-                        days.astype("int32"), mask=mask).cast(f.type)])
-                    changed = True
-            elif pa.types.is_timestamp(f.type):
-                mask = np.asarray(col.is_null())
-                us = np.asarray(pc.fill_null(
-                    col.cast(pa.timestamp("us", tz=f.type.tz))
-                    .cast(pa.int64()).combine_chunks(), 0))
-                day = np.floor_divide(us, _US_PER_DAY)
-                ancient = (day < GREGORIAN_CUTOVER_DAYS) & ~mask
-                if ancient.any():
-                    if self.rebase_mode == "EXCEPTION":
-                        raise DatetimeRebaseError(
-                            f"{path}: column {f.name} holds pre-1582 "
-                            f"timestamps written by a legacy "
-                            f"hybrid-calendar Spark; set rebase_mode to "
-                            f"LEGACY or CORRECTED")
-                    tod = us - day * _US_PER_DAY
-                    day2 = rebase_julian_to_gregorian_days(day)
-                    us = day2 * _US_PER_DAY + tod
-                    # round-trip through us, then back to the ORIGINAL
-                    # field type (tz and unit preserved)
-                    col = pa.chunked_array([pa.Array.from_pandas(
-                        us, mask=mask).cast(pa.timestamp(
-                            "us", tz=f.type.tz)).cast(f.type)])
-                    changed = True
-            cols.append(col)
-        if not changed:
-            return t
-        # untouched columns keep their exact types: reuse the schema
-        return pa.table(cols, schema=t.schema)
+        return rebase_legacy_datetimes(t, self.rebase_mode, path)
 
     def row_group_counts(self, path: str) -> List[int]:
         f = pq.ParquetFile(path)
         return [f.metadata.row_group(i).num_rows
                 for i in range(f.metadata.num_row_groups)]
+
+
+def rebase_legacy_datetimes(t: pa.Table, rebase_mode: str,
+                            path: str = "<table>") -> pa.Table:
+    """Apply Spark's parquet datetime-rebase policy to a read table.
+    Shared by EVERY parquet decode path (scan, Delta, Iceberg, cache) —
+    the legacy footer key travels in the table's schema metadata, so no
+    second footer parse is needed."""
+    if rebase_mode == "CORRECTED":
+        return t
+    has_datetime = any(
+        pa.types.is_date(f.type) or pa.types.is_timestamp(f.type)
+        for f in t.schema)
+    if not has_datetime:
+        return t
+    if LEGACY_DATETIME_KEY not in (t.schema.metadata or {}):
+        return t        # modern writer: labels already proleptic
+    import numpy as np
+    import pyarrow.compute as pc
+    cols = []
+    changed = False
+    for i, f in enumerate(t.schema):
+        col = t.column(i)
+        # fill_null BEFORE to_numpy: a nullable chunked array would
+        # otherwise come back as float64, which both fails the cast
+        # back and cannot hold pre-1582 microseconds exactly (> 2^53)
+        if pa.types.is_date(f.type):
+            mask = np.asarray(col.is_null())
+            days = np.asarray(pc.fill_null(
+                col.cast(pa.int32()).combine_chunks(), 0))
+            ancient = (days < GREGORIAN_CUTOVER_DAYS) & ~mask
+            if ancient.any():
+                if rebase_mode == "EXCEPTION":
+                    raise DatetimeRebaseError(
+                        f"{path}: column {f.name} holds pre-1582 "
+                        f"dates written by a legacy hybrid-calendar "
+                        f"Spark; set rebase_mode to LEGACY (rebase) "
+                        f"or CORRECTED (read as-is)")
+                days = rebase_julian_to_gregorian_days(days)
+                col = pa.chunked_array([pa.Array.from_pandas(
+                    days.astype("int32"), mask=mask).cast(f.type)])
+                changed = True
+        elif pa.types.is_timestamp(f.type):
+            mask = np.asarray(col.is_null())
+            us = np.asarray(pc.fill_null(
+                col.cast(pa.timestamp("us", tz=f.type.tz))
+                .cast(pa.int64()).combine_chunks(), 0))
+            day = np.floor_divide(us, _US_PER_DAY)
+            ancient = (day < GREGORIAN_CUTOVER_DAYS) & ~mask
+            if ancient.any():
+                if rebase_mode == "EXCEPTION":
+                    raise DatetimeRebaseError(
+                        f"{path}: column {f.name} holds pre-1582 "
+                        f"timestamps written by a legacy "
+                        f"hybrid-calendar Spark; set rebase_mode to "
+                        f"LEGACY or CORRECTED")
+                tod = us - day * _US_PER_DAY
+                day2 = rebase_julian_to_gregorian_days(day)
+                us = day2 * _US_PER_DAY + tod
+                # round-trip through us, then back to the ORIGINAL
+                # field type (tz and unit preserved)
+                col = pa.chunked_array([pa.Array.from_pandas(
+                    us, mask=mask).cast(pa.timestamp(
+                        "us", tz=f.type.tz)).cast(f.type)])
+                changed = True
+        cols.append(col)
+    if not changed:
+        return t
+    # untouched columns keep their exact types: reuse the schema
+    return pa.table(cols, schema=t.schema)
 
 
 def write_parquet(table: pa.Table, path: str,

@@ -67,7 +67,12 @@ class MeshLowering:
     """Bottom-up pattern matcher producing a local-step function."""
 
     def __init__(self, mesh: Mesh, axis: str = "data",
-                 join_expansion: int = 2):
+                 join_expansion: int = 1):
+        # join_expansion starts LEAN (output slots = stream capacity):
+        # most planned equi-joins expand <= 1x after filters, and halving
+        # the static output capacity halves every downstream kernel in
+        # the fused program. A fan-out join overflows its flag and the
+        # stage retraces at twice the factor (_run's retry loop).
         self.mesh = mesh
         self.axis = axis
         self.n_dev = mesh.shape[axis]
@@ -311,7 +316,7 @@ class MeshStageExec(LeafExec):
         if self._results is not None:
             return self._results
         low = self.lowering
-        for attempt in range(4):
+        for attempt in range(5):
             program, stacked = self.prepare()
             out, flags = program(*stacked)
             if not bool(np.any(np.asarray(jax.device_get(flags)))):
@@ -330,7 +335,7 @@ class MeshStageExec(LeafExec):
 # ---------------------------------------------------------------------------
 
 def try_lower_to_mesh(plan: Exec, mesh: Mesh,
-                      join_expansion: int = 2) -> Optional[MeshStageExec]:
+                      join_expansion: int = 1) -> Optional[MeshStageExec]:
     """Return the fused mesh stage, or None when the plan shape (or any
     node in it) is outside the fusable subset."""
     try:

@@ -10,10 +10,11 @@ collectives over ICI and inter-slice traffic over DCN automatically, which
 is exactly the tiering the reference builds by hand with
 UCX-for-data/netty-for-control.
 
-Single-chip CI cannot exercise real multi-host; this module is the launch
-recipe plus mesh helpers, validated by the virtual-device path
-(dryrun_multichip) the same way the reference validates UCX protocol logic
-against mocked peers.
+Tested against a REAL 2-process cluster: tests/test_multihost.py launches
+two engine processes that join one coordination service (gloo CPU
+collectives over gRPC) and routes rows across the process boundary through
+mesh_exchange's all_to_all — live multi-process collectives, one tier up
+from the reference's mocked-peer UCX protocol tests.
 """
 
 from __future__ import annotations
@@ -39,6 +40,14 @@ def init_distributed(coordinator: Optional[str] = None,
     num_processes = num_processes or _int_env("RAPIDS_TPU_NPROCS")
     process_id = process_id if process_id is not None \
         else _int_env("RAPIDS_TPU_PROC_ID")
+    # CPU rigs need a multi-process collectives backend; TPU slices ship
+    # their own (ICI/DCN) and IGNORE this setting, so it is set
+    # unconditionally (jax.default_backend() must not be consulted here —
+    # it would initialize the backend before distributed.initialize).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass    # older jax: single-process CPU only
     if coordinator is None and num_processes is None:
         jax.distributed.initialize()            # TPU auto-detection
     else:

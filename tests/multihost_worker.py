@@ -1,0 +1,105 @@
+"""Worker for the 2-process DCN-tier test (launched by test_multihost.py).
+
+Joins a real jax.distributed coordination service (the engine's control
+plane, parallel/multihost.py), builds the global row mesh spanning both
+processes, and runs the engine's mesh_exchange all_to_all DATA PLANE
+across the process boundary — the TPU-native analogue of the reference's
+UCX peer-to-peer shuffle, exercised with real multi-process collectives
+(gloo over gRPC on CPU) instead of mocked peers.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    # load the bootstrap module standalone: the coordination service must
+    # come up before anything initializes the XLA backend, and importing
+    # the full package flips backend-touching config
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "multihost", os.path.join(repo, "spark_rapids_tpu", "parallel",
+                                  "multihost.py"))
+    mh = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mh)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    mh.init_distributed(f"localhost:{port}", 2, pid)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    mesh = mh.global_row_mesh()
+    h = mh.hierarchical_mesh()
+    assert dict(zip(h.axis_names, h.devices.shape)) == {"dcn": 2, "ici": 2}
+
+    sys.path.insert(0, repo)
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.batch import ColumnarBatch, DeviceColumn
+    from spark_rapids_tpu.parallel.mesh import mesh_exchange
+
+    # every device holds 4 rows with values dev*10+i; route row i to
+    # device (value % 4) and check what lands here
+    n_dev, cap = 4, 4
+    local = np.arange(pid * 2, pid * 2 + 2)     # this process's devices
+
+    def make(dev):
+        vals = jnp.asarray(dev * 10 + np.arange(cap, dtype=np.int64))
+        return ColumnarBatch(
+            (DeviceColumn(vals, jnp.ones(cap, bool), None, T.INT64),),
+            jnp.asarray(cap, jnp.int32))
+
+    def step(stacked_vals, stacked_valid, stacked_rows):
+        b = ColumnarBatch(
+            (DeviceColumn(stacked_vals[0], stacked_valid[0], None,
+                          T.INT64),), stacked_rows[0])
+        pids = (b.columns[0].data % n_dev).astype(jnp.int32)
+        out = mesh_exchange(b, pids, n_dev)
+        return (out.columns[0].data[None], out.columns[0].validity[None],
+                out.num_rows[None])
+
+    batches = [make(d) for d in local]
+    sharding = NamedSharding(mesh, P("data"))
+    vals = jax.make_array_from_process_local_data(
+        sharding, np.stack([np.asarray(b.columns[0].data)
+                            for b in batches]))
+    valid = jax.make_array_from_process_local_data(
+        sharding, np.stack([np.asarray(b.columns[0].validity)
+                            for b in batches]))
+    rows = jax.make_array_from_process_local_data(
+        sharding, np.stack([np.asarray(b.num_rows) for b in batches]))
+
+    prog = jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=(P("data"), P("data"), P("data")), check_vma=False))
+    out_vals, out_valid, out_rows = prog(vals, valid, rows)
+
+    for shard in out_vals.addressable_shards:
+        dev_index = shard.index[0].start
+        got_valid = np.asarray(
+            [s for s in out_valid.addressable_shards
+             if s.index == shard.index][0].data)[0]
+        got = np.sort(np.asarray(shard.data)[0][got_valid])
+        expect = np.sort(np.asarray(
+            [d * 10 + i for d in range(n_dev) for i in range(cap)
+             if (d * 10 + i) % n_dev == dev_index], dtype=np.int64))
+        assert np.array_equal(got, expect), (dev_index, got, expect)
+    print(f"proc {pid}: cross-process mesh_exchange(all_to_all) routed "
+          f"rows correctly OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
