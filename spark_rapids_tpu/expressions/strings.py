@@ -779,3 +779,331 @@ class FormatNumber(Expression):
         buf = buf.at[r_idx, sign_pos[:, None]].set(jnp.uint8(ord("-")),
                                                    mode="drop")
         return _string_column(buf, total, c.validity, out_ml)
+
+
+# ---------------------------------------------------------------------------
+# Codepoint decode/encode (UTF-8 unit <-> int32 codepoint matrices) — the
+# foundation for character-order ops (reverse/levenshtein/ascii). cudf keeps
+# a character-index structure; here both directions are rectangular gathers/
+# scatters over the padded byte matrix.
+# ---------------------------------------------------------------------------
+
+def _codepoints(col: DeviceColumn) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(codepoints [n, ml] int32 left-packed, char counts [n]). Slots past
+    a row's character count are 0."""
+    n, ml = col.data.shape
+    pos = jnp.arange(ml, dtype=jnp.int32)[None, :]
+    in_str = pos < col.lengths[:, None]
+    lead = _is_lead(col.data) & in_str
+    starts, nchars = _compact_bytes(
+        jnp.broadcast_to(pos, (n, ml)), lead)
+
+    def byte_at(off):
+        idx = jnp.clip(starts + off, 0, ml - 1)
+        b = jnp.take_along_axis(col.data, idx, axis=1).astype(jnp.int32)
+        ok = (starts + off) < col.lengths[:, None]
+        return jnp.where(ok, b, 0)
+
+    b0, b1, b2, b3 = byte_at(0), byte_at(1), byte_at(2), byte_at(3)
+    cp = jnp.where(
+        b0 < 0x80, b0,
+        jnp.where(b0 < 0xE0, ((b0 & 0x1F) << 6) | (b1 & 0x3F),
+                  jnp.where(b0 < 0xF0,
+                            ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6)
+                            | (b2 & 0x3F),
+                            ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12)
+                            | ((b2 & 0x3F) << 6) | (b3 & 0x3F))))
+    char_live = pos < nchars[:, None]
+    return jnp.where(char_live, cp, 0), nchars
+
+
+def _encode_utf8(cps: jnp.ndarray, counts: jnp.ndarray, out_ml: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Encode left-packed codepoints back to a padded UTF-8 byte matrix;
+    returns (bytes [n, out_ml], byte lengths [n])."""
+    n, ml = cps.shape
+    pos = jnp.arange(ml, dtype=jnp.int32)[None, :]
+    live = pos < counts[:, None]
+    ulen = jnp.where(cps < 0x80, 1,
+                     jnp.where(cps < 0x800, 2,
+                               jnp.where(cps < 0x10000, 3, 4)))
+    ulen = jnp.where(live, ulen, 0)
+    offs = jnp.cumsum(ulen, axis=1) - ulen          # exclusive prefix
+    lengths = jnp.sum(ulen, axis=1).astype(jnp.int32)
+
+    def enc_byte(k):
+        one = jnp.where(k == 0, cps, 0)
+        two = jnp.where(k == 0, 0xC0 | (cps >> 6),
+                        0x80 | (cps & 0x3F))
+        three = jnp.where(k == 0, 0xE0 | (cps >> 12),
+                          jnp.where(k == 1, 0x80 | ((cps >> 6) & 0x3F),
+                                    0x80 | (cps & 0x3F)))
+        four = jnp.where(k == 0, 0xF0 | (cps >> 18),
+                         jnp.where(k == 1, 0x80 | ((cps >> 12) & 0x3F),
+                                   jnp.where(k == 2,
+                                             0x80 | ((cps >> 6) & 0x3F),
+                                             0x80 | (cps & 0x3F))))
+        return jnp.where(ulen == 1, one,
+                         jnp.where(ulen == 2, two,
+                                   jnp.where(ulen == 3, three, four)))
+
+    out = jnp.zeros(n * out_ml + 1, jnp.uint8)
+    row_base = jnp.arange(n, dtype=jnp.int32)[:, None] * out_ml
+    for k in range(4):
+        val = enc_byte(k).astype(jnp.uint8)
+        write = live & (k < ulen)
+        tgt = jnp.where(write, row_base + offs + k, n * out_ml)
+        out = out.at[tgt.reshape(-1)].set(
+            val.reshape(-1), mode="drop")
+    return out[:n * out_ml].reshape(n, out_ml), lengths
+
+
+@dataclass(frozen=True, eq=False)
+class Reverse(Expression):
+    """reverse(str): CODEPOINT order reversed (Spark reverse)."""
+
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Reverse(c[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        ml = c.data.shape[1]
+        cps, nchars = _codepoints(c)
+        pos = jnp.arange(cps.shape[1], dtype=jnp.int32)[None, :]
+        src = jnp.clip(nchars[:, None] - 1 - pos, 0, cps.shape[1] - 1)
+        rev = jnp.where(pos < nchars[:, None],
+                        jnp.take_along_axis(cps, src, axis=1), 0)
+        data, lengths = _encode_utf8(rev, nchars, ml)
+        return _string_column(data, lengths, c.validity, c.dtype.max_len)
+
+
+@dataclass(frozen=True, eq=False)
+class Ascii(Expression):
+    """ascii(str): codepoint of the first character; 0 for empty."""
+
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Ascii(c[0])
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        cps, nchars = _codepoints(c)
+        first = jnp.where(nchars > 0, cps[:, 0], 0)
+        from .base import numeric_column
+        return numeric_column(first.astype(jnp.int32), c.validity, T.INT32)
+
+
+@dataclass(frozen=True, eq=False)
+class Chr(Expression):
+    """chr(n): character with codepoint n % 256; negative n -> empty
+    (Spark chr semantics; 128-255 encode as two UTF-8 bytes)."""
+
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Chr(c[0])
+
+    @property
+    def dtype(self):
+        return T.string(2)
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        n = c.data.astype(jnp.int64)
+        cp = jnp.where(n < 0, -1, n % 256).astype(jnp.int32)
+        counts = jnp.where(cp >= 0, 1, 0).astype(jnp.int32)
+        data, lengths = _encode_utf8(
+            jnp.maximum(cp, 0)[:, None], counts, 2)
+        return _string_column(data, lengths, c.validity, 2)
+
+
+@dataclass(frozen=True, eq=False)
+class OctetLength(Expression):
+    """octet_length / bit_length: BYTES, unlike char length."""
+
+    child: Expression
+    bits: bool = False
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return OctetLength(c[0], self.bits)
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .base import numeric_column
+        c = self.child.eval(batch, ctx)
+        v = c.lengths.astype(jnp.int32)
+        if self.bits:
+            v = v * 8
+        return numeric_column(v, c.validity, T.INT32)
+
+
+@dataclass(frozen=True, eq=False)
+class Levenshtein(Expression):
+    """levenshtein(a, b): edit distance over CODEPOINTS.
+
+    DP rows advance in a fori_loop; the insertion chain inside a row —
+    normally a sequential j-scan — vectorizes as a prefix-min of
+    (cand[j] - j) (min-plus algebra), so each of the max_len iterations
+    is pure elementwise + cummin work."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return Levenshtein(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .base import numeric_column
+        a = self.left.eval(batch, ctx)
+        b = self.right.eval(batch, ctx)
+        cpa, la = _codepoints(a)
+        cpb, lb = _codepoints(b)
+        n, mla = cpa.shape
+        mlb = cpb.shape[1]
+        jpos = jnp.arange(mlb + 1, dtype=jnp.int32)[None, :]
+        row0 = jnp.broadcast_to(jpos, (n, mlb + 1)).astype(jnp.int32)
+        ans0 = row0     # rows with la == 0
+
+        def body(i, carry):
+            row, ans = carry
+            ca = cpa[:, i][:, None]
+            cost = jnp.where(cpb == ca, 0, 1)
+            delete = row[:, 1:] + 1
+            sub = row[:, :-1] + cost
+            cand = jnp.concatenate(
+                [jnp.full((n, 1), i + 1, jnp.int32),
+                 jnp.minimum(delete, sub)], axis=1)
+            # insertion chain new[j] = min_k<=j cand[k] + (j - k)
+            t = cand - jpos
+            new_row = jax.lax.cummin(t, axis=1) + jpos
+            ans = jnp.where((i + 1 == la)[:, None], new_row, ans)
+            return new_row, ans
+
+        _, ans = jax.lax.fori_loop(0, mla, body, (row0, ans0))
+        out = jnp.take_along_axis(
+            ans, jnp.clip(lb, 0, mlb)[:, None], axis=1)[:, 0]
+        return numeric_column(out.astype(jnp.int32),
+                              a.validity & b.validity, T.INT32)
+
+
+_SOUNDEX_CODE = [0] * 128
+for _letters, _code in (("BFPV", 1), ("CGJKQSXZ", 2), ("DT", 3), ("L", 4),
+                        ("MN", 5), ("R", 6), ("HW", 7)):
+    for _ch in _letters:
+        _SOUNDEX_CODE[ord(_ch)] = _code
+
+
+@dataclass(frozen=True, eq=False)
+class Soundex(Expression):
+    """soundex(str): first letter + 3 digits (Spark's UTF8String.soundex:
+    H/W do not separate duplicate codes, vowels do; a non-letter first
+    character returns the input unchanged)."""
+
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Soundex(c[0])
+
+    @property
+    def dtype(self):
+        return T.string(max(self.child.dtype.max_len, 4))
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        n, ml = c.data.shape
+        pos = jnp.arange(ml, dtype=jnp.int32)[None, :]
+        in_str = pos < c.lengths[:, None]
+        up = jnp.where((c.data >= ord("a")) & (c.data <= ord("z")),
+                       c.data - 32, c.data).astype(jnp.int32)
+        is_letter = (up >= ord("A")) & (up <= ord("Z")) & in_str
+        table = jnp.asarray(_SOUNDEX_CODE, jnp.int32)
+        codes = jnp.where(is_letter, jnp.take(table, jnp.clip(up, 0, 127)),
+                          -1)
+
+        first = up[:, 0]
+        first_is_letter = is_letter[:, 0]
+
+        def body(i, carry):
+            emitted, last, digits = carry
+            code = codes[:, i]
+            is_l = is_letter[:, i]
+            emit = is_l & (code >= 1) & (code <= 6) & (code != last)
+            emit = emit & (emitted < 3) & (i > 0)
+            slot = jnp.clip(emitted, 0, 2)
+            newd = digits.at[jnp.arange(n), slot].set(
+                jnp.where(emit, code, digits[jnp.arange(n), slot]))
+            emitted = emitted + emit.astype(jnp.int32)
+            # vowels AND non-letters inside the string reset the
+            # duplicate tracker (Spark's UTF8String.soundex sets
+            # lastCode='0' for every non-letter byte); H/W (7) keep it;
+            # consonants set it
+            in_row = pos[0, i] < c.lengths
+            non_letter = in_row & ~is_l
+            last = jnp.where(is_l & (code >= 1) & (code <= 6), code,
+                             jnp.where((is_l & (code == 0)) | non_letter,
+                                       -1, last))
+            return emitted, last, newd
+
+        init_last = jnp.where(first_is_letter,
+                              codes[:, 0], jnp.int32(-1))
+        emitted, _, digits = jax.lax.fori_loop(
+            0, ml, body,
+            (jnp.zeros(n, jnp.int32), init_last,
+             jnp.zeros((n, 3), jnp.int32)))
+
+        out_ml = self.dtype.max_len
+        sx = jnp.zeros((n, out_ml), jnp.uint8)
+        sx = sx.at[:, 0].set(first.astype(jnp.uint8))
+        for k in range(3):
+            sx = sx.at[:, k + 1].set(
+                (jnp.where(k < emitted, digits[:, k], 0)
+                 + ord("0")).astype(jnp.uint8))
+        sx_len = jnp.full(n, 4, jnp.int32)
+        # non-letter first char: pass the input through unchanged
+        pad = jnp.zeros((n, max(out_ml - ml, 0)), jnp.uint8)
+        orig = jnp.concatenate([c.data, pad], axis=1)[:, :out_ml]
+        data = jnp.where(first_is_letter[:, None], sx, orig)
+        lengths = jnp.where(first_is_letter, sx_len, c.lengths)
+        return _string_column(data, lengths, c.validity, out_ml)

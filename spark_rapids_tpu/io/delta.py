@@ -26,12 +26,12 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 
-def _read_data_file(path):
-    """Parquet data-file read with the shared legacy-datetime policy
-    (Spark's default EXCEPTION mode): a hybrid-calendar file surfaced
-    through the Delta log must not silently keep Julian labels."""
+def _read_data_file(path, rebase_mode: str = "EXCEPTION"):
+    """Parquet data-file read with the shared legacy-datetime policy:
+    a hybrid-calendar file surfaced through the Delta log must not
+    silently keep Julian labels (mode comes from the DeltaTable)."""
     from .parquet import rebase_legacy_datetimes
-    return rebase_legacy_datetimes(pq.read_table(path), "EXCEPTION", path)
+    return rebase_legacy_datetimes(pq.read_table(path), rebase_mode, path)
 
 from ..batch import Schema
 from ..expressions.base import Expression
@@ -62,8 +62,11 @@ class Snapshot:
 
 
 class DeltaTable:
-    def __init__(self, path: str):
+    def __init__(self, path: str, rebase_mode: str = "EXCEPTION"):
         self.path = path
+        # parquet legacy-datetime policy for the table's data files
+        # (EXCEPTION | CORRECTED | LEGACY — see io/parquet.py)
+        self.rebase_mode = rebase_mode.upper()
 
     # ------------------------------------------------------------------
     # log replay
@@ -218,7 +221,7 @@ class DeltaTable:
         actions: List[Dict[str, Any]] = []
         deleted = 0
         for f in snap.files:
-            t = _read_data_file(f)
+            t = _read_data_file(f, self.rebase_mode)
             # DELETE removes rows where the predicate is TRUE; false and
             # null-valued rows stay (null OR true short-circuits in Or)
             keep_cond = Not(predicate) | _pred_null(predicate)
@@ -246,7 +249,7 @@ class DeltaTable:
         actions: List[Dict[str, Any]] = []
         updated = 0
         for f in snap.files:
-            t = _read_data_file(f)
+            t = _read_data_file(f, self.rebase_mode)
             matched = ses.collect(df_table(t).where(predicate))
             if matched.num_rows == 0:
                 continue
@@ -395,9 +398,9 @@ def _merge_impl(table_obj: "DeltaTable", source: pa.Table,
     for f in snap.files:
         if not (has_update_delete or not_matched):
             break
-        keys_t = pq.read_table(f, columns=tgt_keys)  # keys only: rebase-neutral unless datetime-keyed
+        keys_t = pq.read_table(f, columns=tgt_keys)
         from .parquet import rebase_legacy_datetimes
-        keys_t = rebase_legacy_datetimes(keys_t, "EXCEPTION", f)
+        keys_t = rebase_legacy_datetimes(keys_t, table_obj.rebase_mode, f)
         if not_matched:
             key_tables.append(keys_t)
         if not has_update_delete:
@@ -464,7 +467,7 @@ def _merge_impl(table_obj: "DeltaTable", source: pa.Table,
         rewrite_files = touched if not not_matched_by_source else \
             list(snap.files)
         for f in rewrite_files:
-            t = _read_data_file(f)
+            t = _read_data_file(f, table_obj.rebase_mode)
             joined_df = df_table(t).join(df_table(src), tgt_keys, src_keys,
                                          JoinType.LEFT_OUTER)
             m = matched_flag()

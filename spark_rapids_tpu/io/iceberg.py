@@ -68,8 +68,10 @@ def _ice_type_to_arrow(t: Any) -> pa.DataType:
 class IcebergTable:
     """Reader for an Iceberg table directory."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, rebase_mode: str = "EXCEPTION"):
         self.path = rewrite_path(path)
+        # parquet legacy-datetime policy for data + delete files
+        self.rebase_mode = rebase_mode.upper()
         self.meta = self._load_metadata()
 
     # ---- metadata resolution ----
@@ -285,7 +287,8 @@ class IcebergSource(FileSource):
             for d in self.delete_entries:
                 p = self.table._resolve(d["file_path"])
                 from .parquet import rebase_legacy_datetimes
-                t = rebase_legacy_datetimes(pq.read_table(p), "EXCEPTION", p)
+                t = rebase_legacy_datetimes(
+                    pq.read_table(p), self.table.rebase_mode, p)
                 seq = d.get("_seq", 0)
                 if d.get("content", 1) == 1:      # positional
                     for fp, r in zip(t.column("file_path").to_pylist(),
@@ -304,7 +307,8 @@ class IcebergSource(FileSource):
         import numpy as np
         self._load_deletes()
         from .parquet import rebase_legacy_datetimes
-        t = rebase_legacy_datetimes(pq.read_table(path), "EXCEPTION", path)
+        t = rebase_legacy_datetimes(
+            pq.read_table(path), self.table.rebase_mode, path)
         my_seq = self.data_seqs.get(path, 0)
         # positional deletes target this file at a not-lower sequence
         drops = [r for seq, r in self._pos_deletes.get(path, [])

@@ -881,6 +881,76 @@ class RowEvaluator:
         return "".join(mapping.get(ch, ch) for ch in s
                        if mapping.get(ch, ch) is not None)
 
+    def _eval_Reverse(self, e, row):
+        s = self.eval(e.children[0], row)
+        return None if s is None else s[::-1]
+
+    def _eval_Ascii(self, e, row):
+        s = self.eval(e.children[0], row)
+        if s is None:
+            return None
+        return ord(s[0]) if s else 0
+
+    def _eval_Chr(self, e, row):
+        n = self.eval(e.children[0], row)
+        if n is None:
+            return None
+        if n < 0:
+            return ""
+        return chr(int(n) % 256)
+
+    def _eval_OctetLength(self, e, row):
+        s = self.eval(e.children[0], row)
+        if s is None:
+            return None
+        nbytes = len(s.encode("utf-8"))
+        return nbytes * 8 if e.bits else nbytes
+
+    def _eval_Levenshtein(self, e, row):
+        a = self.eval(e.children[0], row)
+        b = self.eval(e.children[1], row)
+        if a is None or b is None:
+            return None
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a):
+            cur = [i + 1]
+            for j, cb in enumerate(b):
+                cur.append(min(prev[j + 1] + 1, cur[j] + 1,
+                               prev[j] + (ca != cb)))
+            prev = cur
+        return prev[len(b)]
+
+    def _eval_Soundex(self, e, row):
+        s = self.eval(e.children[0], row)
+        if s is None:
+            return None
+        if not s or not s[0].isascii() or not s[0].isalpha():
+            return s
+        code_of = {}
+        for letters, code in (("BFPV", "1"), ("CGJKQSXZ", "2"),
+                              ("DT", "3"), ("L", "4"), ("MN", "5"),
+                              ("R", "6"), ("HW", "7")):
+            for ch in letters:
+                code_of[ch] = code
+        out = s[0].upper()
+        last = code_of.get(out, "0")
+        digits = []
+        for ch in s[1:]:
+            u = ch.upper()
+            if not ("A" <= u <= "Z"):
+                last = "-"      # non-letters reset the duplicate tracker
+                continue
+            code = code_of.get(u, "0")
+            if code in "123456" and code != last:
+                digits.append(code)
+                if len(digits) == 3:
+                    break
+            if code in "123456":
+                last = code
+            elif code == "0":       # vowels reset; H/W (7) keep last
+                last = "-"
+        return out + "".join(digits).ljust(3, "0")
+
     def _eval_InitCap(self, e, row):
         s = self.eval(e.child, row)
         if s is None:

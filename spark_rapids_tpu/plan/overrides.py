@@ -103,7 +103,8 @@ def _expr_rules() -> Dict[str, ExprRule]:
     for n in ("Length", "Upper", "Lower", "Substring", "Concat",
               "StringPredicate", "StringLocate", "StringTrim", "StringPad",
               "StringRepeat", "StringReplace", "Translate", "InitCap",
-              "FormatNumber"):
+              "FormatNumber", "Reverse", "Ascii", "Chr", "OctetLength",
+              "Levenshtein", "Soundex"):
         r(n, TS.ALL_BASIC)
     # datetime
     for n in ("ExtractDatePart", "DateAddSub", "DateDiff", "AddMonths",

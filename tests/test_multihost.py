@@ -26,9 +26,17 @@ def test_two_process_cluster_exchanges_rows():
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
         text=True) for i in range(2)]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        # a worker stuck in the distributed-init barrier (peer crashed)
+        # must not outlive the test
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert "mesh_exchange(all_to_all) routed rows correctly OK" in out

@@ -106,3 +106,73 @@ def test_string_filter_pipeline():
     _q(lambda: table(ST)
        .where(contains(col("s"), "a"))
        .select(upper(col("s")).alias("u"), col("n")))
+
+
+# ---- round-3 surface: reverse/ascii/chr/octet/levenshtein/soundex ----
+
+from spark_rapids_tpu.expressions.strings import (  # noqa: E402
+    Ascii, Chr, Levenshtein, OctetLength, Reverse, Soundex)
+
+
+def test_reverse_ascii_and_unicode():
+    t = pa.table({"s": pa.array(["hello", "", "ab", "héllo", "日本語", None])})
+    _q(lambda: table(t).select(Reverse(col("s")).alias("r")))
+
+
+def test_ascii_fn():
+    t = pa.table({"s": pa.array(["Abc", "", "é", "1x", None])})
+    _q(lambda: table(t).select(Ascii(col("s")).alias("a")))
+
+
+def test_chr_fn():
+    t = pa.table({"n": pa.array([65, 97, 0, 255, 256 + 66, -3, None],
+                                pa.int64())})
+    _q(lambda: table(t).select(Chr(col("n")).alias("c")))
+
+
+def test_octet_bit_length():
+    t = pa.table({"s": pa.array(["abc", "", "héllo", "日本語", None])})
+    _q(lambda: table(t).select(OctetLength(col("s")).alias("o"),
+                               OctetLength(col("s"), bits=True).alias("b")))
+
+
+def test_levenshtein():
+    t = pa.table({"a": pa.array(["kitten", "flaw", "", "abc", "same", None]),
+                  "b": pa.array(["sitting", "lawn", "abc", "", "same",
+                                 "x"])})
+    _q(lambda: table(t).select(
+        Levenshtein(col("a"), col("b")).alias("d")))
+
+
+def test_levenshtein_random_differential():
+    g = gen_table([("a", StringGen(min_len=0, max_len=12)),
+                   ("b", StringGen(min_len=0, max_len=12))], n=200,
+                  seed=190)
+    _q(lambda: table(g).select(Levenshtein(col("a"), col("b")).alias("d")))
+
+
+def test_soundex():
+    t = pa.table({"s": pa.array(
+        ["Robert", "Rupert", "Ashcraft", "Ashcroft", "Tymczak", "Pfister",
+         "Honeyman", "", "123", "a", None])})
+    _q(lambda: table(t).select(Soundex(col("s")).alias("sx")))
+
+
+def test_soundex_known_codes():
+    """Published anchors (US-census soundex, Spark's variant where the
+    first letter's own code seeds the duplicate tracker)."""
+    from spark_rapids_tpu.plan import Session
+    t = pa.table({"s": pa.array(["Robert", "Rupert", "Ashcraft", "Tymczak",
+                                 "Pfister", "Honeyman", "Jackson"])})
+    got = Session().collect(table(t).select(Soundex(col("s")).alias("x")))
+    assert got.column("x").to_pylist() == \
+        ["R163", "R163", "A261", "T522", "P236", "H555", "J250"]
+
+
+def test_soundex_non_letter_resets_tracker():
+    """Spark's UTF8String.soundex sets lastCode='0' for non-letters, so a
+    separator lets a duplicate code emit again."""
+    from spark_rapids_tpu.plan import Session
+    t = pa.table({"s": pa.array(["B-b", "Mc-Carthy"])})
+    got = Session().collect(table(t).select(Soundex(col("s")).alias("x")))
+    assert got.column("x").to_pylist() == ["B100", "M226"]
