@@ -150,8 +150,19 @@ class HashAggregateExec(UnaryExec):
             return None, seg, new_group, jnp.asarray(1, jnp.int32), live, \
                 n_live
         all_cols = list(key_cols) + list(value_cols)
+        # Only a direct reference to a schema-non-nullable COLUMN can
+        # drop its null-rank sort lane; computed expressions may produce
+        # nulls at runtime regardless of their static nullable flag
+        # (divide-by-zero, failed casts), and a dropped lane would
+        # interleave those nulls among equal payloads.
+        from ..expressions.base import BoundReference
+        nullable = [not (isinstance(e, BoundReference) and not e.nullable)
+                    for e in self.group_exprs][:len(key_cols)] + \
+            [True] * len(value_cols)
+        if len(nullable) != len(all_cols):
+            nullable = [True] * len(all_cols)
         ops = sort_operands(all_cols, [False] * len(all_cols),
-                            [True] * len(all_cols), live)
+                            [True] * len(all_cols), live, nullable)
         iota = jnp.arange(cap, dtype=jnp.int32)
         perm = jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)[-1]
         sorted_keys = [gather_column(c, perm) for c in key_cols]

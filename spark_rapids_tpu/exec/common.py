@@ -192,17 +192,23 @@ def orderable_words(col: DeviceColumn) -> List[jax.Array]:
 
 
 def sort_operands(cols: Sequence[DeviceColumn], descending: Sequence[bool],
-                  nulls_first: Sequence[bool], live: jax.Array
+                  nulls_first: Sequence[bool], live: jax.Array,
+                  nullable: Optional[Sequence[bool]] = None
                   ) -> List[jax.Array]:
     """Build the lax.sort key operands for a multi-column sort.
 
     Dead rows (beyond num_rows) always sort last regardless of direction.
+    ``nullable[i]=False`` (a schema-level guarantee) drops that column's
+    null-rank operand — one fewer u8 lane through the whole sort.
     """
     ops: List[jax.Array] = [(~live).astype(jnp.uint8)]  # live rows first
-    for col, desc, nf in zip(cols, descending, nulls_first):
-        null_rank = jnp.where(col.validity, jnp.uint8(1),
-                              jnp.uint8(0) if nf else jnp.uint8(2))
-        ops.append(jnp.where(live, null_rank, jnp.uint8(3)))
+    if nullable is None:
+        nullable = [True] * len(cols)
+    for col, desc, nf, nl in zip(cols, descending, nulls_first, nullable):
+        if nl:
+            null_rank = jnp.where(col.validity, jnp.uint8(1),
+                                  jnp.uint8(0) if nf else jnp.uint8(2))
+            ops.append(jnp.where(live, null_rank, jnp.uint8(3)))
         for w in orderable_words(col):
             if not desc:
                 ops.append(w)

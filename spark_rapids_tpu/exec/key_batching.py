@@ -52,7 +52,13 @@ class KeyBatchingExec(UnaryExec):
             key_cols = [e.eval(batch, self.ctx) for e in self.keys]
             live = batch.row_mask()
             k = len(key_cols)
-            ops = sort_operands(key_cols, [False] * k, [True] * k, live)
+            from ..expressions.base import BoundReference
+            # see aggregate._segments: only plain non-nullable column
+            # refs may skip their null lane
+            nullable = [not (isinstance(e, BoundReference)
+                             and not e.nullable) for e in self.keys]
+            ops = sort_operands(key_cols, [False] * k, [True] * k, live,
+                                nullable)
             iota = jnp.arange(batch.capacity, dtype=jnp.int32)
             perm = jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)[-1]
             cols = tuple(gather_column(c, perm) for c in batch.columns)

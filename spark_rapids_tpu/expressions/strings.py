@@ -906,8 +906,29 @@ class Ascii(Expression):
 
     def eval(self, batch, ctx=EvalContext()):
         c = self.child.eval(batch, ctx)
-        cps, nchars = _codepoints(c)
-        first = jnp.where(nchars > 0, cps[:, 0], 0)
+        # the first character always starts at byte 0 — decode just its
+        # (up to 4) bytes, no full-matrix codepoint pass
+        ml = c.data.shape[1]
+
+        def byte_at(k):
+            b = c.data[:, k].astype(jnp.int32) if k < ml else \
+                jnp.zeros(c.data.shape[0], jnp.int32)
+            return jnp.where(k < c.lengths, b, 0)
+
+        b0, b1, b2, b3 = byte_at(0), byte_at(1), byte_at(2), byte_at(3)
+        cp = jnp.where(
+            b0 < 0x80, b0,
+            jnp.where(b0 < 0xE0, ((b0 & 0x1F) << 6) | (b1 & 0x3F),
+                      jnp.where(b0 < 0xF0,
+                                ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6)
+                                | (b2 & 0x3F),
+                                ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12)
+                                | ((b2 & 0x3F) << 6) | (b3 & 0x3F))))
+        # Spark's Ascii is charAt(0) — the first UTF-16 CODE UNIT, i.e.
+        # the high surrogate for supplementary-plane characters
+        cp = jnp.where(cp > 0xFFFF,
+                       0xD800 + ((cp - 0x10000) >> 10), cp)
+        first = jnp.where(c.lengths > 0, cp, 0)
         from .base import numeric_column
         return numeric_column(first.astype(jnp.int32), c.validity, T.INT32)
 

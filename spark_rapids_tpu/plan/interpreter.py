@@ -889,7 +889,12 @@ class RowEvaluator:
         s = self.eval(e.children[0], row)
         if s is None:
             return None
-        return ord(s[0]) if s else 0
+        if not s:
+            return 0
+        cp = ord(s[0])
+        if cp > 0xFFFF:     # Spark: first UTF-16 code unit (surrogate)
+            return 0xD800 + ((cp - 0x10000) >> 10)
+        return cp
 
     def _eval_Chr(self, e, row):
         n = self.eval(e.children[0], row)

@@ -600,17 +600,9 @@ class Overrides:
         return self.conf.get(SHUFFLE_PARTITIONS.key)
 
     def _exchange(self, partitioning, child: Exec) -> Exec:
-        from ..config import (ADAPTIVE_ENABLED, ADAPTIVE_TARGET_ROWS,
-                              SHUFFLE_MODE)
-        mode = str(self.conf.get(SHUFFLE_MODE.key)).upper()
-        if mode == "MULTITHREADED":
-            from ..shuffle.multithreaded import \
-                MultithreadedShuffleExchangeExec
-            return MultithreadedShuffleExchangeExec(partitioning, child)
-        return ShuffleExchangeExec(
-            partitioning, child,
-            adaptive=self.conf.get(ADAPTIVE_ENABLED.key),
-            target_rows=self.conf.get(ADAPTIVE_TARGET_ROWS.key))
+        from ..shuffle.manager import get_shuffle_manager
+        return get_shuffle_manager(self.conf).create_exchange(
+            partitioning, child)
 
     def _to_exec(self, n: L.LogicalPlan, ch: List[Exec]) -> Exec:
         if isinstance(n, L.LogicalScan):

@@ -50,8 +50,8 @@ class Session:
             # CPU-topped plan: stay on the host (no device round-trip for
             # the final island — required for device-unsupported types)
             return "fallback", plan
-        from ..config import SHUFFLE_MODE
-        if str(self.conf.get(SHUFFLE_MODE.key)).upper() == "ICI":
+        from ..shuffle.manager import get_shuffle_manager
+        if get_shuffle_manager(self.conf).wants_mesh_lowering:
             # ICI shuffle mode: fuse the planned query onto ONE SPMD mesh
             # program (exchanges → XLA collectives); unsupported plan
             # shapes keep the host-mediated exchanges

@@ -1,0 +1,63 @@
+"""Shuffle manager façade: one mode-selection point for the exchange
+data plane.
+
+Reference: RapidsShuffleInternalManagerBase.scala:1018 + the per-version
+RapidsShuffleManager façades — Spark asks ONE manager object for writers/
+readers and the manager proxies to the configured implementation (default
+sort-shuffle with the GPU serializer, MULTITHREADED thread pools, UCX
+device-resident transport). Here the planner asks the manager for an
+exchange exec; the ICI mode additionally marks the plan for whole-stage
+mesh lowering at the session layer (collectives replace the exchange
+entirely — the device-resident shuffle of SURVEY §2.10 re-shaped
+collective-first)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import (ADAPTIVE_ENABLED, ADAPTIVE_TARGET_ROWS, SHUFFLE_MODE,
+                      RapidsTpuConf)
+from ..exec.base import Exec
+from .exchange import ShuffleExchangeExec
+from .partitioning import Partitioning
+
+
+class ShuffleManager:
+    """Mode façade; construct through get_shuffle_manager."""
+
+    #: modes, mirroring the reference's three shuffle managers
+    DEFAULT = "DEFAULT"
+    MULTITHREADED = "MULTITHREADED"
+    ICI = "ICI"
+
+    def __init__(self, conf: RapidsTpuConf):
+        self.conf = conf
+        self.mode = str(conf.get(SHUFFLE_MODE.key)).upper()
+        if self.mode not in (self.DEFAULT, self.MULTITHREADED, self.ICI):
+            raise ValueError(
+                f"spark.rapids.tpu.shuffle.mode must be DEFAULT, "
+                f"MULTITHREADED or ICI, got {self.mode!r}")
+
+    def create_exchange(self, partitioning: Partitioning,
+                        child: Exec) -> Exec:
+        """The exchange exec for the configured mode (the reference's
+        getWriter/getReader moment). ICI mode still plants the
+        host-mediated exchange — the session's mesh lowering replaces the
+        whole pipeline with one SPMD program when the plan shape allows,
+        and the host exchange is the fallback for shapes it cannot fuse."""
+        if self.mode == self.MULTITHREADED:
+            from .multithreaded import MultithreadedShuffleExchangeExec
+            return MultithreadedShuffleExchangeExec(partitioning, child)
+        return ShuffleExchangeExec(
+            partitioning, child,
+            adaptive=self.conf.get(ADAPTIVE_ENABLED.key),
+            target_rows=self.conf.get(ADAPTIVE_TARGET_ROWS.key))
+
+    @property
+    def wants_mesh_lowering(self) -> bool:
+        return self.mode == self.ICI
+
+
+def get_shuffle_manager(conf: Optional[RapidsTpuConf] = None
+                        ) -> ShuffleManager:
+    return ShuffleManager(conf or RapidsTpuConf())

@@ -155,3 +155,40 @@ def test_single_partitioning():
     got = rows_of(collect(ex))
     assert_rows_equal(got, [(v,) for v in t.column("v").to_pylist()],
                       ignore_order=True)
+
+
+# ---- shuffle manager façade (reference: RapidsShuffleInternalManagerBase) --
+
+def test_shuffle_manager_mode_selection():
+    import pytest
+    from spark_rapids_tpu.config import RapidsTpuConf
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+    from spark_rapids_tpu.shuffle.multithreaded import \
+        MultithreadedShuffleExchangeExec
+    from spark_rapids_tpu.shuffle import HashPartitioning
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    from spark_rapids_tpu.expressions import col
+    import pyarrow as pa
+
+    t = pa.table({"k": pa.array([1, 2, 3], pa.int64())})
+    scan = InMemoryScanExec(t)
+    part = HashPartitioning([col("k")], 4)
+
+    m = get_shuffle_manager(RapidsTpuConf())
+    assert isinstance(m.create_exchange(part, scan), ShuffleExchangeExec)
+    assert not m.wants_mesh_lowering
+
+    m = get_shuffle_manager(RapidsTpuConf(
+        {"spark.rapids.tpu.shuffle.mode": "MULTITHREADED"}))
+    assert isinstance(m.create_exchange(part, scan),
+                      MultithreadedShuffleExchangeExec)
+
+    m = get_shuffle_manager(RapidsTpuConf(
+        {"spark.rapids.tpu.shuffle.mode": "ICI"}))
+    assert m.wants_mesh_lowering
+    assert isinstance(m.create_exchange(part, scan), ShuffleExchangeExec)
+
+    with pytest.raises(ValueError, match="shuffle.mode"):
+        get_shuffle_manager(RapidsTpuConf(
+            {"spark.rapids.tpu.shuffle.mode": "UCX"}))

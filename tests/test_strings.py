@@ -176,3 +176,27 @@ def test_soundex_non_letter_resets_tracker():
     t = pa.table({"s": pa.array(["B-b", "Mc-Carthy"])})
     got = Session().collect(table(t).select(Soundex(col("s")).alias("x")))
     assert got.column("x").to_pylist() == ["B100", "M226"]
+
+
+def test_ascii_supplementary_plane_returns_surrogate():
+    # Spark ascii() is charAt(0): the UTF-16 high surrogate for emoji
+    from spark_rapids_tpu.plan import Session
+    t = pa.table({"s": pa.array(["\U0001F600x", "A"])})
+    for conf in ({}, {"spark.rapids.tpu.sql.enabled": False}):
+        got = Session(conf).collect(
+            table(t).select(Ascii(col("s")).alias("a")))
+        assert got.column("a").to_pylist() == [0xD83D, 65], conf
+
+
+def test_groupby_null_producing_key_expression():
+    """Regression: a computed key that produces runtime nulls (divide by
+    zero) must keep its null sort lane — dropping it interleaves null and
+    valid rows with equal payloads and splits groups."""
+    from spark_rapids_tpu.expressions import lit
+    t = pa.table({"a": pa.array([10, 10, 10, 7, 7, 10], pa.int64()),
+                  "b": pa.array([0, 2, 0, 7, 7, 2], pa.int64())})
+    from spark_rapids_tpu.expressions.aggregates import Count
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).group_by((col("a") / col("b")).alias("k"))
+        .agg(Count().alias("c")),
+        ignore_order=True)
