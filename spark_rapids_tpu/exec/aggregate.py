@@ -150,13 +150,8 @@ class HashAggregateExec(UnaryExec):
             return None, seg, new_group, jnp.asarray(1, jnp.int32), live, \
                 n_live
         all_cols = list(key_cols) + list(value_cols)
-        # Only a direct reference to a schema-non-nullable COLUMN can
-        # drop its null-rank sort lane; computed expressions may produce
-        # nulls at runtime regardless of their static nullable flag
-        # (divide-by-zero, failed casts), and a dropped lane would
-        # interleave those nulls among equal payloads.
-        from ..expressions.base import BoundReference
-        nullable = [not (isinstance(e, BoundReference) and not e.nullable)
+        from .common import may_skip_null_lane
+        nullable = [not may_skip_null_lane(e)
                     for e in self.group_exprs][:len(key_cols)] + \
             [True] * len(value_cols)
         if len(nullable) != len(all_cols):

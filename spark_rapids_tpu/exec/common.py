@@ -191,6 +191,17 @@ def orderable_words(col: DeviceColumn) -> List[jax.Array]:
     return [u ^ sign]
 
 
+def may_skip_null_lane(expr) -> bool:
+    """True when a sort key expression PROVABLY never yields null rows, so
+    its null-rank operand can be dropped. Only a direct reference to a
+    schema-non-nullable column qualifies: computed expressions may
+    produce runtime nulls (divide-by-zero, failed casts) whatever their
+    static flag claims — those ops also override .nullable to True, but
+    the restriction here is the defense in depth."""
+    from ..expressions.base import BoundReference
+    return isinstance(expr, BoundReference) and not expr.nullable
+
+
 def sort_operands(cols: Sequence[DeviceColumn], descending: Sequence[bool],
                   nulls_first: Sequence[bool], live: jax.Array,
                   nullable: Optional[Sequence[bool]] = None

@@ -52,11 +52,8 @@ class KeyBatchingExec(UnaryExec):
             key_cols = [e.eval(batch, self.ctx) for e in self.keys]
             live = batch.row_mask()
             k = len(key_cols)
-            from ..expressions.base import BoundReference
-            # see aggregate._segments: only plain non-nullable column
-            # refs may skip their null lane
-            nullable = [not (isinstance(e, BoundReference)
-                             and not e.nullable) for e in self.keys]
+            from .common import may_skip_null_lane
+            nullable = [not may_skip_null_lane(e) for e in self.keys]
             ops = sort_operands(key_cols, [False] * k, [True] * k, live,
                                 nullable)
             iota = jnp.arange(batch.capacity, dtype=jnp.int32)

@@ -104,6 +104,14 @@ class Divide(BinaryArithmetic):
     """Spark `/`: true division, result is DOUBLE (decimal deferred);
     x/0 -> null in non-ANSI mode."""
 
+    @property
+    def nullable(self):
+        # zero divisors null the result in non-ANSI mode regardless of
+        # child nullability — the static flag must admit it (a lying
+        # False lets sorts drop this key's null lane)
+        return True
+
+
     SYMBOL = "/"
 
     @property
@@ -126,6 +134,14 @@ class Divide(BinaryArithmetic):
 class IntegralDivide(BinaryArithmetic):
     """Spark `div`: integral division returning LONG; x div 0 -> null.
     Java semantics: truncation toward zero."""
+
+    @property
+    def nullable(self):
+        # zero divisors null the result in non-ANSI mode regardless of
+        # child nullability — the static flag must admit it (a lying
+        # False lets sorts drop this key's null lane)
+        return True
+
 
     SYMBOL = "div"
 
@@ -150,6 +166,14 @@ class IntegralDivide(BinaryArithmetic):
 class Remainder(BinaryArithmetic):
     """Spark `%`: sign follows the dividend (Java %), x%0 -> null."""
 
+    @property
+    def nullable(self):
+        # zero divisors null the result in non-ANSI mode regardless of
+        # child nullability — the static flag must admit it (a lying
+        # False lets sorts drop this key's null lane)
+        return True
+
+
     SYMBOL = "%"
 
     def eval(self, batch, ctx=EvalContext()):
@@ -167,6 +191,14 @@ class Remainder(BinaryArithmetic):
 
 class Pmod(BinaryArithmetic):
     """Spark pmod: non-negative modulus (reference: GpuPmod)."""
+
+    @property
+    def nullable(self):
+        # zero divisors null the result in non-ANSI mode regardless of
+        # child nullability — the static flag must admit it (a lying
+        # False lets sorts drop this key's null lane)
+        return True
+
 
     SYMBOL = "pmod"
 

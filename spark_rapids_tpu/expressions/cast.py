@@ -69,6 +69,12 @@ class Cast(Expression):
     def dtype(self):
         return self.to
 
+    @property
+    def nullable(self):
+        # fallible conversions (string->numeric/date, numeric narrowing)
+        # null invalid rows in non-ANSI mode; declare it statically
+        return True
+
     def device_unsupported_reason(self):
         if not self.child.resolved:
             return None

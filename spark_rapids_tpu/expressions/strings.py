@@ -788,6 +788,18 @@ class FormatNumber(Expression):
 # scatters over the padded byte matrix.
 # ---------------------------------------------------------------------------
 
+def _decode_cp(b0, b1, b2, b3):
+    """UTF-8 unit bytes -> codepoint (shared by every decode site)."""
+    return jnp.where(
+        b0 < 0x80, b0,
+        jnp.where(b0 < 0xE0, ((b0 & 0x1F) << 6) | (b1 & 0x3F),
+                  jnp.where(b0 < 0xF0,
+                            ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6)
+                            | (b2 & 0x3F),
+                            ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12)
+                            | ((b2 & 0x3F) << 6) | (b3 & 0x3F))))
+
+
 def _codepoints(col: DeviceColumn) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(codepoints [n, ml] int32 left-packed, char counts [n]). Slots past
     a row's character count are 0."""
@@ -805,14 +817,7 @@ def _codepoints(col: DeviceColumn) -> Tuple[jnp.ndarray, jnp.ndarray]:
         return jnp.where(ok, b, 0)
 
     b0, b1, b2, b3 = byte_at(0), byte_at(1), byte_at(2), byte_at(3)
-    cp = jnp.where(
-        b0 < 0x80, b0,
-        jnp.where(b0 < 0xE0, ((b0 & 0x1F) << 6) | (b1 & 0x3F),
-                  jnp.where(b0 < 0xF0,
-                            ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6)
-                            | (b2 & 0x3F),
-                            ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12)
-                            | ((b2 & 0x3F) << 6) | (b3 & 0x3F))))
+    cp = _decode_cp(b0, b1, b2, b3)
     char_live = pos < nchars[:, None]
     return jnp.where(char_live, cp, 0), nchars
 
@@ -916,14 +921,7 @@ class Ascii(Expression):
             return jnp.where(k < c.lengths, b, 0)
 
         b0, b1, b2, b3 = byte_at(0), byte_at(1), byte_at(2), byte_at(3)
-        cp = jnp.where(
-            b0 < 0x80, b0,
-            jnp.where(b0 < 0xE0, ((b0 & 0x1F) << 6) | (b1 & 0x3F),
-                      jnp.where(b0 < 0xF0,
-                                ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6)
-                                | (b2 & 0x3F),
-                                ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12)
-                                | ((b2 & 0x3F) << 6) | (b3 & 0x3F))))
+        cp = _decode_cp(b0, b1, b2, b3)
         # Spark's Ascii is charAt(0) — the first UTF-16 CODE UNIT, i.e.
         # the high surrogate for supplementary-plane characters
         cp = jnp.where(cp > 0xFFFF,

@@ -8,7 +8,8 @@ between uses). GDS tier intentionally omitted (no TPU twin; SURVEY.md §2.9).
 
 Tiers:
   DEVICE — live jax arrays (HBM via the runtime)
-  HOST   — numpy copies (device_get), bounded by host_limit
+  HOST   — one contiguous PackedTable per batch (memory/packed.py),
+           bounded by host_limit
   DISK   — .npz files under the spill dir
 
 Spill priority: smaller value spills FIRST (matches the reference's
@@ -47,7 +48,7 @@ class _Entry:
     size: int
     priority: int
     batch: Optional[ColumnarBatch] = None          # DEVICE
-    host: Optional[dict] = None                    # HOST: name -> np array
+    host: Optional[object] = None      # HOST: PackedTable (one buffer)
     path: Optional[str] = None                     # DISK
     schema: Optional[Schema] = None
     pinned: int = 0
@@ -158,7 +159,12 @@ class BufferCatalog:
             if c.data2 is not None:     # map values / string-array lengths
                 host[f"m{i}"] = np.asarray(jax.device_get(c.data2))
         host["n"] = np.asarray(jax.device_get(e.batch.num_rows))
-        e.host = host
+        # ONE contiguous allocation per spilled batch (reference:
+        # contiguous-split packed tables / MetaUtils TableMeta) — the
+        # pinned-staging shape DMA wants, resliceable without reparsing
+        from .packed import PackedTable
+        e.host = PackedTable.pack(
+            host, int(np.asarray(host["n"]).reshape(-1)[0]))
         e.batch = None
         e.tier = StorageTier.HOST
         self.device_used = max(0, self.device_used - e.size)
@@ -178,9 +184,9 @@ class BufferCatalog:
             os.makedirs(self.spill_dir, exist_ok=True)
             path = os.path.join(self.spill_dir, f"buf-{e.handle_id}.rtpu")
             from ..shuffle.serializer import serialize_host
-            n = int(e.host["n"])
+            arrays = e.host.arrays()
             with open(path, "wb") as f:
-                f.write(serialize_host(e.host, n))
+                f.write(serialize_host(arrays, e.host.meta.num_rows))
             e.path = path
             e.host = None
             e.tier = StorageTier.DISK
@@ -199,8 +205,10 @@ class BufferCatalog:
                 self.reserve(e.size)
                 if e.tier is StorageTier.DISK:
                     from ..shuffle.serializer import deserialize_host
+                    from .packed import PackedTable
                     with open(e.path, "rb") as f:
-                        e.host, _ = deserialize_host(f.read())
+                        arrays, n = deserialize_host(f.read())
+                    e.host = PackedTable.pack(arrays, n)
                     os.remove(e.path)
                     e.path = None
                     e.tier = StorageTier.HOST
@@ -214,17 +222,18 @@ class BufferCatalog:
 
     def _host_to_device(self, e: _Entry) -> ColumnarBatch:
         import jax.numpy as jnp
+        host = e.host.arrays()      # zero-copy views into ONE buffer
         cols = []
         for i, f in enumerate(e.schema):
-            lengths = jnp.asarray(e.host[f"l{i}"]) if f"l{i}" in e.host \
+            lengths = jnp.asarray(host[f"l{i}"]) if f"l{i}" in host \
                 else None
-            data2 = jnp.asarray(e.host[f"m{i}"]) if f"m{i}" in e.host \
+            data2 = jnp.asarray(host[f"m{i}"]) if f"m{i}" in host \
                 else None
-            cols.append(DeviceColumn(jnp.asarray(e.host[f"d{i}"]),
-                                     jnp.asarray(e.host[f"v{i}"]),
+            cols.append(DeviceColumn(jnp.asarray(host[f"d{i}"]),
+                                     jnp.asarray(host[f"v{i}"]),
                                      lengths, f.dtype, data2))
         return ColumnarBatch(tuple(cols),
-                             jnp.asarray(e.host["n"], jnp.int32))
+                             jnp.asarray(host["n"], jnp.int32))
 
     def release(self, hid: int) -> None:
         with self._lock:

@@ -1,0 +1,146 @@
+"""Packed host tables: one contiguous buffer + offset metadata.
+
+Reference: the reference's contiguous-split carriers — ContiguousTable /
+GpuPackedTableColumn / GpuColumnVectorFromBuffer plus the FlatBuffers
+TableMeta (MetaUtils.scala) — let spill and shuffle move a whole table as
+ONE buffer and reslice it without reparsing. Same design here for the
+host tiers: `pack` copies a spilled batch's arrays into a single
+allocation (the pinned-staging shape DMA wants), `arrays` returns
+zero-copy numpy views, `split_rows` is a metadata-only contiguous split,
+and `TableMeta.to_bytes` is the self-describing header a disk file or
+wire frame carries next to the raw buffer.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+_MAGIC = b"RTPM"
+
+
+@dataclass(frozen=True)
+class ColumnSection:
+    """One array's slot inside the packed buffer."""
+
+    key: str                    # d{i} / v{i} / l{i} / m{i}
+    dtype: str                  # numpy dtype string
+    shape: Tuple[int, ...]      # rows-leading
+    offset: int
+    nbytes: int
+
+
+@dataclass(frozen=True)
+class TableMeta:
+    """Self-describing layout header (the FlatBuffers TableMeta role)."""
+
+    num_rows: int
+    total_bytes: int
+    sections: Tuple[ColumnSection, ...]
+
+    def to_bytes(self) -> bytes:
+        out = [_MAGIC, struct.pack("<qqI", self.num_rows, self.total_bytes,
+                                   len(self.sections))]
+        for s in self.sections:
+            key = s.key.encode()
+            dt = s.dtype.encode()
+            out.append(struct.pack("<I", len(key)))
+            out.append(key)
+            out.append(struct.pack("<I", len(dt)))
+            out.append(dt)
+            out.append(struct.pack("<I", len(s.shape)))
+            out.append(struct.pack(f"<{len(s.shape)}q", *s.shape))
+            out.append(struct.pack("<qq", s.offset, s.nbytes))
+        return b"".join(out)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "TableMeta":
+        assert data[:4] == _MAGIC, "not a packed-table meta"
+        num_rows, total, nsec = struct.unpack_from("<qqI", data, 4)
+        pos = 4 + 20
+        sections = []
+        for _ in range(nsec):
+            (klen,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            key = data[pos:pos + klen].decode()
+            pos += klen
+            (dlen,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            dt = data[pos:pos + dlen].decode()
+            pos += dlen
+            (ndim,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            shape = struct.unpack_from(f"<{ndim}q", data, pos)
+            pos += 8 * ndim
+            off, nb = struct.unpack_from("<qq", data, pos)
+            pos += 16
+            sections.append(ColumnSection(key, dt, tuple(shape), off, nb))
+        return TableMeta(num_rows, total, tuple(sections))
+
+
+class PackedTable:
+    """Contiguous host carrier for one batch's arrays."""
+
+    def __init__(self, meta: TableMeta, buffer):
+        self.meta = meta
+        self.buffer = buffer        # bytearray or memoryview-able
+
+    @property
+    def nbytes(self) -> int:
+        return self.meta.total_bytes
+
+    @classmethod
+    def pack(cls, arrays: Dict[str, np.ndarray], num_rows: int
+             ) -> "PackedTable":
+        """Copy named arrays into ONE contiguous allocation (64-byte
+        aligned sections, DMA-friendly)."""
+        sections: List[ColumnSection] = []
+        off = 0
+        for key in sorted(arrays):
+            # NOT ascontiguousarray: it promotes 0-d scalars to 1-d
+            a = np.asarray(arrays[key], order="C")
+            off = (off + 63) & ~63
+            sections.append(ColumnSection(key, a.dtype.str, a.shape, off,
+                                          a.nbytes))
+            off += a.nbytes
+        buf = bytearray(off)
+        for s, key in zip(sections, sorted(arrays)):
+            a = np.asarray(arrays[key], order="C")
+            buf[s.offset:s.offset + s.nbytes] = a.tobytes()
+        return cls(TableMeta(num_rows, off, tuple(sections)), buf)
+
+    def arrays(self) -> Dict[str, np.ndarray]:
+        """Zero-copy views into the shared buffer."""
+        mv = memoryview(self.buffer)
+        out: Dict[str, np.ndarray] = {}
+        for s in self.meta.sections:
+            a = np.frombuffer(mv[s.offset:s.offset + s.nbytes],
+                              dtype=np.dtype(s.dtype))
+            out[s.key] = a.reshape(s.shape)
+        return out
+
+    def split_rows(self, bounds: Sequence[int]) -> List["PackedTable"]:
+        """Contiguous split at row bounds — METADATA ONLY, every piece
+        shares this buffer (the reference's contiguousSplit handing out
+        sub-tables of one device allocation). ``bounds`` are split points
+        in [0, capacity]; rows-leading sections reslice by stride."""
+        cuts = [0] + list(bounds) + [None]
+        pieces: List[PackedTable] = []
+        for lo, hi in zip(cuts, cuts[1:]):
+            secs = []
+            for s in self.meta.sections:
+                cap = s.shape[0] if s.shape else 1
+                stride = s.nbytes // max(cap, 1)
+                end = hi if hi is not None else cap
+                secs.append(ColumnSection(
+                    s.key, s.dtype, (end - lo,) + s.shape[1:],
+                    s.offset + lo * stride, (end - lo) * stride))
+            rows = max(min((hi if hi is not None else self.meta.num_rows),
+                           self.meta.num_rows) - lo, 0)
+            pieces.append(PackedTable(
+                TableMeta(rows, self.meta.total_bytes, tuple(secs)),
+                self.buffer))
+        return pieces

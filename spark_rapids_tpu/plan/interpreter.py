@@ -330,6 +330,8 @@ class RowEvaluator:
                 return bool(v)
             if k is TypeKind.DATE:
                 import datetime as _dt
+                if isinstance(v, _dt.datetime):
+                    return v.date()     # datetime IS a date subclass
                 if isinstance(v, _dt.date):
                     return v
                 if isinstance(v, str):
@@ -347,11 +349,108 @@ class RowEvaluator:
                     except ValueError:
                         return None
                 return None
+            if k is TypeKind.TIMESTAMP:
+                import datetime as _dt
+                if isinstance(v, _dt.datetime):
+                    return v
+                if isinstance(v, _dt.date):
+                    return _dt.datetime(v.year, v.month, v.day)
+                if isinstance(v, bool):
+                    # Spark booleanToTimestamp: 1 MICROsecond for true
+                    return _dt.datetime(1970, 1, 1) + \
+                        _dt.timedelta(microseconds=int(v))
+                if isinstance(v, (int, float)):
+                    # Spark numeric -> timestamp: SECONDS since epoch
+                    try:
+                        return _dt.datetime(1970, 1, 1) + \
+                            _dt.timedelta(seconds=v)
+                    except (OverflowError, OSError):
+                        return None
+                if isinstance(v, str):
+                    return self._parse_ts_string(v.strip())
+                return None
+            if k is TypeKind.DECIMAL:
+                import decimal as _dec
+                try:
+                    if isinstance(v, str):
+                        d = _dec.Decimal(v.strip())
+                    elif isinstance(v, float):
+                        d = _dec.Decimal(repr(v))
+                    elif isinstance(v, _dec.Decimal):
+                        d = v
+                    else:
+                        d = _dec.Decimal(int(v))
+                    q = d.quantize(_dec.Decimal(1).scaleb(-to.scale),
+                                   rounding=_dec.ROUND_HALF_UP)
+                except (_dec.InvalidOperation, ValueError):
+                    return None
+                # Spark nulls values exceeding the target precision
+                if len(q.as_tuple().digits) - \
+                        max(-q.as_tuple().exponent - to.scale, 0) > \
+                        to.precision or abs(q) >= \
+                        _dec.Decimal(10) ** (to.precision - to.scale):
+                    return None
+                return q
             if k is TypeKind.STRING:
                 return _spark_string_of(v, e.children[0].dtype)
         except (ValueError, OverflowError):
             return None
         raise NotImplementedError(f"cast to {to}")
+
+    @staticmethod
+    def _parse_ts_string(s):
+        """Spark string->timestamp:
+        yyyy-M-d[ T][H:m:s[.fraction]][zone], zone in Z / ±HH[:MM] / UTC
+        (values normalize to the engine's UTC timeline)."""
+        import datetime as _dt
+        import re as _re
+        if not s:
+            return None
+        offset_min = 0
+        zm = _re.search(r"(Z|UTC|[+-]\d{1,2}(?::?\d{2})?)\s*$", s)
+        # a numeric offset is only a ZONE when a time component precedes
+        # it — otherwise "-04" is the day field of a bare date
+        if zm and (zm.group(1) in ("Z", "UTC") or ":" in s[:zm.start()]):
+            z = zm.group(1)
+            if z not in ("Z", "UTC"):
+                m2 = _re.fullmatch(r"([+-])(\d{1,2})(?::?(\d{2}))?", z)
+                sign = -1 if m2.group(1) == "-" else 1
+                offset_min = sign * (int(m2.group(2)) * 60
+                                     + int(m2.group(3) or 0))
+            s = s[:zm.start()].strip()
+        sep = "T" if "T" in s else " "
+        date_part, _, time_part = s.partition(sep)
+        parts = date_part.split("-")
+        if not 1 <= len(parts) <= 3 or len(parts[0]) != 4 or \
+                any(not p.isdigit() for p in parts):
+            return None
+        try:
+            y = int(parts[0])
+            m = int(parts[1]) if len(parts) > 1 else 1
+            d = int(parts[2]) if len(parts) > 2 else 1
+            base = _dt.datetime(y, m, d)
+        except ValueError:
+            return None
+        if not time_part:
+            return base - _dt.timedelta(minutes=offset_min)
+        frac = 0
+        if "." in time_part:
+            time_part, _, fs = time_part.partition(".")
+            if not fs.isdigit() or len(fs) > 9:
+                return None
+            frac = int(fs.ljust(6, "0")[:6])
+        tp = time_part.split(":")
+        if not 1 <= len(tp) <= 3 or any(not x.isdigit() for x in tp):
+            return None
+        try:
+            hh = int(tp[0])
+            mi = int(tp[1]) if len(tp) > 1 else 0
+            ss = int(tp[2]) if len(tp) > 2 else 0
+            return base.replace(hour=hh, minute=mi, second=ss,
+                                microsecond=frac) - \
+                _dt.timedelta(minutes=offset_min)
+        except ValueError:
+            return None
 
     # ---- math ----
     def _eval_UnaryMath(self, e, row):

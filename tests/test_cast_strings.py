@@ -72,3 +72,111 @@ def test_string_to_float_falls_back():
     assert_tpu_fallback_collect(
         lambda: table(t).select(Cast(col("s"), T.FLOAT64).alias("f")),
         "Project")
+
+
+# ---- interpreter cast corners: timestamp/decimal targets (round 3) ----
+
+def test_cast_timestamp_corners_cpu():
+    import datetime as dt
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions.cast import Cast
+    from spark_rapids_tpu.plan import Session, table
+    from spark_rapids_tpu.expressions import col
+
+    ses = Session({"spark.rapids.tpu.sql.enabled": False})
+    t = pa.table({"s": pa.array(["2020-03-04 05:06:07", "2020-03-04",
+                                 "2020-3-4T5:6:7.25", "nope", ""]),
+                  "n": pa.array([0, 86400, -1, 3600, None], pa.int64()),
+                  "d": pa.array([dt.date(1999, 12, 31)] * 5)})
+    got = ses.collect(table(t).select(
+        Cast(col("s"), T.TIMESTAMP).alias("ts"),
+        Cast(col("n"), T.TIMESTAMP).alias("tn"),
+        Cast(col("d"), T.TIMESTAMP).alias("td")))
+    vals = [v.replace(tzinfo=None) if v else None
+            for v in got.column("ts").to_pylist()]
+    assert vals == [dt.datetime(2020, 3, 4, 5, 6, 7),
+                    dt.datetime(2020, 3, 4),
+                    dt.datetime(2020, 3, 4, 5, 6, 7, 250000), None, None]
+    tn = [v.replace(tzinfo=None) if v else None
+          for v in got.column("tn").to_pylist()]
+    assert tn[0] == dt.datetime(1970, 1, 1)
+    assert tn[1] == dt.datetime(1970, 1, 2)
+    assert tn[4] is None
+    assert got.column("td").to_pylist()[0].replace(tzinfo=None) == \
+        dt.datetime(1999, 12, 31)
+
+
+def test_cast_timestamp_to_date_cpu():
+    import datetime as dt
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions.cast import Cast
+    from spark_rapids_tpu.plan import Session, table
+    from spark_rapids_tpu.expressions import col
+
+    ses = Session({"spark.rapids.tpu.sql.enabled": False})
+    t = pa.table({"ts": pa.array([dt.datetime(2001, 2, 3, 4, 5)])})
+    got = ses.collect(table(t).select(Cast(col("ts"), T.DATE).alias("d")))
+    assert got.column("d").to_pylist() == [dt.date(2001, 2, 3)]
+
+
+def test_cast_decimal_target_cpu():
+    import decimal
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions.cast import Cast
+    from spark_rapids_tpu.plan import Session, table
+    from spark_rapids_tpu.expressions import col
+
+    ses = Session({"spark.rapids.tpu.sql.enabled": False})
+    t = pa.table({"s": pa.array(["12.345", "1e2", "bad", "99999"]),
+                  "f": pa.array([1.005, -2.675, 0.0, 12345.6])})
+    got = ses.collect(table(t).select(
+        Cast(col("s"), T.decimal(6, 2)).alias("ds"),
+        Cast(col("f"), T.decimal(6, 2)).alias("df")))
+    assert got.column("ds").to_pylist() == [
+        decimal.Decimal("12.35"), decimal.Decimal("100.00"), None, None]
+    df = got.column("df").to_pylist()
+    assert df[0] == decimal.Decimal("1.01")      # HALF_UP on repr
+    assert df[3] is None          # 12345.60 needs 7 digits > precision 6
+    assert df[2] == decimal.Decimal("0.00")
+
+
+def test_cast_timestamp_zone_suffixes_cpu():
+    import datetime as dt
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions.cast import Cast
+    from spark_rapids_tpu.plan import Session, table
+    from spark_rapids_tpu.expressions import col
+
+    ses = Session({"spark.rapids.tpu.sql.enabled": False})
+    t = pa.table({"s": pa.array(["2020-03-04T05:06:07Z",
+                                 "2020-03-04 05:06:07+01:00",
+                                 "2020-03-04 05:06:07-0230",
+                                 "2020-03-04 05:06:07 UTC"])})
+    got = ses.collect(table(t).select(Cast(col("s"), T.TIMESTAMP).alias("t")))
+    vals = [v.replace(tzinfo=None) for v in got.column("t").to_pylist()]
+    assert vals == [dt.datetime(2020, 3, 4, 5, 6, 7),
+                    dt.datetime(2020, 3, 4, 4, 6, 7),
+                    dt.datetime(2020, 3, 4, 7, 36, 7),
+                    dt.datetime(2020, 3, 4, 5, 6, 7)]
+
+
+def test_cast_bool_to_timestamp_micros_cpu():
+    import datetime as dt
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions.cast import Cast
+    from spark_rapids_tpu.plan import Session, table
+    from spark_rapids_tpu.expressions import col
+
+    t = pa.table({"b": pa.array([True, False])})
+    for conf in ({}, {"spark.rapids.tpu.sql.enabled": False}):
+        got = Session(conf).collect(
+            table(t).select(Cast(col("b"), T.TIMESTAMP).alias("t")))
+        vals = [v.replace(tzinfo=None) for v in got.column("t").to_pylist()]
+        # Spark booleanToTimestamp: true -> 1 MICROsecond
+        assert vals == [dt.datetime(1970, 1, 1, 0, 0, 0, 1),
+                        dt.datetime(1970, 1, 1)], conf

@@ -1,0 +1,81 @@
+"""Packed-table carrier tests (reference: ContiguousTable /
+GpuPackedTableColumn + MetaUtils TableMeta)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.memory.packed import PackedTable, TableMeta
+
+
+def _arrays():
+    rng = np.random.default_rng(0)
+    return {
+        "d0": rng.integers(-100, 100, 64).astype(np.int64),
+        "v0": np.ones(64, bool),
+        "d1": rng.random((64, 8)).astype(np.float32),   # string-like 2D
+        "n": np.asarray(50, np.int32),
+    }
+
+
+def test_pack_roundtrip_zero_copy():
+    arrays = _arrays()
+    pt = PackedTable.pack(arrays, 50)
+    out = pt.arrays()
+    for k, a in arrays.items():
+        assert out[k].shape == np.asarray(a).shape
+        assert out[k].dtype == np.asarray(a).dtype
+        assert np.array_equal(out[k], a), k
+    # zero copy: every view addresses the ONE backing buffer
+    base = memoryview(pt.buffer)
+    for k, v in out.items():
+        assert v.base is not None
+    # one allocation total
+    assert pt.nbytes == pt.meta.total_bytes
+
+
+def test_meta_bytes_roundtrip():
+    pt = PackedTable.pack(_arrays(), 50)
+    meta2 = TableMeta.from_bytes(pt.meta.to_bytes())
+    assert meta2 == pt.meta
+    # a carrier rebuilt from (meta bytes, raw buffer) is identical —
+    # the disk/wire handoff shape
+    pt2 = PackedTable(meta2, pt.buffer)
+    for k, v in pt2.arrays().items():
+        assert np.array_equal(v, pt.arrays()[k])
+
+
+def test_contiguous_split_is_metadata_only():
+    arrays = {"d0": np.arange(100, dtype=np.int64),
+              "d1": np.arange(200, dtype=np.float64).reshape(100, 2)}
+    pt = PackedTable.pack(arrays, 100)
+    a, b, c = pt.split_rows([30, 70])
+    assert a.buffer is pt.buffer and b.buffer is pt.buffer
+    assert np.array_equal(a.arrays()["d0"], np.arange(30))
+    assert np.array_equal(b.arrays()["d0"], np.arange(30, 70))
+    assert np.array_equal(c.arrays()["d0"], np.arange(70, 100))
+    assert np.array_equal(b.arrays()["d1"],
+                          np.arange(60, 140, dtype=np.float64)
+                          .reshape(40, 2))
+    assert a.meta.num_rows == 30 and c.meta.num_rows == 30
+
+
+def test_catalog_host_tier_uses_packed_carrier():
+    import pyarrow as pa
+    from spark_rapids_tpu.batch import from_arrow
+    from spark_rapids_tpu.memory.catalog import (BufferCatalog,
+                                                 SpillableBatch,
+                                                 StorageTier)
+
+    cat = BufferCatalog(device_limit=1 << 16)
+    t = pa.table({"x": pa.array(np.arange(2000), pa.int64()),
+                  "y": pa.array(np.arange(2000), pa.float64())})
+    b, s = from_arrow(t)
+    sb = SpillableBatch(cat, b, s)
+    cat.synchronous_spill(1 << 30)
+    assert cat.tier_of(sb.hid) is StorageTier.HOST
+    e = cat._entries[sb.hid]
+    assert isinstance(e.host, PackedTable)
+    got = sb.get()          # unspill through the packed views
+    assert np.array_equal(np.asarray(got.columns[0].data)[:2000],
+                          np.arange(2000))
+    sb.close()
