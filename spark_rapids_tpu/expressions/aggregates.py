@@ -587,16 +587,15 @@ class CollectList(AggregateFunction):
 
     def update(self, inputs, seg, live, cap):
         col = inputs[0]
-        if col.lengths is not None:
-            raise NotImplementedError("collect over strings lands with "
-                                      "nested-string arrays")
+        is_string = col.lengths is not None
         ok = col.validity & live
         if self._dedupe:
             # rows are sorted by (keys, value): drop adjacent duplicates
+            # (adjacent_equal owns the string/typed pairwise comparison)
+            from ..exec.common import adjacent_equal
             same_seg = jnp.concatenate(
                 [jnp.zeros(1, bool), seg[1:] == seg[:-1]])
-            same_val = jnp.concatenate(
-                [jnp.zeros(1, bool), col.data[1:] == col.data[:-1]])
+            same_val = adjacent_equal([col])
             prev_ok = jnp.concatenate([jnp.zeros(1, bool), ok[:-1]])
             ok = ok & ~(same_seg & same_val & prev_ok)
         segc = jnp.clip(seg, 0, cap - 1)
@@ -610,14 +609,25 @@ class CollectList(AggregateFunction):
         flat_target = jnp.where(ok & (pos < me),
                                 segc.astype(jnp.int64) * me + pos,
                                 jnp.int64(cap) * me)
-        mat = jnp.zeros(cap * me + 1, col.data.dtype).at[flat_target].set(
-            col.data, mode="drop")[: cap * me].reshape(cap, me)
         # counts stay UNCLAMPED: a group with more than max_elems values
         # surfaces as lengths > max_elems, which the host boundary
         # (to_arrow) rejects loudly — same contract as string max_len —
         # instead of silently truncating the list.
         counts = _seg_sum(ok.astype(jnp.int32), seg, cap)
         valid = jnp.ones(cap, bool)   # empty group -> empty list (not null)
+        if is_string:
+            # array<string>: 3D byte tensor [group, elem, max_len] with
+            # per-element byte lengths in data2 (split()'s layout)
+            ml = col.data.shape[1]
+            mat = jnp.zeros((cap * me + 1, ml), col.data.dtype).at[
+                flat_target].set(col.data, mode="drop")[
+                : cap * me].reshape(cap, me, ml)
+            elens = jnp.zeros(cap * me + 1, jnp.int32).at[
+                flat_target].set(col.lengths, mode="drop")[
+                : cap * me].reshape(cap, me)
+            return [DeviceColumn(mat, valid, counts, self.dtype, elens)]
+        mat = jnp.zeros(cap * me + 1, col.data.dtype).at[flat_target].set(
+            col.data, mode="drop")[: cap * me].reshape(cap, me)
         return [DeviceColumn(mat, valid, counts, self.dtype)]
 
     def merge(self, buffers, seg, live, cap):
@@ -626,7 +636,8 @@ class CollectList(AggregateFunction):
     def evaluate(self, buffers, group_live):
         b = buffers[0]
         return DeviceColumn(b.data, b.validity & group_live,
-                            jnp.where(group_live, b.lengths, 0), self.dtype)
+                            jnp.where(group_live, b.lengths, 0),
+                            self.dtype, b.data2)
 
 
 class CollectSet(CollectList):

@@ -137,7 +137,7 @@ def _expr_rules() -> Dict[str, ExprRule]:
     r("ApproxPercentile", TS.NUMERIC + TS.DATETIME,
       note="answered exactly; sorted segments make exact as cheap as the sketch")
     for n in ("CollectList", "CollectSet"):
-        r(n, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
+        r(n, TS.NUMERIC + TS.DATETIME + TS.BOOLEAN + TS.STRING)
     r("Average", TS.NUMERIC,
       note="float sums reassociate; parity kept by f64 accumulation")
     for n in ("StddevSamp", "StddevPop", "VarianceSamp", "VariancePop"):

@@ -8,8 +8,10 @@ from spark_rapids_tpu.expressions.aggregates import (CollectList, CollectSet,
                                                      Count)
 from spark_rapids_tpu.plan import Session, table
 
-from harness.asserts import assert_rows_equal, rows_of
-from harness.data_gen import IntegerGen, LongGen, gen_table
+from harness.asserts import (assert_rows_equal, rows_of,
+                             assert_tpu_and_cpu_are_equal_collect)
+from harness.data_gen import (IntegerGen, LongGen, StringGen,
+                              gen_table)
 
 CT = gen_table([("k", IntegerGen(min_val=0, max_val=6)),
                 ("v", IntegerGen(min_val=0, max_val=20))], n=400, seed=240)
@@ -60,3 +62,28 @@ def test_collect_list_overflow_raises():
         InMemoryScanExec(CT), AggregateMode.COMPLETE)
     with pytest.raises(CapacityError):
         collect(plan)
+
+
+def test_collect_list_strings():
+    t = gen_table([("k", IntegerGen(min_val=0, max_val=5, nullable=False)),
+                   ("s", StringGen(min_len=0, max_len=8))], n=200, seed=175)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).group_by("k")
+        .agg(CollectList(col("s")).alias("xs")),
+        ignore_order=True)
+    # the comparison must be device-vs-cpu, not fallback-vs-cpu
+    ses = Session()
+    ses.collect(table(t).group_by("k")
+                .agg(CollectList(col("s")).alias("xs")))
+    assert not any("CpuFallback" in n for n in ses.executed_exec_names()), \
+        ses.executed_exec_names()
+
+
+def test_collect_set_strings_dedupes():
+    import pyarrow as pa
+    t = pa.table({"k": pa.array([1, 1, 1, 2, 2], pa.int32()),
+                  "s": pa.array(["a", "a", "b", "x", None])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(t).group_by("k")
+        .agg(CollectSet(col("s")).alias("xs")),
+        ignore_order=True)
