@@ -291,6 +291,19 @@ class PlanMeta:
                             f"its element type")
             except Exception as ex:
                 self.will_not_work(f"generator does not bind: {ex}")
+        if isinstance(n, L.LogicalAggregate):
+            # one sort-sensitive aggregate (percentile/collect) per exec:
+            # each needs its own value-sorted layout. More than one must
+            # fall back cleanly, not crash at exec construction.
+            raw = [e.child if isinstance(e, Alias) else e
+                   for e in n.agg_exprs]
+            sensitive = [a for a in raw
+                         if getattr(a, "requires_sorted_input", False)]
+            if len(sensitive) > 1:
+                self.will_not_work(
+                    f"{len(sensitive)} sort-sensitive aggregates "
+                    f"(percentile/collect) in one aggregation; the device "
+                    f"exec supports one value-sorted layout")
         if isinstance(n, L.LogicalWindow):
             from ..expressions.window import (WindowAgg, WindowExpression,
                                               unsupported_frame_reason)

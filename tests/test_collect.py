@@ -87,3 +87,22 @@ def test_collect_set_strings_dedupes():
         lambda: table(t).group_by("k")
         .agg(CollectSet(col("s")).alias("xs")),
         ignore_order=True)
+
+
+def test_two_collects_fall_back_cleanly():
+    """Two sort-sensitive aggregates need two sorted layouts; the planner
+    must tag CPU fallback instead of crashing at exec construction."""
+    import pyarrow as pa
+    t = pa.table({"k": pa.array([1, 1, 2], pa.int32()),
+                  "s": pa.array(["b", "a", "x"])})
+    q = lambda: table(t).group_by("k").agg(
+        CollectList(col("s")).alias("l"), CollectSet(col("s")).alias("st"))
+    ses = Session()
+    got = ses.collect(q())
+    assert any("CpuFallback" in n for n in ses.executed_exec_names())
+    exp = Session({"spark.rapids.tpu.sql.enabled": False}).collect(q())
+    g = sorted(zip(got.column("k").to_pylist(),
+                   map(tuple, got.column("l").to_pylist())))
+    e = sorted(zip(exp.column("k").to_pylist(),
+                   map(tuple, exp.column("l").to_pylist())))
+    assert g == e
