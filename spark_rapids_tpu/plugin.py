@@ -56,6 +56,7 @@ class ExecutorRuntime:
         self.fatal_error: Optional[BaseException] = None
         self.started_at = time.time()
         self._heartbeats: Dict[str, float] = {}
+        self._hb_senders: List[tuple] = []      # (thread, stop event)
 
         self._version_handshake()
         self.device = self._acquire_device()
@@ -168,12 +169,37 @@ class ExecutorRuntime:
     def heartbeat(self, executor_id: str) -> None:
         self._heartbeats[executor_id] = time.time()
 
+    def start_heartbeat(self, executor_id: str,
+                        interval_s: float = 5.0) -> threading.Event:
+        """Background sender: stamp this executor's liveness every
+        interval (reference: RapidsShuffleHeartbeatEndpoint's executor →
+        driver ping loop). Returns the stop event; shutdown() sets it."""
+        stop = threading.Event()
+
+        def loop():
+            while not stop.is_set():
+                self.heartbeat(executor_id)
+                stop.wait(interval_s)
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"heartbeat-{executor_id}")
+        with self._lock:
+            self._hb_senders.append((t, stop))
+        t.start()
+        return stop
+
     def live_executors(self, timeout_s: float = 30.0) -> List[str]:
         now = time.time()
         return [e for e, t in self._heartbeats.items()
                 if now - t <= timeout_s]
 
     def shutdown(self) -> None:
+        # deterministic teardown: stop AND join the senders so no stamp
+        # can land after shutdown returns
+        for t, stop in list(getattr(self, "_hb_senders", [])):
+            stop.set()
+        for t, stop in list(getattr(self, "_hb_senders", [])):
+            t.join(timeout=10)
         # the MemoryCleaner-at-shutdown analogue (reference:
         # Plugin.scala:283-298 shutdown-hook ordering): surviving catalog
         # handles at engine shutdown are leaks — log them loudly

@@ -253,3 +253,25 @@ def test_peer_resets_mid_frame():
         stop.set()
         srv.close()
         t.join(timeout=5)
+
+
+def test_heartbeat_sender_keeps_executor_live():
+    """The background sender stamps liveness without manual calls; a
+    stopped sender ages out of live_executors."""
+    import time
+    from spark_rapids_tpu.plugin import init
+
+    runtime = init()
+    stop = runtime.start_heartbeat("exec-auto", interval_s=0.05)
+    time.sleep(0.2)
+    assert "exec-auto" in runtime.live_executors(timeout_s=1.0)
+    stop.set()
+    # join the sender: no stamp can land after this point
+    for t, st in runtime._hb_senders:
+        if st is stop:
+            t.join(timeout=10)
+            assert not t.is_alive()
+    last = runtime._heartbeats["exec-auto"]
+    time.sleep(0.2)
+    assert runtime._heartbeats["exec-auto"] == last    # sender stopped
+    assert "exec-auto" not in runtime.live_executors(timeout_s=0.1)
