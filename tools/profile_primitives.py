@@ -1,0 +1,164 @@
+"""Measure the cost of the primitive device ops the engine is built from.
+
+Round-3 profiling (VERDICT r2 Weak #1 / Next #1): before rearchitecting the
+hot paths, establish what each building block actually costs on THIS chip
+behind THIS tunnel. Results are committed to docs/perf_r3.md.
+
+Run: python tools/profile_primitives.py
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 22          # the bench's q1/hash_agg batch size
+
+
+def sync(x):
+    leaves = [l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "dtype")]
+    if leaves:
+        v = leaves[0]
+        float(jnp.sum(v.astype(jnp.float32)))
+
+
+def bench(name, fn, *args, reps=3):
+    f = jax.jit(fn)
+    out = f(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    sync(out)
+    sync_cost = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    sync(out)
+    dt = max(time.perf_counter() - t0 - sync_cost, 1e-9) / reps
+    print(f"{name:55s} {dt*1e3:10.2f} ms")
+    return dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    i32 = jnp.asarray(rng.integers(0, 1 << 20, N).astype(np.int32))
+    i32b = jnp.asarray(rng.integers(0, 1 << 20, N).astype(np.int32))
+    i64 = jnp.asarray(rng.integers(0, 1 << 20, N).astype(np.int64))
+    f32 = jnp.asarray(rng.uniform(0, 1, N).astype(np.float32))
+    f64 = jnp.asarray(rng.uniform(0, 1, N))
+    seg_sorted = jnp.sort(jnp.asarray(
+        rng.integers(0, 1 << 20, N).astype(np.int32)))
+    perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+    small = jnp.asarray(rng.integers(0, 8, N).astype(np.int32))
+
+    # tunnel / dispatch
+    t0 = time.perf_counter()
+    sync(i32)
+    print(f"{'tunnel sync round trip':55s} "
+          f"{(time.perf_counter()-t0)*1e3:10.2f} ms")
+    bench("noop jit (x+1) i32", lambda x: x + 1, i32)
+
+    # sorts
+    bench("lax.sort 1key i32", lambda x: jax.lax.sort([x]), i32)
+    bench("lax.sort 1key i64", lambda x: jax.lax.sort([x]), i64)
+    bench("lax.sort 1key f32", lambda x: jax.lax.sort([x]), f32)
+    bench("lax.sort i32 key + iota (argsort)",
+          lambda x: jax.lax.sort([x, jnp.arange(N, dtype=jnp.int32)],
+                                 num_keys=2), i32)
+    bench("lax.sort 2 i32 keys + iota",
+          lambda x, y: jax.lax.sort(
+              [x, y, jnp.arange(N, dtype=jnp.int32)], num_keys=3),
+          i32, i32b)
+    bench("lax.sort i64 key + iota",
+          lambda x: jax.lax.sort([x, jnp.arange(N, dtype=jnp.int32)],
+                                 num_keys=2), i64)
+    bench("lax.sort 5 operands 1 i32 key (payload carry)",
+          lambda x: jax.lax.sort(
+              [x, jnp.arange(N, dtype=jnp.int32),
+               jnp.arange(N, dtype=jnp.int32),
+               jnp.arange(N, dtype=jnp.int32),
+               jnp.arange(N, dtype=jnp.int32)], num_keys=2), i32)
+
+    # gathers / scatters
+    bench("gather i32 (take perm)", lambda x, p: jnp.take(x, p, axis=0),
+          i32, perm)
+    bench("gather f64 (take perm)", lambda x, p: jnp.take(x, p, axis=0),
+          f64, perm)
+    bench("gather 4x i32", lambda x, p: [jnp.take(x, p, axis=0)
+                                         for _ in range(4)], i32, perm)
+    bench("scatter-add i32 -> 1M slots",
+          lambda x, s: jnp.zeros(1 << 20, jnp.int32).at[s].add(x), i32, i32b)
+    bench("scatter-add i32 -> 8 slots",
+          lambda x, s: jnp.zeros(8, jnp.int32).at[s].add(x), i32, small)
+
+    # segment reductions (sorted ids)
+    bench("segment_sum f32 sorted 1M segs",
+          lambda v, s: jax.ops.segment_sum(v, s, num_segments=1 << 20,
+                                           indices_are_sorted=True),
+          f32, seg_sorted)
+    bench("segment_sum f64 sorted 1M segs",
+          lambda v, s: jax.ops.segment_sum(v, s, num_segments=1 << 20,
+                                           indices_are_sorted=True),
+          f64, seg_sorted)
+    bench("segment_min i32 sorted 1M segs",
+          lambda v, s: jax.ops.segment_min(v, s, num_segments=1 << 20,
+                                           indices_are_sorted=True),
+          i32, seg_sorted)
+
+    # one-hot matmul groupby (small cardinality)
+    def onehot_agg(v, s):
+        oh = jax.nn.one_hot(s, 8, dtype=jnp.float32)
+        return v.astype(jnp.float32) @ oh
+    bench("one-hot(8) matmul agg f32", onehot_agg, f32, small)
+
+    def onehot_agg_multi(v, w, s):
+        oh = jax.nn.one_hot(s, 8, dtype=jnp.float32)
+        stacked = jnp.stack([v.astype(jnp.float32),
+                             w.astype(jnp.float32)])
+        return stacked @ oh
+    bench("one-hot(8) matmul agg 2 cols", onehot_agg_multi, f32, f32, small)
+
+    def onehot1024(v, s):
+        oh = jax.nn.one_hot(s & 1023, 1024, dtype=jnp.float32)
+        return v.astype(jnp.float32) @ oh
+    bench("one-hot(1024) matmul agg f32", onehot1024, f32, i32)
+
+    # cumsum / scans
+    bench("cumsum i32", lambda x: jnp.cumsum(x), i32)
+    bench("cumsum f32", lambda x: jnp.cumsum(x), f32)
+
+    # arithmetic: i64 emulation cost
+    bench("mul i32", lambda x: x * x + 7, i32)
+    bench("mul i64", lambda x: x * x + 7, i64)
+    bench("mul f64", lambda x: x * x + 7.0, f64)
+    bench("f64 -> f32 + mul", lambda x: x.astype(jnp.float32) * 2.0, f64)
+
+    # searchsorted (join probe primitive)
+    keys = jnp.sort(jnp.asarray(
+        rng.integers(0, 1 << 20, 1 << 18).astype(np.int32)))
+    bench("searchsorted 4M in 256K i32",
+          lambda k, q: jnp.searchsorted(k, q), keys, i32)
+    keys64 = keys.astype(jnp.int64)
+    bench("searchsorted 4M in 256K i64",
+          lambda k, q: jnp.searchsorted(k, q), keys64, i64)
+
+    # compaction (filter) via cumsum + scatter vs sort
+    def compact_scatter(v, m):
+        pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+        idx = jnp.where(m, pos, N)
+        out = jnp.zeros(N + 1, v.dtype).at[idx].set(v, mode="drop")
+        return out[:N]
+    mask = i32 < (1 << 19)
+    bench("compact via cumsum+scatter", compact_scatter, f32, mask)
+
+    def compact_sort(v, m):
+        ops = jax.lax.sort([(~m).astype(jnp.int32),
+                            jnp.arange(N, dtype=jnp.int32)], num_keys=1)
+        return jnp.take(v, ops[1], axis=0)
+    bench("compact via flag-sort+gather", compact_sort, f32, mask)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""Round-3 profiling, part 2: decision measurements for the hot-path rework.
+
+Questions this answers (feeding docs/perf_r3.md):
+  A. How should segmented reductions run for f64/i64 (emulated 64-bit)?
+     candidates: segment_sum (scatter), cumsum+diff, two-float(f32,f32)
+     compensated, one-hot matmul (low cardinality).
+  B. What does searchsorted method="sort" cost (the join probe actually
+     in use) vs the scan default measured in part 1?
+  C. Where does the 2.5s of the fused q1 stage actually go?
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 22
+K = 1 << 20      # high-cardinality segment count
+
+
+def sync(x):
+    leaves = [l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "dtype")]
+    if leaves:
+        v = leaves[0]
+        float(jnp.sum(v.astype(jnp.float32)))
+
+
+def bench(name, fn, *args, reps=3, jit=True):
+    try:
+        return _bench(name, fn, *args, reps=reps, jit=jit)
+    except Exception as e:
+        print(f"{name:58s}   FAILED {type(e).__name__}: {str(e)[:80]}")
+        return None
+
+
+def _bench(name, fn, *args, reps=3, jit=True):
+    f = jax.jit(fn) if jit else fn
+    out = f(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    sync(out)
+    sync_cost = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    sync(out)
+    dt = max(time.perf_counter() - t0 - sync_cost, 1e-9) / reps
+    print(f"{name:58s} {dt*1e3:10.2f} ms")
+    return dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    f64 = jnp.asarray(rng.uniform(0, 1, N))
+    i64 = jnp.asarray(rng.integers(0, 1000, N).astype(np.int64))
+    i32 = jnp.asarray(rng.integers(0, K, N).astype(np.int32))
+    seg_sorted = jnp.sort(i32)
+    small = jnp.asarray(rng.integers(0, 8, N).astype(np.int32))
+
+    print("== A. segmented reduction candidates (f64 / i64) ==")
+    bench("segment_sum f64 scatter 1M segs",
+          lambda v, s: jax.ops.segment_sum(v, s, num_segments=K,
+                                           indices_are_sorted=True),
+          f64, seg_sorted)
+    bench("cumsum f64", lambda v: jnp.cumsum(v), f64)
+    bench("cumsum i64", lambda v: jnp.cumsum(v), i64)
+
+    def cumsum_diff_f64(v, s):
+        c = jnp.cumsum(v)
+        iota = jnp.arange(N, dtype=jnp.int32)
+        ends = jax.ops.segment_max(iota, s, num_segments=K,
+                                   indices_are_sorted=True)
+        tot = jnp.take(c, jnp.clip(ends, 0, N - 1))
+        prev = jnp.concatenate([jnp.zeros(1, tot.dtype), tot[:-1]])
+        return tot - prev
+    bench("cumsum+diff f64 (sorted segs)", cumsum_diff_f64, f64, seg_sorted)
+
+    def twofloat_segsum(v, s):
+        hi = v.astype(jnp.float32)
+        lo = (v - hi.astype(jnp.float64)).astype(jnp.float32)
+        shi = jax.ops.segment_sum(hi, s, num_segments=K,
+                                  indices_are_sorted=True)
+        slo = jax.ops.segment_sum(lo, s, num_segments=K,
+                                  indices_are_sorted=True)
+        return shi.astype(jnp.float64) + slo.astype(jnp.float64)
+    bench("two-f32 segment_sum pair -> f64", twofloat_segsum, f64, seg_sorted)
+
+    bench("segment_sum i64 scatter 1M segs",
+          lambda v, s: jax.ops.segment_sum(v, s, num_segments=K,
+                                           indices_are_sorted=True),
+          i64, seg_sorted)
+
+    def i64_as_2xi32_segsum(v, s):
+        lo = (v & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int64)
+        # sum via f64? simpler: two i32 sums with carry handling via i64 at end
+        lo32 = lo.astype(jnp.int32)
+        hi32 = (v >> 32).astype(jnp.int32)
+        slo = jax.ops.segment_sum(lo32.astype(jnp.float64), s,
+                                  num_segments=K, indices_are_sorted=True)
+        shi = jax.ops.segment_sum(hi32, s, num_segments=K,
+                                  indices_are_sorted=True)
+        return slo, shi
+    bench("segment_sum i32 scatter 1M segs",
+          lambda v, s: jax.ops.segment_sum(v, s, num_segments=K,
+                                           indices_are_sorted=True),
+          i32, seg_sorted)
+    bench("segment_sum f32 scatter 1M segs",
+          lambda v, s: jax.ops.segment_sum(v, s, num_segments=K,
+                                           indices_are_sorted=True),
+          f64.astype(jnp.float32), seg_sorted)
+
+    print("== one-hot matmul low-cardinality, f64 via two-f32 ==")
+
+    def onehot_twofloat(v, s):
+        hi = v.astype(jnp.float32)
+        lo = (v - hi.astype(jnp.float64)).astype(jnp.float32)
+        oh = jax.nn.one_hot(s, 8, dtype=jnp.float32)
+        shi = hi @ oh
+        slo = lo @ oh
+        return shi.astype(jnp.float64) + slo.astype(jnp.float64)
+    bench("one-hot(8) two-f32 matmul -> f64", onehot_twofloat, f64, small)
+
+    def onehot_blocked(v, s):
+        # f32 products, f64 accumulation across 4096-row blocks:
+        # full f64-grade precision with MXU throughput
+        B = 1 << 12
+        vb = v.astype(jnp.float32).reshape(N // B, B)
+        sb = s.reshape(N // B, B)
+        oh = jax.nn.one_hot(sb, 8, dtype=jnp.float32)   # [nb, B, 8]
+        part = jnp.einsum("nb,nbk->nk", vb, oh)          # f32 per block
+        return jnp.sum(part.astype(jnp.float64), axis=0)
+    bench("one-hot(8) blocked f64-accum matmul", onehot_blocked, f64, small)
+
+    print("== B. searchsorted (join probe) ==")
+    keys = jnp.sort(jnp.asarray(
+        rng.integers(0, 1 << 20, 1 << 18).astype(np.uint32)))
+    q = jnp.asarray(rng.integers(0, 1 << 20, N).astype(np.uint32))
+    bench("searchsorted sort-method 4M in 256K u32",
+          lambda k, x: jnp.searchsorted(k, x, method="sort"), keys, q)
+    bench("searchsorted sort-method both sides (lo+hi)",
+          lambda k, x: (jnp.searchsorted(k, x, side="left", method="sort"),
+                        jnp.searchsorted(k, x, side="right", method="sort")),
+          keys, q)
+
+    print("== C. q1 stage breakdown ==")
+    import __graft_entry__ as g
+    batch, schema = g._flagship_batch(N)
+    stage, _, _, _ = g._q1_stage(schema)
+    bench("q1 full fused stage", stage, batch)
+
+    # pieces: the sort, the gathers, the segment ops
+    from spark_rapids_tpu.exec.common import sort_operands
+    rf = batch.columns[0].data
+    ls = batch.columns[1].data
+    live = jnp.ones(N, bool)
+
+    def q1_sort_only(rf, ls):
+        ops = sort_operands(
+            [type(batch.columns[0])(rf, live, None, batch.columns[0].dtype),
+             type(batch.columns[1])(ls, live, None, batch.columns[1].dtype)],
+            [False, False], [True, True], live, [False, False])
+        iota = jnp.arange(N, dtype=jnp.int32)
+        return jax.lax.sort(ops + [iota], num_keys=len(ops) + 1)[-1]
+    perm = jax.jit(q1_sort_only)(rf, ls)
+    sync(perm)
+    bench("q1 key sort only", q1_sort_only, rf, ls)
+
+    def q1_gathers(perm):
+        return [jnp.take(c.data, perm, axis=0) for c in batch.columns]
+    bench("q1 gather 6 cols through perm", q1_gathers, perm)
+
+    seg6 = jnp.sort(jnp.asarray(rng.integers(0, 6, N).astype(np.int32)))
+
+    def q1_segsums(v, s):
+        a = jax.ops.segment_sum(v, s, num_segments=N,
+                                indices_are_sorted=True)
+        b = jax.ops.segment_sum(v * 2.0, s, num_segments=N,
+                                indices_are_sorted=True)
+        c = jax.ops.segment_sum(v + 1.0, s, num_segments=N,
+                                indices_are_sorted=True)
+        return a, b, c
+    bench("3x segment_sum f64 -> N segs (as agg does)", q1_segsums,
+          f64, seg6)
+
+    def q1_segsums_small(v, s):
+        a = jax.ops.segment_sum(v, s, num_segments=8,
+                                indices_are_sorted=True)
+        return a
+    bench("1x segment_sum f64 -> 8 segs", q1_segsums_small, f64, seg6)
+
+
+if __name__ == "__main__":
+    main()
