@@ -187,12 +187,22 @@ def bench_join_sort(jax, n_stream=1 << 21, n_build=1 << 18, reps=3):
                         InMemoryScanExec([bb], schema=b_schema))
     plan = SortExec([desc(col("l_revenue"))], join)
 
+    # whole-stage fusion (exec/fuse.py): the stage runs as ONE XLA program
+    # with optimistic join sizing; the overflow flag is validated after the
+    # timed region (it is part of the same program's output — a nonzero
+    # flag raises, so a mis-sized run can never report a number)
+    from spark_rapids_tpu.exec.fuse import try_fuse
+    fused = try_fuse(plan)
+    assert fused is not None, "join+sort stage did not fuse"
+    program, inputs = fused.prepare()
+
     def run():
-        out = None
-        for b in plan.execute():
-            out = b
-        return out
+        out, flags, _needs = program(*inputs)
+        return out, flags
     dt = _time(run, reps, _sync_scalar)
+    import jax.numpy as jnp
+    _, flags = run()
+    assert int(jnp.max(flags)) == 0, "fused join overflowed its bucket"
 
     def oracle():
         j = stream.join(build, keys="l_orderkey",

@@ -31,8 +31,37 @@ from ..expressions.aggregates import AggregateFunction
 from ..expressions.base import Alias, EvalContext, Expression
 from .base import Exec, UnaryExec
 from .basic import bind_all, output_name
-from .common import adjacent_equal, compaction_indices, concat_batches, \
-    gather_column, sort_operands
+from .common import adjacent_equal, adjacent_equal_ops, compaction_indices, \
+    concat_batches, gather_column, sort_operands
+
+# dtypes whose device payload is a flat 1-D array; such columns can ride a
+# key sort as extra payload operands (docs/perf_r3.md: payload carry is
+# ~free, versus 26–65 ms per post-hoc 4M-row gather)
+_FLAT_KINDS = frozenset({
+    T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32, T.TypeKind.INT64,
+    T.TypeKind.FLOAT32, T.TypeKind.FLOAT64, T.TypeKind.BOOLEAN,
+    T.TypeKind.DATE, T.TypeKind.TIMESTAMP,
+})
+
+
+def _is_flat(t: T.SqlType) -> bool:
+    return t.kind in _FLAT_KINDS or (t.kind is T.TypeKind.DECIMAL
+                                     and t.precision <= 18)
+
+
+def _pad_column(c: DeviceColumn, cap: int) -> DeviceColumn:
+    """Zero/False-pad a [L]-capacity column up to [cap] rows."""
+    pad = cap - c.capacity
+    if pad == 0:
+        return c
+
+    def pz(a):
+        if a is None:
+            return None
+        return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+
+    return DeviceColumn(pz(c.data), pz(c.validity), pz(c.lengths), c.dtype,
+                        pz(c.data2))
 
 
 class AggregateMode(enum.Enum):
@@ -55,7 +84,10 @@ class HashAggregateExec(UnaryExec):
                  agg_exprs: Sequence[Expression], child: Exec,
                  mode: AggregateMode = AggregateMode.COMPLETE,
                  ctx: Optional[EvalContext] = None,
-                 max_result_rows: int = 1 << 22):
+                 max_result_rows: int = 1 << 22,
+                 small_groups_bucket: int = 1 << 12,
+                 layout_tiers: Optional[Sequence[int]] = None):
+        self.layout_tiers = layout_tiers
         super().__init__(child, ctx)
         self.mode = mode
         self.max_result_rows = max_result_rows
@@ -123,10 +155,46 @@ class HashAggregateExec(UnaryExec):
                 f"{type(self.sort_sensitive[0]).__name__} supports "
                 f"COMPLETE mode only (not decomposable)")
 
+        # ---- round-3 fast path eligibility (docs/perf_r3.md) ----------
+        # values ride the key sort as payload; group-slot layout shrinks
+        # to `small_groups_bucket` when the observed group count allows
+        self.small_groups_bucket = small_groups_bucket
+        self._upd_value_exprs: List[Expression] = []
+        self._upd_per_agg: List[List[int]] = []
+        index_of = {}
+        for agg in self.aggs:
+            idxs = []
+            for c in agg.children:
+                k = self._expr_key(c)
+                if k not in index_of:
+                    index_of[k] = len(self._upd_value_exprs)
+                    self._upd_value_exprs.append(c)
+                idxs.append(index_of[k])
+            self._upd_per_agg.append(idxs)
+        have_keys = len(self.group_exprs) > 0
+        self._fast_update = (
+            mode in (AggregateMode.PARTIAL, AggregateMode.COMPLETE)
+            and have_keys and not self.sort_sensitive
+            and all(_is_flat(c.dtype) for a in self.aggs for c in a.children)
+            and all(_is_flat(bt) for a in self.aggs for bt in a.buffer_types()))
+        self._fast_merge = (
+            mode in (AggregateMode.PARTIAL_MERGE, AggregateMode.FINAL)
+            and have_keys
+            and all(_is_flat(f.dtype) for f in self.buffer_fields))
+
         self._update_jit = jax.jit(self._update_kernel)
         self._merge_jit = jax.jit(lambda b: self._merge_kernel(b, final=False))
         self._final_jit = jax.jit(lambda b: self._merge_kernel(b, final=True))
         self._eval_buffers_jit = jax.jit(self._eval_buffers_kernel)
+
+    @staticmethod
+    def _expr_key(e: Expression):
+        """Identity for payload dedup: two aggregates over the same bound
+        column share one carried payload lane."""
+        from ..expressions.base import BoundReference
+        if isinstance(e, BoundReference):
+            return ("ref", e.ordinal)
+        return id(e)
 
     @property
     def output_schema(self) -> Schema:
@@ -211,6 +279,137 @@ class HashAggregateExec(UnaryExec):
         return out
 
     # ------------------------------------------------------------------
+    # Round-3 fast kernel (docs/perf_r3.md): ONE key sort carrying every
+    # aggregate input as payload; cumsum-diff reductions over the sorted
+    # layout; dual small/large group-slot layout behind a lax.cond so the
+    # common small-group-count case pays G-sized per-group gathers
+    # instead of capacity-sized ones.
+    # ------------------------------------------------------------------
+
+    def _fast_group_kernel(self, batch: ColumnarBatch, mask,
+                           merge: bool, final: bool) -> ColumnarBatch:
+        cap = batch.capacity
+        in_live = batch.row_mask()
+        if mask is not None:
+            in_live = in_live & mask
+        nk = len(self.key_fields)
+        if merge:
+            key_cols = list(batch.columns[:nk])
+            flat_vals = list(batch.columns[nk:])
+            per_agg, off = [], 0
+            for agg in self.aggs:
+                nb = len(agg.buffer_types())
+                per_agg.append(list(range(off, off + nb)))
+                off += nb
+            nullable = [f.nullable for f in self.key_fields]
+            val_nullable = [f.nullable for f in self.buffer_fields]
+        else:
+            key_cols = [e.eval(batch, self.ctx) for e in self.group_exprs]
+            flat_vals = [e.eval(batch, self.ctx)
+                         for e in self._upd_value_exprs]
+            per_agg = self._upd_per_agg
+            from .common import may_skip_null_lane
+            nullable = [not may_skip_null_lane(e) for e in self.group_exprs]
+            val_nullable = [e.nullable for e in self._upd_value_exprs]
+
+        key_ops = sort_operands(key_cols, [False] * nk, [True] * nk,
+                                in_live, nullable)
+        nko = len(key_ops)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        # provably non-null columns skip their validity payload lane; their
+        # sorted views share ONE validity object (sorted_live), which also
+        # dedups the per-aggregate non-null-count lanes downstream
+        payload: List[jax.Array] = [iota]
+        for c, nl in zip(flat_vals, val_nullable):
+            payload.append(c.data.astype(jnp.uint8)
+                           if c.data.dtype == jnp.bool_ else c.data)
+            if nl:
+                payload.append(c.validity.astype(jnp.uint8))
+        out = jax.lax.sort(key_ops + payload, num_keys=nko)
+        sorted_key_ops, sperm = out[:nko], out[nko]
+        n_live = jnp.sum(in_live.astype(jnp.int32))
+        sorted_live = iota < n_live
+        svals: List[DeviceColumn] = []
+        j = nko + 1
+        for c, nl in zip(flat_vals, val_nullable):
+            data = out[j]
+            j += 1
+            if c.data.dtype == jnp.bool_:
+                data = data.astype(jnp.bool_)
+            if nl:
+                validity = out[j].astype(jnp.bool_)
+                j += 1
+            else:
+                validity = sorted_live
+            svals.append(DeviceColumn(data, validity, None, c.dtype))
+        eq = adjacent_equal_ops(sorted_key_ops[1:])  # skip the dead lane
+        new_group = sorted_live & ~eq
+        gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+        count = jnp.sum(new_group.astype(jnp.int32))
+
+        from ..expressions.aggregates import (FastLanes, LaneResults,
+                                              segment_bounds)
+
+        # planning pass: batched aggregates register lanes on the builder;
+        # the rest fall back to generic update/merge under segment_bounds
+        lanes = FastLanes(sorted_live)
+        plans = []
+        for agg, idxs in zip(self.aggs, per_agg):
+            views = [svals[i] for i in idxs]
+            fin = (agg.fast_merge(views, sorted_live, lanes) if merge
+                   else agg.fast_update(views, sorted_live, lanes))
+            plans.append((agg, views, fin))
+        # branch-independent segment ids for the suffix-scan ladders
+        seg0 = jnp.where(sorted_live, gid, -1)
+
+        def emit(L: int):
+            slot = jnp.arange(L, dtype=jnp.int32)
+            live_slot = slot < count
+            pos = jnp.where(new_group & (gid < L), gid, L)
+            starts = jnp.zeros(L + 1, jnp.int32).at[pos].set(
+                iota, mode="drop")[:L]
+            nxt = jnp.concatenate([starts[1:], jnp.zeros(1, jnp.int32)])
+            ends = jnp.where(slot < count - 1, nxt - 1, n_live - 1)
+            starts_m = jnp.where(live_slot, starts, 1)
+            ends_m = jnp.where(live_slot, ends, 0)
+            first_idx = jnp.take(sperm, jnp.where(live_slot, starts, 0))
+            out_cols = [gather_column(c, first_idx, live_slot)
+                        for c in key_cols]
+            res = LaneResults(lanes, seg0, starts_m, ends_m, live_slot)
+            seg = jnp.where(sorted_live & (gid < L), gid, L)
+            with segment_bounds(starts_m, ends_m):
+                for agg, views, fin in plans:
+                    if fin is not None:
+                        bufs = fin(res)
+                    else:
+                        bufs = (agg.merge(views, seg, sorted_live, L)
+                                if merge
+                                else agg.update(views, seg, sorted_live, L))
+                    if merge and final:
+                        out_cols.append(agg.evaluate(bufs, live_slot))
+                    else:
+                        out_cols.extend(bufs)
+            return tuple(_pad_column(c, cap) for c in out_cols)
+
+        # capacity-tiered layout: per-group gathers scale with the layout
+        # size, so pick the smallest tier the observed group count fits
+        # (nested lax.cond — only the selected tier executes). Tier count
+        # is a compile-time/runtime trade: every tier re-traces the whole
+        # reduction pipeline, and the tunneled TPU compiler chokes past
+        # two tiers (a 3-tier q1 kernel did not compile within 20 min).
+        G = min(self.small_groups_bucket, cap)
+        tiers = sorted({t for t in (self.layout_tiers or (G, cap))
+                        if 0 < t <= cap} | {cap})
+
+        def select(ts):
+            if len(ts) == 1:
+                return emit(ts[0])
+            return jax.lax.cond(count <= ts[0],
+                                lambda: emit(ts[0]), lambda: select(ts[1:]))
+
+        return ColumnarBatch(select(tiers), count)
+
+    # ------------------------------------------------------------------
     # Kernels
     # ------------------------------------------------------------------
 
@@ -220,6 +419,9 @@ class HashAggregateExec(UnaryExec):
         upstream filter into the aggregation: masked rows become dead
         rows of the sort, skipping the separate compaction kernel
         (reference analogue: AST-fused filters)."""
+        if self._fast_update:
+            return self._fast_group_kernel(batch, mask, merge=False,
+                                           final=False)
         cap = batch.capacity
         in_live = batch.row_mask()
         if mask is not None:
@@ -257,6 +459,9 @@ class HashAggregateExec(UnaryExec):
 
     def _merge_kernel(self, batch: ColumnarBatch, final: bool) -> ColumnarBatch:
         """buffer-layout rows -> merged buffer rows (or final results)."""
+        if self._fast_merge:
+            return self._fast_group_kernel(batch, None, merge=True,
+                                           final=final)
         cap = batch.capacity
         nk = len(self.key_fields)
         key_cols = [batch.columns[i] for i in range(nk)]

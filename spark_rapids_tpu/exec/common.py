@@ -166,6 +166,10 @@ def orderable_words(col: DeviceColumn) -> List[jax.Array]:
             for b in range(8):
                 word = (word << jnp.uint64(8)) | chunk[:, b].astype(jnp.uint64)
             words.append(word)
+        # length tiebreak: strings may legally CONTAIN 0x00 bytes, which the
+        # zero padding would otherwise make indistinguishable from absent
+        # bytes ("a" vs "a\x00"); byte-wise order puts the shorter first
+        words.append(col.lengths.astype(jnp.uint64))
         return words
     data = col.data
     if k is TypeKind.DECIMAL and d.precision > 18:
@@ -221,6 +225,12 @@ def sort_operands(cols: Sequence[DeviceColumn], descending: Sequence[bool],
                                   jnp.uint8(0) if nf else jnp.uint8(2))
             ops.append(jnp.where(live, null_rank, jnp.uint8(3)))
         for w in orderable_words(col):
+            if nl:
+                # zero the word lanes of null rows: the rank lane already
+                # dominates the ORDER; equal words make null==null rows
+                # adjacent-EQUAL too, which the aggregate's word-level
+                # group-boundary detection relies on
+                w = jnp.where(col.validity, w, jnp.zeros((), w.dtype))
             if not desc:
                 ops.append(w)
             elif jnp.issubdtype(w.dtype, jnp.floating):
@@ -228,6 +238,21 @@ def sort_operands(cols: Sequence[DeviceColumn], descending: Sequence[bool],
             else:
                 ops.append(~w)
     return ops
+
+
+def adjacent_equal_ops(ops: Sequence[jax.Array]) -> jax.Array:
+    """eq[i] = position i matches position i-1 on EVERY operand; eq[0]=False.
+
+    Word-level group-boundary detection over the SORTED key operands of
+    ``sort_operands`` (null word lanes are zeroed there, so null==null holds
+    without consulting validity). Avoids gathering the original key columns
+    just to compare them.
+    """
+    cap = ops[0].shape[0]
+    eq = jnp.ones(cap - 1, bool)
+    for w in ops:
+        eq = eq & (w[1:] == w[:-1])
+    return jnp.concatenate([jnp.zeros(1, bool), eq])
 
 
 def sort_permutation(batch: ColumnarBatch, key_cols: Sequence[DeviceColumn],

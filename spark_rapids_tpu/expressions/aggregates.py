@@ -81,6 +81,224 @@ def _seg_scan_reduce(x, seg, identity, op):
     return jax.lax.fori_loop(0, max(n - 1, 1).bit_length(), body, x)
 
 
+def _cumsum(x):
+    """Inclusive prefix sum. Native 32-bit cumsum is fast, but EMULATED
+    64-bit types must not lower through XLA's cumulative reduce-window —
+    the variadic pair lowering exhausts scoped vmem inside large fused
+    programs (and a fori_loop with traced shifts runs dynamic rolls,
+    ~480 ms). An UNROLLED static-shift Hillis-Steele ladder compiles
+    small and runs 11–16 ms per 4M 64-bit rows (measured, perf_r3)."""
+    if x.dtype.itemsize < 8:
+        return jnp.cumsum(x)
+    return _prefix_ladder(x)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 batched lane reductions (docs/perf_r3.md)
+#
+# A 4M-row gather costs ~55–65 ms on this chip NO MATTER the element type,
+# and sibling gathers do NOT fuse — but a [N, m] matrix ROW gather costs the
+# same as one scalar gather. So the fast aggregation path batches EVERY
+# per-group reduction into shared float64 lane stacks:
+#   - sums/counts: one stacked inclusive-prefix ladder + ONE row-gather at
+#     segment ends and ONE at segment starts for all lanes together;
+#   - min/max: one segmented suffix-scan ladder per direction, row-gathered
+#     at segment starts.
+# Integer sums ride as THREE 22-bit chunk lanes (chunk sums stay < 2^44,
+# exact in f64; recombination wraps mod 2^64 — Spark's non-ANSI overflow).
+# ---------------------------------------------------------------------------
+
+_I64_CHUNK = jnp.uint64((1 << 22) - 1)
+
+
+def _enc_i64_lanes(x) -> List[jax.Array]:
+    """int64 -> three exact f64 chunk lanes (bits 0-21, 22-43, 44-65)."""
+    u = x.astype(jnp.uint64)
+    return [((u >> jnp.uint64(22 * i)) & _I64_CHUNK).astype(jnp.float64)
+            for i in range(3)]
+
+
+def _dec_i64_lanes(l0, l1, l2) -> jax.Array:
+    """chunk-sum lanes -> int64 sum, wrapping mod 2^64."""
+    return (l0.astype(jnp.uint64)
+            + (l1.astype(jnp.uint64) << jnp.uint64(22))
+            + (l2.astype(jnp.uint64) << jnp.uint64(44))).astype(jnp.int64)
+
+
+class FastLanes:
+    """Collects reduction lanes during the fast kernel's planning pass.
+
+    Lanes are tagged ``exact``: integer-valued f64 lanes (counts, int-sum
+    chunks) whose prefix differences are exact, versus genuine float lanes
+    whose group sums must stay numerically LOCAL to the group (a whole-
+    batch prefix difference cancels small groups against the global
+    running sum — confirmed on device)."""
+
+    def __init__(self, live: jax.Array):
+        self.live = live
+        self.sum_lanes: List[jax.Array] = []
+        self.sum_exact: List[bool] = []
+        self.min_lanes: List[jax.Array] = []
+        self.max_lanes: List[jax.Array] = []
+        self._count_cache: List[Tuple[Optional[jax.Array], int]] = []
+
+    def sum_f64(self, x) -> int:
+        self.sum_lanes.append(x.astype(jnp.float64))
+        self.sum_exact.append(False)
+        return len(self.sum_lanes) - 1
+
+    def _sum_exact_lane(self, x) -> int:
+        self.sum_lanes.append(x.astype(jnp.float64))
+        self.sum_exact.append(True)
+        return len(self.sum_lanes) - 1
+
+    def sum_int(self, x) -> Tuple[int, int, int]:
+        i = len(self.sum_lanes)
+        for lane in _enc_i64_lanes(x):
+            self._sum_exact_lane(lane)
+        return (i, i + 1, i + 2)
+
+    def count(self, ok: Optional[jax.Array]) -> int:
+        """Count of true rows; ok=None counts live rows. The cache holds a
+        REFERENCE to each mask (identity alone could alias a recycled id
+        from a freed temporary in eager execution)."""
+        key = None if ok is None or ok is self.live else ok
+        for cached, idx in self._count_cache:
+            if cached is key:
+                return idx
+        idx = self._sum_exact_lane(
+            (self.live if ok is None else ok).astype(jnp.float64))
+        self._count_cache.append((key, idx))
+        return idx
+
+    def min_f64(self, x) -> int:
+        self.min_lanes.append(x.astype(jnp.float64))
+        return len(self.min_lanes) - 1
+
+    def max_f64(self, x) -> int:
+        self.max_lanes.append(x.astype(jnp.float64))
+        return len(self.max_lanes) - 1
+
+
+def _prefix_ladder(m: jax.Array) -> jax.Array:
+    """Inclusive prefix sum along axis 0, unrolled static-shift ladder
+    (native cumsum on emulated 64-bit lowers to a vmem-exhausting
+    reduce-window; this ladder measures 11–16 ms / 4M rows)."""
+    n = m.shape[0]
+    d = 1
+    while d < n:
+        pad = jnp.zeros((d,) + m.shape[1:], m.dtype)
+        m = m + jnp.concatenate([pad, m[:-d]], axis=0)
+        d <<= 1
+    return m
+
+
+def _suffix_scan_ladder(m: jax.Array, seg: jax.Array, op, identity) -> jax.Array:
+    """Segmented suffix scan along axis 0: row i becomes OP over rows
+    [i..end of i's segment] per lane. Unrolled static shifts."""
+    n = m.shape[0]
+    ident = jnp.full((1,) + m.shape[1:], identity, m.dtype)
+    d = 1
+    while d < n:
+        sm = jnp.concatenate([m[d:], jnp.broadcast_to(
+            ident, (d,) + m.shape[1:])], axis=0)
+        sseg = jnp.concatenate([seg[d:], jnp.full((d,), -2, seg.dtype)])
+        ok = (sseg == seg)
+        m = op(m, jnp.where(ok[:, None] if m.ndim > 1 else ok, sm,
+                            jnp.asarray(identity, m.dtype)))
+        d <<= 1
+    return m
+
+
+class LaneResults:
+    """Per-branch resolved lane reductions at the [L] group-slot layout.
+
+    Sum strategy is layout-tier dependent: small tiers run one prefix
+    ladder plus TWO cheap [L]-row-gathers; large tiers run the segmented
+    SUFFIX ladder (group totals land on each group's first row) so only
+    ONE expensive row-gather remains (a [4M,6] f64 row-gather is ~200 ms —
+    the dominant cost at full capacity)."""
+
+    def __init__(self, lanes: FastLanes, seg: jax.Array,
+                 starts: jax.Array, ends: jax.Array, live_slot: jax.Array):
+        self.live_slot = live_slot
+        n = lanes.live.shape[0]
+        L = starts.shape[0]
+        s = jnp.clip(starts, 0, n - 1)
+        e = jnp.clip(ends, 0, n - 1)
+        self._sum_at = None
+        if lanes.sum_lanes:
+            m = len(lanes.sum_lanes)
+            if L >= (1 << 20):
+                # large layouts: one expensive row-gather instead of two;
+                # the segmented suffix scan is also group-local for floats
+                stack = jnp.stack(lanes.sum_lanes, axis=1)
+                suf = _suffix_scan_ladder(stack, seg, jnp.add, 0.0)
+                self._sum_at = jnp.take(suf, s, axis=0)
+            else:
+                # small layouts: [L]-gathers are free. Integer-exact lanes
+                # take the cheap prefix-difference; FLOAT lanes must scan
+                # segmented so a small group is never differenced against
+                # the whole-batch running sum (catastrophic cancellation).
+                cols = [None] * m
+                ex = [i for i in range(m) if lanes.sum_exact[i]]
+                fl = [i for i in range(m) if not lanes.sum_exact[i]]
+                if ex:
+                    stack = jnp.stack([lanes.sum_lanes[i] for i in ex],
+                                      axis=1)
+                    cum = _prefix_ladder(stack)
+                    excl = cum - stack
+                    win = (jnp.take(cum, e, axis=0)
+                           - jnp.take(excl, s, axis=0))
+                    for j, i in enumerate(ex):
+                        cols[i] = win[:, j]
+                if fl:
+                    stack = jnp.stack([lanes.sum_lanes[i] for i in fl],
+                                      axis=1)
+                    suf = _suffix_scan_ladder(stack, seg, jnp.add, 0.0)
+                    win = jnp.take(suf, s, axis=0)
+                    for j, i in enumerate(fl):
+                        cols[i] = win[:, j]
+                self._sum_at = jnp.stack(cols, axis=1)
+        self._min_at = None
+        if lanes.min_lanes:
+            m = _suffix_scan_ladder(jnp.stack(lanes.min_lanes, axis=1),
+                                    seg, jnp.minimum, jnp.inf)
+            self._min_at = jnp.take(m, s, axis=0)
+        self._max_at = None
+        if lanes.max_lanes:
+            m = _suffix_scan_ladder(jnp.stack(lanes.max_lanes, axis=1),
+                                    seg, jnp.maximum, -jnp.inf)
+            self._max_at = jnp.take(m, s, axis=0)
+
+    def sum_f64(self, ref: int) -> jax.Array:
+        return jnp.where(self.live_slot, self._sum_at[:, ref], 0.0)
+
+    def sum_int(self, refs) -> jax.Array:
+        i0, i1, i2 = refs
+        v = _dec_i64_lanes(self._sum_at[:, i0], self._sum_at[:, i1],
+                           self._sum_at[:, i2])
+        return jnp.where(self.live_slot, v, jnp.int64(0))
+
+    def count(self, ref: int) -> jax.Array:
+        return jnp.where(self.live_slot,
+                         self._sum_at[:, ref].astype(jnp.int64),
+                         jnp.int64(0))
+
+    def min_f64(self, ref: int) -> jax.Array:
+        return self._min_at[:, ref]
+
+    def max_f64(self, ref: int) -> jax.Array:
+        return self._max_at[:, ref]
+
+
+# value kinds a min/max can round-trip exactly through an f64 lane
+_MINMAX_F64_KINDS = frozenset({
+    TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.FLOAT32,
+    TypeKind.FLOAT64, TypeKind.BOOLEAN, TypeKind.DATE,
+})
+
+
 def _at_group_starts(vals, default):
     starts, ends = _SEG_BOUNDS
     out = jnp.take(vals, jnp.clip(starts, 0, vals.shape[0] - 1))
@@ -93,11 +311,30 @@ def _at_group_starts(vals, default):
 # sentinel between live ids).
 def _seg_sum(x, seg, cap):
     if _SEG_BOUNDS is not None:
+        # Round-3 rework (docs/perf_r3.md): segmented sum over key-sorted
+        # rows = ONE cumsum + a window difference at the published group
+        # bounds. cumsum is 3–19 ms per 4M f64 rows where the emulated-
+        # 64-bit scatter was 285–320 ms. Integer cumsums wrap mod 2^w, so
+        # the difference is exact under Spark's non-ANSI wraparound; float
+        # sums trade the scatter's sequential rounding for the prefix
+        # tree's (both order-dependent, like Spark itself). Dead slots use
+        # the (start=1, end=0) convention: c[0]-c[1]+x[1] == 0.
         if x.dtype == jnp.bool_:
             x = x.astype(jnp.int32)
-        zero = jnp.zeros((), x.dtype)
-        suf = _seg_scan_reduce(x, seg, zero, jnp.add)
-        return _at_group_starts(suf, zero)
+        starts, ends = _SEG_BOUNDS
+        n = x.shape[0]
+        s = jnp.clip(starts, 0, n - 1)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            # floats: SEGMENTED suffix scan keeps rounding local to each
+            # group — a whole-batch prefix difference cancels small groups
+            # against the global running sum (confirmed on device)
+            suf = _suffix_scan_ladder(x[:, None], seg, jnp.add,
+                                      0.0)[:, 0]
+            out = jnp.take(suf, s)
+            return jnp.where(ends >= starts, out, jnp.zeros((), x.dtype))
+        c = _cumsum(x)
+        e = jnp.clip(ends, 0, n - 1)
+        return jnp.take(c, e) - jnp.take(c, s) + jnp.take(x, s)
     return jax.ops.segment_sum(x, seg, num_segments=cap)
 
 
@@ -166,6 +403,18 @@ class AggregateFunction(Expression):
                  group_live: jax.Array) -> DeviceColumn:
         """Final result column from merged buffers."""
         raise NotImplementedError
+
+    # ---- batched lane fast path (round 3) ------------------------------
+    # Return a finisher ``f(res: LaneResults) -> List[DeviceColumn]`` after
+    # registering reduction lanes on the builder, or None to run the
+    # generic update/merge under segment_bounds instead.
+    def fast_update(self, inputs: List[DeviceColumn], live: jax.Array,
+                    B: "FastLanes"):
+        return None
+
+    def fast_merge(self, buffers: List[DeviceColumn], live: jax.Array,
+                   B: "FastLanes"):
+        return None
 
 
 def _masked(col: DeviceColumn, live: jax.Array, fill) -> jax.Array:
@@ -251,6 +500,50 @@ class Sum(AggregateFunction):
             valid = valid & ~buffers[2].data
         return DeviceColumn(buffers[0].data, valid, None, self.dtype)
 
+    # ---- batched lanes -------------------------------------------------
+    def _lane_refs(self, x_data, ok, B: "FastLanes"):
+        if self.dtype.kind is TypeKind.FLOAT64:
+            x = jnp.where(ok, x_data, 0.0).astype(jnp.float64)
+            return ("f", B.sum_f64(x))
+        x = jnp.where(ok, x_data.astype(jnp.int64), jnp.int64(0))
+        return ("i", B.sum_int(x))
+
+    def _lane_finish(self, kind_ref, nref, one_validity=None):
+        kind, ref = kind_ref
+
+        def finish(res: "LaneResults"):
+            n = res.count(nref)
+            s = res.sum_f64(ref) if kind == "f" else res.sum_int(ref)
+            valid = n > 0
+            return [DeviceColumn(s, valid, None, self.dtype),
+                    DeviceColumn(n, jnp.ones(s.shape[0], bool), None,
+                                 T.INT64)]
+        return finish
+
+    def fast_update(self, inputs, live, B):
+        if self._is_dec128:
+            return None
+        col = inputs[0]
+        ok = live if col.validity is live else (col.validity & live)
+        return self._lane_finish(self._lane_refs(col.data, ok, B),
+                                 B.count(ok))
+
+    def fast_merge(self, buffers, live, B):
+        if self._is_dec128:
+            return None
+        ok = buffers[0].validity & live
+        kr = self._lane_refs(buffers[0].data, ok, B)
+        ncnt = B.sum_int(jnp.where(live, buffers[1].data, jnp.int64(0)))
+        kind, ref = kr
+
+        def finish(res: "LaneResults"):
+            n = res.sum_int(ncnt)
+            s = res.sum_f64(ref) if kind == "f" else res.sum_int(ref)
+            return [DeviceColumn(s, n > 0, None, self.dtype),
+                    DeviceColumn(n, jnp.ones(s.shape[0], bool), None,
+                                 T.INT64)]
+        return finish
+
 
 class Count(AggregateFunction):
     """count(x) / count(*): bigint, never null, 0 for empty groups."""
@@ -282,6 +575,28 @@ class Count(AggregateFunction):
     def evaluate(self, buffers, group_live):
         return DeviceColumn(jnp.where(group_live, buffers[0].data, 0),
                             group_live, None, T.INT64)
+
+    # ---- batched lanes -------------------------------------------------
+    def fast_update(self, inputs, live, B):
+        ok = None
+        if inputs and inputs[0].validity is not live:
+            ok = inputs[0].validity & live
+        nref = B.count(ok)
+
+        def finish(res: "LaneResults"):
+            n = res.count(nref)
+            return [DeviceColumn(n, jnp.ones(n.shape[0], bool), None,
+                                 T.INT64)]
+        return finish
+
+    def fast_merge(self, buffers, live, B):
+        nref = B.sum_int(jnp.where(live, buffers[0].data, jnp.int64(0)))
+
+        def finish(res: "LaneResults"):
+            n = res.sum_int(nref)
+            return [DeviceColumn(n, jnp.ones(n.shape[0], bool), None,
+                                 T.INT64)]
+        return finish
 
 
 class _MinMax(AggregateFunction):
@@ -357,6 +672,39 @@ class _MinMax(AggregateFunction):
         return DeviceColumn(b.data, b.validity & group_live, b.lengths,
                             self.dtype)
 
+    # ---- batched lanes -------------------------------------------------
+    def _lane(self, col: DeviceColumn, live, B: "FastLanes"):
+        if self.dtype.kind not in _MINMAX_F64_KINDS:
+            return None     # int64/timestamp/decimal/string: not f64-exact
+        ok = live if col.validity is live else (col.validity & live)
+        data = col.data.astype(jnp.uint8) if col.data.dtype == jnp.bool_ \
+            else col.data
+        if self._is_min:
+            x = jnp.where(ok, data.astype(jnp.float64), jnp.inf)
+            ref, get = B.min_f64(x), "min_f64"
+        else:
+            x = jnp.where(ok, data.astype(jnp.float64), -jnp.inf)
+            ref, get = B.max_f64(x), "max_f64"
+        nref = B.count(ok)
+        storage = self.dtype.storage_dtype
+
+        def finish(res: "LaneResults"):
+            n = res.count(nref)
+            valid = n > 0
+            m = getattr(res, get)(ref)
+            if self.dtype.kind is TypeKind.BOOLEAN:
+                out = jnp.where(valid, m > 0, False)
+            else:
+                out = jnp.where(valid, m, 0.0).astype(storage)
+            return [DeviceColumn(out, valid, None, self.dtype)]
+        return finish
+
+    def fast_update(self, inputs, live, B):
+        return self._lane(inputs[0], live, B)
+
+    def fast_merge(self, buffers, live, B):
+        return self._lane(buffers[0], live, B)
+
 
 class Min(_MinMax):
     _is_min = True
@@ -402,6 +750,32 @@ class Average(AggregateFunction):
         valid = (n > 0) & group_live
         avg = buffers[0].data / jnp.where(n > 0, n, 1).astype(jnp.float64)
         return DeviceColumn(jnp.where(valid, avg, 0.0), valid, None, T.FLOAT64)
+
+    # ---- batched lanes -------------------------------------------------
+    def fast_update(self, inputs, live, B):
+        col = inputs[0]
+        ok = live if col.validity is live else (col.validity & live)
+        sref = B.sum_f64(jnp.where(ok, col.data, 0).astype(jnp.float64))
+        nref = B.count(ok)
+
+        def finish(res: "LaneResults"):
+            s, n = res.sum_f64(sref), res.count(nref)
+            one = jnp.ones(s.shape[0], bool)
+            return [DeviceColumn(s, n > 0, None, T.FLOAT64),
+                    DeviceColumn(n, one, None, T.INT64)]
+        return finish
+
+    def fast_merge(self, buffers, live, B):
+        sref = B.sum_f64(jnp.where(live & buffers[0].validity,
+                                   buffers[0].data, 0.0))
+        nref = B.sum_int(jnp.where(live, buffers[1].data, jnp.int64(0)))
+
+        def finish(res: "LaneResults"):
+            s, n = res.sum_f64(sref), res.sum_int(nref)
+            one = jnp.ones(s.shape[0], bool)
+            return [DeviceColumn(s, n > 0, None, T.FLOAT64),
+                    DeviceColumn(n, one, None, T.INT64)]
+        return finish
 
 
 @dataclass(frozen=True, eq=False)
@@ -686,6 +1060,42 @@ class First(AggregateFunction):
         has = buffers[1]
         return DeviceColumn(val.data, val.validity & has.data & group_live,
                             val.lengths, self.dtype)
+
+    # ---- batched lanes: pick-index rides a min/max lane (row positions
+    # are < 2^31, exact in f64), then one gather per First/Last resolves
+    # the value from the sorted view.
+    def _pick(self, col: DeviceColumn, present, B: "FastLanes"):
+        cap = col.capacity
+        order = jnp.arange(cap, dtype=jnp.int32).astype(jnp.float64)
+        if self._take_last:
+            ref, get = B.max_f64(jnp.where(present, order, -jnp.inf)), \
+                "max_f64"
+        else:
+            ref, get = B.min_f64(jnp.where(present, order, jnp.inf)), \
+                "min_f64"
+        nref = B.count(present if present is not None else None)
+
+        def finish(res: "LaneResults"):
+            has = res.count(nref) > 0
+            pick = getattr(res, get)(ref)
+            idx = jnp.clip(jnp.where(has, pick, 0.0), 0, cap - 1) \
+                .astype(jnp.int32)
+            data = jnp.take(col.data, idx, axis=0)
+            validity = jnp.take(col.validity, idx, axis=0) & has
+            lengths = jnp.take(col.lengths, idx, axis=0) \
+                if col.lengths is not None else None
+            data2 = jnp.take(col.data2, idx, axis=0) \
+                if col.data2 is not None else None
+            one = jnp.ones(has.shape[0], bool)
+            return [DeviceColumn(data, validity, lengths, self.dtype, data2),
+                    DeviceColumn(has, one, None, T.BOOLEAN)]
+        return finish
+
+    def fast_update(self, inputs, live, B):
+        return self._pick(inputs[0], live, B)
+
+    def fast_merge(self, buffers, live, B):
+        return self._pick(buffers[0], live & buffers[1].data, B)
 
 
 class Last(First):

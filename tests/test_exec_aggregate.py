@@ -262,3 +262,22 @@ def test_windowed_merge_low_cardinality():
                      if any(x is not None for x in xs) else None),
          lambda xs: len(xs)])
     assert_rows_equal(got, exp, ignore_order=True)
+
+
+def test_float_sum_small_group_after_large_magnitudes():
+    """Regression (round-3 review): a float group's sum must stay
+    numerically LOCAL to the group. A whole-batch prefix-difference
+    formulation cancels a tiny late group against the preceding 1e14-scale
+    running sum and returns 0.0; the segmented scan keeps it exact."""
+    import numpy as np
+    import pyarrow as pa
+    n1 = 16382
+    t = pa.table({
+        "k": np.concatenate([np.zeros(n1, np.int32),
+                             np.ones(2, np.int32)]),
+        "v": np.concatenate([np.full(n1, 1e10), np.full(2, 1e-10)]),
+    })
+    plan = HashAggregateExec([col("k")], [Sum(col("v")).alias("s")],
+                             scan(t), AggregateMode.COMPLETE)
+    got = {r[0]: r[1] for r in rows_of(collect(plan))}
+    assert abs(got[1] - 2e-10) < 1e-16, got
