@@ -197,12 +197,12 @@ def bench_join_sort(jax, n_stream=1 << 21, n_build=1 << 18, reps=3):
     program, inputs = fused.prepare()
 
     def run():
-        out, flags, _needs = program(*inputs)
-        return out, flags
+        out, _errs, over, _needs = program(*inputs)
+        return out, over
     dt = _time(run, reps, _sync_scalar)
     import jax.numpy as jnp
-    _, flags = run()
-    assert int(jnp.max(flags)) == 0, "fused join overflowed its bucket"
+    _, over = run()
+    assert int(jnp.max(over)) == 0, "fused join overflowed its bucket"
 
     def oracle():
         j = stream.join(build, keys="l_orderkey",

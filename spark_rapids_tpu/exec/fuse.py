@@ -58,18 +58,12 @@ class _Planner:
             return
         if isinstance(node, (ProjectExec, FilterExec, SortExec,
                              TakeOrderedAndProjectExec, LocalLimitExec)):
-            if isinstance(node, SortExec) and not node.global_sort:
-                pass
             self.walk(node.children[0])
             return
         if isinstance(node, HashJoinExec):
             if node.join_type not in _FUSABLE_JOIN_TYPES:
                 raise FusionUnsupported(
                     f"join type {node.join_type} needs cross-batch state")
-            if node.condition is not None:
-                self.walk(node.left)
-                self.walk(node.right)
-                return
             self.walk(node.left)
             self.walk(node.right)
             return
@@ -102,13 +96,20 @@ class FusedStage:
     def _trace(self, *batches: ColumnarBatch):
         by_scan: Dict[int, ColumnarBatch] = {
             id(s): b for s, b in zip(self.scans, batches)}
-        flags: List[jax.Array] = []
+        # separate channels: ANSI/capacity error counters (raise as such)
+        # vs join-bucket overflow (drives the exact-size retrace)
+        self._err_kinds: List[str] = []
+        self._err_vals: List[jax.Array] = []
+        self._join_over: List[jax.Array] = []
         self._join_needs: List[jax.Array] = []
-        out = self._emit(self.plan, by_scan, flags)
-        vec = jnp.stack(flags) if flags else jnp.zeros(1, jnp.int64)
+        out = self._emit(self.plan, by_scan, self._join_over)
+        errs = (jnp.stack(self._err_vals) if self._err_vals
+                else jnp.zeros(1, jnp.int64))
+        over = (jnp.stack(self._join_over) if self._join_over
+                else jnp.zeros(1, jnp.int64))
         needs = (jnp.stack(self._join_needs) if self._join_needs
                  else jnp.zeros(1, jnp.int64))
-        return out, vec, needs
+        return out, errs, over, needs
 
     def _emit(self, node: Exec, by_scan, flags) -> ColumnarBatch:
         if isinstance(node, InMemoryScanExec):
@@ -182,10 +183,10 @@ class FusedStage:
                                      (lo, counts, offsets), matched, out_cap)
         return out
 
-    @staticmethod
-    def _err_flags(ctx: EvalContext, flags: List[jax.Array]) -> None:
-        for v in ctx.errors.values():
-            flags.append(sum(v).astype(jnp.int64))
+    def _err_flags(self, ctx: EvalContext, flags) -> None:
+        for kind, v in ctx.errors.items():
+            self._err_kinds.append(kind)
+            self._err_vals.append(sum(v).astype(jnp.int64))
 
     # -- execution -----------------------------------------------------
 
@@ -201,8 +202,11 @@ class FusedStage:
         where a bigger bucket uncovers more candidates downstream)."""
         stage = self
         for _ in range(max_retries):
-            out, flags, needs = stage._program(*stage.inputs)
-            if int(jnp.max(flags)) == 0:
+            out, errs, over, needs = stage._program(*stage.inputs)
+            ev = [int(x) for x in errs]
+            if stage._err_kinds and any(ev):
+                _raise_ansi(dict(zip(stage._err_kinds, ev)))
+            if int(jnp.max(over)) == 0:
                 return out
             grow = int(jnp.max(needs))
             factor = max(stage.expand_factor * max(grow, 2),

@@ -77,9 +77,25 @@ class MeshLowering:
         self.axis = axis
         self.n_dev = mesh.shape[axis]
         self.join_expansion = join_expansion
+        # chained hash exchanges must NOT compound capacity by n_dev each:
+        # balanced routing receives ~cap rows, so bound the output at
+        # exchange_factor*cap and flag overflow for the stage retry loop
+        # (SinglePartitioning still gets the lossless n_dev*cap — ALL rows
+        # genuinely land on one device there)
+        self.exchange_factor = 2
         self.inputs: List[Exec] = []
         self.lowered_names: List[str] = []
         self._trace_flags: List[jax.Array] = []
+
+    def _bounded_exchange(self, b: ColumnarBatch, pids, lossless: bool
+                          ) -> ColumnarBatch:
+        if lossless or self.exchange_factor >= self.n_dev:
+            return mesh_exchange(b, pids, self.n_dev, self.axis)
+        out_cap = bucket_capacity(self.exchange_factor * b.capacity)
+        routed = mesh_exchange(b, pids, self.n_dev, self.axis,
+                               out_capacity=out_cap)
+        self._trace_flags.append(routed.num_rows > out_cap)
+        return routed
 
     # ------------------------------------------------------------------
 
@@ -152,7 +168,126 @@ class MeshLowering:
         if isinstance(node, HashJoinExec):
             return self._lower_join(node)
 
+        if isinstance(node, ShuffleExchangeExec):
+            return self._lower_exchange(node)
+
+        from ..exec.sort import SortExec, TakeOrderedAndProjectExec
+        if isinstance(node, SortExec):
+            return self._lower_sort(node)
+        if isinstance(node, TakeOrderedAndProjectExec):
+            return self._lower_topn(node)
+
         raise MeshUnsupported(f"{node.name} has no mesh lowering")
+
+    # ------------------------------------------------------------------
+
+    def _lower_exchange(self, ex: ShuffleExchangeExec) -> Callable:
+        """Generic hash/single exchange: the building block that lets
+        MULTIPLE exchanges chain inside one stage (shuffled joins,
+        join→agg pipelines — reference GpuShuffleExchangeExecBase:262).
+        Routing is mesh-width (hash % n_dev), not conf shuffle-partition
+        width: inside one SPMD program the device IS the partition."""
+        part = ex.partitioning
+        if not isinstance(part, (HashPartitioning, SinglePartitioning)):
+            raise MeshUnsupported(f"{type(part).__name__} exchange")
+        self.lowered_names.append("mesh_exchange(all_to_all)")
+        child = self._lower_node(ex.child)
+        n_dev, axis = self.n_dev, self.axis
+
+        def exch(args):
+            b = child(args)
+            if isinstance(part, SinglePartitioning):
+                pids = jnp.zeros(b.capacity, jnp.int32)
+                return self._bounded_exchange(b, pids, lossless=True)
+            cols = [e.eval(b) for e in part.exprs]
+            h = murmur3_batch(cols)
+            m = h % jnp.int32(n_dev)
+            pids = jnp.where(m < 0, m + n_dev, m).astype(jnp.int32)
+            return self._bounded_exchange(b, pids, lossless=False)
+        return exch
+
+    def _lower_sort(self, node) -> Callable:
+        """Global sort = splitter-routed range exchange + local sort.
+        Splitters come from strided per-device samples of the FIRST key's
+        sort operands (null-rank + orderable words), all_gathered and
+        sorted so every device derives the same boundaries; rows equal on
+        the first key always route together, so the cross-device order is
+        total for ANY trailing keys (reference: GpuRangePartitioner's
+        sampled bounds)."""
+        from ..exec.common import sort_operands
+        from ..exec.sort import sort_batch
+        if not node.global_sort or self.n_dev == 1:
+            child = self._lower_node(node.child)
+            return lambda args: sort_batch(child(args), node.orders,
+                                           node.ctx)
+        self.lowered_names.append("mesh_exchange(all_to_all)")
+        child = self._lower_node(node.child)
+        n_dev, axis = self.n_dev, self.axis
+        o0 = node.orders[0]
+        S = 32   # samples per device
+
+        def srt(args):
+            b = child(args)
+            k0 = o0.child.eval(b, node.ctx)
+            lanes = sort_operands([k0], [o0.descending],
+                                  [o0.effective_nulls_first], b.row_mask())
+            # lanes[0] is the dead-row flag: dead rows sort greatest, so
+            # including it keeps dead samples out of the splitter range
+            n_live = jnp.maximum(b.num_rows, 1)
+            pos = (jnp.arange(S, dtype=jnp.int32) * n_live) // S
+            samp = [jnp.take(l, jnp.clip(pos, 0, b.capacity - 1))
+                    for l in lanes]
+            # dead devices contribute dead-flagged samples (sort last)
+            gathered = [jax.lax.all_gather(s, axis).reshape(-1)
+                        for s in samp]
+            slanes = jax.lax.sort(gathered, num_keys=len(gathered))
+            # n_dev-1 splitters at even quantiles of the sample pool
+            total = n_dev * S
+            cut = [(d + 1) * total // n_dev for d in range(n_dev - 1)]
+            split = [jnp.stack([l[c] for c in cut]) for l in slanes]
+            # pid = how many splitters are lexicographically <= the row
+            pid = jnp.zeros(b.capacity, jnp.int32)
+            for d in range(n_dev - 1):
+                gt = jnp.zeros(b.capacity, bool)
+                eq = jnp.ones(b.capacity, bool)
+                for li, l in enumerate(lanes):
+                    sv = split[li][d]
+                    lt_here = eq & (sv < l)
+                    gt = gt | lt_here
+                    eq = eq & (l == sv)
+                # splitter <= row  ⇔  NOT row < splitter
+                pid = pid + (gt | eq).astype(jnp.int32)
+            routed = self._bounded_exchange(b, pid, lossless=False)
+            return sort_batch(routed, node.orders, node.ctx)
+        return srt
+
+    def _lower_topn(self, node) -> Callable:
+        """TopN: local top-limit → all_gather → global top-limit, emitted
+        once (device 0) — reference GpuTakeOrderedAndProjectExec."""
+        from ..exec.sort import sort_batch
+        self.lowered_names.append("mesh_broadcast(all_gather)")
+        child = self._lower_node(node.child)
+        n_dev, axis = self.n_dev, self.axis
+        limit = node.limit
+
+        def topn_local(b):
+            s = sort_batch(b, node.orders, node.ctx)
+            n = jnp.minimum(s.num_rows, jnp.int32(limit))
+            cut = bucket_capacity(min(limit, b.capacity))
+            return slice_batch(s, jnp.int32(0), n, cut)
+
+        def topn(args):
+            best = topn_local(child(args))
+            gathered = mesh_broadcast(best, n_dev, axis)
+            out = topn_local(gathered)
+            if node.project:
+                cols = tuple(e.eval(out, node.ctx) for e in node.project)
+                out = ColumnarBatch(cols, out.num_rows)
+            dev = jax.lax.axis_index(axis)
+            return ColumnarBatch(out.columns,
+                                 jnp.where(dev == 0, out.num_rows,
+                                           jnp.int32(0)))
+        return topn
 
     # ------------------------------------------------------------------
 
@@ -206,38 +341,75 @@ class MeshLowering:
         return agg
 
     def _lower_join(self, join: HashJoinExec) -> Callable:
-        if not join.broadcast_build or \
-                not isinstance(join.right, BroadcastExchangeExec):
-            raise MeshUnsupported("only broadcast-build joins lower (v1)")
-        if join.join_type not in _MESH_JOIN_TYPES:
+        if join.broadcast_build:
+            if not isinstance(join.right, BroadcastExchangeExec):
+                raise MeshUnsupported("broadcast join without broadcast "
+                                      "exchange child")
+            if join.join_type not in _MESH_JOIN_TYPES:
+                raise MeshUnsupported(
+                    f"{join.join_type} needs global matched-build state "
+                    f"under a replicated build")
+            self.lowered_names.append(join.right.name)
+            self.lowered_names.append("mesh_broadcast(all_gather)")
+            stream = self._lower_node(join.left)
+            build = self._lower_node(join.right.child)
+            n_dev, axis = self.n_dev, self.axis
+
+            def jn(args):
+                s = stream(args)
+                full_build = mesh_broadcast(build(args), n_dev, axis)
+                return self._join_local(join, s, full_build)
+            return jn
+
+        # co-partitioned (shuffled) hash join: both children carry their
+        # own hash exchanges on the join keys (lowered generically), so
+        # equal keys are device-co-located and EVERY join type is correct
+        # per device — including RIGHT/FULL outer tails, because each
+        # build row lives on exactly one device (reference:
+        # GpuShuffledHashJoinExec:85).
+        def _hash_exchanged(side: Exec) -> bool:
+            return (isinstance(side, ShuffleExchangeExec)
+                    and isinstance(side.partitioning, HashPartitioning))
+        if not (_hash_exchanged(join.left) and _hash_exchanged(join.right)):
             raise MeshUnsupported(
-                f"{join.join_type} needs global matched-build state")
-        self.lowered_names.append(join.right.name)
-        self.lowered_names.append("mesh_broadcast(all_gather)")
+                "shuffled join children must both be hash exchanges")
         stream = self._lower_node(join.left)
-        build = self._lower_node(join.right.child)
-        n_dev, axis = self.n_dev, self.axis
-        factor = self.join_expansion
+        build = self._lower_node(join.right)
+
+        def jn_shuffled(args):
+            s = stream(args)
+            b = build(args)
+            return self._join_local(join, s, b)
+        return jn_shuffled
+
+    def _join_local(self, join: HashJoinExec, s: ColumnarBatch,
+                    build: ColumnarBatch) -> ColumnarBatch:
+        """Single-device probe incl. outer tails; static output capacity
+        with an overflow trace-flag."""
+        sorted_h, perm, _ = join._build_kernel(build)
+        lo, counts, offsets, total = join._count_kernel(s, sorted_h)
+        out_cap = bucket_capacity(self.join_expansion * s.capacity)
+        matched0 = jnp.zeros(build.capacity, bool)
+        self._trace_flags.append(total > out_cap)
         semi = join.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
                                   JoinType.EXISTENCE)
-
-        def jn(args):
-            s = stream(args)
-            full_build = mesh_broadcast(build(args), n_dev, axis)
-            sorted_h, perm, _ = join._build_kernel(full_build)
-            lo, counts, offsets, total = join._count_kernel(s, sorted_h)
-            out_cap = bucket_capacity(factor * s.capacity)
-            matched0 = jnp.zeros(full_build.capacity, bool)
-            self._trace_flags.append(total > out_cap)
-            if semi:
-                return join._semi_kernel(s, (full_build, perm),
-                                         (lo, counts, offsets), matched0,
-                                         out_cap)
-            out, _ = join._expand_kernel(s, (full_build, perm),
-                                         (lo, counts, offsets), matched0,
-                                         out_cap)
-            return out
-        return jn
+        if semi:
+            return join._semi_kernel(s, (build, perm),
+                                     (lo, counts, offsets), matched0,
+                                     out_cap)
+        out, matched = join._expand_kernel(s, (build, perm),
+                                           (lo, counts, offsets), matched0,
+                                           out_cap)
+        if join.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            from ..exec.join import _null_gather
+            unmatched = build.row_mask() & ~matched
+            null_left = _null_gather(join.left_child_placeholder(),
+                                     build.capacity)
+            tail = compact(ColumnarBatch(tuple(null_left) + build.columns,
+                                         build.num_rows), unmatched)
+            out = concat_batches(
+                [out, tail], bucket_capacity(out.capacity + build.capacity))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -322,7 +494,10 @@ class MeshStageExec(LeafExec):
             if not bool(np.any(np.asarray(jax.device_get(flags)))):
                 self._results = unstack_batches(out)
                 return self._results
+            # capacity flags don't say WHICH bucket lost; double both —
+            # retries are rare and the retrace is the expensive part
             low.join_expansion *= 2
+            low.exchange_factor *= 2
         raise MeshCapacityError(
             f"mesh join overflowed at expansion {low.join_expansion}")
 

@@ -104,3 +104,68 @@ def test_mesh_join_overflow_retries():
     expected = cpu.collect(q())
     actual = tpu.collect(q())   # 300×40 pairs ≫ 2× stream capacity
     assert_tables_equal(actual, expected, ignore_order=True)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 widened lowerings: shuffled co-partitioned joins, chained
+# exchanges, global sort (splitter range exchange), TopN
+# ---------------------------------------------------------------------------
+
+NO_BROADCAST = dict(ICI)
+NO_BROADCAST["spark.rapids.tpu.sql.autoBroadcastJoinThreshold"] = 0
+
+
+def _shuffled_vs_cpu(df_fn, ignore_order=True, require_exchanges=0):
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False})
+    tpu = Session(NO_BROADCAST)
+    expected = cpu.collect(df_fn())
+    actual = tpu.collect(df_fn())
+    names = tpu.executed_exec_names()
+    assert any("MeshStage" in n for n in names), names
+    if require_exchanges:
+        stage = tpu.last_plan
+        n_ex = stage.lowered.count("mesh_exchange(all_to_all)")
+        assert n_ex >= require_exchanges, (n_ex, stage.lowered)
+    assert_tables_equal(actual, expected, ignore_order=ignore_order)
+    return tpu
+
+
+def test_planned_shuffled_join_on_mesh():
+    """Both sides hash-exchanged on the join keys, local probe per device
+    (reference: GpuShuffledHashJoinExec:85)."""
+    _shuffled_vs_cpu(lambda: table(FACT).join(table(DIM), ["k"], ["dk"],
+                                              JoinType.INNER),
+                     require_exchanges=2)
+
+
+def test_planned_shuffled_right_outer_join_on_mesh():
+    """RIGHT OUTER is legal on the shuffled path: co-partitioning makes
+    per-device unmatched-build tails exact."""
+    _shuffled_vs_cpu(lambda: table(FACT).join(table(DIM), ["k"], ["dk"],
+                                              JoinType.RIGHT_OUTER),
+                     require_exchanges=2)
+
+
+def test_planned_join_agg_sort_chain_on_mesh():
+    """The q72 shape: shuffled join + group-by + global sort — >=3 chained
+    exchanges in ONE SPMD program."""
+    from spark_rapids_tpu.exec.sort import desc
+
+    def q():
+        return (table(FACT)
+                .join(table(DIM), ["k"], ["dk"], JoinType.INNER)
+                .group_by("g")
+                .agg(Sum(col("v")).alias("sv"), Count().alias("c"))
+                .order_by(desc(col("sv"))))
+    _shuffled_vs_cpu(q, ignore_order=False, require_exchanges=3)
+
+
+def test_planned_global_sort_on_mesh():
+    """Splitter-routed range exchange + local sort: output order must
+    equal the CPU interpreter's EXACTLY (cross-device total order)."""
+    from spark_rapids_tpu.exec.sort import asc, desc
+
+    def q():
+        return table(FACT).order_by(desc(col("v")), asc(col("k")))
+    ses = _shuffled_vs_cpu(q, ignore_order=False, require_exchanges=1)
+    assert "MeshStageExec" in ses.executed_exec_names()
