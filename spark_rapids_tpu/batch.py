@@ -413,9 +413,20 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None,
                schema: Optional[Schema] = None,
                string_max_len: int = 64,
                truncate_strings: bool = False) -> Tuple[ColumnarBatch, Schema]:
-    """Build a device batch from an Arrow table (the scan H2D boundary)."""
+    """Build a device batch from an Arrow table (the scan H2D boundary).
+
+    Nullability is tightened from the DATA (null_count metadata, free in
+    Arrow): a null-free column becomes non-nullable, which lets the
+    aggregation fast path skip its validity payload lane and share one
+    count lane across aggregates (the reference's readers track per-batch
+    null counts the same way)."""
     if schema is None:
         schema = schema_from_arrow(table.schema, string_max_len)
+        tight = []
+        for i, f in enumerate(schema):
+            nullable = f.nullable and table.column(i).null_count > 0
+            tight.append(Field(f.name, f.dtype, nullable))
+        schema = Schema(tight)
     n = table.num_rows
     cap = capacity or bucket_capacity(n)
     cols = [column_from_arrow(table.column(i), f.dtype, cap, truncate_strings)

@@ -310,3 +310,44 @@ class BitwiseNot(Expression):
     def eval(self, batch, ctx=EvalContext()):
         c = self.child.eval(batch, ctx)
         return numeric_column(jnp.bitwise_not(c.data), c.validity, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class Shift(Expression):
+    """shiftleft/shiftright/shiftrightunsigned (reference:
+    GpuOverrides shift operator rules). Java semantics: the shift amount
+    wraps modulo the value's bit width (32 for int, 64 for long)."""
+
+    left: Expression
+    right: Expression
+    op: str = "left"        # left | right | right_unsigned
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return Shift(c[0], c[1], self.op)
+
+    @property
+    def dtype(self):
+        # Spark: INT or BIGINT result; narrower inputs are promoted to INT
+        # (the analyzer inserts the cast — mirror it here)
+        if self.left.dtype.kind is TypeKind.INT64:
+            return self.left.dtype
+        return T.INT32
+
+    def eval(self, batch, ctx=EvalContext()):
+        lc = self.left.eval(batch, ctx)
+        rc = self.right.eval(batch, ctx)
+        v = lc.data.astype(self.dtype.storage_dtype)
+        width = v.dtype.itemsize * 8
+        amt = rc.data.astype(jnp.int32) & jnp.int32(width - 1)
+        if self.op == "left":
+            out = v << amt.astype(v.dtype)
+        elif self.op == "right":
+            out = v >> amt.astype(v.dtype)   # arithmetic (signed input)
+        else:
+            u = v.astype(jnp.uint32 if width == 32 else jnp.uint64)
+            out = (u >> amt.astype(u.dtype)).astype(v.dtype)
+        return numeric_column(out, and_validity([lc, rc]), self.dtype)

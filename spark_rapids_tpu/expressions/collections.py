@@ -393,6 +393,19 @@ class GetStructField(Expression):
         bound = self.child.bind(schema)
         if isinstance(bound, CreateStruct):
             return bound.elems[self.ordinal]
+        from .json import GetJsonObject, JsonToStructs
+        if isinstance(bound, JsonToStructs) and bound.field_names:
+            # from_json(j, schema).f  ->  cast(get_json_object(j, '$.f'))
+            # (GpuJsonToStructs analogue: the reference also only reads
+            # projected fields from the parsed table)
+            from .base import lit
+            from .cast import Cast
+            name = bound.field_names[self.ordinal]
+            inner = GetJsonObject(bound.child, lit("$." + name))
+            ft = bound.schema.children[self.ordinal]
+            if ft.kind is TypeKind.STRING:
+                return inner
+            return Cast(inner, ft)
         return GetStructField(bound, self.ordinal)
 
     @property
@@ -839,3 +852,668 @@ class MapFromArrays(Expression):
         ok = ka.validity & va.validity & ~mismatch
         return DeviceColumn(kd, ok, jnp.where(ok, ka.lengths, 0),
                             self.dtype, vd)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 breadth: slice/sequence/flatten, set operations, map HOFs
+# (reference: collectionOperations.scala Slice/Sequence/Flatten/ArrayUnion…,
+# higherOrderFunctions.scala TransformKeys/TransformValues/MapFilter/ZipWith)
+# ---------------------------------------------------------------------------
+
+def _elem_eq_matrix(a: DeviceColumn, b: DeviceColumn,
+                    la, lb) -> jnp.ndarray:
+    """eq[row, i, j] = a[row, i] == b[row, j], masked to live elements."""
+    eq = a.data[:, :, None] == b.data[:, None, :]
+    mea, meb = a.data.shape[1], b.data.shape[1]
+    live_a = jnp.arange(mea)[None, :, None] < la[:, None, None]
+    live_b = jnp.arange(meb)[None, None, :] < lb[:, None, None]
+    return eq & live_a & live_b
+
+
+def _compact_elems(data, keep):
+    """Per-row stable left-compaction of kept elements (shared kernel
+    with the string byte compaction)."""
+    from .strings import _compact_bytes
+    return _compact_bytes(data, keep)
+
+
+class _ArraySetBase(Expression):
+    """Shared: scalar-element binary array ops via equality matrices."""
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def device_unsupported_reason(self):
+        return (_scalar_elems_reason(self.left, type(self).__name__)
+                or _scalar_elems_reason(self.right, type(self).__name__))
+
+    def _eval_sides(self, batch, ctx):
+        a = self.left.eval(batch, ctx)
+        b = self.right.eval(batch, ctx)
+        la = jnp.where(a.validity, a.lengths, 0)
+        lb = jnp.where(b.validity, b.lengths, 0)
+        return a, b, la, lb
+
+
+def _first_occurrence(data, live):
+    """keep[row, i] = element i is live and is the FIRST equal element."""
+    n, me = data.shape
+    eq = (data[:, :, None] == data[:, None, :]) \
+        & live[:, :, None] & live[:, None, :]
+    earlier = jnp.tril(jnp.ones((me, me), bool), k=-1)[None]
+    dup = jnp.any(eq & earlier, axis=2)
+    return live & ~dup
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayDistinct(Expression):
+    """array_distinct(a): first-occurrence order (Spark)."""
+
+    child: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return ArrayDistinct(c[0])
+
+    def device_unsupported_reason(self):
+        return _scalar_elems_reason(self.child, "array_distinct")
+
+    @property
+    def dtype(self):
+        _require_array(self.child, "array_distinct")
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.child.eval(batch, ctx)
+        me = a.data.shape[1]
+        live = (jnp.arange(me)[None, :] < a.lengths[:, None])
+        keep = _first_occurrence(a.data, live)
+        out, ln = _compact_elems(a.data, keep)
+        return DeviceColumn(out, a.validity, jnp.where(a.validity, ln, 0),
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayUnion(_ArraySetBase):
+    """array_union(a, b): distinct(concat), first-occurrence order."""
+
+    left: Optional[Expression] = None
+    right: Optional[Expression] = None
+
+    def with_children(self, c):
+        return ArrayUnion(c[0], c[1])
+
+    @property
+    def dtype(self):
+        et = _require_array(self.left, "array_union")
+        _require_array(self.right, "array_union")
+        return T.array(et, self.left.dtype.max_len
+                       + self.right.dtype.max_len)
+
+    @property
+    def nullable(self):
+        return self.left.nullable or self.right.nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        a, b, la, lb = self._eval_sides(batch, ctx)
+        mea, meb = a.data.shape[1], b.data.shape[1]
+        me = mea + meb
+        idx = jnp.arange(me)[None, :]
+        # write a then b via compaction of a two-part keep mask
+        both = jnp.concatenate([a.data, b.data], axis=1)
+        live = jnp.concatenate(
+            [jnp.arange(mea)[None, :] < la[:, None],
+             jnp.arange(meb)[None, :] < lb[:, None]], axis=1)
+        packed, _ = _compact_elems(both, live)
+        total = la + lb
+        plive = idx < total[:, None]
+        keep = _first_occurrence(packed, plive)
+        out, ln = _compact_elems(packed, keep)
+        validity = a.validity & b.validity
+        return DeviceColumn(out, validity, jnp.where(validity, ln, 0),
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayIntersect(_ArraySetBase):
+    """array_intersect(a, b): distinct elements of a present in b."""
+
+    left: Optional[Expression] = None
+    right: Optional[Expression] = None
+
+    def with_children(self, c):
+        return ArrayIntersect(c[0], c[1])
+
+    @property
+    def dtype(self):
+        _require_array(self.right, "array_intersect")
+        return self.left.dtype
+
+    @property
+    def nullable(self):
+        return self.left.nullable or self.right.nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        a, b, la, lb = self._eval_sides(batch, ctx)
+        me = a.data.shape[1]
+        live = jnp.arange(me)[None, :] < la[:, None]
+        in_b = jnp.any(_elem_eq_matrix(a, b, la, lb), axis=2)
+        keep = _first_occurrence(a.data, live) & in_b
+        out, ln = _compact_elems(a.data, keep)
+        validity = a.validity & b.validity
+        return DeviceColumn(out, validity, jnp.where(validity, ln, 0),
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayExcept(ArrayIntersect):
+    """array_except(a, b): distinct elements of a NOT in b."""
+
+    def with_children(self, c):
+        return ArrayExcept(c[0], c[1])
+
+    def eval(self, batch, ctx=EvalContext()):
+        a, b, la, lb = self._eval_sides(batch, ctx)
+        me = a.data.shape[1]
+        live = jnp.arange(me)[None, :] < la[:, None]
+        in_b = jnp.any(_elem_eq_matrix(a, b, la, lb), axis=2)
+        keep = _first_occurrence(a.data, live) & ~in_b
+        out, ln = _compact_elems(a.data, keep)
+        validity = a.validity & b.validity
+        return DeviceColumn(out, validity, jnp.where(validity, ln, 0),
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ArraysOverlap(_ArraySetBase):
+    """arrays_overlap(a, b): any common element."""
+
+    left: Optional[Expression] = None
+    right: Optional[Expression] = None
+
+    def with_children(self, c):
+        return ArraysOverlap(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def eval(self, batch, ctx=EvalContext()):
+        a, b, la, lb = self._eval_sides(batch, ctx)
+        any_common = jnp.any(_elem_eq_matrix(a, b, la, lb), axis=(1, 2))
+        from .base import numeric_column
+        return numeric_column(any_common, a.validity & b.validity,
+                              T.BOOLEAN)
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayRemove(Expression):
+    """array_remove(a, v): drop every element equal to v."""
+
+    child: Optional[Expression] = None
+    value: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.child, self.value)
+
+    def with_children(self, c):
+        return ArrayRemove(c[0], c[1])
+
+    def device_unsupported_reason(self):
+        return _scalar_elems_reason(self.child, "array_remove")
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.child.eval(batch, ctx)
+        v = self.value.eval(batch, ctx)
+        me = a.data.shape[1]
+        live = jnp.arange(me)[None, :] < a.lengths[:, None]
+        keep = live & ~(a.data == v.data[:, None])
+        out, ln = _compact_elems(a.data, keep)
+        validity = a.validity & v.validity
+        return DeviceColumn(out, validity, jnp.where(validity, ln, 0),
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayPosition(Expression):
+    """array_position(a, v): 1-based first index, 0 when absent (bigint)."""
+
+    child: Optional[Expression] = None
+    value: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.child, self.value)
+
+    def with_children(self, c):
+        return ArrayPosition(c[0], c[1])
+
+    def device_unsupported_reason(self):
+        return _scalar_elems_reason(self.child, "array_position")
+
+    @property
+    def dtype(self):
+        return T.INT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.child.eval(batch, ctx)
+        v = self.value.eval(batch, ctx)
+        me = a.data.shape[1]
+        live = jnp.arange(me)[None, :] < a.lengths[:, None]
+        hit = live & (a.data == v.data[:, None])
+        pos = jnp.where(jnp.any(hit, axis=1),
+                        jnp.argmax(hit, axis=1).astype(jnp.int64) + 1,
+                        jnp.int64(0))
+        from .base import numeric_column
+        return numeric_column(pos, a.validity & v.validity, T.INT64)
+
+
+@dataclass(frozen=True, eq=False)
+class ArrayRepeat(Expression):
+    """array_repeat(v, n): LITERAL count (defines the static budget)."""
+
+    value: Optional[Expression] = None
+    count: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.value, self.count)
+
+    def with_children(self, c):
+        return ArrayRepeat(c[0], c[1])
+
+    def _n(self) -> int:
+        if not isinstance(self.count, Literal):
+            raise CollectionUnsupported(
+                "array_repeat count must be a literal (static budget)")
+        return max(int(self.count.value), 0)
+
+    def device_unsupported_reason(self):
+        if not isinstance(self.count, Literal):
+            return "array_repeat with non-literal count has no static budget"
+        if self.value is not None and self.value.resolved and \
+                self.value.dtype.kind is TypeKind.STRING:
+            return "array_repeat over strings has no device kernel"
+        return None
+
+    @property
+    def dtype(self):
+        return T.array(self.value.dtype, max(self._n(), 1))
+
+    def eval(self, batch, ctx=EvalContext()):
+        v = self.value.eval(batch, ctx)
+        nrep = self._n()
+        data = jnp.broadcast_to(v.data[:, None],
+                                (batch.capacity, max(nrep, 1)))
+        ln = jnp.full(batch.capacity, nrep, jnp.int32)
+        return DeviceColumn(data, v.validity, jnp.where(v.validity, ln, 0),
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ArraySlice(Expression):
+    """slice(a, start, length): 1-based start; negative = from the end."""
+
+    child: Optional[Expression] = None
+    start: Optional[Expression] = None
+    length: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.child, self.start, self.length)
+
+    def with_children(self, c):
+        return ArraySlice(c[0], c[1], c[2])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.child.eval(batch, ctx)
+        s = self.start.eval(batch, ctx)
+        ln = self.length.eval(batch, ctx)
+        me = a.data.shape[1]
+        st = s.data.astype(jnp.int32)
+        validity = a.validity & s.validity & ln.validity
+        # Spark: start == 0 and negative length are runtime errors
+        ctx.report(validity & (st == 0), "SLICE_START_ZERO", always=True)
+        ctx.report(validity & (ln.data < 0), "SLICE_NEGATIVE_LENGTH",
+                   always=True)
+        # 1-based; negative counts from the end; out-of-range -> empty
+        begin = jnp.where(st > 0, st - 1, a.lengths + st)
+        want = jnp.clip(ln.data.astype(jnp.int32), 0, me)
+        take = jnp.where(begin >= 0,
+                         jnp.clip(jnp.minimum(want, a.lengths - begin),
+                                  0, me), 0)
+        idx = jnp.arange(me)[None, :] + jnp.clip(begin, 0, me - 1)[:, None]
+        data = jnp.take_along_axis(
+            jnp.concatenate([a.data, a.data[:, :1]], axis=1),
+            jnp.clip(idx, 0, me), axis=1)[:, :me]
+        live = jnp.arange(me)[None, :] < take[:, None]
+        data = jnp.where(live, data, 0)
+        return DeviceColumn(data, validity, jnp.where(validity, take, 0),
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class Sequence(Expression):
+    """sequence(start, stop[, step]) over integers; rows needing more than
+    ``max_elems`` slots report CAPACITY_sequence (fail-loud budget)."""
+
+    start: Optional[Expression] = None
+    stop: Optional[Expression] = None
+    step: Optional[Expression] = None
+    max_elems: int = 256
+
+    @property
+    def children(self):
+        return (self.start, self.stop) + \
+            ((self.step,) if self.step is not None else ())
+
+    def with_children(self, c):
+        return Sequence(c[0], c[1], c[2] if len(c) > 2 else None,
+                        self.max_elems)
+
+    @property
+    def dtype(self):
+        return T.array(self.start.dtype, self.max_elems)
+
+    def eval(self, batch, ctx=EvalContext()):
+        a = self.start.eval(batch, ctx)
+        b = self.stop.eval(batch, ctx)
+        if self.step is not None:
+            st = self.step.eval(batch, ctx)
+            step = st.data.astype(jnp.int64)
+            sv = st.validity
+        else:
+            step = jnp.where(b.data >= a.data, jnp.int64(1), jnp.int64(-1))
+            sv = jnp.ones(batch.capacity, bool)
+        lo = a.data.astype(jnp.int64)
+        hi = b.data.astype(jnp.int64)
+        ok_dir = jnp.where(step > 0, hi >= lo,
+                           jnp.where(step < 0, hi <= lo, False))
+        safe_step = jnp.where(step == 0, 1, step)
+        count = jnp.where(ok_dir, (hi - lo) // safe_step + 1, 0)
+        validity = a.validity & b.validity & sv & (step != 0)
+        me = self.max_elems
+        overflow = validity & (count > me)
+        ctx.report(overflow, "CAPACITY_sequence_max_elems", always=True)
+        n = jnp.clip(count, 0, me).astype(jnp.int32)
+        vals = lo[:, None] + jnp.arange(me, dtype=jnp.int64)[None, :] \
+            * step[:, None]
+        live = jnp.arange(me)[None, :] < n[:, None]
+        data = jnp.where(live, vals, 0).astype(a.data.dtype)
+        return DeviceColumn(data, validity, jnp.where(validity, n, 0),
+                            self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class Flatten(Expression):
+    """flatten(array(a1, a2, ...)): device support via the bind-time
+    CreateArray rewrite — nested array COLUMNS have no device layout, so
+    anything else is a planner CPU fallback."""
+
+    child: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Flatten(c[0])
+
+    def bind(self, schema):
+        return Flatten(self.child.bind(schema))
+
+    def device_unsupported_reason(self):
+        if not isinstance(self.child, CreateArray):
+            return ("flatten over a nested-array column has no device "
+                    "layout (only flatten(array(...)) lowers)")
+        return None
+
+    @property
+    def dtype(self):
+        if isinstance(self.child, CreateArray):
+            inner = [e.dtype for e in self.child.elems]
+            et = inner[0].children[0]
+            total = sum(t.max_len for t in inner)
+            return T.array(et, max(total, 1))
+        ct = self.child.dtype
+        return ct.children[0]
+
+    def eval(self, batch, ctx=EvalContext()):
+        if not isinstance(self.child, CreateArray):
+            raise CollectionUnsupported("flatten needs CreateArray input")
+        arrs = [e.eval(batch, ctx) for e in self.child.elems]
+        datas = jnp.concatenate([a.data for a in arrs], axis=1)
+        live = jnp.concatenate(
+            [jnp.arange(a.data.shape[1])[None, :] < a.lengths[:, None]
+             for a in arrs], axis=1)
+        out, ln = _compact_elems(datas, live)
+        validity = batch.row_mask()
+        for a in arrs:
+            validity = validity & a.validity
+        return DeviceColumn(out, validity, jnp.where(validity, ln, 0),
+                            self.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Map higher-order functions (two-variable lambdas over the zipped
+# keys/values matrices; reference: higherOrderFunctions.scala
+# TransformKeys :2814, TransformValues, MapFilter, ZipWith :2692)
+# ---------------------------------------------------------------------------
+
+class _MapHofBase(Expression):
+    @property
+    def children(self):
+        return (self.m,)
+
+    def _check(self):
+        kt, vt = _require_map(self.m, type(self).__name__)
+        if self.kvar.elem_type != kt or self.vvar.elem_type != vt:
+            raise TypeError("lambda variable types must match map entry "
+                            f"types ({kt}, {vt})")
+        return kt, vt
+
+    def _eval_body(self, batch, ctx, body):
+        m = self.m.eval(batch, ctx)
+        flat, live, me = _flat_elem_batch(batch, m)
+        kt, vt = _require_map(self.m, type(self).__name__)
+        kcol = DeviceColumn(m.data.reshape(batch.capacity * me), live,
+                            None, kt)
+        vcol = DeviceColumn(m.data2.reshape(batch.capacity * me), live,
+                            None, vt)
+        self.kvar._cell[0] = kcol
+        self.vvar._cell[0] = vcol
+        try:
+            out = body.eval(flat, ctx)
+        finally:
+            self.kvar._cell[0] = None
+            self.vvar._cell[0] = None
+        return m, out, live, me
+
+
+@dataclass(frozen=True, eq=False)
+class TransformKeys(_MapHofBase):
+    """transform_keys(m, (k, v) -> body)."""
+
+    m: Optional[Expression] = None
+    kvar: Optional[LambdaVariable] = None
+    vvar: Optional[LambdaVariable] = None
+    body: Optional[Expression] = None
+
+    def with_children(self, c):
+        return type(self)(c[0], self.kvar, self.vvar, self.body)
+
+    def bind(self, schema):
+        b = type(self)(self.m.bind(schema), self.kvar, self.vvar,
+                       self.body.bind(schema))
+        b._check()
+        return b
+
+    def device_unsupported_reason(self):
+        if self.body.resolved and self.body.nullable:
+            return "map HOF body may produce nulls (no device storage)"
+        return None
+
+    @property
+    def dtype(self):
+        _, vt = _require_map(self.m, "transform_keys")
+        return T.map_(self.body.dtype, vt, self.m.dtype.max_len)
+
+    @property
+    def nullable(self):
+        return self.m.nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        m, out, live, me = self._eval_body(batch, ctx, self.body)
+        new_keys = out.data.reshape(batch.capacity, me)
+        new_keys = jnp.where(live.reshape(batch.capacity, me), new_keys, 0)
+        return DeviceColumn(new_keys, m.validity, m.lengths, self.dtype,
+                            m.data2)
+
+
+@dataclass(frozen=True, eq=False)
+class TransformValues(TransformKeys):
+    """transform_values(m, (k, v) -> body)."""
+
+    @property
+    def dtype(self):
+        kt, _ = _require_map(self.m, "transform_values")
+        return T.map_(kt, self.body.dtype, self.m.dtype.max_len)
+
+    def eval(self, batch, ctx=EvalContext()):
+        m, out, live, me = self._eval_body(batch, ctx, self.body)
+        new_vals = out.data.reshape(batch.capacity, me)
+        new_vals = jnp.where(live.reshape(batch.capacity, me), new_vals, 0)
+        return DeviceColumn(m.data, m.validity, m.lengths, self.dtype,
+                            new_vals)
+
+
+@dataclass(frozen=True, eq=False)
+class MapFilter(TransformKeys):
+    """map_filter(m, (k, v) -> pred): keep entries where pred holds."""
+
+    def bind(self, schema):
+        b = type(self)(self.m.bind(schema), self.kvar, self.vvar,
+                       self.body.bind(schema))
+        b._check()
+        if b.body.dtype.kind is not TypeKind.BOOLEAN:
+            raise TypeError("map_filter predicate must be boolean")
+        return b
+
+    def device_unsupported_reason(self):
+        return None     # dropping entries is always storable
+
+    @property
+    def dtype(self):
+        return self.m.dtype
+
+    def eval(self, batch, ctx=EvalContext()):
+        m, out, live, me = self._eval_body(batch, ctx, self.body)
+        keep = (live & out.data & out.validity).reshape(batch.capacity, me)
+        kd, kl = _compact_elems(m.data, keep)
+        vd, _ = _compact_elems(m.data2, keep)
+        return DeviceColumn(kd, m.validity,
+                            jnp.where(m.validity, kl, 0), self.dtype, vd)
+
+
+@dataclass(frozen=True, eq=False)
+class ZipWith(Expression):
+    """zip_with(a, b, (x, y) -> body). Device subset: the result length is
+    max(len(a), len(b)) with the shorter side's variable NULL — so the
+    body must be provably non-null over nullable inputs (coalesce-style
+    bodies); anything else is a planner CPU fallback."""
+
+    left: Optional[Expression] = None
+    right: Optional[Expression] = None
+    xvar: Optional[LambdaVariable] = None
+    yvar: Optional[LambdaVariable] = None
+    body: Optional[Expression] = None
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return ZipWith(c[0], c[1], self.xvar, self.yvar, self.body)
+
+    def bind(self, schema):
+        b = ZipWith(self.left.bind(schema), self.right.bind(schema),
+                    self.xvar, self.yvar, self.body.bind(schema))
+        _require_array(b.left, "zip_with")
+        _require_array(b.right, "zip_with")
+        return b
+
+    def device_unsupported_reason(self):
+        r = (_scalar_elems_reason(self.left, "zip_with")
+             or _scalar_elems_reason(self.right, "zip_with"))
+        if r:
+            return r
+        if self.body.resolved and self.body.nullable:
+            return ("zip_with body may produce null elements over the "
+                    "shorter side's padding (no device storage)")
+        return None
+
+    @property
+    def dtype(self):
+        me = max(self.left.dtype.max_len, self.right.dtype.max_len)
+        return T.array(self.body.dtype, me)
+
+    @property
+    def nullable(self):
+        return self.left.nullable or self.right.nullable
+
+    def eval(self, batch, ctx=EvalContext()):
+        from ..exec.common import gather_column
+        a = self.left.eval(batch, ctx)
+        b = self.right.eval(batch, ctx)
+        cap = batch.capacity
+        me = max(a.data.shape[1], b.data.shape[1])
+
+        def padded(col):
+            pad = me - col.data.shape[1]
+            d = jnp.pad(col.data, ((0, 0), (0, pad)))
+            return d
+        da, db = padded(a), padded(b)
+        row = jnp.repeat(jnp.arange(cap, dtype=jnp.int32), me)
+        pos = jnp.tile(jnp.arange(me, dtype=jnp.int32), cap)
+        la = jnp.take(a.lengths, row)
+        lb = jnp.take(b.lengths, row)
+        live_a = pos < la
+        live_b = pos < lb
+        live = (live_a | live_b) & jnp.take(a.validity & b.validity, row)
+        cols = tuple(gather_column(c, row) for c in batch.columns)
+        flat = ColumnarBatch(cols, jnp.asarray(cap * me, jnp.int32))
+        xt = self.left.dtype.children[0]
+        yt = self.right.dtype.children[0]
+        self.xvar._cell[0] = DeviceColumn(da.reshape(-1), live_a & live,
+                                          None, xt)
+        self.yvar._cell[0] = DeviceColumn(db.reshape(-1), live_b & live,
+                                          None, yt)
+        try:
+            out = self.body.eval(flat, ctx)
+        finally:
+            self.xvar._cell[0] = None
+            self.yvar._cell[0] = None
+        n = jnp.maximum(a.lengths, b.lengths)
+        validity = a.validity & b.validity
+        live2 = live.reshape(cap, me)
+        out_ok = out.validity.reshape(cap, me)
+        # a live slot whose body evaluated to null has no device storage —
+        # fail loud (fixed-budget contract) instead of storing garbage
+        bad = jnp.any(live2 & ~out_ok, axis=1) & validity
+        ctx.report(bad, "CAPACITY_zip_with_null_element", always=True)
+        data = jnp.where(live2, out.data.reshape(cap, me), 0)
+        return DeviceColumn(data, validity, jnp.where(validity, n, 0),
+                            self.dtype)

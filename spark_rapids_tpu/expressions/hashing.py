@@ -257,3 +257,191 @@ def partition_ids(cols: Sequence[DeviceColumn], num_partitions: int) -> jnp.ndar
     h = murmur3_batch(cols)
     m = h % jnp.int32(num_partitions)
     return jnp.where(m < 0, m + num_partitions, m)
+
+
+# ---------------------------------------------------------------------------
+# Spark-compatible XXH64 (reference: GpuOverrides XxHash64 rule; Spark
+# catalyst XXH64 / XxHash64Function). All arithmetic in uint64 (emulated on
+# TPU but elementwise-cheap); strings follow hashUnsafeBytes: 32-byte
+# stripes, then 8-byte words, one 4-byte word, then tail bytes.
+# ---------------------------------------------------------------------------
+
+_XP1 = jnp.uint64(0x9E3779B185EBCA87)
+_XP2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
+_XP3 = jnp.uint64(0x165667B19E3779F9)
+_XP4 = jnp.uint64(0x85EBCA77C2B2AE63)
+_XP5 = jnp.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r):
+    r = jnp.uint64(r)
+    return (x << r) | (x >> (jnp.uint64(64) - r))
+
+
+def _xx_avalanche(h):
+    h = h ^ (h >> jnp.uint64(33))
+    h = h * _XP2
+    h = h ^ (h >> jnp.uint64(29))
+    h = h * _XP3
+    return h ^ (h >> jnp.uint64(32))
+
+
+def _xx_u64(v) -> jnp.ndarray:
+    """int64 array -> uint64 bits (arithmetic, no 64-bit bitcast)."""
+    return v.astype(jnp.int64).astype(jnp.uint64)
+
+
+def xxhash64_long(v, seed):
+    """XXH64.hashLong(l, seed)."""
+    h = seed + _XP5 + jnp.uint64(8)
+    k1 = _rotl64(_xx_u64(v) * _XP2, 31) * _XP1
+    h = h ^ k1
+    h = _rotl64(h, 27) * _XP1 + _XP4
+    return _xx_avalanche(h)
+
+def xxhash64_int(v, seed):
+    """XXH64.hashInt(i, seed): the int is zero-extended to a u32 lane."""
+    h = seed + _XP5 + jnp.uint64(4)
+    u = v.astype(jnp.int32).view(jnp.uint32).astype(jnp.uint64)
+    h = h ^ (u * _XP1)
+    h = _rotl64(h, 23) * _XP2 + _XP3
+    return _xx_avalanche(h)
+
+
+def _xx_word64(data, off):
+    """Little-endian u64 word at byte offset ``off`` of each row."""
+    w = jnp.zeros(data.shape[0], jnp.uint64)
+    for b in range(8):
+        w = w | (data[:, off + b].astype(jnp.uint64)
+                 << jnp.uint64(8 * b))
+    return w
+
+
+def _xxhash64_string(col: DeviceColumn, seed):
+    data, lengths = col.data, col.lengths
+    n, max_len = data.shape
+    length64 = lengths.astype(jnp.uint64)
+    # stripe phase: rows with len >= 32 run 32-byte stripes through four
+    # accumulators; stripe count = len // 32
+    v1 = seed + _XP1 + _XP2
+    v2 = seed + _XP2
+    v3 = seed + jnp.uint64(0)
+    v4 = seed - _XP1
+    v1 = jnp.broadcast_to(v1, (n,))
+    v2 = jnp.broadcast_to(v2, (n,))
+    v3 = jnp.broadcast_to(v3, (n,))
+    v4 = jnp.broadcast_to(v4, (n,))
+
+    def stripe_round(acc, w):
+        acc = acc + w * _XP2
+        return _rotl64(acc, 31) * _XP1
+
+    for s in range(max_len // 32):
+        use = lengths >= (s + 1) * 32
+        nv1 = stripe_round(v1, _xx_word64(data, 32 * s))
+        nv2 = stripe_round(v2, _xx_word64(data, 32 * s + 8))
+        nv3 = stripe_round(v3, _xx_word64(data, 32 * s + 16))
+        nv4 = stripe_round(v4, _xx_word64(data, 32 * s + 24))
+        v1 = jnp.where(use, nv1, v1)
+        v2 = jnp.where(use, nv2, v2)
+        v3 = jnp.where(use, nv3, v3)
+        v4 = jnp.where(use, nv4, v4)
+
+    merged = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+              + _rotl64(v4, 18))
+
+    def merge_acc(h, acc):
+        h = h ^ (_rotl64(acc * _XP2, 31) * _XP1)
+        return h * _XP1 + _XP4
+
+    merged = merge_acc(merged, v1)
+    merged = merge_acc(merged, v2)
+    merged = merge_acc(merged, v3)
+    merged = merge_acc(merged, v4)
+    short = seed + _XP5
+    h = jnp.where(lengths >= 32, merged, jnp.broadcast_to(short, (n,)))
+    h = h + length64
+
+    # remaining 8-byte words from (len//32)*32 — always 8-aligned
+    stripe_end = (lengths // 32) * 32
+    word_end = stripe_end + ((lengths - stripe_end) // 8) * 8
+    for o in range(0, max_len - 7, 8):
+        use = (o >= stripe_end) & (o + 8 <= lengths)
+        k1 = _rotl64(_xx_word64(data, o) * _XP2, 31) * _XP1
+        nh = _rotl64(h ^ k1, 27) * _XP1 + _XP4
+        h = jnp.where(use, nh, h)
+    # one 4-byte word — always 4-aligned
+    int_end = word_end + ((lengths - word_end) // 4) * 4
+    for o in range(0, max_len - 3, 4):
+        use = (o == word_end) & (o + 4 <= lengths)
+        w = (data[:, o].astype(jnp.uint64)
+             | (data[:, o + 1].astype(jnp.uint64) << jnp.uint64(8))
+             | (data[:, o + 2].astype(jnp.uint64) << jnp.uint64(16))
+             | (data[:, o + 3].astype(jnp.uint64) << jnp.uint64(24)))
+        nh = _rotl64(h ^ (w * _XP1), 23) * _XP2 + _XP3
+        h = jnp.where(use, nh, h)
+    # tail bytes
+    for o in range(max_len):
+        use = (o >= int_end) & (o < lengths)
+        b = data[:, o].astype(jnp.uint64)
+        nh = _rotl64(h ^ (b * _XP5), 11) * _XP1
+        h = jnp.where(use, nh, h)
+    return _xx_avalanche(h)
+
+
+def xxhash64_column(col: DeviceColumn, seed) -> jnp.ndarray:
+    k = col.dtype.kind
+    seed = jnp.broadcast_to(seed, col.validity.shape).astype(jnp.uint64)
+    if k is TypeKind.STRING:
+        h = _xxhash64_string(col, seed)
+    elif k in (TypeKind.INT64, TypeKind.TIMESTAMP):
+        h = xxhash64_long(col.data, seed)
+    elif k is TypeKind.FLOAT64:
+        x = jnp.where(col.data == 0.0, 0.0, col.data)
+        low, high = _double_bits_words(x)
+        bits = (high.astype(jnp.uint64) << jnp.uint64(32)) \
+            | low.astype(jnp.uint64)
+        h = xxhash64_long(bits.astype(jnp.int64), seed)
+    elif k is TypeKind.FLOAT32:
+        import jax
+        x = jnp.where(col.data == 0.0, jnp.float32(0.0), col.data)
+        h = xxhash64_int(
+            jax.lax.bitcast_convert_type(x, jnp.uint32).view(jnp.int32),
+            seed)
+    elif k is TypeKind.BOOLEAN:
+        h = xxhash64_int(col.data.astype(jnp.int32), seed)
+    elif k is TypeKind.DECIMAL:
+        h = xxhash64_long(col.data, seed)
+    else:   # int8/16/32, date
+        h = xxhash64_int(col.data.astype(jnp.int32), seed)
+    return jnp.where(col.validity, h, seed)
+
+
+@dataclass(frozen=True, eq=False)
+class XxHash64(Expression):
+    """xxhash64(cols...) — bigint row hash, seed 42 (Spark XxHash64)."""
+
+    exprs: Tuple[Expression, ...]
+    seed: int = DEFAULT_SEED
+
+    @property
+    def children(self):
+        return self.exprs
+
+    def with_children(self, c):
+        return XxHash64(tuple(c), self.seed)
+
+    @property
+    def dtype(self):
+        return T.INT64
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, batch: ColumnarBatch, ctx=EvalContext()):
+        h = jnp.full(batch.capacity, self.seed, jnp.uint64)
+        for e in self.exprs:
+            h = xxhash64_column(e.eval(batch, ctx), h)
+        return DeviceColumn(h.astype(jnp.int64), batch.row_mask(), None,
+                            T.INT64)

@@ -1,0 +1,364 @@
+"""JSON expressions: get_json_object / from_json (flat-struct subset).
+
+Reference: sql-plugin/.../GpuOverrides.scala:3379 (GetJsonObject),
+GpuJsonToStructs.scala — the reference delegates to cudf's JSON kernels;
+the TPU-native design parses the padded byte matrices directly with
+vectorized state masks, all inside the jit:
+
+- escape mask      : backslash-run parity per position
+- string mask      : parity of unescaped quotes (prefix scan per row)
+- depth            : prefix sum of non-string braces/brackets
+- key match        : sliding-window compare of '"key"' at depth 1
+- value extraction : type-directed end detection (string close quote /
+                     scalar delimiter / matching bracket), then a per-row
+                     shift gather and basic escape decoding
+
+Subset contract (planner notes gate the rest): paths are literal
+``$.a.b[i]`` chains; ``\\uXXXX`` escapes in extracted strings null the row
+(no device decoder yet) — the same explicit-divergence policy as the regex
+transpiler's unsupported constructs.
+"""
+
+from __future__ import annotations
+
+import re as _re
+from dataclasses import dataclass
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import ColumnarBatch, DeviceColumn
+from ..types import TypeKind
+from .base import EvalContext, Expression, Literal
+from .strings import _string_column, _window_match
+
+
+class JsonPathUnsupported(ValueError):
+    """Path outside the device subset (planner CPU-fallback signal)."""
+
+
+_STEP_RE = _re.compile(r"\.([A-Za-z_][A-Za-z0-9_\- ]*)|\[(\d+)\]|\['([^']+)'\]")
+
+
+def parse_json_path(path: str) -> List[Union[str, int]]:
+    if not path.startswith("$"):
+        raise JsonPathUnsupported(f"path must start with $: {path!r}")
+    steps: List[Union[str, int]] = []
+    i = 1
+    while i < len(path):
+        m = _STEP_RE.match(path, i)
+        if not m:
+            raise JsonPathUnsupported(f"unsupported path syntax: {path!r}")
+        if m.group(1) is not None:
+            steps.append(m.group(1))
+        elif m.group(2) is not None:
+            steps.append(int(m.group(2)))
+        else:
+            steps.append(m.group(3))
+        i = m.end()
+    return steps
+
+
+def _scan_masks(data: jnp.ndarray, lengths: jnp.ndarray):
+    """(escaped, unescaped_quote, outside_string, depth_incl) per byte."""
+    n, ml = data.shape
+    idx = jnp.arange(ml)[None, :]
+    live = idx < lengths[:, None]
+    bs = (data == ord("\\")) & live
+    # last index <= j that is NOT a backslash (per row, running max)
+    notbs_idx = jnp.where(~bs, idx, -1)
+    last_nb = jax_cummax(notbs_idx)
+    # backslash run ending just before position j
+    prev_last = jnp.concatenate(
+        [jnp.full((n, 1), -1, last_nb.dtype), last_nb[:, :-1]], axis=1)
+    run_before = (idx - 1) - prev_last
+    escaped = (run_before % 2) == 1
+    q = (data == ord('"')) & ~escaped & live
+    cum_q = jnp.cumsum(q.astype(jnp.int32), axis=1)
+    excl_q = cum_q - q.astype(jnp.int32)
+    outside = (excl_q % 2 == 0)          # true at opening quotes too
+    content_outside = outside & ~q       # strictly outside any string
+    opens = content_outside & ((data == ord("{")) | (data == ord("[")))
+    closes = content_outside & ((data == ord("}")) | (data == ord("]")))
+    depth = jnp.cumsum(opens.astype(jnp.int32) - closes.astype(jnp.int32),
+                       axis=1)
+    return escaped, q, outside, content_outside, depth, live
+
+
+def jax_cummax(x: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise inclusive running max (unrolled static-shift ladder)."""
+    ml = x.shape[1]
+    d = 1
+    while d < ml:
+        pad = jnp.full(x.shape[:1] + (d,), -(2 ** 31), x.dtype)
+        x = jnp.maximum(x, jnp.concatenate([pad, x[:, :-d]], axis=1))
+        d <<= 1
+    return x
+
+
+def _next_nonws_table(data: jnp.ndarray) -> jnp.ndarray:
+    """t[row, i] = smallest j >= i with a non-ws byte (ml if none):
+    reverse running-min ladder — EXACT whitespace skipping, not a capped
+    probe loop."""
+    n, ml = data.shape
+    idx = jnp.arange(ml)[None, :]
+    x = jnp.where(~_is_ws(data), idx, ml).astype(jnp.int32)
+    x = jnp.broadcast_to(x, (n, ml))
+    d = 1
+    while d < ml:
+        pad = jnp.full((n, d), ml, x.dtype)
+        x = jnp.minimum(x, jnp.concatenate([x[:, d:], pad], axis=1))
+        d <<= 1
+    return x
+
+
+_WS = (ord(" "), ord("\t"), ord("\n"), ord("\r"))
+
+
+def _is_ws(b):
+    out = jnp.zeros(b.shape, bool)
+    for w in _WS:
+        out = out | (b == w)
+    return out
+
+
+def _first_true(mask: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(index of first true per row, any true)."""
+    any_ = jnp.any(mask, axis=1)
+    return jnp.argmax(mask, axis=1).astype(jnp.int32), any_
+
+
+def _shift_left(data, lengths, start, count):
+    """Per-row substring [start, start+count) into a fresh matrix."""
+    n, ml = data.shape
+    idx = jnp.arange(ml)[None, :]
+    gidx = jnp.clip(idx + start[:, None], 0, ml - 1)
+    out = jnp.take_along_axis(data, gidx, axis=1)
+    out = jnp.where(idx < count[:, None], out, 0)
+    return out, jnp.clip(count, 0, ml)
+
+
+def _extract_step(data, lengths, valid, step) -> Tuple:
+    """One path step over current JSON text; returns (data', lengths',
+    valid', is_string_value, had_unicode_escape)."""
+    n, ml = data.shape
+    escaped, q, outside, content_outside, depth, live = _scan_masks(
+        data, lengths)
+    idx = jnp.arange(ml)[None, :]
+
+    if isinstance(step, int):
+        # array index: element boundaries are top-level commas at depth 1
+        # inside a root array
+        root_ok = valid & (lengths > 0) & (data[:, 0] == ord("["))
+        commas = content_outside & (data == ord(",")) & (depth == 1)
+        elem_id = jnp.cumsum(commas.astype(jnp.int32), axis=1) \
+            - commas.astype(jnp.int32)
+        in_elem = (idx >= 1) & (idx < (lengths - 1)[:, None]) \
+            & (elem_id == step) & ~(commas & (elem_id == step))
+        has = root_ok & jnp.any(in_elem, axis=1)
+        start, _ = _first_true(in_elem)
+        last = (ml - 1) - jnp.argmax(in_elem[:, ::-1], axis=1) \
+            .astype(jnp.int32)
+        count = jnp.where(has, last - start + 1, 0)
+        out, cnt = _shift_left(data, lengths, start, count)
+        out, cnt = _trim_ws(out, cnt)
+        return _finish_value(out, cnt, has & valid)
+
+    # field step
+    pat = b'"' + step.encode("utf-8") + b'"'
+    m = _window_match(data, lengths, pat)
+    # the opening quote must open a string at depth 1 (inside the root
+    # object), and the next non-ws char after the close quote must be ':'
+    opens_str = q & outside
+    cand = m & opens_str & (depth == 1)
+    after = idx + len(pat)
+    # first non-ws at/after the key's closing quote must be ':'
+    nnw = _next_nonws_table(data)
+    padded = jnp.pad(data, ((0, 0), (0, 1)))
+    nnw_pad = jnp.pad(nnw, ((0, 0), (0, 1)), constant_values=ml)
+    pos = jnp.take_along_axis(nnw_pad, jnp.clip(after, 0, ml), axis=1)
+    ch = jnp.take_along_axis(padded, jnp.clip(pos, 0, ml), axis=1)
+    cand = cand & (ch == ord(":"))
+    vstart0 = pos + 1
+    first, has = _first_true(cand)
+    vs = jnp.take_along_axis(vstart0, first[:, None], axis=1)[:, 0]
+    # skip ws after the colon (exact)
+    vs = jnp.take_along_axis(nnw_pad, jnp.clip(vs, 0, ml)[:, None],
+                             axis=1)[:, 0]
+    vchar = jnp.take_along_axis(padded, jnp.clip(vs, 0, ml)[:, None],
+                                axis=1)[:, 0]
+    valid = valid & has & (vs < lengths)
+
+    vdepth = jnp.take_along_axis(
+        jnp.pad(depth, ((0, 0), (0, 1))),
+        jnp.clip(vs, 0, ml)[:, None], axis=1)[:, 0]
+    is_str = vchar == ord('"')
+    is_nest = (vchar == ord("{")) | (vchar == ord("["))
+
+    # string value: first unescaped quote after vs
+    close_q = q & (idx > vs[:, None])
+    qpos, has_q = _first_true(close_q)
+    s_start = vs + 1
+    s_count = jnp.where(has_q, qpos - s_start, 0)
+
+    # nested value: first closer bringing depth back below vdepth
+    closer = content_outside & (idx > vs[:, None]) \
+        & (depth == (vdepth - 1)[:, None]) \
+        & ((data == ord("}")) | (data == ord("]")))
+    cpos, has_c = _first_true(closer)
+    n_count = jnp.where(has_c, cpos - vs + 1, 0)
+
+    # scalar: up to the next top-value delimiter
+    delim = content_outside & (idx > vs[:, None]) & (
+        ((data == ord(",")) & (depth == vdepth[:, None]))
+        | (((data == ord("}")) | (data == ord("]")))
+           & (depth == (vdepth - 1)[:, None])))
+    dpos, has_d = _first_true(delim)
+    sc_count = jnp.where(has_d, dpos - vs, lengths - vs)
+
+    start = jnp.where(is_str, s_start, vs)
+    count = jnp.where(is_str, s_count,
+                      jnp.where(is_nest, n_count, sc_count))
+    valid = valid & jnp.where(is_str, has_q, True)
+    out, cnt = _shift_left(data, lengths, start, count)
+    # trim surrounding ws on scalars/nested (string contents stay as-is)
+    out2, cnt2 = _trim_ws(out, cnt)
+    pad2 = out.shape[1] - out2.shape[1]
+    out = jnp.where(is_str[:, None], out, out2)
+    cnt = jnp.where(is_str, cnt, cnt2)
+    return _finish_value(out, cnt, valid, is_str)
+
+
+def _trim_ws(data, lengths):
+    n, ml = data.shape
+    idx = jnp.arange(ml)[None, :]
+    live = idx < lengths[:, None]
+    nonws = live & ~_is_ws(data)
+    # leading
+    lead, any_ = _first_true(nonws)
+    lead = jnp.where(any_, lead, 0)
+    # trailing
+    last = (ml - 1) - jnp.argmax(nonws[:, ::-1], axis=1).astype(jnp.int32)
+    count = jnp.where(any_, last - lead + 1, 0)
+    return _shift_left(data, lengths, lead, count)
+
+
+def _finish_value(data, lengths, valid, is_str=None):
+    """null literal -> invalid; report string-ness for escape decoding."""
+    n, ml = data.shape
+    if is_str is None:
+        is_str = jnp.zeros(n, bool)
+    nul = (lengths == 4)
+    for j, ch in enumerate(b"null"):
+        col = data[:, j] if j < ml else jnp.zeros(n, jnp.uint8)
+        nul = nul & (col == ch)
+    valid = valid & ~(nul & ~is_str)
+    return data, lengths, valid, is_str
+
+
+def _decode_escapes(data, lengths, is_str):
+    """Decode \\" \\\\ \\/ \\b \\f \\n \\r \\t in string values; rows with
+    \\uXXXX turn invalid (no device decoder)."""
+    n, ml = data.shape
+    idx = jnp.arange(ml)[None, :]
+    live = idx < lengths[:, None]
+    bs = (data == ord("\\")) & live
+    notbs_idx = jnp.where(~bs, idx, -1)
+    last_nb = jax_cummax(notbs_idx)
+    prev_last = jnp.concatenate(
+        [jnp.full((n, 1), -1, last_nb.dtype), last_nb[:, :-1]], axis=1)
+    escaped = ((idx - 1 - prev_last) % 2) == 1
+    escaper = bs & ~escaped
+    has_unicode = jnp.any(escaped & (data == ord("u")) & live, axis=1) \
+        & is_str
+    mapped = data
+    for src, dst in ((ord("n"), ord("\n")), (ord("t"), ord("\t")),
+                     (ord("r"), ord("\r")), (ord("b"), ord("\b")),
+                     (ord("f"), ord("\f"))):
+        mapped = jnp.where(escaped & (data == src), dst, mapped)
+    keep = live & ~(escaper & is_str[:, None])
+    use_map = jnp.where(is_str[:, None], mapped, data)
+    from .strings import _compact_bytes
+    out, ln = _compact_bytes(use_map, keep)
+    return out, ln, has_unicode
+
+
+@dataclass(frozen=True, eq=False)
+class GetJsonObject(Expression):
+    """get_json_object(json, '$.path') — literal path."""
+
+    child: Expression
+    path: Expression
+
+    @property
+    def children(self):
+        return (self.child, self.path)
+
+    def with_children(self, c):
+        return GetJsonObject(c[0], c[1])
+
+    def _steps(self):
+        if not isinstance(self.path, Literal):
+            raise JsonPathUnsupported("json path must be a literal")
+        return parse_json_path(str(self.path.value))
+
+    def device_unsupported_reason(self):
+        try:
+            self._steps()
+        except JsonPathUnsupported as e:
+            return str(e)
+        return None
+
+    @property
+    def dtype(self):
+        return T.string(self.child.dtype.max_len)
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        data, lengths = c.data, c.lengths
+        valid = c.validity
+        is_str = jnp.zeros(batch.capacity, bool)
+        steps = self._steps()
+        if not steps:
+            # "$" returns the (trimmed) document itself
+            data, lengths = _trim_ws(data, lengths)
+        for step in steps:
+            data, lengths, valid, is_str = _extract_step(
+                data, lengths, valid, step)
+        data, lengths, has_unicode = _decode_escapes(data, lengths, is_str)
+        valid = valid & ~has_unicode
+        ml = data.shape[1]
+        return _string_column(data, jnp.where(valid, lengths, 0), valid,
+                              ml)
+
+
+@dataclass(frozen=True, eq=False)
+class JsonToStructs(Expression):
+    """from_json for FLAT structs of primitive fields: only meaningful
+    under a GetStructField projection, which the planner rewrites to
+    get_json_object + cast (GpuJsonToStructs analogue). Standalone struct
+    output has no device storage -> CPU fallback."""
+
+    child: Optional[Expression] = None
+    schema: Optional[T.SqlType] = None
+    field_names: Tuple[str, ...] = ()
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return JsonToStructs(c[0], self.schema, self.field_names)
+
+    @property
+    def dtype(self):
+        return self.schema
+
+    def device_unsupported_reason(self):
+        return ("from_json produces a struct column (no device storage); "
+                "project individual fields so the planner can rewrite to "
+                "get_json_object")
+
+    def eval(self, batch, ctx=EvalContext()):
+        raise JsonPathUnsupported("JsonToStructs has no direct device eval")

@@ -1126,3 +1126,362 @@ class Soundex(Expression):
         data = jnp.where(first_is_letter[:, None], sx, orig)
         lengths = jnp.where(first_is_letter, sx_len, c.lengths)
         return _string_column(data, lengths, c.validity, out_ml)
+
+
+@dataclass(frozen=True, eq=False)
+class ConcatWs(Expression):
+    """concat_ws(sep, s1, s2, ...): skips NULL inputs (unlike concat);
+    null only when the separator is null (reference: GpuOverrides
+    concat_ws rule). Literal separator."""
+
+    sep: Expression
+    exprs: Tuple[Expression, ...]
+
+    @property
+    def children(self):
+        return (self.sep,) + self.exprs
+
+    def with_children(self, c):
+        return ConcatWs(c[0], tuple(c[1:]))
+
+    @property
+    def nullable(self):
+        return self.sep.nullable
+
+    def device_unsupported_reason(self):
+        from .base import Literal
+        if not isinstance(self.sep, Literal):
+            return "concat_ws separator must be a literal"
+        return None
+
+    def _sep(self):
+        from .base import Literal
+        assert isinstance(self.sep, Literal)
+        if self.sep.value is None:
+            return None          # null separator -> all-null result
+        return str(self.sep.value).encode("utf-8")
+
+    @property
+    def dtype(self):
+        from .base import Literal
+        total = sum(e.dtype.max_len for e in self.exprs)
+        if isinstance(self.sep, Literal):
+            sep_len = len(self._sep() or b"")
+        else:
+            sep_len = self.sep.dtype.max_len   # planner still needs a type
+        total += sep_len * max(len(self.exprs) - 1, 0)
+        return T.string(max(total, 1))
+
+    def eval(self, batch, ctx=EvalContext()):
+        sep = self._sep()
+        out_ml = self.dtype.max_len
+        if sep is None:
+            n = batch.capacity
+            return _string_column(jnp.zeros((n, out_ml), jnp.uint8),
+                                  jnp.zeros(n, jnp.int32),
+                                  jnp.zeros(n, bool), out_ml)
+        cols = [e.eval(batch, ctx) for e in self.exprs]
+        n = batch.capacity
+        flat = jnp.zeros(n * out_ml + 1, jnp.uint8)
+        offset = jnp.zeros(n, jnp.int32)
+        rows = jnp.arange(n)[:, None]
+        sep_a = jnp.asarray(bytearray(sep), jnp.uint8) if sep else None
+        seen = jnp.zeros(n, bool)    # a non-null value already emitted
+        for c in cols:
+            ml = c.data.shape[1]
+            lengths = jnp.where(c.validity, c.lengths, 0)
+            # separator before this value when something precedes it
+            if sep_a is not None and len(sep) > 0:
+                put_sep = seen & c.validity
+                tgt = jnp.where(put_sep[:, None],
+                                rows * out_ml + offset[:, None]
+                                + jnp.arange(len(sep))[None, :],
+                                n * out_ml)
+                flat = flat.at[tgt.reshape(-1)].set(
+                    jnp.broadcast_to(sep_a, (n, len(sep))).reshape(-1),
+                    mode="drop")
+                offset = offset + jnp.where(put_sep, len(sep), 0)
+            in_str = (jnp.arange(ml)[None, :] < lengths[:, None]) \
+                & c.validity[:, None]
+            target = jnp.where(in_str,
+                               rows * out_ml + offset[:, None]
+                               + jnp.arange(ml)[None, :],
+                               n * out_ml)
+            flat = flat.at[target.reshape(-1)].set(c.data.reshape(-1),
+                                                   mode="drop")
+            offset = offset + lengths
+            seen = seen | c.validity
+        out = flat[: n * out_ml].reshape(n, out_ml)
+        validity = batch.row_mask()
+        return _string_column(out, jnp.minimum(offset, out_ml), validity,
+                              out_ml)
+
+
+@dataclass(frozen=True, eq=False)
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count): prefix before the count-th
+    delimiter (count<0: suffix after the |count|-th from the right).
+    Literal delimiter (reference: GpuSubstringIndex — same restriction)."""
+
+    child: Expression
+    delim: Expression
+    count: Expression
+
+    @property
+    def children(self):
+        return (self.child, self.delim, self.count)
+
+    def with_children(self, c):
+        return SubstringIndex(c[0], c[1], c[2])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def device_unsupported_reason(self):
+        from .base import Literal
+        if not (isinstance(self.delim, Literal)
+                and isinstance(self.count, Literal)):
+            return "substring_index delimiter/count must be literals"
+        return None
+
+    def _parts(self):
+        from .base import Literal
+        assert isinstance(self.delim, Literal) and \
+            isinstance(self.count, Literal)
+        return str(self.delim.value).encode("utf-8"), int(self.count.value)
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        delim, cnt = self._parts()
+        ml = c.data.shape[1]
+        if cnt == 0 or not delim:
+            return _string_column(jnp.zeros_like(c.data),
+                                  jnp.zeros_like(c.lengths), c.validity, ml)
+        m = _window_match(c.data, c.lengths, delim)
+        occ = jnp.cumsum(m.astype(jnp.int32), axis=1)   # occurrences so far
+        total = occ[:, -1]
+        k = len(delim)
+        idx = jnp.arange(ml)[None, :]
+        if cnt > 0:
+            # end = start of the cnt-th occurrence (whole string if fewer)
+            hit = m & (occ == cnt)
+            pos = jnp.where(jnp.any(hit, axis=1),
+                            jnp.argmax(hit, axis=1).astype(jnp.int32),
+                            c.lengths)
+            data = jnp.where(idx < pos[:, None], c.data, 0)
+            return _string_column(data, pos, c.validity, ml)
+        # negative: start after the (total+cnt)-th occurrence's end
+        want = total + cnt   # index of the occurrence BEFORE the suffix
+        hit = m & (occ == jnp.maximum(want, 0)[:, None] + 1)
+        has = (want >= 0) & jnp.any(hit, axis=1)
+        start = jnp.where(has,
+                          jnp.argmax(hit, axis=1).astype(jnp.int32) + k,
+                          0)
+        new_len = jnp.maximum(c.lengths - start, 0)
+        # shift left by start (per-row roll via gather)
+        gather_idx = jnp.clip(idx + start[:, None], 0, ml - 1)
+        data = jnp.take_along_axis(c.data, gather_idx, axis=1)
+        data = jnp.where(idx < new_len[:, None], data, 0)
+        return _string_column(data, new_len, c.validity, ml)
+
+
+_HEX_DIGITS = jnp.asarray(bytearray(b"0123456789ABCDEF"), jnp.uint8)
+
+
+@dataclass(frozen=True, eq=False)
+class Hex(Expression):
+    """hex(bigint) / hex(string): uppercase hex, no leading zeros for
+    numbers (two's complement for negatives), per-byte for strings."""
+
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Hex(c[0])
+
+    @property
+    def dtype(self):
+        from ..types import TypeKind as K
+        if self.child.dtype.kind is K.STRING:
+            return T.string(max(self.child.dtype.max_len * 2, 1))
+        return T.string(16)
+
+    def eval(self, batch, ctx=EvalContext()):
+        from ..types import TypeKind as K
+        c = self.child.eval(batch, ctx)
+        if self.child.dtype.kind is K.STRING:
+            ml = c.data.shape[1]
+            hi = jnp.take(_HEX_DIGITS, (c.data >> 4).astype(jnp.int32))
+            lo = jnp.take(_HEX_DIGITS, (c.data & 15).astype(jnp.int32))
+            out = jnp.stack([hi, lo], axis=2).reshape(c.data.shape[0],
+                                                      2 * ml)
+            return _string_column(out, c.lengths * 2, c.validity, 2 * ml)
+        v = c.data.astype(jnp.int64).astype(jnp.uint64)
+        n = batch.capacity
+        digs = []
+        for d in range(16):
+            nib = ((v >> jnp.uint64(4 * (15 - d))) & jnp.uint64(15)) \
+                .astype(jnp.int32)
+            digs.append(jnp.take(_HEX_DIGITS, nib))
+        mat = jnp.stack(digs, axis=1)                       # [n, 16]
+        nz = mat != ord("0")
+        first = jnp.where(jnp.any(nz, axis=1),
+                          jnp.argmax(nz, axis=1).astype(jnp.int32), 15)
+        length = 16 - first
+        idx = jnp.arange(16)[None, :]
+        shifted = jnp.take_along_axis(
+            mat, jnp.clip(idx + first[:, None], 0, 15), axis=1)
+        data = jnp.where(idx < length[:, None], shifted, 0)
+        return _string_column(data, length, c.validity, 16)
+
+
+@dataclass(frozen=True, eq=False)
+class Bin(Expression):
+    """bin(bigint): binary string, no leading zeros (two's complement)."""
+
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Bin(c[0])
+
+    @property
+    def dtype(self):
+        return T.string(64)
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        v = c.data.astype(jnp.int64).astype(jnp.uint64)
+        bits = []
+        for d in range(64):
+            b = ((v >> jnp.uint64(63 - d)) & jnp.uint64(1)).astype(jnp.uint8)
+            bits.append(b + ord("0"))
+        mat = jnp.stack(bits, axis=1)
+        nz = mat != ord("0")
+        first = jnp.where(jnp.any(nz, axis=1),
+                          jnp.argmax(nz, axis=1).astype(jnp.int32), 63)
+        length = 64 - first
+        idx = jnp.arange(64)[None, :]
+        shifted = jnp.take_along_axis(
+            mat, jnp.clip(idx + first[:, None], 0, 63), axis=1)
+        data = jnp.where(idx < length[:, None], shifted, 0)
+        return _string_column(data, length, c.validity, 64)
+
+
+@dataclass(frozen=True, eq=False)
+class Conv(Expression):
+    """conv(numstr, from_base, to_base): base conversion with LITERAL
+    bases 2..36 (reference: GpuConv — same literal restriction). Follows
+    Spark: parses the longest valid prefix, empty/invalid -> "0"; negative
+    inputs are interpreted via unsigned 64-bit wraparound when to_base>0."""
+
+    child: Expression
+    from_base: Expression
+    to_base: Expression
+
+    @property
+    def children(self):
+        return (self.child, self.from_base, self.to_base)
+
+    def with_children(self, c):
+        return Conv(c[0], c[1], c[2])
+
+    @property
+    def dtype(self):
+        return T.string(65)
+
+    def device_unsupported_reason(self):
+        from .base import Literal
+        if not (isinstance(self.from_base, Literal)
+                and isinstance(self.to_base, Literal)):
+            return "conv bases must be literals"
+        return None
+
+    def _bases(self):
+        from .base import Literal
+        assert isinstance(self.from_base, Literal) and \
+            isinstance(self.to_base, Literal)
+        return int(self.from_base.value), int(self.to_base.value)
+
+    def eval(self, batch, ctx=EvalContext()):
+        fb, tb = self._bases()
+        c = self.child.eval(batch, ctx)
+        validity = c.validity
+        if not (2 <= fb <= 36 and 2 <= abs(tb) <= 36):
+            return _string_column(
+                jnp.zeros((batch.capacity, 65), jnp.uint8),
+                jnp.zeros(batch.capacity, jnp.int32),
+                jnp.zeros(batch.capacity, bool), 65)
+        data, lengths = c.data, c.lengths
+        n, ml = data.shape
+        # parse: optional '-', then digits of from_base (longest prefix)
+        neg = (lengths > 0) & (data[:, 0] == ord("-"))
+        start = neg.astype(jnp.int32)
+        up = jnp.where((data >= ord("a")) & (data <= ord("z")),
+                       data - 32, data)
+        digit = jnp.where((up >= ord("0")) & (up <= ord("9")),
+                          up - ord("0"),
+                          jnp.where((up >= ord("A")) & (up <= ord("Z")),
+                                    up - ord("A") + 10, 99)).astype(jnp.int32)
+        idx = jnp.arange(ml)[None, :]
+        in_range = (idx >= start[:, None]) & (idx < lengths[:, None])
+        ok = in_range & (digit < fb)
+        # longest valid prefix: stop at first non-digit
+        bad_before = jnp.cumsum((in_range & ~(digit < fb)).astype(jnp.int32),
+                                axis=1)
+        use = ok & (bad_before == 0)
+        v = jnp.zeros(n, jnp.uint64)
+        for j in range(ml):
+            d = digit[:, j].astype(jnp.uint64)
+            v = jnp.where(use[:, j], v * jnp.uint64(fb) + d, v)
+        any_digit = jnp.any(use, axis=1)
+        # Spark: negative input with to_base>0 wraps as unsigned 64-bit
+        v = jnp.where(neg & any_digit, (~v) + jnp.uint64(1), v)
+        signed_out = tb < 0
+        ab = abs(tb)
+        if signed_out:
+            sv = v.astype(jnp.int64)
+            out_neg = sv < 0
+            mag = jnp.where(out_neg, (-sv), sv).astype(jnp.uint64)
+        else:
+            out_neg = jnp.zeros(n, bool)
+            mag = v
+        # emit digits most-significant first into 64 slots
+        digs = []
+        cur = mag
+        for _ in range(64):
+            digs.append((cur % jnp.uint64(ab)).astype(jnp.int32))
+            cur = cur // jnp.uint64(ab)
+        mat = jnp.stack(digs[::-1], axis=1)                  # [n, 64]
+        ch = jnp.take(_HEX_DIGITS, jnp.clip(mat, 0, 15))
+        # digits >= 16 need letters beyond F
+        ch = jnp.where(mat >= 16, (mat - 10 + ord("A")).astype(jnp.uint8),
+                       ch)
+        nz = mat != 0
+        first = jnp.where(jnp.any(nz, axis=1),
+                          jnp.argmax(nz, axis=1).astype(jnp.int32), 63)
+        length = 64 - first
+        pos = jnp.arange(65)[None, :]
+        shifted = jnp.take_along_axis(
+            jnp.pad(ch, ((0, 0), (0, 1))),
+            jnp.clip(pos + first[:, None], 0, 64), axis=1)
+        body = jnp.where(pos < length[:, None], shifted, 0)
+        # prepend '-' for signed negative output
+        out = jnp.where(out_neg[:, None],
+                        jnp.concatenate([jnp.full((n, 1), ord("-"),
+                                                  jnp.uint8),
+                                         body[:, :-1]], axis=1),
+                        body)
+        out_len = length + out_neg.astype(jnp.int32)
+        out_len = jnp.where(any_digit, out_len, 1)
+        out = jnp.where(any_digit[:, None], out,
+                        jnp.pad(jnp.full((n, 1), ord("0"), jnp.uint8),
+                                ((0, 0), (0, 64))))
+        return _string_column(out, out_len, validity, 65)

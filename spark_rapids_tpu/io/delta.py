@@ -9,8 +9,8 @@ data files, optimistic concurrency via O_EXCL commit-file creation, row-
 level DELETE/UPDATE as copy-on-write file rewrites executed by the TPU
 engine, snapshot isolation and time travel by log replay.
 
-(MERGE INTO and z-ordered layout land in a later round; the log protocol
-here already carries what they need.)
+MERGE INTO (cardinality-checked, all WHEN clauses) and z-order clustered
+writes are implemented below (GpuMergeIntoCommand / ZOrderRules analogues).
 """
 
 from __future__ import annotations

@@ -154,35 +154,6 @@ class ParquetSource(FileSource):
             t = pq.read_table(path, columns=self.columns)
         return rebase_legacy_datetimes(t, self.rebase_mode, path)
 
-    def read_split(self, files):
-        """MULTITHREADED parquet decode at FRAGMENT granularity: one
-        dataset over the split (one metadata pass), per-file fragments
-        decoded on the shared reader pool, results streamed in file order
-        while later fragments still decode (reference:
-        MultiFileCloudPartitionReaderBase's background-read pipeline)."""
-        from .source import ReaderType, reader_pool
-        if self.effective_reader() is not ReaderType.MULTITHREADED:
-            yield from super().read_split(files)
-            return
-        import pyarrow.dataset as ds
-        filt = expression_to_arrow_filter(self.predicate) \
-            if self.predicate is not None else None
-        dataset = ds.dataset(list(files), format="parquet")
-        frags = list(dataset.get_fragments())
-        pool = reader_pool(self.num_threads)
-
-        def decode(frag):
-            t = frag.to_table(columns=self.columns, filter=filt)
-            return rebase_legacy_datetimes(t, self.rebase_mode, frag.path)
-
-        futures = [(f.path, pool.submit(decode, f)) for f in frags]
-        for path, fut in futures:
-            t = self._decorate(fut.result(), path)
-            for off in range(0, max(t.num_rows, 1), self.batch_rows):
-                yield t.slice(off, self.batch_rows)
-                if t.num_rows == 0:
-                    break
-
     def row_group_counts(self, path: str) -> List[int]:
         f = pq.ParquetFile(path)
         return [f.metadata.row_group(i).num_rows

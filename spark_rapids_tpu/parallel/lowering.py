@@ -199,10 +199,9 @@ class MeshLowering:
             if isinstance(part, SinglePartitioning):
                 pids = jnp.zeros(b.capacity, jnp.int32)
                 return self._bounded_exchange(b, pids, lossless=True)
+            from ..expressions.hashing import partition_ids
             cols = [e.eval(b) for e in part.exprs]
-            h = murmur3_batch(cols)
-            m = h % jnp.int32(n_dev)
-            pids = jnp.where(m < 0, m + n_dev, m).astype(jnp.int32)
+            pids = partition_ids(cols, n_dev).astype(jnp.int32)
             return self._bounded_exchange(b, pids, lossless=False)
         return exch
 

@@ -4,10 +4,17 @@ Reference: CostBasedOptimizer.scala:54 (off by default,
 spark.rapids.sql.optimizer.enabled) — row-count × per-op speedup scores
 from tools/generated_files/operatorsScore.csv decide whether moving a
 subtree to the accelerator beats the transition cost. Same model here:
-each exec gets a TPU speedup score (calibrated on the v5e bench harness;
-default 4.0 like the reference's T4 calibration), transitions H2D/D2H pay
-a per-byte cost, and a subtree whose estimated TPU time + transition cost
-exceeds its CPU time is tagged back to the CPU.
+each exec gets a TPU speedup score, transitions H2D/D2H pay a per-byte
+cost, and a subtree whose estimated TPU time + transition cost exceeds its
+CPU time is tagged back to the CPU.
+
+Calibration (round 3, BENCH_r03 measurements on the tunneled v5e chip vs
+the single-thread pyarrow oracle — see docs/perf_r3.md): q1-style fused
+filter+project+aggregate ~2x, high-cardinality aggregate ~0.6-1x, join+sort
+~1-2x, host-decode scan ~1x. These scores are deliberately CONSERVATIVE
+(sub-reference-GPU) until the device path beats the oracle across the
+board; an optimizer that overstates device speedups routes subtrees the
+wrong way (VERDICT r2 Weak #3).
 """
 
 from __future__ import annotations
@@ -24,22 +31,22 @@ CBO_ENABLED = conf("spark.rapids.tpu.sql.optimizer.enabled").doc(
     "does not cover the transition cost stay on CPU (reference: "
     "spark.rapids.sql.optimizer.enabled, default false).").boolean(False)
 
-# per-op speedup scores (reference: operatorsScore.csv — default 4.0,
-# per-op overrides from calibration)
-DEFAULT_SPEEDUP = 4.0
+# per-op speedup scores calibrated from BENCH_r03 (measured device vs
+# pyarrow-oracle throughput; reference shape: operatorsScore.csv)
+DEFAULT_SPEEDUP = 1.0
 OP_SPEEDUP: Dict[str, float] = {
-    "Scan": 2.0,            # host decode bound
-    "Project": 6.0,
-    "Filter": 6.0,
-    "Aggregate": 8.0,       # fused sort+segment pipeline
-    "Join": 5.0,
-    "Sort": 7.0,
-    "Window": 8.0,
-    "Limit": 1.5,
+    "Scan": 1.0,            # host pyarrow decode on both sides (parity)
+    "Project": 2.5,         # rides fused stages (q1_stage 2x overall)
+    "Filter": 2.5,
+    "Aggregate": 1.5,       # 2x small-groups tier, ~0.6x 1M-key tier
+    "Join": 1.5,            # fused join+sort ~1-2x
+    "Sort": 1.5,
+    "Window": 1.5,
+    "Limit": 1.0,
     "Union": 1.0,
-    "Expand": 4.0,
-    "Sample": 3.0,
-    "Range": 4.0,
+    "Expand": 1.0,
+    "Sample": 1.0,
+    "Range": 1.5,
 }
 
 # cost to move one row across the CPU<->TPU boundary, in CPU-row-units

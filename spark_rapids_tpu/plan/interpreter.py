@@ -1758,3 +1758,315 @@ class _NegKey:
 
     def __eq__(self, other):
         return self.k == other.k
+
+
+# ---------------------------------------------------------------------------
+# Round-3 breadth expressions (row semantics for CPU-fallback islands)
+# ---------------------------------------------------------------------------
+
+def _rw_shift(self, e, row):
+    v = self.eval(e.left, row)
+    a = self.eval(e.right, row)
+    if v is None or a is None:
+        return None
+    from .. import types as T
+    wide = e.left.dtype.kind is T.TypeKind.INT64
+    width = 64 if wide else 32
+    mask = (1 << width) - 1
+    a = a % width
+    if e.op == "left":
+        out = (v << a) & mask
+    elif e.op == "right":
+        return v >> a
+    else:
+        out = (v & mask) >> a
+    if out >= 1 << (width - 1):
+        out -= 1 << width
+    return out
+
+
+def _rw_concat_ws(self, e, row):
+    sep = self.eval(e.sep, row)
+    if sep is None:
+        return None
+    parts = [self.eval(c, row) for c in e.exprs]
+    return sep.join(p for p in parts if p is not None)
+
+
+def _rw_substring_index(self, e, row):
+    v = self.eval(e.child, row)
+    d = self.eval(e.delim, row)
+    c = self.eval(e.count, row)
+    if v is None or d is None or c is None:
+        return None
+    if c == 0 or not d:
+        return ""
+    if c > 0:
+        parts = v.split(d)
+        return d.join(parts[:c]) if len(parts) > c else v
+    parts = v.split(d)
+    k = -c
+    return d.join(parts[-k:]) if len(parts) > k else v
+
+
+def _rw_hex(self, e, row):
+    v = self.eval(e.child, row)
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v.encode("utf-8").hex().upper()
+    return format(v & ((1 << 64) - 1), "X")
+
+
+def _rw_bin(self, e, row):
+    v = self.eval(e.child, row)
+    if v is None:
+        return None
+    return format(v & ((1 << 64) - 1), "b")
+
+
+def _rw_conv(self, e, row):
+    v = self.eval(e.child, row)
+    fb = self.eval(e.from_base, row)
+    tb = self.eval(e.to_base, row)
+    if v is None or fb is None or tb is None:
+        return None
+    if not (2 <= fb <= 36 and 2 <= abs(tb) <= 36):
+        return None
+    s = str(v).strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    digits = "0123456789abcdefghijklmnopqrstuvwxyz"[:fb]
+    acc = 0
+    any_d = False
+    for ch in s.lower():
+        if ch not in digits:
+            break
+        acc = acc * fb + digits.index(ch)
+        any_d = True
+    if not any_d:
+        return "0"
+    if neg:
+        acc = ((~acc) + 1) & ((1 << 64) - 1)
+    if tb < 0:
+        if acc >= 1 << 63:
+            acc -= 1 << 64
+        sign = "-" if acc < 0 else ""
+        acc = abs(acc)
+        tb = -tb
+    else:
+        sign = ""
+    out = ""
+    ds = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    while acc:
+        out = ds[acc % tb] + out
+        acc //= tb
+    return sign + (out or "0")
+
+
+def _rw_xxhash64(self, e, row):
+    # reuse the exact device implementation on scalars
+    import numpy as np
+    import jax.numpy as jnp
+    from ..batch import DeviceColumn
+    from ..expressions.hashing import xxhash64_column
+    from .. import types as T
+    h = jnp.full(1, e.seed, jnp.uint64)
+    for c in e.exprs:
+        v = self.eval(c, row)
+        dt = c.dtype
+        if dt.kind is T.TypeKind.STRING:
+            b = (v or "").encode("utf-8")
+            data = np.zeros((1, max(len(b), 1)), np.uint8)
+            data[0, :len(b)] = np.frombuffer(b, np.uint8)
+            col = DeviceColumn(jnp.asarray(data),
+                               jnp.asarray([v is not None]),
+                               jnp.asarray([len(b)], jnp.int32), dt)
+        else:
+            col = DeviceColumn(
+                jnp.asarray([v if v is not None else 0],
+                            dt.storage_dtype),
+                jnp.asarray([v is not None]), None, dt)
+        h = xxhash64_column(col, h)
+    return int(jnp.asarray(h.astype(jnp.int64))[0])
+
+
+def _rw_array_distinct(self, e, row):
+    v = self.eval(e.child, row)
+    if v is None:
+        return None
+    out = []
+    for x in v:
+        if x not in out:
+            out.append(x)
+    return out
+
+
+def _rw_array_union(self, e, row):
+    a = self.eval(e.left, row)
+    b = self.eval(e.right, row)
+    if a is None or b is None:
+        return None
+    out = []
+    for x in list(a) + list(b):
+        if x not in out:
+            out.append(x)
+    return out
+
+
+def _rw_array_intersect(self, e, row):
+    a = self.eval(e.left, row)
+    b = self.eval(e.right, row)
+    if a is None or b is None:
+        return None
+    out = []
+    for x in a:
+        if x in b and x not in out:
+            out.append(x)
+    return out
+
+
+def _rw_array_except(self, e, row):
+    a = self.eval(e.left, row)
+    b = self.eval(e.right, row)
+    if a is None or b is None:
+        return None
+    out = []
+    for x in a:
+        if x not in b and x not in out:
+            out.append(x)
+    return out
+
+
+def _rw_arrays_overlap(self, e, row):
+    a = self.eval(e.left, row)
+    b = self.eval(e.right, row)
+    if a is None or b is None:
+        return None
+    return any(x in b for x in a)
+
+
+def _rw_array_remove(self, e, row):
+    a = self.eval(e.child, row)
+    v = self.eval(e.value, row)
+    if a is None or v is None:
+        return None
+    return [x for x in a if x != v]
+
+
+def _rw_array_position(self, e, row):
+    a = self.eval(e.child, row)
+    v = self.eval(e.value, row)
+    if a is None or v is None:
+        return None
+    for i, x in enumerate(a):
+        if x == v:
+            return i + 1
+    return 0
+
+
+def _rw_array_repeat(self, e, row):
+    v = self.eval(e.value, row)
+    n = self.eval(e.count, row)
+    if n is None:
+        return None
+    return [v] * max(n, 0)
+
+
+def _rw_array_slice(self, e, row):
+    a = self.eval(e.child, row)
+    s = self.eval(e.start, row)
+    ln = self.eval(e.length, row)
+    if a is None or s is None or ln is None:
+        return None
+    if s == 0 or ln < 0:
+        raise ArithmeticError("slice: invalid start/length")
+    begin = s - 1 if s > 0 else len(a) + s
+    if begin < 0:
+        return []
+    return list(a[begin:begin + ln])
+
+
+def _rw_sequence(self, e, row):
+    lo = self.eval(e.start, row)
+    hi = self.eval(e.stop, row)
+    st = self.eval(e.step, row) if e.step is not None else None
+    if lo is None or hi is None:
+        return None
+    if st is None:
+        st = 1 if hi >= lo else -1
+    if st == 0:
+        return None
+    out = []
+    x = lo
+    while (st > 0 and x <= hi) or (st < 0 and x >= hi):
+        out.append(x)
+        x += st
+    return out
+
+
+def _rw_flatten(self, e, row):
+    v = self.eval(e.child, row)
+    if v is None:
+        return None
+    out = []
+    for sub in v:
+        if sub is None:
+            return None
+        out.extend(sub)
+    return out
+
+
+def _rw_get_json_object(self, e, row):
+    import json as _json
+    v = self.eval(e.child, row)
+    p = self.eval(e.path, row)
+    if v is None or p is None:
+        return None
+    from ..expressions.json import parse_json_path, JsonPathUnsupported
+    try:
+        steps = parse_json_path(p)
+        doc = _json.loads(v)
+    except (JsonPathUnsupported, ValueError):
+        return None
+    cur = doc
+    for s in steps:
+        try:
+            cur = cur[s]
+        except (KeyError, IndexError, TypeError):
+            return None
+    if cur is None:
+        return None
+    if isinstance(cur, str):
+        return cur
+    if isinstance(cur, bool):
+        return "true" if cur else "false"
+    if isinstance(cur, (dict, list)):
+        return _json.dumps(cur, separators=(", ", ": "))
+    return str(cur)
+
+
+def _install_breadth_rows(cls):
+    cls._eval_Shift = _rw_shift
+    cls._eval_ConcatWs = _rw_concat_ws
+    cls._eval_SubstringIndex = _rw_substring_index
+    cls._eval_Hex = _rw_hex
+    cls._eval_Bin = _rw_bin
+    cls._eval_Conv = _rw_conv
+    cls._eval_XxHash64 = _rw_xxhash64
+    cls._eval_ArrayDistinct = _rw_array_distinct
+    cls._eval_ArrayUnion = _rw_array_union
+    cls._eval_ArrayIntersect = _rw_array_intersect
+    cls._eval_ArrayExcept = _rw_array_except
+    cls._eval_ArraysOverlap = _rw_arrays_overlap
+    cls._eval_ArrayRemove = _rw_array_remove
+    cls._eval_ArrayPosition = _rw_array_position
+    cls._eval_ArrayRepeat = _rw_array_repeat
+    cls._eval_ArraySlice = _rw_array_slice
+    cls._eval_Sequence = _rw_sequence
+    cls._eval_Flatten = _rw_flatten
+    cls._eval_GetJsonObject = _rw_get_json_object
+
+
+_install_breadth_rows(RowEvaluator)

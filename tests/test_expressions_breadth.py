@@ -1,0 +1,244 @@
+"""Round-3 expression breadth (VERDICT r2 Missing #3): shifts, xxhash64,
+hex/bin/conv, concat_ws/substring_index, array set ops/slice/sequence/
+flatten, map HOFs, zip_with, JSON extraction — each differentially checked
+against a Python/Spark-semantics oracle."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec import InMemoryScanExec, ProjectExec
+from spark_rapids_tpu.exec.base import collect
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.arithmetic import Shift
+from spark_rapids_tpu.expressions.collections import (
+    ArrayDistinct, ArrayExcept, ArrayIntersect, ArrayPosition, ArrayRemove,
+    ArrayRepeat, ArraySlice, ArrayUnion, ArraysOverlap, CreateArray,
+    Flatten, GetStructField, LambdaVariable, MapFilter, Sequence,
+    TransformKeys, TransformValues, ZipWith)
+from spark_rapids_tpu.expressions.hashing import XxHash64
+from spark_rapids_tpu.expressions.json import (GetJsonObject, JsonToStructs,
+                                               parse_json_path,
+                                               JsonPathUnsupported)
+from spark_rapids_tpu.expressions.strings import (Bin, ConcatWs, Conv, Hex,
+                                                  SubstringIndex)
+
+
+def _project(table, exprs):
+    return collect(ProjectExec(exprs, InMemoryScanExec(table)))
+
+
+def test_shifts_java_semantics():
+    ivals = [1, -8, 2**31 - 1, None]
+    lvals = [1, -8, 2**62, None]
+    by = [33, 1, 4, 2]
+    t = pa.table({"i": pa.array(ivals, pa.int32()),
+                  "l": pa.array(lvals, pa.int64()),
+                  "by": pa.array(by, pa.int32())})
+    out = _project(t, [
+        Shift(col("i"), col("by"), "left").alias("shl"),
+        Shift(col("i"), col("by"), "right").alias("shr"),
+        Shift(col("i"), col("by"), "right_unsigned").alias("shru"),
+        Shift(col("l"), col("by"), "left").alias("lshl"),
+    ])
+
+    def j32(v):   # two's-complement int32 wrap
+        return int(np.int32(np.uint32(v % 2**32)))
+
+    def j64(v):
+        return int(np.int64(np.uint64(v % 2**64)))
+
+    # Java: shift amount wraps mod the operand width
+    exp_shl = [None if v is None else j32(v << (b % 32))
+               for v, b in zip(ivals, by)]
+    exp_shr = [None if v is None else v >> (b % 32)
+               for v, b in zip(ivals, by)]
+    exp_shru = [None if v is None else (v % 2**32) >> (b % 32)
+                for v, b in zip(ivals, by)]
+    exp_shru = [None if v is None else j32(v) for v in exp_shru]
+    exp_lshl = [None if v is None else j64(v << (b % 64))
+                for v, b in zip(lvals, by)]
+    assert out.column("shl").to_pylist() == exp_shl
+    assert out.column("shr").to_pylist() == exp_shr
+    assert out.column("shru").to_pylist() == exp_shru
+    assert out.column("lshl").to_pylist() == exp_lshl
+
+
+def test_xxhash64_reference_vectors():
+    # reference values computed from the XXH64 spec implementation
+    M = (1 << 64) - 1
+    P1, P2, P3 = 0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9
+    P4, P5 = 0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    def aval(h):
+        h ^= h >> 33
+        h = (h * P2) & M
+        h ^= h >> 29
+        h = (h * P3) & M
+        return h ^ (h >> 32)
+
+    def ref_long(v, seed=42):
+        h = (seed + P5 + 8) & M
+        k1 = (rotl((v & M) * P2 & M, 31) * P1) & M
+        return aval((rotl(h ^ k1, 27) * P1 + P4) & M)
+
+    vals = [0, 1, -1, 123456789123456789]
+    t = pa.table({"v": pa.array(vals, pa.int64())})
+    out = _project(t, [XxHash64((col("v"),)).alias("h")])
+    got = [x & M for x in out.column("h").to_pylist()]
+    assert got == [ref_long(v) for v in vals]
+
+
+def test_hex_bin_conv():
+    t = pa.table({"n": pa.array([255, -1, 0, None], pa.int64()),
+                  "s": pa.array(["ff", "7b", "zz", "-10"])})
+    out = _project(t, [
+        Hex(col("n")).alias("hx"),
+        Bin(col("n")).alias("bn"),
+        Conv(col("s"), lit(16), lit(10)).alias("cv"),
+        Conv(col("s"), lit(16), lit(-10)).alias("cvs"),
+    ])
+    assert out.column("hx").to_pylist() == ["FF", "F" * 16, "0", None]
+    assert out.column("bn").to_pylist() == ["11111111", "1" * 64, "0", None]
+    assert out.column("cv").to_pylist() == ["255", "123", "0",
+                                            str(2**64 - 16)]
+    assert out.column("cvs").to_pylist() == ["255", "123", "0", "-16"]
+
+
+def test_concat_ws_skips_nulls():
+    t = pa.table({"a": pa.array(["x", None, "y"]),
+                  "b": pa.array(["1", "2", None])})
+    out = _project(t, [ConcatWs(lit(","), (col("a"), col("b"))).alias("c")])
+    assert out.column("c").to_pylist() == ["x,1", "2", "y"]
+
+
+def test_substring_index_both_directions():
+    t = pa.table({"s": pa.array(["a.b.c", "nodot", "", None])})
+    out = _project(t, [
+        SubstringIndex(col("s"), lit("."), lit(2)).alias("p"),
+        SubstringIndex(col("s"), lit("."), lit(-1)).alias("q"),
+    ])
+    assert out.column("p").to_pylist() == ["a.b", "nodot", "", None]
+    assert out.column("q").to_pylist() == ["c", "nodot", "", None]
+
+
+ARR = pa.table({
+    "a": pa.array([[1, 2, 2, 3], [5, 5], [], None], pa.list_(pa.int64())),
+    "b": pa.array([[2, 9], [5], [1], [4]], pa.list_(pa.int64())),
+})
+
+
+def test_array_set_ops():
+    out = _project(ARR, [
+        ArrayDistinct(col("a")).alias("d"),
+        ArrayUnion(col("a"), col("b")).alias("u"),
+        ArrayIntersect(col("a"), col("b")).alias("i"),
+        ArrayExcept(col("a"), col("b")).alias("e"),
+        ArraysOverlap(col("a"), col("b")).alias("o"),
+    ])
+    assert out.column("d").to_pylist() == [[1, 2, 3], [5], [], None]
+    assert out.column("u").to_pylist() == [[1, 2, 3, 9], [5], [1], None]
+    assert out.column("i").to_pylist() == [[2], [5], [], None]
+    assert out.column("e").to_pylist() == [[1, 3], [], [], None]
+    assert out.column("o").to_pylist() == [True, True, False, None]
+
+
+def test_array_remove_position_repeat_slice():
+    out = _project(ARR, [
+        ArrayRemove(col("a"), lit(2, T.INT64)).alias("r"),
+        ArrayPosition(col("a"), lit(5, T.INT64)).alias("p"),
+        ArraySlice(col("a"), lit(2), lit(2)).alias("s"),
+        ArraySlice(col("a"), lit(-2), lit(2)).alias("neg"),
+    ])
+    assert out.column("r").to_pylist() == [[1, 3], [5, 5], [], None]
+    assert out.column("p").to_pylist() == [0, 1, 0, None]
+    assert out.column("s").to_pylist() == [[2, 2], [5], [], None]
+    assert out.column("neg").to_pylist() == [[2, 3], [5, 5], [], None]
+
+
+def test_sequence_and_flatten():
+    t = pa.table({"lo": pa.array([1, 5, 0], pa.int64()),
+                  "hi": pa.array([4, 1, 0], pa.int64())})
+    out = _project(t, [Sequence(col("lo"), col("hi")).alias("q")])
+    assert out.column("q").to_pylist() == [[1, 2, 3, 4],
+                                           [5, 4, 3, 2, 1], [0]]
+    out2 = _project(ARR, [
+        Flatten(CreateArray((col("a"), col("b")))).alias("f")])
+    assert out2.column("f").to_pylist() == [[1, 2, 2, 3, 2, 9],
+                                            [5, 5, 5], [1], None]
+
+
+MAPT = pa.table({"m": pa.array([[(1, 10), (2, 20)], [(3, 30)], []],
+                               pa.map_(pa.int64(), pa.int64()))})
+
+
+def test_map_hofs():
+    kv, vv = LambdaVariable("k", T.INT64), LambdaVariable("v", T.INT64)
+    kv2, vv2 = LambdaVariable("k", T.INT64), LambdaVariable("v", T.INT64)
+    kv3, vv3 = LambdaVariable("k", T.INT64), LambdaVariable("v", T.INT64)
+    out = _project(MAPT, [
+        TransformKeys(col("m"), kv, vv,
+                      kv + lit(100, T.INT64)).alias("tk"),
+        TransformValues(col("m"), kv2, vv2,
+                        vv2 * lit(2, T.INT64)).alias("tv"),
+        MapFilter(col("m"), kv3, vv3,
+                  vv3 > lit(15, T.INT64)).alias("mf"),
+    ])
+    assert out.column("tk").to_pylist() == [[(101, 10), (102, 20)],
+                                            [(103, 30)], []]
+    assert out.column("tv").to_pylist() == [[(1, 20), (2, 40)],
+                                            [(3, 60)], []]
+    assert out.column("mf").to_pylist() == [[(2, 20)], [(3, 30)], []]
+
+
+def test_zip_with_equal_lengths():
+    t = pa.table({"p": pa.array([[1, 2], [3]], pa.list_(pa.int64())),
+                  "q": pa.array([[10, 20], [30]], pa.list_(pa.int64()))})
+    xv, yv = LambdaVariable("x", T.INT64), LambdaVariable("y", T.INT64)
+    out = _project(t, [ZipWith(col("p"), col("q"), xv, yv,
+                               xv + yv).alias("z")])
+    assert out.column("z").to_pylist() == [[11, 22], [33]]
+
+
+def test_get_json_object_matrix():
+    docs = ['{"a": 1, "b": "x"}', '{"a": {"c": 7}}',
+            '{"b": "q\\"uo\\nte"}', '{"arr": [10, 20]}',
+            'garbage', None, '{"a": null}']
+    t = pa.table({"j": pa.array(docs)})
+    out = _project(t, [
+        GetJsonObject(col("j"), lit("$.a")).alias("a"),
+        GetJsonObject(col("j"), lit("$.b")).alias("b"),
+        GetJsonObject(col("j"), lit("$.a.c")).alias("ac"),
+        GetJsonObject(col("j"), lit("$.arr[1]")).alias("x1"),
+    ])
+    assert out.column("a").to_pylist() == ["1", '{"c": 7}', None, None,
+                                           None, None, None]
+    assert out.column("b").to_pylist() == ["x", None, 'q"uo\nte', None,
+                                           None, None, None]
+    assert out.column("ac").to_pylist() == [None, "7", None, None, None,
+                                            None, None]
+    assert out.column("x1").to_pylist() == [None, None, None, "20", None,
+                                            None, None]
+
+
+def test_json_path_gating():
+    with pytest.raises(JsonPathUnsupported):
+        parse_json_path("$..recursive")
+    with pytest.raises(JsonPathUnsupported):
+        parse_json_path("no_dollar")
+    assert parse_json_path("$.a[3].b") == ["a", 3, "b"]
+
+
+def test_from_json_field_projection():
+    t = pa.table({"j": pa.array(['{"x": 5, "y": "ab"}', '{"x": 7}',
+                                 None])})
+    js = JsonToStructs(col("j"), T.struct(T.INT64, T.string(16)),
+                       ("x", "y"))
+    out = _project(t, [GetStructField(js, 0).alias("x"),
+                       GetStructField(js, 1).alias("y")])
+    assert out.column("x").to_pylist() == [5, 7, None]
+    assert out.column("y").to_pylist() == ["ab", None, None]
