@@ -192,10 +192,9 @@ def bench_join_sort(jax, n_stream=1 << 21, n_build=1 << 18, reps=3):
     # timed region (it is part of the same program's output — a nonzero
     # flag raises, so a mis-sized run can never report a number)
     from spark_rapids_tpu.exec.fuse import try_fuse
-    # expand_factor=2: 32-bit hash collisions add ~n_probe*n_build/2^31
-    # candidate pairs on top of the true matches, which pushes a full
-    # 2M-row FK probe just past the 1x bucket
-    fused = try_fuse(plan, expand_factor=2)
+    # single-int-key joins probe EXACTLY (no hash collisions), so the
+    # 1x stream-capacity bucket is tight for FK joins
+    fused = try_fuse(plan)
     assert fused is not None, "join+sort stage did not fuse"
     program, inputs = fused.prepare()
 

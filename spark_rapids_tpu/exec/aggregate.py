@@ -373,8 +373,8 @@ class HashAggregateExec(UnaryExec):
             starts_m = jnp.where(live_slot, starts, 1)
             ends_m = jnp.where(live_slot, ends, 0)
             first_idx = jnp.take(sperm, jnp.where(live_slot, starts, 0))
-            out_cols = [gather_column(c, first_idx, live_slot)
-                        for c in key_cols]
+            from .common import gather_columns
+            out_cols = gather_columns(key_cols, first_idx, live_slot)
             res = LaneResults(lanes, seg0, starts_m, ends_m, live_slot)
             seg = jnp.where(sorted_live & (gid < L), gid, L)
             with segment_bounds(starts_m, ends_m):

@@ -48,9 +48,70 @@ def gather_column(col: DeviceColumn, indices: jax.Array,
     return DeviceColumn(data, validity, lengths, col.dtype, data2)
 
 
+def _batched_takes(arrays: Sequence[jax.Array], idx: jax.Array
+                   ) -> List[jax.Array]:
+    """Gather many same-length arrays at ONE index set with as few device
+    gathers as possible: same-dtype 1-D arrays stack into a [n, m] matrix
+    for a single row-gather (docs/perf_r3.md: a 4M-row gather costs
+    ~55-65 ms regardless of row width, and sibling gathers do NOT fuse)."""
+    from collections import defaultdict
+    byd = defaultdict(list)
+    for i, a in enumerate(arrays):
+        byd[(a.dtype, a.ndim)].append(i)
+    out: List[Optional[jax.Array]] = [None] * len(arrays)
+    for (dt, nd), idxs in byd.items():
+        if nd != 1 or len(idxs) == 1:
+            for i in idxs:
+                out[i] = jnp.take(arrays[i], idx, axis=0)
+        else:
+            m = jnp.stack([arrays[i] for i in idxs], axis=1)
+            g = jnp.take(m, idx, axis=0)
+            for j, i in enumerate(idxs):
+                out[i] = g[:, j]
+    return out
+
+
+def gather_columns(cols: Sequence[DeviceColumn], indices: jax.Array,
+                   row_valid: Optional[jax.Array] = None
+                   ) -> List[DeviceColumn]:
+    """Gather MANY columns at one index set, batching the underlying takes
+    (data lanes by dtype, all validity lanes together, lengths with other
+    int32 lanes)."""
+    if not cols:
+        return []
+    cap = cols[0].capacity
+    idx = jnp.clip(indices, 0, cap - 1)
+    flat: List[jax.Array] = []
+    slots = []      # (col_i, field_name) per flat entry
+    for i, c in enumerate(cols):
+        flat.append(c.data)
+        slots.append((i, "data"))
+        flat.append(c.validity)
+        slots.append((i, "validity"))
+        if c.lengths is not None:
+            flat.append(c.lengths)
+            slots.append((i, "lengths"))
+        if c.data2 is not None:
+            flat.append(c.data2)
+            slots.append((i, "data2"))
+    taken = _batched_takes(flat, idx)
+    parts: List[dict] = [{} for _ in cols]
+    for (i, name), arr in zip(slots, taken):
+        parts[i][name] = arr
+    out = []
+    for i, c in enumerate(cols):
+        validity = parts[i]["validity"]
+        if row_valid is not None:
+            validity = validity & row_valid
+        out.append(DeviceColumn(parts[i]["data"], validity,
+                                parts[i].get("lengths"), c.dtype,
+                                parts[i].get("data2")))
+    return out
+
+
 def gather(batch: ColumnarBatch, indices: jax.Array, num_rows: jax.Array,
            row_valid: Optional[jax.Array] = None) -> ColumnarBatch:
-    cols = tuple(gather_column(c, indices, row_valid) for c in batch.columns)
+    cols = tuple(gather_columns(batch.columns, indices, row_valid))
     return ColumnarBatch(cols, jnp.asarray(num_rows, jnp.int32))
 
 

@@ -156,10 +156,42 @@ class HashJoinExec(BinaryExec):
                 + [Field(f.name, f.dtype, f.nullable or r_nullable) for f in rf])
         self.condition = condition.bind(self._pair_schema()) if condition else None
 
+        # single fixed-width key: probe the key's orderable word EXACTLY
+        # (sorted keys ARE the hash table; zero false candidates, so the
+        # optimistic fused-output bucket never overflows on FK joins).
+        # Multi-key / float / string keys keep the 32-bit hash probe with
+        # equality verification.
+        _EXACT_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32,
+                        TypeKind.INT64, TypeKind.DATE, TypeKind.TIMESTAMP,
+                        TypeKind.BOOLEAN)
+        self._exact_probe = (
+            len(self.right_keys) == 1
+            and self.right_keys[0].dtype.kind in _EXACT_KINDS)
+
         self._build_jit = jax.jit(self._build_kernel)
         self._count_jit = jax.jit(self._count_kernel)
         self._expand_jit = jax.jit(self._expand_kernel, static_argnums=(4,))
         self._semi_jit = jax.jit(self._semi_kernel, static_argnums=(4,))
+
+    def _probe_words(self, keys, valid, build_side: bool) -> jnp.ndarray:
+        """The sorted/probed search key: exact orderable word (single
+        fixed-width key) or verified 32-bit hash."""
+        if self._exact_probe:
+            from .common import orderable_words
+            w = orderable_words(keys[0])[0]
+            if build_side:
+                # dead/invalid build rows take the MAX word so they sort
+                # last; the validity tie-break in _build_kernel puts real
+                # max-key rows BEFORE them, and _count_kernel clamps
+                # search bounds by the live count — so padding can never
+                # inflate candidate counts (a padded dim batch otherwise
+                # makes every key-0 probe match the whole dead tail)
+                return jnp.where(valid, w, ~jnp.zeros((), w.dtype))
+            return w
+        h = _hash64(keys, valid)
+        if not build_side:
+            return jnp.where(valid, h, ~jnp.uint32(0) - 1)
+        return h
 
     def _pair_schema(self) -> Schema:
         return Schema(list(self.left.output_schema.fields)
@@ -177,10 +209,14 @@ class HashJoinExec(BinaryExec):
         valid = live
         for k in keys:
             valid = valid & k.validity
-        h = _hash64(keys, valid)
+        h = self._probe_words(keys, valid, build_side=True)
         iota = jnp.arange(build.capacity, dtype=jnp.int32)
-        sorted_h, perm = jax.lax.sort([h, iota], num_keys=1)
-        return sorted_h, perm, valid
+        # tie-break on validity: equal-word VALID rows sort before dead
+        # rows, so clamping searches by n_valid is exact even for max-key
+        sorted_h, _, perm = jax.lax.sort(
+            [h, (~valid).astype(jnp.uint8), iota], num_keys=2)
+        n_valid = jnp.sum(valid.astype(jnp.int32))
+        return (sorted_h, n_valid), perm, valid
 
     def _count_kernel(self, stream: ColumnarBatch, sorted_h):
         keys = [e.eval(stream, self.ctx) for e in self.left_keys]
@@ -188,18 +224,24 @@ class HashJoinExec(BinaryExec):
         valid = live
         for k in keys:
             valid = valid & k.validity
-        # probe sentinel differs from the build sentinel: ~0 >> 1 never
-        # equals ~0, so null/dead probes find nothing.
-        # probe sentinel 0xFFFFFFFE ≠ build null sentinel 0xFFFFFFFF, and
-        # both have the top bit real hashes never set
-        h = jnp.where(valid, _hash64(keys, valid), ~jnp.uint32(0) - 1)
+        # hash path: probe sentinel 0xFFFFFFFE ≠ build null sentinel
+        # 0xFFFFFFFF, both outside the >>1 hash range, so null/dead
+        # probes find nothing. Exact path: no sentinel — counts are only
+        # taken where `valid` (below), and any invalid-build collision
+        # candidate is rejected by key-equality verification.
+        h = self._probe_words(keys, valid, build_side=False)
+        sorted_words, n_valid = sorted_h
         # method="sort": one concat-sort instead of a serialized binary
         # search (log-n dependent gather rounds) — measured 5.2x faster
         # at 4M probes on v5e
-        lo = jnp.searchsorted(sorted_h, h, side="left",
+        lo = jnp.searchsorted(sorted_words, h, side="left",
                               method="sort").astype(jnp.int32)
-        hi = jnp.searchsorted(sorted_h, h, side="right",
+        hi = jnp.searchsorted(sorted_words, h, side="right",
                               method="sort").astype(jnp.int32)
+        # dead build rows occupy [n_valid, cap): clamp them out of every
+        # candidate range
+        lo = jnp.minimum(lo, n_valid)
+        hi = jnp.minimum(hi, n_valid)
         counts = jnp.where(valid, hi - lo, 0)
         offsets = jnp.cumsum(counts)
         # int32 offsets keep the searches native-width; the 64-bit total
@@ -221,12 +263,21 @@ class HashJoinExec(BinaryExec):
         build_row = jnp.take(perm, build_pos)
         in_range = j < total
 
-        s_cols = [gather_column(c, probe_row, in_range) for c in stream.columns]
-        b_cols = [gather_column(c, build_row, in_range) for c in build.columns]
-        s_keys = [gather_column(e.eval(stream, self.ctx), probe_row)
-                  for e in self.left_keys]
-        b_keys = [gather_column(e.eval(build, self.ctx), build_row)
-                  for e in self.right_keys]
+        # ONE batched gather per side: output columns and key columns share
+        # the side's index set (docs/perf_r3.md — sibling gathers don't
+        # fuse; stacked row-gathers are width-flat)
+        from .common import gather_columns
+        s_all = gather_columns(
+            list(stream.columns)
+            + [e.eval(stream, self.ctx) for e in self.left_keys],
+            probe_row, in_range)
+        b_all = gather_columns(
+            list(build.columns)
+            + [e.eval(build, self.ctx) for e in self.right_keys],
+            build_row, in_range)
+        ns, nb = len(stream.columns), len(build.columns)
+        s_cols, s_keys = s_all[:ns], s_all[ns:]
+        b_cols, b_keys = b_all[:nb], b_all[nb:]
         pair_ok = in_range & _keys_equal(s_keys, b_keys)
         if self.condition is not None:
             pair_batch = ColumnarBatch(tuple(s_cols + b_cols), total)
@@ -238,6 +289,71 @@ class HashJoinExec(BinaryExec):
                        out_cap: int):
         build, perm = build_pack
         lo, counts, offsets = lo_counts
+        # FK fast path (the overwhelmingly common star-schema shape):
+        # when every probe has AT MOST ONE candidate, the expansion is a
+        # 1:1 row mapping — no cumulative-offset search, no out_cap-wide
+        # pair gathers, no pair compaction. Selected per batch by
+        # lax.cond; both branches produce the same [out_cap] layout.
+        if self.condition is None and \
+                self.join_type in (JoinType.INNER, JoinType.LEFT_OUTER) \
+                and out_cap >= stream.capacity:
+            unique = jnp.max(counts) <= 1
+            return jax.lax.cond(
+                unique,
+                lambda: self._expand_unique(stream, build, perm, lo,
+                                            counts, matched_build_in,
+                                            out_cap),
+                lambda: self._expand_general(stream, build, perm, lo,
+                                             counts, offsets,
+                                             matched_build_in, out_cap))
+        return self._expand_general(stream, build, perm, lo, counts,
+                                    offsets, matched_build_in, out_cap)
+
+    def _expand_unique(self, stream, build, perm, lo, counts,
+                       matched_build_in, out_cap: int):
+        """<=1 match per probe: direct row mapping at stream capacity."""
+        from .common import gather_columns
+        matched = counts > 0
+        build_pos = jnp.clip(lo, 0, build.capacity - 1)
+        build_row = jnp.take(perm, build_pos)
+        b_all = gather_columns(
+            list(build.columns)
+            + [e.eval(build, self.ctx) for e in self.right_keys],
+            build_row, matched)
+        nb = len(build.columns)
+        b_cols, b_keys = b_all[:nb], b_all[nb:]
+        s_keys = [e.eval(stream, self.ctx) for e in self.left_keys]
+        pair_ok = matched & stream.row_mask() & _keys_equal(s_keys, b_keys)
+        matched_build = matched_build_in.at[
+            jnp.where(pair_ok, build_row, build.capacity)].set(
+            True, mode="drop")
+        if self.join_type is JoinType.LEFT_OUTER:
+            # every stream row survives; unmatched rows take null builds.
+            # Pad to the general path's post-concat capacity so lax.cond
+            # sees identical output types.
+            b_cols = [c.replace(validity=c.validity & pair_ok)
+                      for c in b_cols]
+            out = ColumnarBatch(stream.columns + tuple(b_cols),
+                                stream.num_rows)
+            target = bucket_capacity(out_cap + stream.capacity)
+        else:
+            out = compact(ColumnarBatch(stream.columns + tuple(b_cols),
+                                        stream.num_rows), pair_ok)
+            target = out_cap
+        return self._pad_batch(out, target), matched_build
+
+    @staticmethod
+    def _pad_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
+        if batch.capacity == cap:
+            return batch
+        from .aggregate import _pad_column
+        return ColumnarBatch(
+            tuple(_pad_column(c, cap) for c in batch.columns),
+            batch.num_rows)
+
+    def _expand_general(self, stream, build_pack_or_build, perm, lo,
+                        counts, offsets, matched_build_in, out_cap: int):
+        build = build_pack_or_build
         s_cols, b_cols, pair_ok, probe_row, build_row = self._gather_pairs(
             stream, build, perm, lo, counts, offsets, out_cap)
 

@@ -1,0 +1,102 @@
+"""Pallas substring-search kernel (cudf string-search role; reference:
+sql-plugin/.../sql/rapids/stringFunctions.scala GpuContains/GpuStringLocate).
+
+The XLA formulation of window matching (expressions/strings._window_match)
+rolls the whole [n, max_len] byte matrix once per pattern byte — k full
+HBM passes for a k-byte pattern. This kernel loads each tile into VMEM
+ONCE and runs all k shifted compares in-register: one read pass + one
+write pass, ~k/2 x less HBM traffic for long patterns.
+
+Layout trick: 8-bit Mosaic tiles want 128-wide rows, but string columns
+are [n, max_len] with max_len typically 32/64. When max_len divides 128,
+pack 128//max_len strings per VMEM row — shifted compares never produce
+FALSE matches across string boundaries for match starts the caller keeps
+(start <= max_len - k), because s + j < max_len stays inside the packed
+string's byte range.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_ROW_TILE = 256          # packed 128-byte rows per grid step
+
+
+def _mk_kernel(pat: bytes, ml: int):
+    k = len(pat)
+
+    def kernel(data_ref, out_ref):
+        # widen to i32 in-register: v5e Mosaic has no 8-bit vector compare
+        d = data_ref[:].astype(jnp.int32)     # [T, 128]
+        m = jnp.ones(d.shape, jnp.int32)
+        for j in range(k):
+            if j == 0:
+                shifted = d
+            else:
+                # static shift left by j within the packed row; the tail
+                # bytes compare garbage but fall outside kept starts
+                pad = jnp.zeros((d.shape[0], j), jnp.int32)
+                shifted = jnp.concatenate([d[:, j:], pad], axis=1)
+            m = m & (shifted == jnp.int32(pat[j])).astype(jnp.int32)
+        out_ref[:] = m.astype(jnp.uint8)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("pat", "ml", "interpret"))
+def _pallas_match_packed(packed: jax.Array, pat: bytes, ml: int,
+                         interpret: bool = False) -> jax.Array:
+    rows = packed.shape[0]
+    grid = (rows // _ROW_TILE,)
+    # Mosaic rejects the i64 scalars the global x64 mode would put in the
+    # grid index maps ("failed to legalize func.return"); the kernel is
+    # all-32-bit, so trace it in an x64-disabled scope
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _mk_kernel(pat, ml),
+            out_shape=jax.ShapeDtypeStruct(packed.shape, jnp.uint8),
+            grid=grid,
+            in_specs=[pl.BlockSpec((_ROW_TILE, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((_ROW_TILE, 128), lambda i: (i, 0)),
+            interpret=interpret,
+        )(packed)
+
+
+def supports(n: int, ml: int, pat: bytes) -> bool:
+    """Kernel applicability: packable row widths, pattern fits, enough
+    rows to amortize the launch."""
+    if not (0 < len(pat) <= ml):
+        return False
+    if ml > 128 or 128 % ml != 0:
+        return False
+    return n >= (128 // ml) * _ROW_TILE
+
+
+def pallas_window_match(data: jax.Array, lengths: jax.Array, pat: bytes,
+                        interpret: bool = False) -> jax.Array:
+    """match[row, s] = pat equals data[row, s:s+k]; same contract as
+    expressions/strings._window_match."""
+    n, ml = data.shape
+    k = len(pat)
+    per = 128 // ml
+    pack_rows = -(-n // per)
+    # pad row count so the packed matrix tiles evenly; when the row count
+    # already aligns (power-of-two capacities do), packing is a FREE
+    # reshape — no copy pass
+    row_align = _ROW_TILE
+    padded_rows = -(-pack_rows // row_align) * row_align
+    if padded_rows * per == n:
+        packed = data.reshape(padded_rows, 128)
+    else:
+        flat = jnp.zeros((padded_rows * per, ml), jnp.uint8)
+        flat = flat.at[:n].set(data)
+        packed = flat.reshape(padded_rows, 128)
+    m = _pallas_match_packed(packed, pat, ml, interpret)
+    m = m.reshape(padded_rows * per, ml)[:n]
+    valid_start = jnp.arange(ml)[None, :] + k <= lengths[:, None]
+    return (m != 0) & valid_start

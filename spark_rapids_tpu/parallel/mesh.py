@@ -87,6 +87,10 @@ def mesh_exchange(batch: ColumnarBatch, pids: jnp.ndarray, n_dev: int,
     smaller bound when the partitioning is known balanced to save HBM).
     """
     cap = batch.capacity
+    if n_dev == 1:
+        # degenerate mesh: every row already lives on its destination —
+        # the exchange is the identity (no compaction, no collective)
+        return batch
     out_cap = out_capacity or n_dev * cap
     pieces = [compact(batch, pids == d) for d in range(n_dev)]
     counts = jnp.stack([p.num_rows for p in pieces])          # [n_dev]

@@ -40,3 +40,35 @@ def test_pallas_murmur3_chained_seeds():
     e1 = hash_int(c1, jnp.uint32(42))
     e2 = hash_int(c2, e1).view(jnp.int32)
     np.testing.assert_array_equal(np.asarray(h2), np.asarray(e2))
+
+
+def test_pallas_string_search_matches_xla_reference():
+    """kernels/string_search vs expressions/strings._window_match over
+    random byte matrices and lengths (interpret mode on CPU)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.kernels.string_search import (pallas_window_match,
+                                                        supports)
+
+    def xla_reference(data, lengths, pat):
+        # the rolled-compare formulation, inlined so the reference can
+        # NEVER dispatch to the kernel under test (the production
+        # _window_match cuts over to the kernel for long patterns)
+        n, ml = data.shape
+        k = len(pat)
+        pat_a = jnp.asarray(bytearray(pat), jnp.uint8)
+        m = jnp.ones((n, ml), bool)
+        for j in range(k):
+            m = m & (jnp.roll(data, -j, axis=1) == pat_a[j])
+        return m & (jnp.arange(ml)[None, :] + k <= lengths[:, None])
+
+    rng = np.random.default_rng(5)
+    n, ml = 1024, 64
+    data = jnp.asarray(rng.integers(97, 101, (n, ml)).astype(np.uint8))
+    lengths = jnp.asarray(rng.integers(0, ml + 1, n).astype(np.int32))
+    for pat in (b"ab", b"aabb", b"abcabcabcabcab", b"a" * 30):
+        assert supports(n, ml, pat)
+        ref = np.asarray(xla_reference(data, lengths, pat))
+        got = np.asarray(pallas_window_match(data, lengths, pat,
+                                             interpret=True))
+        assert np.array_equal(ref, got), pat
