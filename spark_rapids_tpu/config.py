@@ -173,6 +173,12 @@ SHUFFLE_MODE = conf("spark.rapids.tpu.shuffle.mode").doc(
     "(thread-pooled writers/readers) or ICI (device-resident, collective "
     "data plane; reference: rapids-shuffle.md three modes).").text("DEFAULT")
 
+DPP_ENABLED = conf(
+    "spark.rapids.tpu.sql.dynamicPartitionPruning.enabled").doc(
+    "Prune hive-partitioned scan files at plan time using the distinct "
+    "join-key values of a broadcast build side (reference: "
+    "GpuSubqueryBroadcastExec / dpp_test.py).").boolean(True)
+
 BROADCAST_THRESHOLD = conf(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
     "Max estimated build-side bytes for a broadcast hash join; larger (or "

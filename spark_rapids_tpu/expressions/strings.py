@@ -88,8 +88,35 @@ class Length(Expression):
         return numeric_column(_char_count(c), c.validity, T.INT32)
 
 
+def _case_tables():
+    """Single-char case maps for the 2-byte UTF-8 range (U+0080-U+07FF:
+    Latin-1 Supplement, Latin Extended, Greek, Cyrillic, ...): codepoint ->
+    codepoint, identity where the mapping changes char count or leaves the
+    2-byte range (those rows are why Upper/Lower are default-incompat)."""
+    import numpy as np
+    up = np.arange(0x800, dtype=np.int32)
+    lo = np.arange(0x800, dtype=np.int32)
+    for cp in range(0x80, 0x800):
+        u = chr(cp).upper()
+        if len(u) == 1 and 0x80 <= ord(u) < 0x800:
+            up[cp] = ord(u)
+        l = chr(cp).lower()
+        if len(l) == 1 and 0x80 <= ord(l) < 0x800:
+            lo[cp] = ord(l)
+    return up, lo
+
+
+_UPPER_2B, _LOWER_2B = _case_tables()
+
+
 @dataclass(frozen=True, eq=False)
 class Upper(Expression):
+    """upper/lower: ASCII bytewise plus SIMPLE case mapping for every
+    2-byte UTF-8 codepoint whose counterpart is also 2-byte (Latin-1/
+    Extended, Greek, Cyrillic). Length-changing mappings (ß→SS) and
+    3/4-byte scripts pass through — the rule is default-incompat for that
+    residue (reference gates locale-sensitive case the same way)."""
+
     child: Expression
     _upper = True
 
@@ -110,9 +137,25 @@ class Upper(Expression):
         if self._upper:
             is_lo = (d >= ord("a")) & (d <= ord("z"))
             out = jnp.where(is_lo, d - 32, d)
+            table = jnp.asarray(_UPPER_2B)
         else:
             is_up = (d >= ord("A")) & (d <= ord("Z"))
             out = jnp.where(is_up, d + 32, d)
+            table = jnp.asarray(_LOWER_2B)
+        # 2-byte sequences: lead 0xC2-0xDF followed by a continuation
+        nxt = jnp.concatenate([d[:, 1:], jnp.zeros_like(d[:, :1])], axis=1)
+        lead2 = (d >= 0xC2) & (d <= 0xDF) & (nxt >= 0x80) & (nxt < 0xC0)
+        cp = ((d.astype(jnp.int32) & 0x1F) << 6) \
+            | (nxt.astype(jnp.int32) & 0x3F)
+        mapped = jnp.take(table, jnp.clip(cp, 0, 0x7FF))
+        new_lead = (0xC0 | (mapped >> 6)).astype(d.dtype)
+        new_cont = (0x80 | (mapped & 0x3F)).astype(d.dtype)
+        out = jnp.where(lead2, new_lead, out)
+        prev_lead2 = jnp.concatenate(
+            [jnp.zeros_like(lead2[:, :1]), lead2[:, :-1]], axis=1)
+        prev_cont = jnp.concatenate(
+            [jnp.zeros_like(new_cont[:, :1]), new_cont[:, :-1]], axis=1)
+        out = jnp.where(prev_lead2, prev_cont, out)
         return DeviceColumn(out, c.validity, c.lengths, c.dtype)
 
 

@@ -80,6 +80,31 @@ def expand_paths(paths) -> List[str]:
     return out
 
 
+#: hive default-partition marker (null partition value)
+_HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+def _is_int(v: str) -> bool:
+    try:
+        int(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def hive_partition_values(path: str) -> dict:
+    """`key=value` directory components of a path (hive layout). Values
+    are %XX-unescaped (hive/Spark escape special chars when writing)."""
+    from urllib.parse import unquote
+    out = {}
+    for comp in os.path.dirname(path).split(os.sep):
+        if "=" in comp:
+            k, _, v = comp.partition("=")
+            if k:
+                out[k] = None if v == _HIVE_NULL else unquote(v)
+    return out
+
+
 class FileSource:
     """A format + file list + pushed-down projection/predicate."""
 
@@ -94,21 +119,83 @@ class FileSource:
                  reader_type: ReaderType = ReaderType.AUTO,
                  batch_rows: int = 1 << 20,
                  num_threads: int = 8,
-                 with_file_name: bool = False):
+                 with_file_name: bool = False,
+                 hive_partitions: bool = True):
         self.files = expand_paths(paths)
         if not self.files:
             raise FileNotFoundError(f"no files match {paths}")
         self.columns = columns
+        self._requested_columns = columns
         self.predicate = predicate
         self.reader_type = reader_type
         self.batch_rows = batch_rows
         self.num_threads = num_threads
         self.with_file_name = with_file_name
         self._schema = schema
+        # hive-layout partition columns (reference: partition-values
+        # handling in GpuFileSourceScanExec): key=value path components
+        # become constant columns; files_pruned counts DPP removals
+        self.partition_schema: List[tuple] = []
+        self._pvalues: dict = {}
+        self.files_pruned = 0
+        if hive_partitions:
+            self._discover_hive_partitions()
+            if self.columns and self.partition_schema:
+                pnames = {nm for nm, _ in self.partition_schema}
+                # file-level projection excludes partition columns (they
+                # come from paths); appended partition fields honor the
+                # request
+                self.partition_schema = [
+                    (nm, kind) for nm, kind in self.partition_schema
+                    if nm in self.columns]
+                self.columns = [c for c in self.columns
+                                if c not in pnames] or None
+
+    def _discover_hive_partitions(self) -> None:
+        per_file = [hive_partition_values(f) for f in self.files]
+        if not per_file or not per_file[0]:
+            return
+        names = [k for k in per_file[0]
+                 if all(k in pv for pv in per_file)]
+        for name in names:
+            vals = [pv[name] for pv in per_file]
+            typed = vals
+            if all(v is None or _is_int(v) for v in vals):
+                typed = [None if v is None else int(v) for v in vals]
+                kind = "int"
+            else:
+                kind = "string"
+            self.partition_schema.append((name, kind))
+            self._pvalues[name] = dict(zip(self.files, typed))
+
+    def partition_value(self, name: str, path: str):
+        return self._pvalues[name][path]
+
+    def prune_partitions(self, name: str, allowed) -> int:
+        """DPP: keep only files whose partition value is in ``allowed``;
+        returns how many files were pruned (reference:
+        GpuSubqueryBroadcastExec feeding partition filters)."""
+        if name not in self._pvalues:
+            return 0
+        before = len(self.files)
+        keep = [f for f in self.files
+                if self._pvalues[name][f] in allowed]
+        self.files = keep or self.files[:1]   # degenerate: keep one file
+        if not keep:
+            # no partition matches: one file remains but every row will
+            # fail the join anyway; record full pruning
+            self.files_pruned += before - 1
+            return before - 1
+        self.files_pruned += before - len(keep)
+        return before - len(keep)
 
     def _decorate(self, t: pa.Table, path: str) -> pa.Table:
-        """Attach the source path column (input_file_name() parity —
-        reference: GpuInputFileName resolved from the task's split)."""
+        """Attach partition-value and source-path columns (reference:
+        partition values + GpuInputFileName resolved from the split)."""
+        for name, kind in self.partition_schema:
+            v = self._pvalues[name][path]
+            typ = pa.int64() if kind == "int" else pa.string()
+            t = t.append_column(name, pa.array([v] * t.num_rows, typ))
         if self.with_file_name:
             t = t.append_column(
                 self.FILE_NAME_COL,
@@ -136,6 +223,9 @@ class FileSource:
             s = self.infer_arrow_schema()
             if self.columns:
                 s = pa.schema([s.field(c) for c in self.columns])
+            for name, kind in self.partition_schema:
+                s = s.append(pa.field(
+                    name, pa.int64() if kind == "int" else pa.string()))
             if self.with_file_name:
                 # widen ONLY the synthetic path column, not every string
                 from .. import types as T

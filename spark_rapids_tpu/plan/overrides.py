@@ -100,7 +100,11 @@ def _expr_rules() -> Dict[str, ExprRule]:
     r("FloorCeil", TS.NUMERIC)
     r("Murmur3Hash", TS.ALL_BASIC)
     # strings
-    for n in ("Length", "Upper", "Lower", "Substring", "Concat",
+    for n in ("Upper", "Lower"):
+        r(n, TS.ALL_BASIC, incompat=True,
+          note="simple case mapping (ASCII + 2-byte Latin/Greek/Cyrillic); "
+               "length-changing and locale-special mappings pass through")
+    for n in ("Length", "Substring", "Concat",
               "StringPredicate", "StringLocate", "StringTrim", "StringPad",
               "StringRepeat", "StringReplace", "Translate", "InitCap",
               "FormatNumber", "Reverse", "Ascii", "Chr", "OctetLength",
@@ -740,6 +744,78 @@ class Overrides:
                                     self.conf.get(WINDOW_BATCH_ROWS.key))
         return WindowExec(n.window_exprs, child)
 
+    def _maybe_dpp(self, stream: Exec, build: Exec, left_keys, right_keys,
+                   join_type: JoinType) -> None:
+        """Dynamic partition pruning (reference: GpuSubqueryBroadcastExec +
+        dpp_test.py): when the stream side scans a hive-partitioned source
+        and a join key IS a partition column, run the (already broadcast-
+        sized) build side at plan time and drop stream files whose
+        partition value cannot match. Only join types that DROP unmatched
+        stream rows are eligible."""
+        from ..config import DPP_ENABLED
+        if not self.conf.get(DPP_ENABLED.key):
+            return None
+        if join_type not in (JoinType.INNER, JoinType.LEFT_SEMI,
+                             JoinType.RIGHT_OUTER):
+            return None
+        def _through_projections(name: str):
+            """Walk the stream side down to a scan, tracking what ``name``
+            refers to: a projection must pass the column through UNCHANGED
+            (a computed alias like year+1 AS year must disable pruning)."""
+            from ..exec.coalesce import CoalesceBatchesExec
+            node, cur = stream, name
+            while True:
+                if isinstance(node, (FilterExec, CoalesceBatchesExec)):
+                    node = node.children[0]
+                    continue
+                if isinstance(node, ProjectExec):
+                    match = None
+                    child_schema = node.children[0].output_schema
+                    for i, f in enumerate(node.output_schema.fields):
+                        if f.name == cur:
+                            match = _expr_passthrough_name(
+                                node.exprs[i], child_schema)
+                            break
+                    if match is None:
+                        return None, None
+                    cur = match
+                    node = node.children[0]
+                    continue
+                return node, cur
+        from ..io.scan import FileSourceScanExec
+        build_tbl = None
+        for lk, rk in zip(left_keys, right_keys):
+            name = getattr(lk, "name", None)
+            rk_name = getattr(rk, "name", None)
+            if name is None or rk_name is None:
+                continue
+            node, scan_col = _through_projections(name)
+            if not isinstance(node, FileSourceScanExec):
+                continue
+            src = node.source
+            if scan_col not in {nm for nm, _ in
+                                getattr(src, "partition_schema", [])}:
+                continue
+            try:
+                ordinal = build.output_schema.index_of(rk_name)
+            except KeyError:
+                continue
+            if build_tbl is None:
+                from ..exec.base import collect as _collect
+                build_tbl = _collect(build)
+            values = set(build_tbl.column(ordinal).to_pylist())
+            values.discard(None)          # join keys never match null
+            pruned = src.prune_partitions(scan_col, values)
+            if pruned:
+                node._num_slices = max(
+                    1, min(node._num_slices, len(src.files)))
+        if build_tbl is None:
+            return None
+        # the build already ran for pruning: reuse its materialization so
+        # the broadcast does not recompute the dim subtree (reference:
+        # GpuSubqueryBroadcastExec reuses the broadcast result)
+        return InMemoryScanExec(build_tbl, schema=build.output_schema)
+
     def _broadcast(self, child: Exec) -> Exec:
         from ..config import BROADCAST_LIMIT
         return BroadcastExchangeExec(
@@ -775,6 +851,8 @@ class Overrides:
             swapped = True
 
         if build_bytes is not None and build_bytes <= threshold:
+            r = self._maybe_dpp(l, r, left_keys, right_keys,
+                                n.join_type) or r
             join: Exec = HashJoinExec(
                 left_keys, right_keys, n.join_type, l,
                 self._broadcast(r), condition=n.condition,
@@ -805,6 +883,22 @@ class Overrides:
                      for i, f in enumerate(ch[1].output_schema.fields)]
             join = ProjectExec(refs, join)
         return join
+
+
+def _expr_passthrough_name(expr, child_schema):
+    """The child-schema column name an output expression passes through
+    UNCHANGED, else None (DPP safety: computed aliases disable pruning)."""
+    e = expr
+    if isinstance(e, Alias):
+        e = e.child
+    if isinstance(e, EB.BoundReference):
+        try:
+            return child_schema.fields[e.ordinal].name
+        except IndexError:
+            return None
+    if isinstance(e, EB.UnresolvedColumn):
+        return e.name
+    return None
 
 
 def plan_query(logical: L.LogicalPlan,

@@ -27,3 +27,28 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+# ---------------------------------------------------------------------------
+# Test tiering (round 3): `-m smoke` runs a <2-minute core subset as the
+# commit gate; the full suite stays the nightly tier (the reference splits
+# premerge vs nightly the same way — jenkins/spark-premerge-build.sh).
+# ---------------------------------------------------------------------------
+
+SMOKE_FILES = {
+    "test_batch.py", "test_io.py", "test_dpp.py", "test_pallas_kernels.py",
+    "test_strings.py", "test_expressions.py", "test_expressions_breadth.py",
+    "test_native.py",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "smoke: fast core subset (<2 min) used as the commit "
+                   "gate; full suite is the nightly tier")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if os.path.basename(str(item.fspath)) in SMOKE_FILES:
+            item.add_marker(pytest.mark.smoke)
