@@ -29,15 +29,36 @@ from .base import Exec, UnaryExec
 _python_semaphore = TpuSemaphore(4)
 
 
+# ---- forked-worker adapters (module-level: must pickle to the daemon;
+# reference: python/rapids/worker.py applies the UDF inside the fork) ----
+
+def _scalar_udf_on_table(table: pa.Table, fn, input_cols, out_names):
+    pdf = table.to_pandas()
+    args = [pdf[c] for c in input_cols]
+    result = fn(*args)
+    if not isinstance(result, (list, tuple)):
+        result = [result]
+    for name, series in zip(out_names, result):
+        pdf[name] = series
+    return pa.Table.from_pandas(pdf, preserve_index=False)
+
+
+def _map_udf_on_table(table: pa.Table, fn):
+    return pa.Table.from_pandas(fn(table.to_pandas()),
+                                preserve_index=False)
+
+
 class ArrowEvalPythonExec(UnaryExec):
     """Append columns computed by a scalar pandas UDF."""
 
     def __init__(self, fn: Callable, input_cols: Sequence[str],
-                 output_fields: Sequence[Field], child: Exec):
+                 output_fields: Sequence[Field], child: Exec,
+                 use_daemon: bool = True):
         super().__init__(child)
         self.fn = fn
         self.input_cols = list(input_cols)
         self.output_fields = list(output_fields)
+        self.use_daemon = use_daemon
         self._schema = Schema(list(child.output_schema.fields)
                               + self.output_fields)
 
@@ -47,17 +68,17 @@ class ArrowEvalPythonExec(UnaryExec):
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         child_schema = self.child.output_schema
+        from ..python_worker import worker_apply
+        out_names = [f.name for f in self.output_fields]
         for batch in self.child.execute_partition(p):
             with _python_semaphore.task():
                 table = to_arrow(batch, child_schema)     # D2H + Arrow
-                pdf = table.to_pandas()
-                args = [pdf[c] for c in self.input_cols]
-                result = self.fn(*args)
-                if not isinstance(result, (list, tuple)):
-                    result = [result]
-                for f, series in zip(self.output_fields, result):
-                    pdf[f.name] = series
-                out = pa.Table.from_pandas(pdf, preserve_index=False)
+                # forked worker when the UDF pickles (process isolation —
+                # a crashing UDF fails the query, not the executor);
+                # closures downgrade to in-process
+                out = worker_apply(_scalar_udf_on_table, table,
+                                   (self.fn, self.input_cols, out_names),
+                                   use_daemon=self.use_daemon)
                 # cast to the declared output schema (pandas widens types)
                 from .. import types as T
                 target = pa.schema(
@@ -72,9 +93,11 @@ class MapInBatchExec(UnaryExec):
     """mapInPandas: df-in, df-out with a new schema (reference:
     GpuMapInBatchExec)."""
 
-    def __init__(self, fn: Callable, output_schema: Schema, child: Exec):
+    def __init__(self, fn: Callable, output_schema: Schema, child: Exec,
+                 use_daemon: bool = True):
         super().__init__(child)
         self.fn = fn
+        self.use_daemon = use_daemon
         self._schema = output_schema
 
     @property
@@ -86,11 +109,12 @@ class MapInBatchExec(UnaryExec):
         from .. import types as T
         target = pa.schema([pa.field(f.name, T.to_arrow(f.dtype), f.nullable)
                             for f in self._schema])
+        from ..python_worker import worker_apply
         for batch in self.child.execute_partition(p):
             with _python_semaphore.task():
-                pdf = to_arrow(batch, child_schema).to_pandas()
-                out_pdf = self.fn(pdf)
-                out = pa.Table.from_pandas(out_pdf, preserve_index=False)
+                table = to_arrow(batch, child_schema)
+                out = worker_apply(_map_udf_on_table, table, (self.fn,),
+                                   use_daemon=self.use_daemon)
                 out = out.select(self._schema.names).cast(target)
             if out.num_rows == 0:
                 continue

@@ -18,8 +18,30 @@ class FileSourceScanExec(LeafExec):
     def __init__(self, source: FileSource, num_slices: int = 1):
         super().__init__()
         self.source = source
+        #: per-PLAN file list: DPP prunes THIS copy, never the shared
+        #: FileSource (a pruned source would corrupt later queries)
+        self.files = list(source.files)
+        self.files_pruned = 0
         self._num_slices = max(1, min(num_slices, len(source.files)))
         self._schema = source.schema()
+
+    def prune_partitions(self, name: str, allowed) -> int:
+        """DPP entry: drop this plan's files whose hive partition value
+        cannot join (reference: GpuSubqueryBroadcastExec feeding the
+        scan's partition filters)."""
+        values = getattr(self.source, "_pvalues", {}).get(name)
+        if not values:
+            return 0
+        before = len(self.files)
+        keep = [f for f in self.files if values[f] in allowed]
+        self.files = keep or self.files[:1]
+        pruned = before - len(self.files)
+        self.files_pruned += pruned
+        # surface the stat on the source for observability/tests
+        self.source.files_pruned = getattr(
+            self.source, "files_pruned", 0) + pruned
+        self._num_slices = max(1, min(self._num_slices, len(self.files)))
+        return pruned
 
     @property
     def name(self):
@@ -34,7 +56,7 @@ class FileSourceScanExec(LeafExec):
         return self._num_slices
 
     def _files_for(self, p: int) -> List[str]:
-        return [f for i, f in enumerate(self.source.files)
+        return [f for i, f in enumerate(self.files)
                 if i % self._num_slices == p]
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:

@@ -171,27 +171,10 @@ class FileSource:
     def partition_value(self, name: str, path: str):
         return self._pvalues[name][path]
 
-    def prune_partitions(self, name: str, allowed) -> int:
-        """DPP: keep only files whose partition value is in ``allowed``;
-        returns how many files were pruned (reference:
-        GpuSubqueryBroadcastExec feeding partition filters)."""
-        if name not in self._pvalues:
-            return 0
-        before = len(self.files)
-        keep = [f for f in self.files
-                if self._pvalues[name][f] in allowed]
-        self.files = keep or self.files[:1]   # degenerate: keep one file
-        if not keep:
-            # no partition matches: one file remains but every row will
-            # fail the join anyway; record full pruning
-            self.files_pruned += before - 1
-            return before - 1
-        self.files_pruned += before - len(keep)
-        return before - len(keep)
-
     def _decorate(self, t: pa.Table, path: str) -> pa.Table:
         """Attach partition-value and source-path columns (reference:
-        partition values + GpuInputFileName resolved from the split)."""
+        partition values + GpuInputFileName resolved from the split),
+        then restore the REQUESTED column order."""
         for name, kind in self.partition_schema:
             v = self._pvalues[name][path]
             typ = pa.int64() if kind == "int" else pa.string()
@@ -200,6 +183,11 @@ class FileSource:
             t = t.append_column(
                 self.FILE_NAME_COL,
                 pa.array([path] * t.num_rows, pa.string()))
+        if self._requested_columns:
+            order = [c for c in self._requested_columns
+                     if c in t.column_names]
+            order += [c for c in t.column_names if c not in order]
+            t = t.select(order)
         return t
 
     def estimated_bytes(self) -> Optional[int]:
@@ -226,6 +214,11 @@ class FileSource:
             for name, kind in self.partition_schema:
                 s = s.append(pa.field(
                     name, pa.int64() if kind == "int" else pa.string()))
+            if self._requested_columns:
+                names = [f.name for f in s]
+                order = [c for c in self._requested_columns if c in names]
+                order += [c for c in names if c not in order]
+                s = pa.schema([s.field(c) for c in order])
             if self.with_file_name:
                 # widen ONLY the synthetic path column, not every string
                 from .. import types as T

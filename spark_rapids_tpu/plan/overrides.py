@@ -792,9 +792,9 @@ class Overrides:
             node, scan_col = _through_projections(name)
             if not isinstance(node, FileSourceScanExec):
                 continue
-            src = node.source
             if scan_col not in {nm for nm, _ in
-                                getattr(src, "partition_schema", [])}:
+                                getattr(node.source, "partition_schema",
+                                        [])}:
                 continue
             try:
                 ordinal = build.output_schema.index_of(rk_name)
@@ -805,10 +805,7 @@ class Overrides:
                 build_tbl = _collect(build)
             values = set(build_tbl.column(ordinal).to_pylist())
             values.discard(None)          # join keys never match null
-            pruned = src.prune_partitions(scan_col, values)
-            if pruned:
-                node._num_slices = max(
-                    1, min(node._num_slices, len(src.files)))
+            node.prune_partitions(scan_col, values)
         if build_tbl is None:
             return None
         # the build already ran for pruning: reuse its materialization so

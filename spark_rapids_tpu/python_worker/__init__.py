@@ -1,0 +1,4 @@
+from .daemon import (PythonWorkerError, WorkerPool, shared_pool,
+                     worker_apply)
+
+__all__ = ["PythonWorkerError", "WorkerPool", "shared_pool", "worker_apply"]

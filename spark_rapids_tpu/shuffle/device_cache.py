@@ -1,0 +1,112 @@
+"""Device-resident cross-process shuffle cache.
+
+Reference: RapidsShuffleInternalManagerBase.scala:876 (RapidsCachingWriter)
++ ShuffleBufferCatalog.scala + RapidsShuffleTransport.scala:303 — the UCX
+"cached" mode: map outputs STAY in device memory as spillable catalog
+entries; peers pull them through the transport; nothing touches the
+shared filesystem unless memory pressure spills it.
+
+TPU shape: each block is a ``SpillableBatch`` riding the tiered memory
+catalog (DEVICE→HOST→DISK under pressure), registered LAZILY with the
+TCP transport — serialization (D2H + framed codec) happens only when a
+peer actually fetches the block. Local reads hand back the device batch
+with zero serialization. Peer liveness comes from the plugin heartbeat
+registry through the transport's ``liveness`` hook (the reference's
+RapidsShuffleHeartbeatManager feeding endpoint setup).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..batch import ColumnarBatch, Schema
+from .serializer import deserialize_batch, serialize_batch
+from .transport import ShuffleTransport, TransportError
+
+
+class DeviceShuffleCache:
+    """ShuffleBufferCatalog analogue over the spill catalog + transport."""
+
+    def __init__(self, transport, catalog=None):
+        from ..memory import device_budget
+        self.transport = transport
+        self.catalog = catalog or device_budget()
+        self._blocks: Dict[Tuple[int, int, int], tuple] = {}
+        self._lock = threading.Lock()
+        transport.resolver = self._serve
+
+    # ---- writer side (RapidsCachingWriter.write) ----
+    def add_batch(self, shuffle_id: int, map_id: int, reduce_id: int,
+                  batch: ColumnarBatch, schema: Schema) -> None:
+        from ..memory import SpillableBatch
+        sb = SpillableBatch(self.catalog, batch, schema)
+        with self._lock:
+            self._blocks[(shuffle_id, map_id, reduce_id)] = (sb, schema)
+        self.transport.publish_lazy(shuffle_id, map_id, reduce_id)
+
+    # ---- local reader: zero-serialization device handoff ----
+    def get_local(self, shuffle_id: int, map_id: int,
+                  reduce_id: int) -> Optional[ColumnarBatch]:
+        with self._lock:
+            ent = self._blocks.get((shuffle_id, map_id, reduce_id))
+        if ent is None:
+            return None
+        sb, _ = ent
+        out = sb.get()
+        sb.done_with()
+        return out
+
+    # ---- transport resolver: serialize ON DEMAND for remote fetches ----
+    def _serve(self, shuffle_id: int, map_id: int,
+               reduce_id: int) -> Optional[bytes]:
+        with self._lock:
+            ent = self._blocks.get((shuffle_id, map_id, reduce_id))
+        if ent is None:
+            return None
+        sb, schema = ent
+        batch = sb.get()
+        try:
+            return serialize_batch(batch, schema)
+        finally:
+            sb.done_with()
+
+    # ---- remote reader ----
+    def fetch(self, shuffle_id: int, map_id: int, reduce_id: int,
+              schema: Schema) -> ColumnarBatch:
+        """Local catalog hit or a transport pull from whichever LIVE peer
+        owns the block; the deserialized batch lands on THIS device."""
+        local = self.get_local(shuffle_id, map_id, reduce_id)
+        if local is not None:
+            return local
+        data = self.transport.fetch(shuffle_id, map_id, reduce_id)
+        return deserialize_batch(data, schema)
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            gone = [k for k in self._blocks if k[0] == shuffle_id]
+            for k in gone:
+                sb, _ = self._blocks.pop(k)
+                sb.close()
+        self.transport.remove_shuffle(shuffle_id)
+
+    def close(self) -> None:
+        with self._lock:
+            for sb, _ in self._blocks.values():
+                sb.close()
+            self._blocks.clear()
+
+
+_SHARED = None
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_device_cache() -> DeviceShuffleCache:
+    """Process-wide cache over a lazily started TCP transport (peers come
+    from conf/heartbeats when the multi-process tier is configured)."""
+    global _SHARED
+    with _SHARED_LOCK:
+        if _SHARED is None:
+            from .transport import TcpTransport
+            _SHARED = DeviceShuffleCache(TcpTransport())
+        return _SHARED

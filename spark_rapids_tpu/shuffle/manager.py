@@ -29,14 +29,16 @@ class ShuffleManager:
     DEFAULT = "DEFAULT"
     MULTITHREADED = "MULTITHREADED"
     ICI = "ICI"
+    CACHED = "CACHED"
 
     def __init__(self, conf: RapidsTpuConf):
         self.conf = conf
         self.mode = str(conf.get(SHUFFLE_MODE.key)).upper()
-        if self.mode not in (self.DEFAULT, self.MULTITHREADED, self.ICI):
+        if self.mode not in (self.DEFAULT, self.MULTITHREADED, self.ICI,
+                             self.CACHED):
             raise ValueError(
                 f"spark.rapids.tpu.shuffle.mode must be DEFAULT, "
-                f"MULTITHREADED or ICI, got {self.mode!r}")
+                f"MULTITHREADED, ICI or CACHED, got {self.mode!r}")
 
     def create_exchange(self, partitioning: Partitioning,
                         child: Exec) -> Exec:
@@ -48,6 +50,11 @@ class ShuffleManager:
         if self.mode == self.MULTITHREADED:
             from .multithreaded import MultithreadedShuffleExchangeExec
             return MultithreadedShuffleExchangeExec(partitioning, child)
+        if self.mode == self.CACHED:
+            # device-resident blocks in the spillable cache, served P2P
+            # (the reference's UCX cached mode)
+            from .exchange import CachedShuffleExchangeExec
+            return CachedShuffleExchangeExec(partitioning, child)
         return ShuffleExchangeExec(
             partitioning, child,
             adaptive=self.conf.get(ADAPTIVE_ENABLED.key),

@@ -170,6 +170,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 s, m, r = struct.unpack("<qqq", payload)
                 blk = store._local.get((s, m, r))
+                if blk is None and store.resolver is not None:
+                    # lazy block: device-resident until a peer asks
+                    # (DeviceShuffleCache serializes on demand)
+                    blk = store.resolver(s, m, r)
                 if blk is None:
                     _send_frame(self.request, _MISSING, b"")
                 else:
@@ -192,6 +196,9 @@ class TcpTransport(ShuffleTransport):
                  retries: int = 3, liveness=None):
         self._local: Dict[Tuple[int, int, int], bytes] = {}
         self._index: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
+        #: optional (s, m, r) -> bytes|None hook serving LAZY blocks whose
+        #: payload lives elsewhere (the device-resident shuffle cache)
+        self.resolver = None
         self.peers = dict(peers or {})
         self.retries = retries
         # liveness: () -> iterable of live peer ids, normally the driver
@@ -212,6 +219,12 @@ class TcpTransport(ShuffleTransport):
     def publish(self, s: int, m: int, r: int, payload: bytes) -> None:
         with self._lock:
             self._local[(s, m, r)] = payload
+            self._index.setdefault((s, r), []).append((s, m, r))
+
+    def publish_lazy(self, s: int, m: int, r: int) -> None:
+        """Register a block whose bytes the ``resolver`` produces on
+        demand (device-resident until fetched)."""
+        with self._lock:
             self._index.setdefault((s, r), []).append((s, m, r))
 
     def local_blocks(self, s: int, r: int):

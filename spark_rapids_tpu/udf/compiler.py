@@ -37,6 +37,27 @@ class CompileError(Exception):
     pass
 
 
+#: unroll budget for counted range() loops (each iteration inlines the
+#: body's expression tree; beyond this the tree blows up the trace)
+MAX_LOOP_TRIP = 64
+
+
+class _RangeIter:
+    """A concrete range(...) iterator discovered at compile time."""
+
+    def __init__(self, values):
+        self.values = list(values)
+
+
+class _State:
+    """Mid-loop machine state returned when execution reaches the loop's
+    back-edge (JUMP_BACKWARD to the FOR_ITER head)."""
+
+    def __init__(self, stack, locals_):
+        self.stack = stack
+        self.locals = locals_
+
+
 def _py_mod(l, r):
     """Python %: floor-mod (sign of divisor). SQL Remainder is Java %
     (sign of dividend); ((a % b) + b) % b converts exactly."""
@@ -109,14 +130,34 @@ class _Simulator:
 
     def run(self) -> Expression:
         locals_: Dict[int, Any] = dict(enumerate(self.arg_exprs))
-        return self._exec(0, [], locals_, depth=0)
+        out = self._exec(0, [], locals_, depth=0)
+        if isinstance(out, _State):
+            raise CompileError("dangling loop state (malformed CFG)")
+        return out
+
+    def _merge_states(self, cond, a: "_State", b: "_State") -> "_State":
+        """Join two loop-body arms: per-slot If() where they diverge."""
+        if len(a.stack) != len(b.stack):
+            raise CompileError("loop arms leave different stack depths")
+        stack = []
+        for x, y in zip(a.stack, b.stack):
+            stack.append(x if x is y
+                         else ECOND.If(cond, self._expr(x), self._expr(y)))
+        locals_ = {}
+        for k in set(a.locals) & set(b.locals):
+            x, y = a.locals[k], b.locals[k]
+            if x is y:
+                locals_[k] = x
+            else:
+                locals_[k] = ECOND.If(cond, self._expr(x), self._expr(y))
+        return _State(stack, locals_)
 
     # ------------------------------------------------------------------
 
     def _exec(self, idx: int, stack: List[Any], locals_: Dict[int, Any],
-              depth: int) -> Expression:
+              depth: int, loop_head: Optional[int] = None):
         if depth > 40:
-            raise CompileError("branch nesting too deep (loop?)")
+            raise CompileError("branch nesting too deep")
         stack = list(stack)
         locals_ = dict(locals_)
         n = len(self.instructions)
@@ -179,7 +220,10 @@ class _Simulator:
             elif op == "BINARY_OP":
                 r = self._expr(stack.pop())
                 l = self._expr(stack.pop())
-                fn = _BINARY_OPS.get(ins.arg)
+                # args >= 13 are the NB_INPLACE_* variants; on immutable
+                # values they reduce to the plain operator
+                fn = _BINARY_OPS.get(ins.arg if ins.arg < 13
+                                     else ins.arg - 13)
                 if fn is None:
                     raise CompileError(f"binary op {ins.argrepr}")
                 stack.append(fn(l, r))
@@ -219,9 +263,15 @@ class _Simulator:
                     cond = EC.IsNotNull(self._expr(tos))
                 else:
                     cond = EC.IsNull(self._expr(tos))
-                then_e = self._exec(idx + 1, stack, locals_, depth + 1)
+                then_e = self._exec(idx + 1, stack, locals_, depth + 1,
+                                    loop_head)
                 else_e = self._exec(self.by_offset[ins.argval], stack,
-                                    locals_, depth + 1)
+                                    locals_, depth + 1, loop_head)
+                if isinstance(then_e, _State) and isinstance(else_e, _State):
+                    return self._merge_states(cond, then_e, else_e)
+                if isinstance(then_e, _State) or isinstance(else_e, _State):
+                    raise CompileError(
+                        "return inside a loop body is not compilable")
                 return ECOND.If(cond, then_e, else_e)
             elif op in ("JUMP_FORWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
                 tgt = self.by_offset.get(ins.argval)
@@ -229,9 +279,47 @@ class _Simulator:
                     raise CompileError("backward jump (loop) unsupported")
                 idx = tgt
             elif op == "JUMP_BACKWARD":
-                raise CompileError("loops are not compilable")
+                if loop_head is not None and ins.argval == loop_head:
+                    return _State(stack, locals_)
+                raise CompileError(
+                    "only counted range() for-loops are compilable "
+                    "(while loops and generators stay on the CPU path)")
+            elif op == "GET_ITER":
+                tos = stack.pop()
+                if not isinstance(tos, _RangeIter):
+                    raise CompileError(
+                        "only range() objects are iterable here")
+                stack.append(tos)
+                idx += 1
+            elif op == "FOR_ITER":
+                it = stack[-1]
+                if not isinstance(it, _RangeIter):
+                    raise CompileError("FOR_ITER over a non-range value")
+                # unroll: run the body once per concrete value; each
+                # iteration's arms rejoin at the back-edge (reference
+                # compiles loops via CFG reconstruction — CFG.scala; here
+                # the trip count is static so unrolling is exact)
+                cur = _State(list(stack), dict(locals_))
+                for v in it.values:
+                    body_stack = list(cur.stack) + [lit(v)]
+                    r = self._exec(idx + 1, body_stack, cur.locals,
+                                   depth + 1, loop_head=ins.offset)
+                    if not isinstance(r, _State):
+                        raise CompileError(
+                            "return inside a loop body is not compilable")
+                    cur = r
+                # exhausted: fall to the loop exit (END_FOR pops the iter)
+                idx = self.by_offset[ins.argval]
+                stack = list(cur.stack)
+                locals_ = dict(cur.locals)
+            elif op == "END_FOR":
+                stack.pop()
+                idx += 1
             elif op == "RETURN_VALUE":
                 return self._expr(stack.pop())
+            elif op == "POP_TOP":
+                stack.pop()
+                idx += 1
             elif op in ("COPY",):
                 stack.append(stack[-ins.arg])
                 idx += 1
@@ -253,8 +341,22 @@ class _Simulator:
             return lit(v)
         raise CompileError(f"non-expression on stack: {v!r}")
 
-    def _call(self, fn, args) -> Expression:
+    def _call(self, fn, args):
         import builtins
+        if fn is builtins.range:
+            vals = []
+            for a in args:
+                if not (isinstance(a, Literal)
+                        and isinstance(a.value, int)):
+                    raise CompileError(
+                        "range() bounds must be compile-time constants")
+                vals.append(a.value)
+            r = range(*vals)
+            if len(r) > MAX_LOOP_TRIP:
+                raise CompileError(
+                    f"loop trip count {len(r)} exceeds the unroll budget "
+                    f"({MAX_LOOP_TRIP})")
+            return _RangeIter(r)
         if isinstance(fn, _Method):
             return self._str_method(fn, args)
         if fn is builtins.abs:

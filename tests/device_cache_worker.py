@@ -1,0 +1,34 @@
+"""Owner process for the device-resident shuffle cache test: builds a
+DEVICE batch, registers it in the spillable cache, serves it over TCP."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pyarrow as pa
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from spark_rapids_tpu.batch import from_arrow
+from spark_rapids_tpu.shuffle.device_cache import DeviceShuffleCache
+from spark_rapids_tpu.shuffle.transport import TcpTransport
+
+
+def main():
+    t = pa.table({"k": pa.array(np.arange(1000, dtype=np.int64)),
+                  "v": pa.array((np.arange(1000) * 3).astype(np.float64))})
+    batch, schema = from_arrow(t)          # DEVICE-resident batch
+    transport = TcpTransport()
+    cache = DeviceShuffleCache(transport)
+    cache.add_batch(7, 0, 0, batch, schema)
+    print(f"PORT {transport.address[1]}", flush=True)
+    sys.stdin.readline()                    # parent closes stdin to stop
+    cache.close()
+    transport.close()
+
+
+if __name__ == "__main__":
+    main()

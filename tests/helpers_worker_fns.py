@@ -1,0 +1,12 @@
+"""Module-level (picklable) UDFs for worker-isolation drives."""
+
+
+def crash_map(pdf):
+    import os
+    os._exit(11)
+
+
+def ok_map(pdf):
+    pdf = pdf.copy()
+    pdf["y"] = pdf["x"] + 1
+    return pdf[["y"]]

@@ -1,0 +1,106 @@
+"""Device-resident cross-process shuffle cache (reference:
+RapidsCachingWriter + ShuffleBufferCatalog + RapidsShuffleTransport):
+map output stays a spillable DEVICE batch in the owner process and a PEER
+PROCESS pulls it over the TCP transport — no shared filesystem."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "device_cache_worker.py")
+
+
+def test_peer_process_fetches_device_block():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    owner = subprocess.Popen([sys.executable, WORKER],
+                             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                             text=True, env=env)
+    try:
+        line = owner.stdout.readline().strip()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+
+        from spark_rapids_tpu.batch import from_arrow, to_arrow
+        from spark_rapids_tpu.shuffle.device_cache import DeviceShuffleCache
+        from spark_rapids_tpu.shuffle.transport import TcpTransport
+        t = pa.table({"k": pa.array(np.arange(1000, dtype=np.int64)),
+                      "v": pa.array((np.arange(1000) * 3)
+                                    .astype(np.float64))})
+        _, schema = from_arrow(t)
+        transport = TcpTransport(peers={0: ("127.0.0.1", port)})
+        cache = DeviceShuffleCache(transport)
+        batch = cache.fetch(7, 0, 0, schema)      # remote pull -> device
+        got = to_arrow(batch, schema)
+        assert got.column("k").to_pylist() == list(range(1000))
+        assert got.column("v").to_pylist() == [i * 3.0 for i in range(1000)]
+        transport.close()
+    finally:
+        try:
+            owner.stdin.close()
+        except OSError:
+            pass
+        owner.wait(timeout=30)
+
+
+def test_local_blocks_skip_serialization():
+    from spark_rapids_tpu.batch import from_arrow, to_arrow
+    from spark_rapids_tpu.shuffle.device_cache import DeviceShuffleCache
+    from spark_rapids_tpu.shuffle.transport import TcpTransport
+    t = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+    batch, schema = from_arrow(t)
+    transport = TcpTransport()
+    cache = DeviceShuffleCache(transport)
+    cache.add_batch(1, 0, 0, batch, schema)
+    out = cache.fetch(1, 0, 0, schema)
+    assert to_arrow(out, schema).column("x").to_pylist() == [1, 2, 3]
+    cache.remove_shuffle(1)
+    assert cache.get_local(1, 0, 0) is None
+    transport.close()
+
+
+def test_dead_peer_liveness_excluded():
+    """Heartbeat-driven expiry consumed: a peer the liveness registry
+    declares dead is skipped without a socket timeout."""
+    from spark_rapids_tpu.shuffle.device_cache import DeviceShuffleCache
+    from spark_rapids_tpu.shuffle.transport import TcpTransport, \
+        TransportError
+    from spark_rapids_tpu.batch import from_arrow
+    t = pa.table({"x": pa.array([1], pa.int64())})
+    _, schema = from_arrow(t)
+    transport = TcpTransport(peers={9: ("127.0.0.1", 1)},   # unreachable
+                             liveness=lambda: [])            # ...and dead
+    cache = DeviceShuffleCache(transport)
+    with pytest.raises(TransportError, match="not found"):
+        cache.fetch(5, 0, 0, schema)
+    transport.close()
+
+
+def test_cached_shuffle_mode_session():
+    """CACHED shuffle mode (UCX cached-mode analogue): the exchange's map
+    outputs live in the device cache; a grouped query over 3 input slices
+    must equal the CPU interpreter."""
+    from spark_rapids_tpu.plan import Session, table as df_table
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Count, Sum
+    rng = np.random.default_rng(0)
+    t = pa.table({"k": rng.integers(0, 50, 3000).astype(np.int32),
+                  "v": rng.integers(-100, 100, 3000).astype(np.int64)})
+    cached = Session({"spark.rapids.tpu.shuffle.mode": "CACHED"})
+    cpu = Session({"spark.rapids.tpu.sql.enabled": False})
+
+    def q():
+        return (df_table(t, num_slices=3).group_by("k")
+                .agg(Sum(col("v")).alias("s"), Count().alias("c")))
+    g = cached.collect(q())
+    e = cpu.collect(q())
+    sg = sorted(map(tuple, zip(*[g.column(i).to_pylist()
+                                 for i in range(g.num_columns)])))
+    se = sorted(map(tuple, zip(*[e.column(i).to_pylist()
+                                 for i in range(e.num_columns)])))
+    assert sg == se
+    assert any("CachedShuffle" in n for n in cached.executed_exec_names())

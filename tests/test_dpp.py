@@ -46,8 +46,10 @@ def test_dpp_prunes_files_and_matches_cpu(hive_fact_dir):
     out = ses.collect(q(fact))
     src = fact.plan.source
     # dim keeps grp==1 -> dk in {2, 3}: 6 of 8 partition files pruned
-    assert src.files_pruned == 6, (src.files_pruned, src.files)
-    assert len(src.files) == 2
+    # (pruning is PLAN-scoped: the shared source keeps its full file list
+    # so later queries see everything; the stat mirrors to the source)
+    assert src.files_pruned == 6, src.files_pruned
+    assert len(src.files) == 8
 
     cpu = Session({"spark.rapids.tpu.sql.enabled": False})
     fact2 = read_parquet(hive_fact_dir, num_slices=4)
@@ -131,3 +133,18 @@ def test_partition_column_projection():
         [src._decorate(src.read_file(f), f) for f in src.files])
     assert set(tbl.column_names) == {"v", "d"}
     assert set(tbl.column("d").to_pylist()) == {1, 2}
+
+
+def test_dpp_does_not_corrupt_later_queries(hive_fact_dir):
+    """Regression (review): pruning must be PLAN-scoped — a second query
+    over the same DataFrame/source must see every file."""
+    ses = Session({})
+    fact = read_parquet(hive_fact_dir)
+    dim1 = df_table(_dim()).where(col("grp") == lit(1))    # dk {2,3}
+    out1 = ses.collect(fact.join(dim1, ["d"], ["dk"], JoinType.INNER))
+    assert out1.num_rows == 100
+    dim2 = df_table(_dim()).where(col("grp") == lit(2))    # dk {4,5}
+    out2 = ses.collect(fact.join(dim2, ["d"], ["dk"], JoinType.INNER))
+    assert out2.num_rows == 100          # not zero: files were not lost
+    full = ses.collect(fact)
+    assert full.num_rows == 400

@@ -123,3 +123,94 @@ def test_window_in_pandas():
     # original row order preserved; per-group mean subtracted
     assert out.column("centered").to_pylist() == [-1.0, -1.0, 1.0, 1.0]
     assert out.column("v").to_pylist() == [1.0, 2.0, 3.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# Round-3: forked worker daemon (reference: python/rapids/daemon.py —
+# process isolation; a crashing UDF fails the QUERY, not the executor)
+# ---------------------------------------------------------------------------
+
+def _double_series(s):
+    return s * 2
+
+
+def _crash_map(pdf):
+    import os
+    os._exit(11)          # simulate a hard native crash in the worker
+
+
+def _ok_map(pdf):
+    pdf = pdf.copy()
+    pdf["y"] = pdf["x"] + 1
+    return pdf[["y"]]
+
+
+def test_worker_daemon_scalar_udf():
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.batch import Field
+    from spark_rapids_tpu.exec.base import collect
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+    from spark_rapids_tpu.exec.python_exec import ArrowEvalPythonExec
+    t = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+    plan = ArrowEvalPythonExec(_double_series, ["x"],
+                               [Field("d", T.INT64)],
+                               InMemoryScanExec(t), use_daemon=True)
+    out = collect(plan)
+    assert out.column("d").to_pylist() == [2, 4, 6]
+
+
+def test_worker_crash_fails_query_not_executor():
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.batch import Field, Schema
+    from spark_rapids_tpu.exec.base import collect
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+    from spark_rapids_tpu.exec.python_exec import MapInBatchExec
+    from spark_rapids_tpu.python_worker import PythonWorkerError
+    t = pa.table({"x": pa.array([1, 2], pa.int64())})
+    crash = MapInBatchExec(_crash_map, Schema([Field("y", T.INT64)]),
+                           InMemoryScanExec(t), use_daemon=True)
+    with pytest.raises(PythonWorkerError, match="died"):
+        collect(crash)
+    # the executor (this process) survives and the pool still serves
+    ok = MapInBatchExec(_ok_map, Schema([Field("y", T.INT64)]),
+                        InMemoryScanExec(t), use_daemon=True)
+    out = collect(ok)
+    assert out.column("y").to_pylist() == [2, 3]
+
+
+def test_worker_udf_exception_propagates():
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.batch import Field, Schema
+    from spark_rapids_tpu.exec.base import collect
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+    from spark_rapids_tpu.exec.python_exec import MapInBatchExec
+    from spark_rapids_tpu.python_worker import PythonWorkerError
+
+    t = pa.table({"x": pa.array([1], pa.int64())})
+    plan = MapInBatchExec(_raise_map, Schema([Field("y", T.INT64)]),
+                          InMemoryScanExec(t), use_daemon=True)
+    with pytest.raises(PythonWorkerError, match="boom"):
+        collect(plan)
+
+
+def _raise_map(pdf):
+    raise ValueError("boom")
+
+
+def test_unpicklable_udf_runs_in_process():
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.batch import Field
+    from spark_rapids_tpu.exec.base import collect
+    from spark_rapids_tpu.exec.basic import InMemoryScanExec
+    from spark_rapids_tpu.exec.python_exec import ArrowEvalPythonExec
+    t = pa.table({"x": pa.array([5], pa.int64())})
+    k = 7
+    plan = ArrowEvalPythonExec(lambda s: s + k, ["x"],
+                               [Field("d", T.INT64)],
+                               InMemoryScanExec(t), use_daemon=True)
+    out = collect(plan)
+    assert out.column("d").to_pylist() == [12]

@@ -119,14 +119,14 @@ def test_modulo_negate():
     check(lambda x: (-x) % 7 if x != 0 else 0, "x")
 
 
-def test_loop_rejected():
+def test_loop_inplace_accumulation():
+    # was test_loop_rejected before round-3 loop support landed
     def f(x):
         t = 0
         for i in range(3):
             t += x
         return t
-    with pytest.raises(CompileError):
-        compile_udf(f, [col("x")])
+    check(f, "x")
 
 
 def test_unsupported_call_rejected():
@@ -142,3 +142,67 @@ def test_udf_decorator():
     got = ses.collect(table(T1).select(times3(col("x")).alias("r")))
     exp = oracle(lambda x: x * 3, "x")
     assert [r[0] for r in rows_of(got)] == exp
+
+
+# Round-3: counted range() loops (reference: udf-compiler CFG.scala loop
+# reconstruction / OpcodeSuite for-accumulation patterns)
+# ---------------------------------------------------------------------------
+
+def test_loop_accumulation():
+    def poly(x):
+        acc = 0
+        for i in range(1, 4):
+            acc = acc + x * i
+        return acc
+    check(poly, "x")
+
+
+def test_loop_with_branches_in_body():
+    def cond_loop(x):
+        acc = 0
+        for i in range(5):
+            if x > i:
+                acc = acc + i
+            else:
+                acc = acc - 1
+        return acc
+    check(cond_loop, "x")
+
+
+def test_loop_horner():
+    def horner(d):
+        acc = 0.0
+        for c in range(3):
+            acc = acc * d + c
+        return acc
+    check(horner, "d")
+
+
+def test_nested_loops():
+    def nested(x):
+        acc = 0
+        for i in range(3):
+            for j in range(2):
+                acc = acc + x * i + j
+        return acc
+    check(nested, "x")
+
+
+def test_while_loop_rejected():
+    def w(x):
+        acc = 0
+        while acc < x:
+            acc = acc + 1
+        return acc
+    with pytest.raises(CompileError):
+        compile_udf(w, [col("x")])
+
+
+def test_huge_trip_count_rejected():
+    def big(x):
+        acc = 0
+        for i in range(1000):
+            acc = acc + x
+        return acc
+    with pytest.raises(CompileError):
+        compile_udf(big, [col("x")])
