@@ -168,6 +168,13 @@ READER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
     "PERFILE, COALESCING, MULTITHREADED or AUTO (reference: "
     "spark.rapids.sql.format.parquet.reader.type).").text("AUTO")
 
+FUSION_ENABLED = conf("spark.rapids.tpu.sql.fusion.enabled").doc(
+    "Whole-stage fusion: compile an eligible linear single-batch stage "
+    "(scan/filter/project/join/sort/topN/aggregate) into ONE XLA program "
+    "with optimistic join sizing and flag-validated retries (the XLA twin "
+    "of Spark's whole-stage codegen; reference: GpuTieredProject / "
+    "whole-stage pipelining, SURVEY.md §3.3).").boolean(True)
+
 SHUFFLE_MODE = conf("spark.rapids.tpu.shuffle.mode").doc(
     "Shuffle manager mode: DEFAULT (serialized host batches), MULTITHREADED "
     "(thread-pooled writers/readers) or ICI (device-resident, collective "

@@ -375,7 +375,7 @@ class HashAggregateExec(UnaryExec):
             first_idx = jnp.take(sperm, jnp.where(live_slot, starts, 0))
             from .common import gather_columns
             out_cols = gather_columns(key_cols, first_idx, live_slot)
-            res = LaneResults(lanes, seg0, starts_m, ends_m, live_slot)
+            res = LaneResults(lanes, seg0, starts_m, live_slot)
             seg = jnp.where(sorted_live & (gid < L), gid, L)
             with segment_bounds(starts_m, ends_m):
                 for agg, views, fin in plans:
@@ -395,10 +395,14 @@ class HashAggregateExec(UnaryExec):
         # size, so pick the smallest tier the observed group count fits
         # (nested lax.cond — only the selected tier executes). Tier count
         # is a compile-time/runtime trade: every tier re-traces the whole
-        # reduction pipeline, and the tunneled TPU compiler chokes past
-        # two tiers (a 3-tier q1 kernel did not compile within 20 min).
+        # reduction pipeline. Since the round-4 blocked scans shrank the
+        # per-tier HLO, a THIRD mid tier (cap/4) is affordable and cuts the
+        # group-starts row-gather 5x for mid-cardinality batches
+        # (tools/profile_round4.py: (4M,6) f64 gather 180 ms at L=4M vs
+        # 33 ms at L=1M; 1M-key hash_agg 568 ms -> 228 ms).
         G = min(self.small_groups_bucket, cap)
-        tiers = sorted({t for t in (self.layout_tiers or (G, cap))
+        default = (G, cap >> 2, cap) if cap >> 2 > G else (G, cap)
+        tiers = sorted({t for t in (self.layout_tiers or default)
                         if 0 < t <= cap} | {cap})
 
         def select(ts):

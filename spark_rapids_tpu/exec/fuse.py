@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from ..batch import ColumnarBatch, Schema, bucket_capacity
 from ..expressions.base import EvalContext
-from .base import Exec
+from .base import Exec, LeafExec
 from .basic import (FilterExec, InMemoryScanExec, LocalLimitExec,
                     ProjectExec, _raise_ansi)
 from .common import compact, slice_batch
@@ -165,10 +165,10 @@ class FusedStage:
 
     def _emit_join(self, node: HashJoinExec, stream: ColumnarBatch,
                    build: ColumnarBatch, flags) -> ColumnarBatch:
-        sorted_h, perm, _ = node._build_kernel(build)
+        sorted_h, sbuild, _ = node._build_kernel(build)
         lo, counts, offsets, total = node._count_kernel(stream, sorted_h)
         out_cap = bucket_capacity(stream.capacity * self.expand_factor)
-        matched = jnp.zeros(build.capacity, bool)
+        matched = jnp.zeros(sbuild.capacity, bool)
         semi = node.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
                                   JoinType.EXISTENCE)
         # overflow: candidates that would not fit the optimistic bucket.
@@ -177,9 +177,9 @@ class FusedStage:
         self._join_needs.append(
             ((total + out_cap - 1) // out_cap).astype(jnp.int64))
         if semi:
-            return node._semi_kernel(stream, (build, perm),
+            return node._semi_kernel(stream, sbuild,
                                      (lo, counts, offsets), matched, out_cap)
-        out, _ = node._expand_kernel(stream, (build, perm),
+        out, _ = node._expand_kernel(stream, sbuild,
                                      (lo, counts, offsets), matched, out_cap)
         return out
 
@@ -223,3 +223,33 @@ def try_fuse(plan: Exec, expand_factor: int = 1) -> Optional[FusedStage]:
         return FusedStage(plan, expand_factor)
     except FusionUnsupported:
         return None
+
+
+class FusedStageExec(LeafExec):
+    """Planner wrapper: the fused program as a one-partition exec, so the
+    session's collect path runs whole-stage programs transparently
+    (Session.prepare wires this in under sql.fusion.enabled)."""
+
+    def __init__(self, stage: FusedStage):
+        super().__init__()
+        self.stage = stage
+
+    @property
+    def name(self) -> str:
+        return "FusedStageExec"
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.stage.plan.output_schema
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def do_execute_partition(self, p: int):
+        yield self.stage.run()
+
+
+def try_fuse_exec(plan: Exec) -> Optional[FusedStageExec]:
+    stage = try_fuse(plan)
+    return FusedStageExec(stage) if stage is not None else None

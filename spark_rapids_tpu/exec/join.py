@@ -204,6 +204,11 @@ class HashJoinExec(BinaryExec):
     # ------------------------------------------------------------------
 
     def _build_kernel(self, build: ColumnarBatch):
+        """Sort the build side by probe word and MATERIALIZE it in that
+        order. Expansion then gathers build columns directly at sorted
+        positions — no perm indirection per probe batch (a 1M-row index
+        gather costs ~7 ms on this chip; the build-side gather here is
+        paid once and amortizes over every probe batch)."""
         keys = [e.eval(build, self.ctx) for e in self.right_keys]
         live = build.row_mask()
         valid = live
@@ -211,12 +216,47 @@ class HashJoinExec(BinaryExec):
             valid = valid & k.validity
         h = self._probe_words(keys, valid, build_side=True)
         iota = jnp.arange(build.capacity, dtype=jnp.int32)
-        # tie-break on validity: equal-word VALID rows sort before dead
-        # rows, so clamping searches by n_valid is exact even for max-key
-        sorted_h, _, perm = jax.lax.sort(
-            [h, (~valid).astype(jnp.uint8), iota], num_keys=2)
-        n_valid = jnp.sum(valid.astype(jnp.int32))
-        return (sorted_h, n_valid), perm, valid
+        # three-way rank tie-break: valid-keyed rows sort by word first,
+        # then LIVE null-keyed rows (outer tails still need them), then
+        # dead padding — so live rows stay a prefix in sorted order
+        rank = jnp.where(valid, 0, jnp.where(live, 1, 2)).astype(jnp.uint8)
+        sorted_h, _, perm = jax.lax.sort([h, rank, iota], num_keys=2)
+        n_valid = jnp.sum(valid.astype(jnp.int32)).astype(jnp.int32)
+        from .common import gather_columns
+        sorted_live = iota < build.num_rows
+        sorted_cols = gather_columns(list(build.columns), perm, sorted_live)
+        sorted_build = ColumnarBatch(tuple(sorted_cols), build.num_rows)
+        # per-position run length of the word STARTING at that position:
+        # candidate count for a probe that lands on a run start. Replaces
+        # the probe-side side="right" searchsorted (a second concat-sort
+        # of the whole stream, ~40 ms per 4M probes) with build-side work
+        # that amortizes over every probe batch.
+        prev_ne = jnp.concatenate(
+            [jnp.ones(1, bool), sorted_h[1:] != sorted_h[:-1]])
+        gid = jnp.cumsum(prev_ne.astype(jnp.int32)) - 1
+        run_start = jax.ops.segment_min(
+            iota, gid, num_segments=build.capacity, indices_are_sorted=True)
+        nxt = jnp.concatenate(
+            [run_start[1:], jnp.full(1, 0, jnp.int32)])
+        n_runs = gid[-1] + 1
+        run_len_g = jnp.where(
+            jnp.arange(build.capacity, dtype=jnp.int32) < n_runs - 1,
+            nxt - run_start, build.capacity - run_start)
+        runlen = jnp.take(run_len_g, gid).astype(jnp.int32)
+        # clamp runs that spill into the dead tail ([n_valid, cap))
+        runlen = jnp.minimum(runlen, jnp.maximum(n_valid - iota, 0))
+        # dense-unique detection (exact-probe only): dimension PKs are
+        # typically a contiguous range, making the probe a DIRECT index —
+        # no searchsorted at all (reference: cudf builds a hash table; a
+        # contiguous sorted build IS a perfect hash). Uniqueness is part
+        # of the predicate: span == n-1 alone holds for {0,2,2}, where a
+        # direct landing would hit mid-run and miss candidates.
+        last = jnp.take(sorted_h, jnp.maximum(n_valid - 1, 0))
+        first = sorted_h[0]
+        valid_runs = jnp.take(gid, jnp.maximum(n_valid - 1, 0)) + 1
+        dense = (n_valid > 0) & (valid_runs == n_valid) & \
+            ((last - first) == (n_valid - 1).astype(sorted_h.dtype))
+        return (sorted_h, n_valid, runlen, first, dense), sorted_build, valid
 
     def _count_kernel(self, stream: ColumnarBatch, sorted_h):
         keys = [e.eval(stream, self.ctx) for e in self.left_keys]
@@ -227,30 +267,93 @@ class HashJoinExec(BinaryExec):
         # hash path: probe sentinel 0xFFFFFFFE ≠ build null sentinel
         # 0xFFFFFFFF, both outside the >>1 hash range, so null/dead
         # probes find nothing. Exact path: no sentinel — counts are only
-        # taken where `valid` (below), and any invalid-build collision
-        # candidate is rejected by key-equality verification.
+        # taken where `valid` (below), and a wrong-landing probe fails the
+        # word-equality check.
         h = self._probe_words(keys, valid, build_side=False)
-        sorted_words, n_valid = sorted_h
-        # method="sort": one concat-sort instead of a serialized binary
-        # search (log-n dependent gather rounds) — measured 5.2x faster
-        # at 4M probes on v5e
-        lo = jnp.searchsorted(sorted_words, h, side="left",
-                              method="sort").astype(jnp.int32)
-        hi = jnp.searchsorted(sorted_words, h, side="right",
-                              method="sort").astype(jnp.int32)
-        # dead build rows occupy [n_valid, cap): clamp them out of every
-        # candidate range
-        lo = jnp.minimum(lo, n_valid)
-        hi = jnp.minimum(hi, n_valid)
-        counts = jnp.where(valid, hi - lo, 0)
+        sorted_words, n_valid, runlen, first, dense = sorted_h
+
+        def dense_path():
+            # unique contiguous build (a dimension PK): position is
+            # (key - first) and presence is a RANGE test — the whole probe
+            # is elementwise, zero gathers, zero searches
+            off = h - first
+            in_r = (h >= first) & (off < n_valid.astype(h.dtype))
+            lo = jnp.where(in_r, off, 0).astype(jnp.int32)
+            counts = jnp.where(valid & in_r, 1, 0).astype(jnp.int32)
+            return lo, counts
+
+        def general_path():
+            # method="sort": one concat-sort instead of a serialized
+            # binary search (log-n dependent gather rounds) — measured
+            # 5.2x faster at 4M probes on v5e. The old side="right"
+            # second search is a build-side run-length gather now.
+            lo = jnp.minimum(
+                jnp.searchsorted(sorted_words, h, side="left",
+                                 method="sort").astype(jnp.int32),
+                n_valid)
+            word_at = jnp.take(sorted_words,
+                               jnp.clip(lo, 0, runlen.shape[0] - 1))
+            hit = (word_at == h) & (lo < n_valid)
+            counts = jnp.where(valid & hit,
+                               jnp.take(runlen, lo), 0).astype(jnp.int32)
+            return lo, counts
+        lo, counts = jax.lax.cond(dense, dense_path, general_path) \
+            if self._exact_probe else general_path()
         offsets = jnp.cumsum(counts)
         # int32 offsets keep the searches native-width; the 64-bit total
         # lets the host detect candidate counts that would wrap them
         total64 = jnp.sum(counts.astype(jnp.int64))
         return lo, counts, offsets, total64
 
-    def _gather_pairs(self, stream, build, perm, lo, counts, offsets, out_cap):
-        """Candidate pair gather + key verification (+ condition)."""
+    def _side_gather(self, batch, keys, idx, ok, need_keys: bool,
+                     subst=None):
+        """ONE batched gather per side (docs/perf_r3.md — sibling gathers
+        don't fuse; stacked row-gathers are width-flat). Key columns that
+        are plain references reuse the already-gathered output column
+        instead of adding a duplicate gather lane; on the exact-probe path
+        keys aren't gathered at all (word equality IS key equality).
+        ``subst`` maps an output ordinal to a pre-known column (the build
+        key equals the probe key on exact matches — no gather needed)."""
+        from ..expressions.base import BoundReference
+        from .common import gather_columns
+        subst = subst or {}
+        cols = list(batch.columns)
+        gathered_idx = [i for i in range(len(cols)) if i not in subst]
+        extra, key_src = [], []
+        if need_keys:
+            for e in keys:
+                if isinstance(e, BoundReference) and e.ordinal not in subst:
+                    key_src.append(("col", e.ordinal))
+                else:
+                    key_src.append(("extra", len(extra)))
+                    extra.append(e.eval(batch, self.ctx))
+        g = gather_columns([cols[i] for i in gathered_idx] + extra, idx, ok)
+        out_cols: List[Optional[DeviceColumn]] = [None] * len(cols)
+        for j, i in enumerate(gathered_idx):
+            out_cols[i] = g[j]
+        for i, c in subst.items():
+            out_cols[i] = c
+        key_cols = [out_cols[i] if kind == "col"
+                    else g[len(gathered_idx) + i]
+                    for (kind, i) in key_src]
+        return out_cols, key_cols
+
+    def _exact_subst(self, key_col_at_pairs, pair_ok):
+        """Exact-probe: the build key column's output values equal the
+        probe key values on every surviving slot, so substitute instead of
+        gathering (kills the build side's whole i32 gather group for a
+        typical star-schema dim). Returns {build ordinal: column} or {}."""
+        from ..expressions.base import BoundReference
+        rk = self.right_keys[0] if self._exact_probe else None
+        if not isinstance(rk, BoundReference) or key_col_at_pairs is None:
+            return {}
+        return {rk.ordinal: key_col_at_pairs.replace(
+            validity=key_col_at_pairs.validity & pair_ok)}
+
+    def _gather_pairs(self, stream, build, lo, counts, offsets, out_cap):
+        """Candidate pair gather + key verification (+ condition).
+        ``build`` is the build-kernel's SORTED build batch, so candidate
+        positions index it directly (no perm indirection)."""
         j = jnp.arange(out_cap, dtype=jnp.int32)
         total = offsets[-1]
         probe_row = jnp.searchsorted(offsets, j, side="right",
@@ -258,36 +361,33 @@ class HashJoinExec(BinaryExec):
         probe_row = jnp.clip(probe_row, 0, stream.capacity - 1)
         start = jnp.take(offsets, probe_row) - jnp.take(counts, probe_row)
         ordinal = j - start
-        build_pos = jnp.take(lo, probe_row) + ordinal
-        build_pos = jnp.clip(build_pos, 0, build.capacity - 1).astype(jnp.int32)
-        build_row = jnp.take(perm, build_pos)
+        build_row = jnp.take(lo, probe_row) + ordinal
+        build_row = jnp.clip(build_row, 0, build.capacity - 1).astype(jnp.int32)
         in_range = j < total
 
-        # ONE batched gather per side: output columns and key columns share
-        # the side's index set (docs/perf_r3.md — sibling gathers don't
-        # fuse; stacked row-gathers are width-flat)
-        from .common import gather_columns
-        s_all = gather_columns(
-            list(stream.columns)
-            + [e.eval(stream, self.ctx) for e in self.left_keys],
-            probe_row, in_range)
-        b_all = gather_columns(
-            list(build.columns)
-            + [e.eval(build, self.ctx) for e in self.right_keys],
-            build_row, in_range)
-        ns, nb = len(stream.columns), len(build.columns)
-        s_cols, s_keys = s_all[:ns], s_all[ns:]
-        b_cols, b_keys = b_all[:nb], b_all[nb:]
-        pair_ok = in_range & _keys_equal(s_keys, b_keys)
+        # exact-probe candidates already matched on the full key word, so
+        # no key re-gather or equality verification is needed; the hash
+        # path gathers keys and rejects collisions here
+        need_keys = not self._exact_probe
+        s_cols, s_keys = self._side_gather(stream, self.left_keys,
+                                           probe_row, in_range, need_keys)
+        from ..expressions.base import BoundReference
+        lk = self.left_keys[0]
+        key_at_pairs = s_cols[lk.ordinal] \
+            if self._exact_probe and isinstance(lk, BoundReference) else None
+        b_cols, b_keys = self._side_gather(
+            build, self.right_keys, build_row, in_range, need_keys,
+            self._exact_subst(key_at_pairs, in_range))
+        pair_ok = in_range if self._exact_probe \
+            else in_range & _keys_equal(s_keys, b_keys)
         if self.condition is not None:
             pair_batch = ColumnarBatch(tuple(s_cols + b_cols), total)
             c = self.condition.eval(pair_batch, self.ctx)
             pair_ok = pair_ok & c.data & c.validity
         return s_cols, b_cols, pair_ok, probe_row, build_row
 
-    def _expand_kernel(self, stream, build_pack, lo_counts, matched_build_in,
+    def _expand_kernel(self, stream, build, lo_counts, matched_build_in,
                        out_cap: int):
-        build, perm = build_pack
         lo, counts, offsets = lo_counts
         # FK fast path (the overwhelmingly common star-schema shape):
         # when every probe has AT MOST ONE candidate, the expansion is a
@@ -300,33 +400,45 @@ class HashJoinExec(BinaryExec):
             unique = jnp.max(counts) <= 1
             return jax.lax.cond(
                 unique,
-                lambda: self._expand_unique(stream, build, perm, lo,
+                lambda: self._expand_unique(stream, build, lo,
                                             counts, matched_build_in,
                                             out_cap),
-                lambda: self._expand_general(stream, build, perm, lo,
+                lambda: self._expand_general(stream, build, lo,
                                              counts, offsets,
                                              matched_build_in, out_cap))
-        return self._expand_general(stream, build, perm, lo, counts,
+        return self._expand_general(stream, build, lo, counts,
                                     offsets, matched_build_in, out_cap)
 
-    def _expand_unique(self, stream, build, perm, lo, counts,
+    def _unique_probe_cols(self, stream, build, lo, counts):
+        """Shared <=1-match-per-probe verification: gather build columns
+        1:1 at stream layout and compute the verified pair mask (exact
+        path: word equality IS key equality + key substitution; hash
+        path: gather keys and reject collisions)."""
+        matched = counts > 0
+        build_row = jnp.clip(lo, 0, build.capacity - 1)
+        if self._exact_probe:
+            pair_ok = matched & stream.row_mask()
+            from ..expressions.base import BoundReference
+            key_col = self.left_keys[0].eval(stream, self.ctx) \
+                if isinstance(self.right_keys[0], BoundReference) else None
+            b_cols, _ = self._side_gather(
+                build, self.right_keys, build_row, matched, False,
+                self._exact_subst(key_col, pair_ok))
+        else:
+            b_cols, b_keys = self._side_gather(build, self.right_keys,
+                                               build_row, matched, True)
+            s_keys = [e.eval(stream, self.ctx) for e in self.left_keys]
+            pair_ok = matched & stream.row_mask() & \
+                _keys_equal(s_keys, b_keys)
+        return b_cols, pair_ok
+
+    def _expand_unique(self, stream, build, lo, counts,
                        matched_build_in, out_cap: int):
         """<=1 match per probe: direct row mapping at stream capacity."""
-        from .common import gather_columns
-        matched = counts > 0
-        build_pos = jnp.clip(lo, 0, build.capacity - 1)
-        build_row = jnp.take(perm, build_pos)
-        b_all = gather_columns(
-            list(build.columns)
-            + [e.eval(build, self.ctx) for e in self.right_keys],
-            build_row, matched)
-        nb = len(build.columns)
-        b_cols, b_keys = b_all[:nb], b_all[nb:]
-        s_keys = [e.eval(stream, self.ctx) for e in self.left_keys]
-        pair_ok = matched & stream.row_mask() & _keys_equal(s_keys, b_keys)
-        matched_build = matched_build_in.at[
-            jnp.where(pair_ok, build_row, build.capacity)].set(
-            True, mode="drop")
+        b_cols, pair_ok = self._unique_probe_cols(stream, build, lo, counts)
+        # only RIGHT/FULL outer consume build-match state, and this path
+        # serves INNER/LEFT only — skip the scatter
+        matched_build = matched_build_in
         if self.join_type is JoinType.LEFT_OUTER:
             # every stream row survives; unmatched rows take null builds.
             # Pad to the general path's post-concat capacity so lax.cond
@@ -351,11 +463,10 @@ class HashJoinExec(BinaryExec):
             tuple(_pad_column(c, cap) for c in batch.columns),
             batch.num_rows)
 
-    def _expand_general(self, stream, build_pack_or_build, perm, lo,
+    def _expand_general(self, stream, build, lo,
                         counts, offsets, matched_build_in, out_cap: int):
-        build = build_pack_or_build
         s_cols, b_cols, pair_ok, probe_row, build_row = self._gather_pairs(
-            stream, build, perm, lo, counts, offsets, out_cap)
+            stream, build, lo, counts, offsets, out_cap)
 
         # compact verified pairs to the front
         pairs = compact(ColumnarBatch(tuple(s_cols + b_cols),
@@ -367,9 +478,12 @@ class HashJoinExec(BinaryExec):
         stream_matches = jax.ops.segment_sum(
             pair_ok.astype(jnp.int32), seg, num_segments=stream.capacity + 1,
             indices_are_sorted=True)[: stream.capacity]
-        matched_build = matched_build_in.at[
-            jnp.where(pair_ok, build_row, build.capacity)].set(
-            True, mode="drop")
+        if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            matched_build = matched_build_in.at[
+                jnp.where(pair_ok, build_row, build.capacity)].set(
+                True, mode="drop")
+        else:
+            matched_build = matched_build_in
 
         if self.join_type in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER):
             unmatched = stream.row_mask() & (stream_matches == 0)
@@ -382,16 +496,57 @@ class HashJoinExec(BinaryExec):
             out = pairs
         return out, matched_build
 
-    def _semi_kernel(self, stream, build_pack, lo_counts, matched_build_in,
+    def _expand_masked(self, stream, build, lo, counts, offsets,
+                       out_cap: int):
+        """INNER-join expansion WITHOUT the compaction pass: the pair
+        batch at out_cap slots (num_rows == capacity) plus a live-pair
+        mask, for consumers that tolerate interleaved dead rows — a
+        downstream aggregation key-sorts anyway, so fused join→agg skips
+        an entire compact (cumsum + scatter + per-column gathers).
+        Reference analogue: AST-fused filter feeding cudf groupby."""
+        assert self.join_type is JoinType.INNER
+
+        def unique_fn():
+            b_cols, pair_ok = self._unique_probe_cols(stream, build, lo,
+                                                      counts)
+            if self.condition is not None:
+                pb = ColumnarBatch(stream.columns + tuple(b_cols),
+                                   stream.num_rows)
+                c = self.condition.eval(pb, self.ctx)
+                pair_ok = pair_ok & c.data & c.validity
+            out = self._pad_batch(
+                ColumnarBatch(stream.columns + tuple(b_cols),
+                              jnp.asarray(stream.capacity, jnp.int32)),
+                out_cap)
+            mask = jnp.pad(pair_ok, (0, out_cap - stream.capacity))
+            return out, mask
+
+        def general_fn():
+            s_cols, b_cols, pair_ok, _, _ = self._gather_pairs(
+                stream, build, lo, counts, offsets, out_cap)
+            return ColumnarBatch(tuple(s_cols + b_cols),
+                                 jnp.asarray(out_cap, jnp.int32)), pair_ok
+
+        if out_cap >= stream.capacity:
+            unique = jnp.max(counts) <= 1
+            return jax.lax.cond(unique, unique_fn, general_fn)
+        return general_fn()
+
+    def _semi_kernel(self, stream, build, lo_counts, matched_build_in,
                      out_cap: int):
-        build, perm = build_pack
         lo, counts, offsets = lo_counts
-        _, _, pair_ok, probe_row, _ = self._gather_pairs(
-            stream, build, perm, lo, counts, offsets, out_cap)
-        seg = jnp.where(pair_ok, probe_row, stream.capacity)
-        stream_matches = jax.ops.segment_sum(
-            pair_ok.astype(jnp.int32), seg, num_segments=stream.capacity + 1,
-            indices_are_sorted=True)[: stream.capacity]
+        if self._exact_probe and self.condition is None:
+            # candidate counts ARE verified match counts on the exact
+            # path: no pair expansion at all
+            stream_matches = counts
+        else:
+            _, _, pair_ok, probe_row, _ = self._gather_pairs(
+                stream, build, lo, counts, offsets, out_cap)
+            seg = jnp.where(pair_ok, probe_row, stream.capacity)
+            stream_matches = jax.ops.segment_sum(
+                pair_ok.astype(jnp.int32), seg,
+                num_segments=stream.capacity + 1,
+                indices_are_sorted=True)[: stream.capacity]
         if self.join_type is JoinType.LEFT_SEMI:
             keep = stream_matches > 0
         elif self.join_type is JoinType.LEFT_ANTI:
@@ -487,8 +642,8 @@ class HashJoinExec(BinaryExec):
         else:
             cap = bucket_capacity(sum(b.capacity for b in build_batches))
             build = concat_batches(build_batches, cap)
-        sorted_h, perm, _ = self._build_jit(build)
-        matched_build = jnp.zeros(build.capacity, bool)
+        sorted_h, sbuild, _ = self._build_jit(build)
+        matched_build = jnp.zeros(sbuild.capacity, bool)
 
         semi = self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
                                   JoinType.EXISTENCE)
@@ -502,21 +657,24 @@ class HashJoinExec(BinaryExec):
                     f"the batch size or pre-aggregate the build side")
             out_cap = bucket_capacity(max(total_i, 1))
             if semi:
-                yield self._semi_jit(stream, (build, perm),
+                yield self._semi_jit(stream, sbuild,
                                      (lo, counts, offsets), matched_build,
                                      out_cap)
             else:
                 out, matched_build = self._expand_jit(
-                    stream, (build, perm), (lo, counts, offsets),
+                    stream, sbuild, (lo, counts, offsets),
                     matched_build, out_cap)
                 yield out
 
         if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
-            unmatched = build.row_mask() & ~matched_build
+            # matched state lives in SORTED build space; the tail reads
+            # the sorted build batch (row order is not part of the
+            # contract)
+            unmatched = sbuild.row_mask() & ~matched_build
             null_left = _null_gather(self.left_child_placeholder(),
-                                     build.capacity)
-            tail = ColumnarBatch(tuple(null_left) + build.columns,
-                                 build.num_rows)
+                                     sbuild.capacity)
+            tail = ColumnarBatch(tuple(null_left) + sbuild.columns,
+                                 sbuild.num_rows)
             yield compact(tail, unmatched)
 
     # ------------------------------------------------------------------

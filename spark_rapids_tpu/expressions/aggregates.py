@@ -68,17 +68,7 @@ class segment_bounds:
 
 def _seg_scan_reduce(x, seg, identity, op):
     """suffix[i] = OP over x[j] for j in [i .. end of i's segment]."""
-    n = x.shape[0]
-    idx = jnp.arange(n, dtype=jnp.int32)
-
-    def body(k, acc):
-        d = jnp.int32(1) << k
-        shifted = jnp.roll(acc, -d)
-        sseg = jnp.roll(seg, -d)
-        ok = (idx + d < n) & (sseg == seg)
-        return op(acc, jnp.where(ok, shifted, identity))
-
-    return jax.lax.fori_loop(0, max(n - 1, 1).bit_length(), body, x)
+    return _suffix_scan_ladder(x, seg, op, identity)
 
 
 def _cumsum(x):
@@ -180,10 +170,16 @@ class FastLanes:
         return len(self.max_lanes) - 1
 
 
-def _prefix_ladder(m: jax.Array) -> jax.Array:
-    """Inclusive prefix sum along axis 0, unrolled static-shift ladder
-    (native cumsum on emulated 64-bit lowers to a vmem-exhausting
-    reduce-window; this ladder measures 11–16 ms / 4M rows)."""
+# Block width for the two-level scans. A flat Hillis-Steele ladder over n
+# rows runs log2(n) full-array rounds; reshaping to (n/C, C) runs the heavy
+# rounds along the SHORT axis only (log2(C) of them) plus a cheap n/C-sized
+# second level. Measured on-chip (tools/profile_round4.py): segmented suffix
+# over (4M,6) f64 went 58 ms (flat, 22 rounds) -> 3.8 ms at C=512, exact to
+# 2.8e-14.
+_SCAN_BLOCK = 512
+
+
+def _prefix_ladder_flat(m: jax.Array) -> jax.Array:
     n = m.shape[0]
     d = 1
     while d < n:
@@ -193,9 +189,31 @@ def _prefix_ladder(m: jax.Array) -> jax.Array:
     return m
 
 
-def _suffix_scan_ladder(m: jax.Array, seg: jax.Array, op, identity) -> jax.Array:
-    """Segmented suffix scan along axis 0: row i becomes OP over rows
-    [i..end of i's segment] per lane. Unrolled static shifts."""
+def _prefix_ladder(m: jax.Array) -> jax.Array:
+    """Inclusive prefix sum along axis 0 (native cumsum on emulated 64-bit
+    lowers to a vmem-exhausting reduce-window; cumsum over (4M,6) f64 also
+    measures 160 ms where this blocked ladder is ~4 ms)."""
+    n = m.shape[0]
+    C = _SCAN_BLOCK
+    if n <= C or n % C != 0:
+        return _prefix_ladder_flat(m)
+    squeeze = m.ndim == 1
+    if squeeze:
+        m = m[:, None]
+    R = n // C
+    acc = m.reshape(R, C, m.shape[1])
+    d = 1
+    while d < C:
+        z = jnp.zeros((R, d, acc.shape[2]), acc.dtype)
+        acc = acc + jnp.concatenate([z, acc[:, :-d]], axis=1)
+        d <<= 1
+    totals = acc[:, -1, :]
+    offs = _prefix_ladder_flat(totals) - totals     # exclusive row offsets
+    out = (acc + offs[:, None, :]).reshape(n, -1)
+    return out[:, 0] if squeeze else out
+
+
+def _suffix_flat(m, seg, op, identity):
     n = m.shape[0]
     ident = jnp.full((1,) + m.shape[1:], identity, m.dtype)
     d = 1
@@ -210,56 +228,84 @@ def _suffix_scan_ladder(m: jax.Array, seg: jax.Array, op, identity) -> jax.Array
     return m
 
 
+def _suffix_scan_ladder(m: jax.Array, seg: jax.Array, op, identity) -> jax.Array:
+    """Segmented suffix scan along axis 0: row i becomes OP over rows
+    [i..end of i's segment] per lane.
+
+    Two-level blocked form: within-block segmented suffix along the short
+    axis (log2(C) rounds), then a block-start recurrence over n/C rows and
+    one continuation combine. PRECONDITION (held by every caller): ``seg``
+    is non-decreasing over the live prefix followed by a constant dead-tail
+    sentinel — the kernels' key-sorted layouts. The second-level ladder
+    jumps over intermediate blocks, which is only sound when equal
+    block-head segments imply every block between is the same segment."""
+    n = m.shape[0]
+    C = _SCAN_BLOCK
+    if n <= C or n % C != 0:
+        return _suffix_flat(m, seg, op, identity)
+    squeeze = m.ndim == 1
+    if squeeze:
+        m = m[:, None]
+    R, k = n // C, m.shape[1]
+    ident = jnp.asarray(identity, m.dtype)
+    acc = m.reshape(R, C, k)
+    s2 = seg.reshape(R, C)
+    d = 1
+    while d < C:
+        sm = jnp.concatenate(
+            [acc[:, d:], jnp.full((R, d, k), ident, acc.dtype)], axis=1)
+        ss = jnp.concatenate(
+            [s2[:, d:], jnp.full((R, d), -2, s2.dtype)], axis=1)
+        ok = (ss == s2)[..., None]
+        acc = op(acc, jnp.where(ok, sm, ident))
+        d <<= 1
+    # full suffix at each block start: segmented ladder over block heads
+    head = acc[:, 0, :]
+    seg_head, seg_tail = s2[:, 0], s2[:, -1]
+    tot = head
+    d = 1
+    while d < R:
+        sm = jnp.concatenate(
+            [tot[d:], jnp.full((d, k), ident, tot.dtype)], axis=0)
+        ss = jnp.concatenate(
+            [seg_head[d:], jnp.full((d,), -2, seg_head.dtype)])
+        ok = (ss == seg_head)[:, None]
+        tot = op(tot, jnp.where(ok, sm, ident))
+        d <<= 1
+    # rows whose segment crosses the block end pick up the continuation
+    cont = jnp.concatenate(
+        [seg_tail[:-1] == seg_head[1:], jnp.zeros((1,), bool)])
+    carry = jnp.concatenate(
+        [tot[1:], jnp.full((1, k), ident, tot.dtype)], axis=0)
+    cross = (s2 == seg_tail[:, None]) & cont[:, None]
+    out = op(acc, jnp.where(cross[..., None], carry[:, None, :], ident))
+    out = out.reshape(n, k)
+    return out[:, 0] if squeeze else out
+
+
 class LaneResults:
     """Per-branch resolved lane reductions at the [L] group-slot layout.
 
-    Sum strategy is layout-tier dependent: small tiers run one prefix
-    ladder plus TWO cheap [L]-row-gathers; large tiers run the segmented
-    SUFFIX ladder (group totals land on each group's first row) so only
-    ONE expensive row-gather remains (a [4M,6] f64 row-gather is ~200 ms —
-    the dominant cost at full capacity)."""
+    Every reduction kind runs one blocked segmented suffix scan (group
+    totals land on each group's first row) followed by ONE [L]-row-gather
+    at group starts; the gather is the tier-dependent cost (a [4M,6] f64
+    row-gather at L=4M is ~180 ms, ~33 ms at L=1M — pick tiers well)."""
 
     def __init__(self, lanes: FastLanes, seg: jax.Array,
-                 starts: jax.Array, ends: jax.Array, live_slot: jax.Array):
+                 starts: jax.Array, live_slot: jax.Array):
         self.live_slot = live_slot
         n = lanes.live.shape[0]
-        L = starts.shape[0]
         s = jnp.clip(starts, 0, n - 1)
-        e = jnp.clip(ends, 0, n - 1)
         self._sum_at = None
         if lanes.sum_lanes:
-            m = len(lanes.sum_lanes)
-            if L >= (1 << 20):
-                # large layouts: one expensive row-gather instead of two;
-                # the segmented suffix scan is also group-local for floats
-                stack = jnp.stack(lanes.sum_lanes, axis=1)
-                suf = _suffix_scan_ladder(stack, seg, jnp.add, 0.0)
-                self._sum_at = jnp.take(suf, s, axis=0)
-            else:
-                # small layouts: [L]-gathers are free. Integer-exact lanes
-                # take the cheap prefix-difference; FLOAT lanes must scan
-                # segmented so a small group is never differenced against
-                # the whole-batch running sum (catastrophic cancellation).
-                cols = [None] * m
-                ex = [i for i in range(m) if lanes.sum_exact[i]]
-                fl = [i for i in range(m) if not lanes.sum_exact[i]]
-                if ex:
-                    stack = jnp.stack([lanes.sum_lanes[i] for i in ex],
-                                      axis=1)
-                    cum = _prefix_ladder(stack)
-                    excl = cum - stack
-                    win = (jnp.take(cum, e, axis=0)
-                           - jnp.take(excl, s, axis=0))
-                    for j, i in enumerate(ex):
-                        cols[i] = win[:, j]
-                if fl:
-                    stack = jnp.stack([lanes.sum_lanes[i] for i in fl],
-                                      axis=1)
-                    suf = _suffix_scan_ladder(stack, seg, jnp.add, 0.0)
-                    win = jnp.take(suf, s, axis=0)
-                    for j, i in enumerate(fl):
-                        cols[i] = win[:, j]
-                self._sum_at = jnp.stack(cols, axis=1)
+            # one two-level segmented suffix scan (group-local rounding,
+            # ~4 ms per (4M,6) f64) + ONE [L]-row-gather at group starts —
+            # the cheapest shape at every tier now that the scan is blocked
+            # (the old prefix-difference needed TWO gathers and was only
+            # exact for integer lanes anyway)
+            stack = jnp.stack(lanes.sum_lanes, axis=1)
+            suf = _suffix_scan_ladder(stack, seg, jnp.add, 0.0)
+            self._sum_at = jnp.take(suf, s, axis=0)
         self._min_at = None
         if lanes.min_lanes:
             m = _suffix_scan_ladder(jnp.stack(lanes.min_lanes, axis=1),

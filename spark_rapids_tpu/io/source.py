@@ -162,7 +162,12 @@ class FileSource:
             typed = vals
             if all(v is None or _is_int(v) for v in vals):
                 typed = [None if v is None else int(v) for v in vals]
-                kind = "int"
+                # Spark's partition inference yields IntegerType when every
+                # value fits int32 (widening to int64 otherwise); matching
+                # it keeps round-tripped schemas and join key dtypes stable
+                kind = "int" if all(
+                    v is None or -(1 << 31) <= v < (1 << 31)
+                    for v in typed) else "int64"
             else:
                 kind = "string"
             self.partition_schema.append((name, kind))
@@ -177,7 +182,8 @@ class FileSource:
         then restore the REQUESTED column order."""
         for name, kind in self.partition_schema:
             v = self._pvalues[name][path]
-            typ = pa.int64() if kind == "int" else pa.string()
+            typ = (pa.int32() if kind == "int" else
+                   pa.int64() if kind == "int64" else pa.string())
             t = t.append_column(name, pa.array([v] * t.num_rows, typ))
         if self.with_file_name:
             t = t.append_column(
@@ -213,7 +219,9 @@ class FileSource:
                 s = pa.schema([s.field(c) for c in self.columns])
             for name, kind in self.partition_schema:
                 s = s.append(pa.field(
-                    name, pa.int64() if kind == "int" else pa.string()))
+                    name,
+                    pa.int32() if kind == "int" else
+                    pa.int64() if kind == "int64" else pa.string()))
             if self._requested_columns:
                 names = [f.name for f in s]
                 order = [c for c in self._requested_columns if c in names]

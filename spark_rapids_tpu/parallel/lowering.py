@@ -83,6 +83,12 @@ class MeshLowering:
         # (SinglePartitioning still gets the lossless n_dev*cap — ALL rows
         # genuinely land on one device there)
         self.exchange_factor = 2
+        # partial-aggregate outputs keep their INPUT capacity (static
+        # shapes), but carry only distinct-key rows — routing them at full
+        # width makes the exchange and the final merge re-sort millions of
+        # dead slots. Slice to this bucket before routing; the overflow
+        # flag + stage retry (x4) covers genuinely high-cardinality keys.
+        self.agg_bucket = 1 << 16
         self.inputs: List[Exec] = []
         self.lowered_names: List[str] = []
         self._trace_flags: List[jax.Array] = []
@@ -314,13 +320,35 @@ class MeshLowering:
             raise MeshUnsupported("FINAL child is not a PARTIAL agg")
         self.lowered_names.append(partial.name)
         self.lowered_names.append("mesh_exchange(all_to_all)")
-        child = self._lower_node(partial.child)
+        # join→agg mask fusion: an INNER join directly below the partial
+        # aggregate emits its pair slots UNCOMPACTED with a live mask; the
+        # aggregate's key sort pushes dead slots to the tail anyway, so a
+        # whole compact pass (cumsum + scatter + per-column gathers)
+        # disappears from the fused program
+        inner = partial.child
+        while isinstance(inner, CoalesceBatchesExec):
+            inner = inner.child
+        masked_join = None
+        if isinstance(inner, HashJoinExec) and \
+                inner.join_type is JoinType.INNER:
+            masked_join = self._lower_join(inner, masked=True)
+        else:
+            child = self._lower_node(partial.child)
         nk = len(partial.key_fields)
         n_dev, axis = self.n_dev, self.axis
 
         def agg(args):
-            b = child(args)
-            part = partial._update_kernel(b)
+            if masked_join is not None:
+                b, mask = masked_join(args)
+                part = partial._update_kernel(b, mask)
+            else:
+                b = child(args)
+                part = partial._update_kernel(b)
+            shrink = bucket_capacity(min(part.capacity, self.agg_bucket))
+            if shrink < part.capacity:
+                self._trace_flags.append(part.num_rows > shrink)
+                part = slice_batch(part, jnp.int32(0), part.num_rows,
+                                   shrink)
             if nk == 0 or isinstance(part_kind, SinglePartitioning):
                 pids = jnp.zeros(part.capacity, jnp.int32)
             else:
@@ -339,7 +367,10 @@ class MeshLowering:
             return out
         return agg
 
-    def _lower_join(self, join: HashJoinExec) -> Callable:
+    def _lower_join(self, join: HashJoinExec, masked: bool = False
+                    ) -> Callable:
+        if masked:
+            self.lowered_names.append(join.name + "(masked)")
         if join.broadcast_build:
             if not isinstance(join.right, BroadcastExchangeExec):
                 raise MeshUnsupported("broadcast join without broadcast "
@@ -357,6 +388,8 @@ class MeshLowering:
             def jn(args):
                 s = stream(args)
                 full_build = mesh_broadcast(build(args), n_dev, axis)
+                if masked:
+                    return self._join_masked(join, s, full_build)
                 return self._join_local(join, s, full_build)
             return jn
 
@@ -378,36 +411,49 @@ class MeshLowering:
         def jn_shuffled(args):
             s = stream(args)
             b = build(args)
+            if masked:
+                return self._join_masked(join, s, b)
             return self._join_local(join, s, b)
         return jn_shuffled
+
+    def _join_masked(self, join: HashJoinExec, s: ColumnarBatch,
+                     build: ColumnarBatch):
+        """INNER probe WITHOUT pair compaction: (pair batch, live mask)
+        for the aggregate's fused-mask input."""
+        sorted_h, sbuild, _ = join._build_kernel(build)
+        lo, counts, offsets, total = join._count_kernel(s, sorted_h)
+        out_cap = bucket_capacity(self.join_expansion * s.capacity)
+        self._trace_flags.append(total > out_cap)
+        return join._expand_masked(s, sbuild, lo, counts, offsets, out_cap)
 
     def _join_local(self, join: HashJoinExec, s: ColumnarBatch,
                     build: ColumnarBatch) -> ColumnarBatch:
         """Single-device probe incl. outer tails; static output capacity
         with an overflow trace-flag."""
-        sorted_h, perm, _ = join._build_kernel(build)
+        sorted_h, sbuild, _ = join._build_kernel(build)
         lo, counts, offsets, total = join._count_kernel(s, sorted_h)
         out_cap = bucket_capacity(self.join_expansion * s.capacity)
-        matched0 = jnp.zeros(build.capacity, bool)
+        matched0 = jnp.zeros(sbuild.capacity, bool)
         self._trace_flags.append(total > out_cap)
         semi = join.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
                                   JoinType.EXISTENCE)
         if semi:
-            return join._semi_kernel(s, (build, perm),
+            return join._semi_kernel(s, sbuild,
                                      (lo, counts, offsets), matched0,
                                      out_cap)
-        out, matched = join._expand_kernel(s, (build, perm),
+        out, matched = join._expand_kernel(s, sbuild,
                                            (lo, counts, offsets), matched0,
                                            out_cap)
         if join.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
             from ..exec.join import _null_gather
-            unmatched = build.row_mask() & ~matched
+            unmatched = sbuild.row_mask() & ~matched
             null_left = _null_gather(join.left_child_placeholder(),
-                                     build.capacity)
-            tail = compact(ColumnarBatch(tuple(null_left) + build.columns,
-                                         build.num_rows), unmatched)
+                                     sbuild.capacity)
+            tail = compact(ColumnarBatch(tuple(null_left) + sbuild.columns,
+                                         sbuild.num_rows), unmatched)
             out = concat_batches(
-                [out, tail], bucket_capacity(out.capacity + build.capacity))
+                [out, tail],
+                bucket_capacity(out.capacity + sbuild.capacity))
         return out
 
 
@@ -493,10 +539,11 @@ class MeshStageExec(LeafExec):
             if not bool(np.any(np.asarray(jax.device_get(flags)))):
                 self._results = unstack_batches(out)
                 return self._results
-            # capacity flags don't say WHICH bucket lost; double both —
+            # capacity flags don't say WHICH bucket lost; grow all —
             # retries are rare and the retrace is the expensive part
             low.join_expansion *= 2
             low.exchange_factor *= 2
+            low.agg_bucket *= 4
         raise MeshCapacityError(
             f"mesh join overflowed at expansion {low.join_expansion}")
 

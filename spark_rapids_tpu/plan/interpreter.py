@@ -2043,7 +2043,11 @@ def _rw_get_json_object(self, e, row):
     if isinstance(cur, bool):
         return "true" if cur else "false"
     if isinstance(cur, (dict, list)):
-        return _json.dumps(cur, separators=(", ", ": "))
+        # Spark emits compact Jackson output ({"c":7}); the device path
+        # returns the raw input span, which agrees only when the input
+        # itself is compact — that divergence is pinned by
+        # test_get_json_object_nested_whitespace
+        return _json.dumps(cur, separators=(",", ":"))
     return str(cur)
 
 

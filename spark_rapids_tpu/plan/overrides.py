@@ -784,7 +784,15 @@ class Overrides:
                 return node, cur
         from ..io.scan import FileSourceScanExec
         build_tbl = None
+        from ..expressions.cast import Cast
         for lk, rk in zip(left_keys, right_keys):
+            # planner-inserted widening casts (mismatched integral key
+            # pairs) are transparent to pruning: the PARTITION VALUES are
+            # python ints, compared against the build values semantically
+            while isinstance(lk, Cast):
+                lk = lk.child
+            while isinstance(rk, Cast):
+                rk = rk.child
             name = getattr(lk, "name", None)
             rk_name = getattr(rk, "name", None)
             if name is None or rk_name is None:
@@ -835,6 +843,23 @@ class Overrides:
 
         left_keys, right_keys = list(n.left_keys), list(n.right_keys)
         l, r = ch[0], ch[1]
+        # implicit key casts (Spark inserts these during analysis): widen
+        # mismatched integral key pairs to the wider side so int32
+        # partition columns join against bigint dims without user casts
+        from .. import types as T
+        from ..expressions.cast import Cast
+        _INT_ORDER = {T.TypeKind.INT8: 0, T.TypeKind.INT16: 1,
+                      T.TypeKind.INT32: 2, T.TypeKind.INT64: 3}
+        for i, (lk, rk) in enumerate(zip(left_keys, right_keys)):
+            lt = lk.bind(l.output_schema).dtype
+            rt = rk.bind(r.output_schema).dtype
+            if lt == rt or lt.kind not in _INT_ORDER or \
+                    rt.kind not in _INT_ORDER:
+                continue
+            if _INT_ORDER[lt.kind] < _INT_ORDER[rt.kind]:
+                left_keys[i] = Cast(lk, rt)
+            else:
+                right_keys[i] = Cast(rk, lt)
         swapped = False
         # build-side selection: INNER is symmetric, so put the smaller side
         # on the build (right) when the estimate says left is smaller

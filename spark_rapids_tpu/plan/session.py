@@ -60,6 +60,17 @@ class Session:
             if lowered is not None:
                 plan = lowered
                 self.last_plan = plan
+                return "exec", plan
+        from ..config import FUSION_ENABLED
+        if self.conf.get(FUSION_ENABLED.key):
+            # whole-stage fusion: an eligible linear single-batch stage
+            # runs as ONE XLA program (overflow-flag retries inside
+            # FusedStage.run); ineligible shapes keep the iterator path
+            from ..exec.fuse import try_fuse_exec
+            fused = try_fuse_exec(plan)
+            if fused is not None:
+                plan = fused
+                self.last_plan = plan
         return "exec", plan
 
     def collect(self, df: DataFrame) -> pa.Table:

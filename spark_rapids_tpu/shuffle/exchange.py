@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import itertools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -413,6 +414,7 @@ class CachedShuffleExchangeExec(UnaryExec):
         self._shuffle_id = next(_cached_shuffle_ids)
         self._cache = cache
         self._written = False
+        self._write_lock = threading.Lock()
         self._slice_jit = jax.jit(
             lambda b, pids, p: compact(b, pids == p), static_argnums=2)
         self._pids_jit = jax.jit(
@@ -433,8 +435,17 @@ class CachedShuffleExchangeExec(UnaryExec):
         return self.partitioning.num_partitions
 
     def _write(self) -> None:
+        # double-checked under the lock: concurrent reduce-partition
+        # consumers must not both enter and register duplicate blocks
+        # (same discipline DeviceShuffleCache uses internally)
         if self._written:
             return
+        with self._write_lock:
+            if self._written:
+                return
+            self._write_locked()
+
+    def _write_locked(self) -> None:
         cache = self._get_cache()
         schema = self.child.output_schema
         m = 0

@@ -1,0 +1,106 @@
+"""Whole-stage fusion (exec/fuse.py) — the production path.
+
+ADVICE r3 #2: fusion must be wired into the session (not bench-only) and
+FusedStage.run()'s overflow-retry and ANSI-raise paths need direct tests.
+Reference analogue: whole-stage codegen pipelining (SURVEY.md §3.3).
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec import InMemoryScanExec, HashJoinExec, JoinType
+from spark_rapids_tpu.exec.fuse import FusedStageExec, FusedStage, try_fuse
+from spark_rapids_tpu.exec.sort import SortExec, desc
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.plan import Session, table as df_table
+from spark_rapids_tpu.plan.interpreter import Interpreter
+
+
+def _assert_tables_equal(a: pa.Table, b: pa.Table, sort_by=None):
+    if sort_by:
+        a = a.sort_by(sort_by)
+        b = b.sort_by(sort_by)
+    assert a.schema.names == b.schema.names
+    for n in a.schema.names:
+        assert a.column(n).to_pylist() == b.column(n).to_pylist(), n
+
+
+def test_session_engages_fusion_for_linear_stage():
+    t = pa.table({"a": np.arange(100, dtype=np.int64),
+                  "b": np.arange(100, dtype=np.float64)})
+    q = df_table(t).where(col("a") < lit(50)).select(
+        (col("a") * lit(2)).alias("a2"), col("b"))
+    ses = Session({})
+    out = ses.collect(q)
+    assert isinstance(ses.last_plan, FusedStageExec), type(ses.last_plan)
+    expected = Interpreter().execute(q.plan)
+    _assert_tables_equal(out, expected, sort_by=[("a2", "ascending")])
+
+
+def test_session_fusion_disabled_by_conf():
+    t = pa.table({"a": np.arange(10, dtype=np.int64)})
+    q = df_table(t).where(col("a") < lit(5))
+    ses = Session({"spark.rapids.tpu.sql.fusion.enabled": False})
+    ses.collect(q)
+    assert not isinstance(ses.last_plan, FusedStageExec)
+
+
+def test_fused_join_overflow_retry():
+    # every probe matches 8 build rows -> 8x expansion overflows the
+    # optimistic 1x bucket; run() must retrace at the needed factor and
+    # produce the exact join result
+    n = 256
+    stream = pa.table({"k": np.arange(n, dtype=np.int64) % 16,
+                       "v": np.arange(n, dtype=np.float64)})
+    build = pa.table({"bk": np.repeat(np.arange(16, dtype=np.int64), 8),
+                      "w": np.arange(128, dtype=np.int64)})
+    join = HashJoinExec([col("k")], [col("bk")], JoinType.INNER,
+                        InMemoryScanExec(stream), InMemoryScanExec(build))
+    plan = SortExec([desc(col("v"))], join)
+    stage = try_fuse(plan, expand_factor=1)
+    assert stage is not None
+    out = stage.run()
+    from spark_rapids_tpu.batch import to_arrow
+    got = to_arrow(out, plan.output_schema)
+    expected = stream.join(build, keys="k", right_keys="bk",
+                           join_type="inner")
+    assert got.num_rows == expected.num_rows == n * 8
+    _assert_tables_equal(
+        got.select(["k", "v", "w"]),
+        expected.select(["k", "v", "w"]),
+        sort_by=[("v", "ascending"), ("w", "ascending")])
+
+
+def test_fused_ansi_error_raises():
+    t = pa.table({"a": pa.array([1, 2, 2 ** 62], pa.int64())})
+    q = df_table(t).select((col("a") * lit(4)).alias("x"))
+    ses = Session({"spark.rapids.tpu.sql.ansi.enabled": True})
+    with pytest.raises(Exception) as ei:
+        ses.collect(q)
+    assert "overflow" in str(ei.value).lower()
+
+
+def test_fused_ansi_clean_inputs_pass():
+    t = pa.table({"a": pa.array([1, 2, 3], pa.int64())})
+    q = df_table(t).select((col("a") * lit(4)).alias("x"))
+    ses = Session({"spark.rapids.tpu.sql.ansi.enabled": True})
+    out = ses.collect(q)
+    assert out.column("x").to_pylist() == [4, 8, 12]
+
+
+def test_fusion_skips_exchange_plans():
+    # a shuffled aggregate carries an exchange node — outside the fusable
+    # subset; the iterator path must still produce the right answer
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    t = pa.table({"g": np.arange(64, dtype=np.int64) % 4,
+                  "a": np.arange(64, dtype=np.int64)})
+    q = df_table(t, num_slices=4).group_by("g").agg(
+        Sum(col("a")).alias("s"))
+    ses = Session({})
+    out = ses.collect(q)
+    assert not isinstance(ses.last_plan, FusedStageExec)
+    got = dict(zip(out.column("g").to_pylist(), out.column("s").to_pylist()))
+    exp = {}
+    for g, a in zip(t.column("g").to_pylist(), t.column("a").to_pylist()):
+        exp[g] = exp.get(g, 0) + a
+    assert got == exp

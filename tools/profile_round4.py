@@ -1,0 +1,422 @@
+"""Round-4 profiling: decompose the two losing bench configs on the chip.
+
+VERDICT r3 Next #1: (a) profile the LOWERED SPMD program the way perf_r3
+profiled the host path; (b) find the hash_agg residue a Pallas segmented
+reduction should replace. Results drive docs/perf_r4.md.
+
+Run: python tools/profile_round4.py [hash_agg|ici|prims]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 22
+NKEYS = 1 << 20
+
+
+def sync(x):
+    leaves = [l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "dtype")]
+    if leaves:
+        v = leaves[0]
+        float(jnp.sum(v.astype(jnp.float32)))
+
+
+def bench(name, fn, *args, reps=3, jit=True):
+    f = jax.jit(fn) if jit else fn
+    t0 = time.perf_counter()
+    out = f(*args)
+    sync(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sync(out)
+    sync_cost = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    sync(out)
+    dt = max(time.perf_counter() - t0 - sync_cost, 1e-9) / reps
+    print(f"{name:58s} {dt*1e3:9.2f} ms   (compile {compile_s:.1f}s)",
+          flush=True)
+    return dt
+
+
+def prims():
+    """Primitives specific to the round-4 questions."""
+    from spark_rapids_tpu.expressions.aggregates import (
+        _prefix_ladder, _suffix_scan_ladder)
+    rng = np.random.default_rng(0)
+    key = jnp.asarray(rng.integers(0, NKEYS, N).astype(np.int32))
+    iota = jnp.arange(N, dtype=jnp.int32)
+    i64 = jnp.asarray(rng.integers(-1000, 1000, N).astype(np.int64))
+    f64a = jnp.asarray(rng.uniform(0, 1, N))
+    f64b = jnp.asarray(rng.uniform(0, 1, N))
+    seg = jnp.sort(key)
+    perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+    m6 = jnp.asarray(rng.uniform(0, 1, (N, 6)))
+    starts_1m = jnp.asarray(
+        np.sort(rng.integers(0, N, NKEYS)).astype(np.int32))
+    starts_4m = jnp.asarray(
+        np.sort(rng.integers(0, N, N)).astype(np.int32))
+
+    # Q1: what does the sort cost with 64-bit payload lanes vs bare?
+    bench("sort key+iota (2 ops)", lambda k, i: jax.lax.sort(
+        [k, i], num_keys=1), key, iota)
+    bench("sort key+iota+i64+f64+f64 (current payload carry)",
+          lambda k, i, a, b, c: jax.lax.sort([k, i, a, b, c], num_keys=1),
+          key, iota, i64, f64a, f64b)
+    # Q2: stacked row-gather of the 6 f64 lanes through perm
+    bench("row-gather (4M,6) f64 through perm",
+          lambda m, p: jnp.take(m, p, axis=0), m6, perm)
+    bench("row-gather (4M,6) f64 at sorted starts (L=4M)",
+          lambda m, p: jnp.take(m, p, axis=0), m6, starts_4m)
+    bench("row-gather (1M,6) f64 at sorted starts (L=1M)",
+          lambda m, p: jnp.take(m, p, axis=0), m6, starts_1m)
+    # Q3: suffix ladder over (4M,6): the large-tier sum machinery
+    bench("suffix_scan_ladder (4M,6) f64 (22 rounds)",
+          lambda m, s: _suffix_scan_ladder(m, s, jnp.add, 0.0), m6, seg)
+    bench("prefix_ladder (4M,6) f64",
+          lambda m: _prefix_ladder(m), m6)
+    bench("cumsum (4M,6) f64 axis0",
+          lambda m: jnp.cumsum(m, axis=0), m6)
+    # Q4: two-level segmented suffix scan (reshape (R,C); C inner rounds)
+    def two_level(m, s, C=2048):
+        n = m.shape[0]
+        R = n // C
+        m2 = m.reshape(R, C, -1)
+        s2 = s.reshape(R, C)
+        # within-row segmented suffix scan (log2(C) rounds)
+        d = 1
+        acc = m2
+        while d < C:
+            sm = jnp.concatenate(
+                [acc[:, d:], jnp.zeros((R, d, acc.shape[2]), acc.dtype)],
+                axis=1)
+            ss = jnp.concatenate(
+                [s2[:, d:], jnp.full((R, d), -2, s2.dtype)], axis=1)
+            ok = (ss == s2)[..., None]
+            acc = acc + jnp.where(ok, sm, 0.0)
+            d <<= 1
+        # row-start recurrence over R elements (cheap)
+        head = acc[:, 0, :]                   # within-row suffix at col 0
+        seg_head = s2[:, 0]
+        seg_tail = s2[:, -1]
+        # carry[r] = suffix sum starting at row r+1 for seg_tail[r]
+        cont = jnp.concatenate(
+            [(seg_tail[:-1] == seg_head[1:]), jnp.zeros(1, bool)])
+        d = 1
+        tot = head
+        # tot[r] accumulates full suffix for the segment at row r start
+        carry_seg = seg_head
+        while d < R:
+            sm = jnp.concatenate(
+                [tot[d:], jnp.zeros((d, tot.shape[1]), tot.dtype)], axis=0)
+            ss = jnp.concatenate([carry_seg[d:], jnp.full(d, -2)], axis=0)
+            ok = (ss == carry_seg)[:, None]
+            tot = tot + jnp.where(ok, sm, 0.0)
+            d <<= 1
+        # add continuation to every element whose segment crosses row end
+        carry = jnp.concatenate(
+            [tot[1:], jnp.zeros((1, tot.shape[1]), tot.dtype)], axis=0)
+        cross = (s2 == seg_tail[:, None]) & cont[:, None]
+        out = acc + jnp.where(cross[..., None], carry[:, None, :], 0.0)
+        return out.reshape(n, -1)
+
+    two = bench("two-level segmented suffix (4M,6) C=2048",
+                lambda m, s: two_level(m, s, 2048), m6, seg)
+    bench("two-level segmented suffix (4M,6) C=512",
+          lambda m, s: two_level(m, s, 512), m6, seg)
+    # correctness spot check (small n)
+    from spark_rapids_tpu.expressions.aggregates import _suffix_scan_ladder \
+        as ladder
+    ms = m6[:1 << 14]
+    ss_ = seg[:1 << 14]
+    a = jax.jit(lambda m, s: ladder(m, s, jnp.add, 0.0))(ms, ss_)
+    b = jax.jit(lambda m, s: two_level(m, s, 512))(ms, ss_)
+    err = float(jnp.max(jnp.abs(a - b)))
+    print(f"two-level vs ladder max err: {err:.2e}")
+    # Q5: scatter-based segment_sum f32 pair trick
+    bench("segment_sum f32 (unsorted ids)",
+          lambda x, s: jax.ops.segment_sum(
+              x.astype(jnp.float32), s, num_segments=NKEYS), f64a, key)
+    bench("segment_sum f64 (sorted ids, indices_are_sorted)",
+          lambda x, s: jax.ops.segment_sum(
+              x, s, num_segments=NKEYS, indices_are_sorted=True), f64a, seg)
+
+
+def hash_agg():
+    """Decompose the current hash_agg _update_kernel."""
+    import pyarrow as pa
+    from spark_rapids_tpu.batch import from_arrow
+    from spark_rapids_tpu.exec import (AggregateMode, HashAggregateExec,
+                                       InMemoryScanExec)
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Average, Count, Sum
+    rng = np.random.default_rng(5)
+    table = pa.table({
+        "ss_item_sk": rng.integers(0, NKEYS, N).astype(np.int32),
+        "ss_quantity": rng.integers(1, 100, N).astype(np.int64),
+        "ss_sales_price": rng.uniform(0.5, 500.0, N),
+        "ss_net_profit": rng.uniform(-100.0, 400.0, N),
+    })
+    dev_batch, schema = from_arrow(table)
+
+    def make(tiers=None):
+        return HashAggregateExec(
+            [col("ss_item_sk")],
+            [Sum(col("ss_quantity")).alias("sq"),
+             Sum(col("ss_net_profit")).alias("sp"),
+             Average(col("ss_sales_price")).alias("ap"),
+             Count().alias("c")],
+            InMemoryScanExec(table), AggregateMode.COMPLETE,
+            layout_tiers=tiers)
+
+    agg = make()
+    bench("hash_agg _update_kernel (current tiers 4096/cap)",
+          agg._update_kernel, dev_batch)
+    agg2 = make(tiers=(1 << 12, 1 << 20, 1 << 22))
+    bench("hash_agg _update_kernel (3 tiers incl 1M)",
+          agg2._update_kernel, dev_batch)
+
+    # pyarrow oracle for reference
+    t0 = time.perf_counter()
+    for _ in range(3):
+        table.group_by(["ss_item_sk"]).aggregate(
+            [("ss_quantity", "sum"), ("ss_net_profit", "sum"),
+             ("ss_sales_price", "mean"), ("ss_item_sk", "count")])
+    print(f"{'pyarrow oracle':58s} "
+          f"{(time.perf_counter()-t0)/3*1e3:9.2f} ms", flush=True)
+
+
+def ici():
+    """Decompose the lowered SPMD join+agg program (bench_ici_exchange)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.exec.join import JoinType
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Count, Sum
+    from spark_rapids_tpu.plan import Session, table as df_table
+    from spark_rapids_tpu.plan.overrides import Overrides
+    from spark_rapids_tpu.parallel.lowering import try_lower_to_mesh
+    n = 1 << 20
+    rng = np.random.default_rng(11)
+    n_dim = 1 << 12
+    fact = pa.table({
+        "k": rng.integers(0, n_dim, n).astype(np.int32),
+        "g": rng.integers(0, 64, n).astype(np.int32),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+    })
+    dim = pa.table({
+        "dk": np.arange(n_dim, dtype=np.int32),
+        "w": rng.integers(0, 10, n_dim).astype(np.int64),
+    })
+    ses = Session({"spark.rapids.tpu.shuffle.mode": "ICI"})
+
+    def q():
+        return (df_table(fact)
+                .join(df_table(dim), ["k"], ["dk"], JoinType.INNER)
+                .group_by("g")
+                .agg(Sum(col("v")).alias("sv"), Sum(col("w")).alias("sw"),
+                     Count().alias("c")))
+
+    plan = Overrides(ses.conf).plan(q().plan)
+
+    def show(p, d=0):
+        print("  " * d + p.name)
+        for c in p.children:
+            show(c, d + 1)
+    print("--- planned tree:")
+    show(plan)
+    stage = try_lower_to_mesh(plan, ses._mesh())
+    print("--- lowered:", stage.lowered)
+    program, stacked = stage.prepare()
+    bench("ici full lowered program", lambda: program(*stacked), jit=False,
+          reps=5)
+
+    # piecewise: the same work outside the mesh wrapper on one device
+    from spark_rapids_tpu.batch import from_arrow
+    from spark_rapids_tpu.exec import (AggregateMode, HashAggregateExec,
+                                       InMemoryScanExec)
+    fb, fs = from_arrow(fact)
+    db, dsch = from_arrow(dim)
+    # find the join node in the plan
+    from spark_rapids_tpu.exec.join import HashJoinExec
+    jn = None
+    stack = [plan]
+    while stack:
+        nd = stack.pop()
+        if isinstance(nd, HashJoinExec):
+            jn = nd
+            break
+        stack.extend(nd.children)
+    print("join node:", jn.name, "broadcast:", jn.broadcast_build)
+
+    def join_only(s, b):
+        sorted_h, sbuild, _ = jn._build_kernel(b)
+        lo, counts, offsets, total = jn._count_kernel(s, sorted_h)
+        from spark_rapids_tpu.batch import bucket_capacity
+        out_cap = bucket_capacity(s.capacity)
+        matched0 = jnp.zeros(sbuild.capacity, bool)
+        out, matched = jn._expand_kernel(
+            s, sbuild, (lo, counts, offsets), matched0, out_cap)
+        return out
+    joined = jax.jit(join_only)(fb, db)
+    bench("join kernel alone (1M probe, 4K build)", join_only, fb, db)
+
+    # partial agg over the joined batch shape
+    agg_node = None
+    stack = [plan]
+    while stack:
+        nd = stack.pop()
+        if isinstance(nd, HashAggregateExec) and \
+                nd.mode is AggregateMode.PARTIAL:
+            agg_node = nd
+            break
+        stack.extend(nd.children)
+    if agg_node is not None:
+        bench("partial agg kernel alone (joined batch)",
+              agg_node._update_kernel, joined)
+        part = jax.jit(agg_node._update_kernel)(joined)
+        final_node = None
+        stack = [plan]
+        while stack:
+            nd = stack.pop()
+            if isinstance(nd, HashAggregateExec) and \
+                    nd.mode is AggregateMode.FINAL:
+                final_node = nd
+                break
+            stack.extend(nd.children)
+        if final_node is not None:
+            bench("final agg kernel alone",
+                  lambda b: final_node._merge_kernel(b, final=True), part)
+
+
+def join_fine():
+    """Fine-grained join kernel decomposition (1M probe, 4K build)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.batch import from_arrow, bucket_capacity
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    from spark_rapids_tpu.exec.join import HashJoinExec, JoinType
+    from spark_rapids_tpu.expressions import col
+    n = 1 << 20
+    n_dim = 1 << 12
+    rng = np.random.default_rng(11)
+    fact = pa.table({
+        "k": rng.integers(0, n_dim, n).astype(np.int32),
+        "g": rng.integers(0, 64, n).astype(np.int32),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+    })
+    dim = pa.table({
+        "dk": np.arange(n_dim, dtype=np.int32),
+        "w": rng.integers(0, 10, n_dim).astype(np.int64),
+    })
+    fb, _ = from_arrow(fact)
+    db, _ = from_arrow(dim)
+    jn = HashJoinExec([col("k")], [col("dk")], JoinType.INNER,
+                      InMemoryScanExec(fact), InMemoryScanExec(dim))
+    bench("build kernel (4K)", jn._build_kernel, db)
+    sh, perm, _ = jax.jit(jn._build_kernel)(db)
+    print("dense detected:", bool(sh[4]))
+    bench("count kernel (1M probes)", lambda s: jn._count_kernel(s, sh), fb)
+    lo, counts, offsets, total = jax.jit(
+        lambda s: jn._count_kernel(s, sh))(fb)
+    out_cap = bucket_capacity(n)
+    m0 = jnp.zeros(db.capacity, bool)
+    bench("expand kernel (FK cond path)",
+          lambda s: jn._expand_kernel(s, (db, perm), (lo, counts, offsets),
+                                      m0, out_cap), fb)
+    bench("expand_unique direct",
+          lambda s: jn._expand_unique(s, db, perm, lo, counts, m0, out_cap),
+          fb)
+    bench("expand_general direct",
+          lambda s: jn._expand_general(s, db, perm, lo, counts, offsets,
+                                       m0, out_cap), fb)
+    bench("build+count+expand fused",
+          lambda s, b: jn._expand_kernel(
+              s, (b, jn._build_kernel(b)[1]),
+              jn._count_kernel(s, jn._build_kernel(b)[0])[:3],
+              jnp.zeros(b.capacity, bool), out_cap), fb, db)
+    # raw searchsorted for calibration
+    words = jnp.asarray(rng.integers(0, n_dim, n).astype(np.uint32))
+    table = jnp.sort(jnp.asarray(np.arange(n_dim).astype(np.uint32)))
+    bench("raw searchsorted 1M in 4K (method=sort)",
+          lambda w, t: jnp.searchsorted(t, w, method="sort"), words, table)
+    bench("raw gather 1M i32 from 4K", lambda t, i: jnp.take(t, i),
+          jnp.asarray(np.arange(n_dim, dtype=np.int32)),
+          jnp.asarray(rng.integers(0, n_dim, n).astype(np.int32)))
+
+
+def join_fuse():
+    """Why does build+count+expand in ONE jit cost 9x the sum of parts?"""
+    import pyarrow as pa
+    from spark_rapids_tpu.batch import from_arrow, bucket_capacity
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    from spark_rapids_tpu.exec.join import HashJoinExec, JoinType
+    from spark_rapids_tpu.expressions import col
+    n = 1 << 20
+    n_dim = 1 << 12
+    rng = np.random.default_rng(11)
+    fact = pa.table({
+        "k": rng.integers(0, n_dim, n).astype(np.int32),
+        "g": rng.integers(0, 64, n).astype(np.int32),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+    })
+    dim = pa.table({
+        "dk": np.arange(n_dim, dtype=np.int32),
+        "w": rng.integers(0, 10, n_dim).astype(np.int64),
+    })
+    fb, _ = from_arrow(fact)
+    db, _ = from_arrow(dim)
+    jn = HashJoinExec([col("k")], [col("dk")], JoinType.INNER,
+                      InMemoryScanExec(fact), InMemoryScanExec(dim))
+    out_cap = bucket_capacity(n)
+
+    def fused_single_build(s, b):
+        sh, perm, _ = jn._build_kernel(b)
+        lo, counts, offsets, _t = jn._count_kernel(s, sh)
+        return jn._expand_kernel(s, (b, perm), (lo, counts, offsets),
+                                 jnp.zeros(b.capacity, bool), out_cap)
+    bench("fused single-build (cond FK path)", fused_single_build, fb, db,
+          reps=5)
+
+    def fused_unique(s, b):
+        sh, perm, _ = jn._build_kernel(b)
+        lo, counts, offsets, _t = jn._count_kernel(s, sh)
+        return jn._expand_unique(s, b, perm, lo, counts,
+                                 jnp.zeros(b.capacity, bool), out_cap)
+    bench("fused single-build -> expand_unique (no cond)", fused_unique,
+          fb, db, reps=5)
+
+    def count_expand(s, b, sh, perm):
+        lo, counts, offsets, _t = jn._count_kernel(s, sh)
+        return jn._expand_kernel(s, (b, perm), (lo, counts, offsets),
+                                 jnp.zeros(b.capacity, bool), out_cap)
+    sh, perm, _ = jax.jit(jn._build_kernel)(db)
+    bench("count+expand fused (build outside)",
+          lambda s, b: count_expand(s, b, sh, perm), fb, db, reps=5)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("prims", "all"):
+        print("=== primitives ===")
+        prims()
+    if which in ("hash_agg", "all"):
+        print("=== hash_agg ===")
+        hash_agg()
+    if which == "join_fine":
+        print("=== join fine ===")
+        join_fine()
+    if which in ("ici", "all"):
+        print("=== ici ===")
+        ici()
+    if which == "join_fuse":
+        print("=== join fuse ===")
+        join_fuse()
