@@ -274,10 +274,23 @@ class FileSource:
                     break
         else:  # MULTITHREADED: pipelined background decode
             pool = reader_pool(self.num_threads)
-            futures = [(f, pool.submit(self.read_file, f)) for f in files]
+            tasks = self.decode_tasks(files)
+            if tasks is None:
+                tasks = [(f, (lambda f=f: self.read_file(f)))
+                         for f in files]
+            futures = [(f, pool.submit(fn)) for f, fn in tasks]
             for f, fut in futures:
                 t = self._decorate(fut.result(), f)
                 for off in range(0, max(t.num_rows, 1), self.batch_rows):
                     yield t.slice(off, self.batch_rows)
                     if t.num_rows == 0:
                         break
+
+    def decode_tasks(self, files: Sequence[str]):
+        """Optional finer-than-file decode units for the MULTITHREADED
+        reader: a list of (path, thunk) pairs, each thunk decoding ONE
+        unit single-threaded (a parquet row group). None = per-file
+        decode. Sub-file units keep the shared pool saturated without
+        oversubscribing it with per-task thread fan-out (reference:
+        MultiFileCloudParquetPartitionReader chunked reads)."""
+        return None

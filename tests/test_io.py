@@ -179,3 +179,53 @@ def test_input_file_name_column(tmp_path):
     got = sorted(zip(out.column("x").to_pylist(),
                      out.column("_input_file_name").to_pylist()))
     assert got == [(1, p1), (2, p1), (3, p2)]
+
+
+def test_parquet_row_group_stats_pruning(tmp_path):
+    """Footer min/max stats must skip row groups the predicate excludes,
+    without changing results (reference: filterRowGroups in
+    ParquetFileFilterHandler)."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.io.source import ReaderType
+    t = pa.table({"k": np.arange(4000, dtype=np.int64),
+                  "v": np.arange(4000, dtype=np.float64)})
+    p = str(tmp_path / "rg.parquet")
+    pq.write_table(t, p, row_group_size=1000)   # 4 groups: k in [0,1000)...
+    src = ParquetSource([p], predicate=col("k") >= lit(2500),
+                        reader_type=ReaderType.MULTITHREADED)
+    got = pa.concat_tables(src.read_split(src.files))
+    assert src.row_groups_pruned == 2          # groups [0,1000) and [1000,2000)
+    assert sorted(got.column("k").to_pylist()) == list(range(2500, 4000))
+    # flipped literal side + equality
+    src2 = ParquetSource([p], predicate=lit(500) > col("k"),
+                         reader_type=ReaderType.MULTITHREADED)
+    got2 = pa.concat_tables(src2.read_split(src2.files))
+    assert src2.row_groups_pruned == 3
+    assert sorted(got2.column("k").to_pylist()) == list(range(500))
+
+
+def test_parquet_predicate_on_unprojected_column(tmp_path):
+    """Pushdown filters BEFORE projection (dataset semantics): a predicate
+    over a column absent from the projection must work in every reader
+    mode and not leak into the output schema."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.io.source import ReaderType
+    t = pa.table({"k": np.arange(100, dtype=np.int64),
+                  "v": np.arange(100, dtype=np.float64)})
+    p = str(tmp_path / "u.parquet")
+    pq.write_table(t, p, row_group_size=40)
+    for mode in (ReaderType.PERFILE, ReaderType.COALESCING,
+                 ReaderType.MULTITHREADED):
+        src = ParquetSource([p], columns=["v"],
+                            predicate=col("k") >= lit(90),
+                            reader_type=mode)
+        got = pa.concat_tables(src.read_split(src.files))
+        assert got.column_names == ["v"], (mode, got.column_names)
+        assert sorted(got.column("v").to_pylist()) == [float(x) for x in
+                                                       range(90, 100)], mode

@@ -322,7 +322,7 @@ def join_fine():
     jn = HashJoinExec([col("k")], [col("dk")], JoinType.INNER,
                       InMemoryScanExec(fact), InMemoryScanExec(dim))
     bench("build kernel (4K)", jn._build_kernel, db)
-    sh, perm, _ = jax.jit(jn._build_kernel)(db)
+    sh, sbuild, _ = jax.jit(jn._build_kernel)(db)
     print("dense detected:", bool(sh[4]))
     bench("count kernel (1M probes)", lambda s: jn._count_kernel(s, sh), fb)
     lo, counts, offsets, total = jax.jit(
@@ -330,17 +330,17 @@ def join_fine():
     out_cap = bucket_capacity(n)
     m0 = jnp.zeros(db.capacity, bool)
     bench("expand kernel (FK cond path)",
-          lambda s: jn._expand_kernel(s, (db, perm), (lo, counts, offsets),
+          lambda s: jn._expand_kernel(s, sbuild, (lo, counts, offsets),
                                       m0, out_cap), fb)
     bench("expand_unique direct",
-          lambda s: jn._expand_unique(s, db, perm, lo, counts, m0, out_cap),
+          lambda s: jn._expand_unique(s, sbuild, lo, counts, m0, out_cap),
           fb)
     bench("expand_general direct",
-          lambda s: jn._expand_general(s, db, perm, lo, counts, offsets,
+          lambda s: jn._expand_general(s, sbuild, lo, counts, offsets,
                                        m0, out_cap), fb)
     bench("build+count+expand fused",
           lambda s, b: jn._expand_kernel(
-              s, (b, jn._build_kernel(b)[1]),
+              s, jn._build_kernel(b)[1],
               jn._count_kernel(s, jn._build_kernel(b)[0])[:3],
               jnp.zeros(b.capacity, bool), out_cap), fb, db)
     # raw searchsorted for calibration
@@ -379,28 +379,28 @@ def join_fuse():
     out_cap = bucket_capacity(n)
 
     def fused_single_build(s, b):
-        sh, perm, _ = jn._build_kernel(b)
+        sh, sb, _ = jn._build_kernel(b)
         lo, counts, offsets, _t = jn._count_kernel(s, sh)
-        return jn._expand_kernel(s, (b, perm), (lo, counts, offsets),
-                                 jnp.zeros(b.capacity, bool), out_cap)
+        return jn._expand_kernel(s, sb, (lo, counts, offsets),
+                                 jnp.zeros(sb.capacity, bool), out_cap)
     bench("fused single-build (cond FK path)", fused_single_build, fb, db,
           reps=5)
 
     def fused_unique(s, b):
-        sh, perm, _ = jn._build_kernel(b)
+        sh, sb, _ = jn._build_kernel(b)
         lo, counts, offsets, _t = jn._count_kernel(s, sh)
-        return jn._expand_unique(s, b, perm, lo, counts,
-                                 jnp.zeros(b.capacity, bool), out_cap)
+        return jn._expand_unique(s, sb, lo, counts,
+                                 jnp.zeros(sb.capacity, bool), out_cap)
     bench("fused single-build -> expand_unique (no cond)", fused_unique,
           fb, db, reps=5)
 
-    def count_expand(s, b, sh, perm):
+    def count_expand(s, sb, sh):
         lo, counts, offsets, _t = jn._count_kernel(s, sh)
-        return jn._expand_kernel(s, (b, perm), (lo, counts, offsets),
-                                 jnp.zeros(b.capacity, bool), out_cap)
-    sh, perm, _ = jax.jit(jn._build_kernel)(db)
+        return jn._expand_kernel(s, sb, (lo, counts, offsets),
+                                 jnp.zeros(sb.capacity, bool), out_cap)
+    sh, sb, _ = jax.jit(jn._build_kernel)(db)
     bench("count+expand fused (build outside)",
-          lambda s, b: count_expand(s, b, sh, perm), fb, db, reps=5)
+          lambda s: count_expand(s, sb, sh), fb, reps=5)
 
 
 if __name__ == "__main__":
