@@ -64,12 +64,25 @@ def _sync_scalar(out):
     float(f(x))
 
 
+def _time_st_oracle(oracle, reps=3):
+    """Primary oracle column, pinned to ONE pyarrow compute thread so the
+    label "single-thread pyarrow" is true even on multi-core hosts
+    (pyarrow's pool defaults to every core and its APIs default
+    use_threads=True)."""
+    import pyarrow as pa
+    prev = pa.cpu_count()
+    pa.set_cpu_count(1)
+    try:
+        return _time(oracle, reps, lambda *_: None)
+    finally:
+        pa.set_cpu_count(prev)
+
+
 def _time_mt_oracle(oracle, reps=3):
     """Second oracle column (VERDICT r3 Next #2): the same relational work
-    with pyarrow's compute pool sized to EVERY host core and use_threads
-    engaged. On this environment's single-core tunnel host it coincides
-    with the single-thread oracle — "host_cores" in the output JSON lets
-    the reader weigh the two columns."""
+    with pyarrow's compute pool sized to EVERY host core. On this
+    environment's single-core tunnel host the two columns coincide —
+    "host_cores" in the output JSON lets the reader weigh them."""
     import os
     import pyarrow as pa
     prev = pa.cpu_count()
@@ -146,7 +159,7 @@ def bench_q1_stage(jax, n=1 << 22, reps=4):
             [("l_quantity", "sum"), ("l_extendedprice", "sum"),
              ("disc_price", "sum"), ("l_quantity", "mean"),
              ("l_discount", "mean"), ("l_quantity", "count")])
-    cpu_dt = _time(oracle, 3, lambda *_: None)
+    cpu_dt = _time_st_oracle(oracle)
     return n / dt, n / cpu_dt, n / _time_mt_oracle(oracle)
 
 
@@ -172,13 +185,8 @@ def bench_hash_agg(jax, n=1 << 22, n_keys=1 << 20, reps=4):
         return table.group_by(["ss_item_sk"]).aggregate(
             [("ss_quantity", "sum"), ("ss_net_profit", "sum"),
              ("ss_sales_price", "mean"), ("ss_item_sk", "count")])
-    cpu_dt = _time(oracle, 3, lambda *_: None)
-
-    def mt_oracle():
-        return table.group_by(["ss_item_sk"], use_threads=True).aggregate(
-            [("ss_quantity", "sum"), ("ss_net_profit", "sum"),
-             ("ss_sales_price", "mean"), ("ss_item_sk", "count")])
-    return n / dt, n / cpu_dt, n / _time_mt_oracle(mt_oracle)
+    cpu_dt = _time_st_oracle(oracle)
+    return n / dt, n / cpu_dt, n / _time_mt_oracle(oracle)
 
 
 def bench_join_sort(jax, n_stream=1 << 21, n_build=1 << 18, reps=3):
@@ -231,7 +239,7 @@ def bench_join_sort(jax, n_stream=1 << 21, n_build=1 << 18, reps=3):
         j = stream.join(build, keys="l_orderkey",
                         right_keys="o_orderkey", join_type="inner")
         return j.sort_by([("l_revenue", "descending")])
-    cpu_dt = _time(oracle, 2, lambda *_: None)
+    cpu_dt = _time_st_oracle(oracle, reps=2)
     return n_stream / dt, n_stream / cpu_dt, \
         n_stream / _time_mt_oracle(oracle, reps=2)
 
@@ -272,7 +280,7 @@ def bench_parquet_scan(jax, n=1 << 21, n_files=8, reps=3):
         d = ds.dataset(paths)
         return d.to_table(columns=cols,
                           filter=ds.field("l_shipdate") <= 10471)
-    cpu_dt = _time(oracle, 3, lambda *_: None)
+    cpu_dt = _time_st_oracle(oracle)
     return n / dt, n / cpu_dt, n / _time_mt_oracle(oracle)
 
 
@@ -321,7 +329,7 @@ def bench_ici_exchange(jax, n=1 << 20, reps=3):
         j = fact.join(dim, keys="k", right_keys="dk", join_type="inner")
         return j.group_by(["g"]).aggregate(
             [("v", "sum"), ("w", "sum"), ("g", "count")])
-    cpu_dt = _time(oracle, 3, lambda *_: None)
+    cpu_dt = _time_st_oracle(oracle)
     return n / dt, n / cpu_dt, n / _time_mt_oracle(oracle)
 
 

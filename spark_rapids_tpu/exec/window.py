@@ -62,7 +62,7 @@ class WindowExec(UnaryExec):
             unsupported_frame_reason
         for w in self.exprs:
             if isinstance(w.function, _WA):
-                reason = unsupported_frame_reason(w.spec.frame)
+                reason = unsupported_frame_reason(w.spec.frame, w.spec)
                 if reason:
                     raise NotImplementedError(reason)
         fields = list(child.output_schema.fields)
@@ -95,6 +95,10 @@ class WindowExec(UnaryExec):
         s_pkeys = [gather_column(c, perm) for c in pkeys]
         s_okeys = [gather_column(c, perm) for c in okeys]
         sorted_live = iota < batch.num_rows
+        # trace-scoped context for value-bounded RANGE ranking (the merge
+        # rank re-evaluates the order column; XLA CSEs the duplicate)
+        self._range_batch = batch
+        self._range_perm = perm
 
         if s_pkeys:
             same_part = adjacent_equal(s_pkeys)
@@ -270,25 +274,191 @@ class WindowExec(UnaryExec):
             pe = segmented_scan(jnp.where(peer_tail, iota, cap), peer_tail,
                                 jnp.minimum, reverse=True)
             return jnp.take(run, jnp.clip(pe, 0, cap - 1)), None
-        if frame.start is None or frame.end is None:
-            # unbounded one side; compute via reverse running
-            if frame.start is None:
-                raise NotImplementedError("bounded-end unbounded-start")
-            rev = segmented_scan(x, tail, op, reverse=True)
-            if frame.is_rows and frame.start == 0:
-                return rev, None
-            raise NotImplementedError("general unbounded-following frames")
-        # bounded ROWS frame: static shift fold (small literal windows)
-        p, f = -frame.start, frame.end
-        pid = jnp.cumsum(head.astype(jnp.int32))
-        acc = jnp.full(x.shape, identity, x.dtype)
-        for o in range(-p, f + 1):
-            ix = jnp.clip(iota + o, 0, cap - 1)
-            ok = (iota + o >= 0) & (iota + o < cap)
-            same = ok & (jnp.take(pid, ix) == pid)
-            contrib = jnp.where(same, jnp.take(x, ix), identity)
-            acc = op(acc, contrib)
-        return acc, None
+        if frame.is_rows and frame.start is not None and \
+                frame.end is not None and frame.end - frame.start < 64:
+            # small literal ROWS windows: static shift fold beats the
+            # scan/gather machinery (exact for every op, incl. floats)
+            p, f = -frame.start, frame.end
+            pid = jnp.cumsum(head.astype(jnp.int32))
+            acc = jnp.full(x.shape, identity, x.dtype)
+            for o in range(-p, f + 1):
+                ix = jnp.clip(iota + o, 0, cap - 1)
+                ok = (iota + o >= 0) & (iota + o < cap)
+                same = ok & (jnp.take(pid, ix) == pid)
+                contrib = jnp.where(same, jnp.take(x, ix), identity)
+                acc = op(acc, contrib)
+            return acc, None
+        # general path: per-row [lo, hi] absolute bounds, then a
+        # prefix-difference (sums) or sparse-table (min/max) reduction
+        # (reference: GpuWindowExec.scala:1846 double-pass machinery)
+        lo, hi = self._frame_bounds(frame, head, tail, peer_head, live,
+                                    iota, cap)
+        return self._reduce_between(x, op, identity, lo, hi, head, cap), None
+
+    # ------------------------------------------------------------------
+    # General frames (round 4 — VERDICT r3 Next #3)
+    # ------------------------------------------------------------------
+
+    def _partition_bounds(self, head, tail, iota, cap):
+        seg_start = segmented_scan(jnp.where(head, iota, 0), head,
+                                   jnp.maximum)
+        seg_end = segmented_scan(jnp.where(tail, iota, cap), tail,
+                                 jnp.minimum, reverse=True)
+        return seg_start, seg_end
+
+    def _frame_bounds(self, frame: WindowFrame, head, tail, peer_head,
+                      live, iota, cap):
+        """Absolute sorted-layout [lo, hi] index bounds of each row's
+        frame (hi < lo = empty). ROWS bounds are positional; RANGE bounds
+        with nonzero offsets rank shifted ORDER VALUES into the sorted
+        layout via one merge-sort per bounded side."""
+        seg_start, seg_end = self._partition_bounds(head, tail, iota, cap)
+        if frame.is_rows:
+            lo = seg_start if frame.start is None \
+                else jnp.maximum(iota + frame.start, seg_start)
+            hi = seg_end if frame.end is None \
+                else jnp.minimum(iota + frame.end, seg_end)
+            return lo, jnp.maximum(hi, lo - 1)
+        # RANGE: peer-group bounds for CURRENT ROW ends; merge-rank for
+        # value offsets
+        peer_tail = jnp.concatenate(
+            [peer_head[1:], jnp.ones(1, bool)]) | tail
+        peer_start = segmented_scan(jnp.where(peer_head, iota, 0), head,
+                                    jnp.maximum)
+        peer_end = segmented_scan(jnp.where(peer_tail, iota, cap),
+                                  peer_tail, jnp.minimum, reverse=True)
+        if frame.start is None:
+            lo = seg_start
+        elif frame.start == 0:
+            lo = peer_start
+        else:
+            lo = self._range_rank(frame.start, True, head, peer_start,
+                                  peer_end, live, iota, cap)
+        if frame.end is None:
+            hi = seg_end
+        elif frame.end == 0:
+            hi = peer_end
+        else:
+            hi = self._range_rank(frame.end, False, head, peer_start,
+                                  peer_end, live, iota, cap)
+        return lo, hi
+
+    def _range_rank(self, delta: int, is_lo: bool, head, peer_start,
+                    peer_end, live, iota, cap):
+        """Rank each row's shifted order value among the partition's rows:
+        lo = first index with value >= v+delta, hi = last index with
+        value <= v+delta. One (pid, null-rank, word, tag, iota) merge sort
+        of 2n lanes; bound rows' sorted relative order equals their
+        original order (values ascend within partitions), so
+        count-of-data-before = merged position - own index. NULL order
+        rows take their peer group (the SQL standard's all-nulls frame)."""
+        from .common import orderable_words
+        spec = self.spec
+        o = spec.orders[0]
+        # evaluated + sorted order column (CSE'd with the kernel's own
+        # sort by XLA — identical subgraphs)
+        batch = self._range_batch
+        col = o.child.eval(batch, self.ctx)
+        col = gather_column(col, self._range_perm)
+        data = col.data
+        if o.descending:
+            # descending layouts sort by FLIPPED orderable words (~w,
+            # bijective — value negation would merge INT_MIN with
+            # INT_MIN+1); Spark's desc range frame covers values
+            # [v-end, v-start], so the bound value is v - delta and only
+            # the word domain flips
+            shifted = self._sat_add(data, -delta)
+            word = ~orderable_words(
+                col.replace(data=shifted, validity=col.validity))[0]
+            data_word = ~orderable_words(
+                col.replace(data=data, validity=col.validity))[0]
+        else:
+            shifted = self._sat_add(data, delta)
+            word = orderable_words(
+                col.replace(data=shifted, validity=col.validity))[0]
+            data_word = orderable_words(
+                col.replace(data=data, validity=col.validity))[0]
+        nulls_first = o.effective_nulls_first
+        n_rank = jnp.where(col.validity,
+                           jnp.uint8(1),
+                           jnp.uint8(0 if nulls_first else 2))
+        pid_raw = jnp.cumsum(head.astype(jnp.int32))
+        pid = jnp.where(live, pid_raw, jnp.int32(2147483647))
+        # tag: lo-side bounds sort BEFORE equal data (rank = count of
+        # data strictly below); hi-side bounds sort AFTER equal data
+        tag_data = jnp.full(cap, 1 if is_lo else 0, jnp.uint8)
+        tag_bound = jnp.full(cap, 0 if is_lo else 1, jnp.uint8)
+        # bounds carry their row's OWN null rank: null-row bounds stay
+        # confined to the null region (their words are garbage; the rank
+        # lane keeps them from interleaving among real-valued entries,
+        # which preserves the bounds-sort-in-original-order identity the
+        # count arithmetic relies on)
+        lanes = [
+            jnp.concatenate([pid, pid]),
+            jnp.concatenate([n_rank, n_rank]),
+            jnp.concatenate([data_word, word]),
+            jnp.concatenate([tag_data, tag_bound]),
+            jnp.arange(2 * cap, dtype=jnp.int32),
+        ]
+        perm2 = jax.lax.sort(lanes, num_keys=4)[-1]
+        inv = jnp.zeros(2 * cap, jnp.int32).at[perm2].set(
+            jnp.arange(2 * cap, dtype=jnp.int32))
+        count_before = inv[cap:] - iota          # data rows sorting before
+        if is_lo:
+            pos = count_before                   # first idx with w >= bound
+        else:
+            pos = count_before - 1               # last idx with w <= bound
+        # null order rows: frame = their (all-null) peer group
+        pos = jnp.where(col.validity, pos,
+                        peer_start if is_lo else peer_end)
+        return pos
+
+    @staticmethod
+    def _sat_add(x, d: int):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x + d
+        info = jnp.iinfo(x.dtype)
+        if d >= 0:
+            return jnp.where(x > info.max - d, info.max, x + d)
+        return jnp.where(x < info.min - d, info.min, x + d)
+
+    def _reduce_between(self, x, op, identity, lo, hi, head, cap):
+        """Per-row reduce of x over [lo, hi] (identity when hi < lo).
+        Sums ride a segmented prefix difference (rounding stays partition-
+        local); arbitrary ops (min/max/and/or — idempotent) use a doubling
+        sparse table: result = op(T_j[lo], T_j[hi-2^j+1]) with
+        j = floor(log2(len)), overlap harmless for idempotent ops."""
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        ident = jnp.asarray(identity, x.dtype)
+        empty = hi < lo
+        lo_c = jnp.clip(lo, 0, cap - 1)
+        hi_c = jnp.clip(hi, 0, cap - 1)
+        if op is jnp.add:
+            run = segmented_scan(x, head, jnp.add)
+            seg_start = segmented_scan(jnp.where(head, iota, 0), head,
+                                       jnp.maximum)
+            upper = jnp.take(run, hi_c)
+            lower = jnp.where(lo > seg_start,
+                              jnp.take(run, jnp.clip(lo - 1, 0, cap - 1)),
+                              jnp.zeros_like(ident))
+            return jnp.where(empty, ident, upper - lower)
+        levels = [x]
+        d = 1
+        while d < cap:
+            top = levels[-1]
+            shifted = jnp.concatenate(
+                [top[d:], jnp.full((d,), ident, top.dtype)])
+            levels.append(op(top, shifted))
+            d <<= 1
+        stacked = jnp.stack(levels)              # (J, cap)
+        L = jnp.maximum(hi - lo + 1, 1)
+        j = jnp.floor(jnp.log2(L.astype(jnp.float64))).astype(jnp.int32)
+        flat = stacked.reshape(-1)
+        a = jnp.take(flat, j * cap + lo_c)
+        b_pos = jnp.clip(hi - jnp.left_shift(jnp.int32(1), j) + 1,
+                         0, cap - 1)
+        b = jnp.take(flat, j * cap + b_pos)
+        return jnp.where(empty, ident, op(a, b))
 
     # ------------------------------------------------------------------
 

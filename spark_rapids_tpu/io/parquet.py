@@ -267,8 +267,16 @@ class ParquetSource(FileSource):
         for path, md in zip(files, mds):
             names = [md.schema.column(j).path
                      for j in range(md.num_columns)]
+            # legacy-rebase files: footer stats carry HYBRID-calendar
+            # day/micro values while the decode path re-encodes them
+            # proleptic-Gregorian (LEGACY mode) — raw stats vs rebased
+            # literals would wrongly prune MATCHING groups (data loss),
+            # so stats pruning is disabled for such files
+            kvm = md.metadata or {}
+            legacy = LEGACY_DATETIME_KEY in kvm and \
+                self.rebase_mode != "CORRECTED"
             for i in range(md.num_row_groups):
-                if self.predicate is not None and \
+                if self.predicate is not None and not legacy and \
                         not _rg_can_match(md.row_group(i), names,
                                           self.predicate):
                     self.row_groups_pruned += 1

@@ -1659,28 +1659,57 @@ class Interpreter:
                         hi = m - 1 if frame.end is None else j + frame.end
                         lo, hi = max(lo, 0), min(hi, m - 1)
                     else:
-                        # bounded RANGE over a single numeric order key:
-                        # rows whose key falls in [key+start, key+end];
-                        # a null key ranges only over its null peers (Spark)
-                        if len(spec.orders) != 1 or \
-                                spec.orders[0].descending:
-                            raise NotImplementedError(
-                                "bounded RANGE needs one ascending order key")
-                        ovals = [ev.eval(spec.orders[0].child, rows[part[x]])
+                        # value-bounded RANGE: positional scan with bound
+                        # comparisons under the sort ordering (nulls take
+                        # their nulls-first/last rank; a null current row's
+                        # bound is null) — exactly Spark's
+                        # RangeBoundOrdering frame scan, which makes null
+                        # rows positional members of unbounded sides
+                        if len(spec.orders) != 1:
+                            raise ValueError(
+                                "value-bounded RANGE frames need exactly "
+                                "one order key")
+                        o0 = spec.orders[0]
+                        nf = o0.effective_nulls_first
+                        ovals = [ev.eval(o0.child, rows[part[x]])
                                  for x in range(m)]
                         k = ovals[j]
-                        if k is None:
-                            idxs = [x for x in range(m) if ovals[x] is None]
+
+                        def rk(v):
+                            return (0 if nf else 2) if v is None else 1
+
+                        def ocmp(a, b):
+                            ra, rb = rk(a), rk(b)
+                            if ra != rb:
+                                return -1 if ra < rb else 1
+                            if ra != 1 or a == b:
+                                return 0
+                            lt = a < b
+                            if o0.descending:
+                                lt = not lt
+                            return -1 if lt else 1
+
+                        def bound(delta):
+                            if k is None:
+                                return None
+                            return k - delta if o0.descending else k + delta
+
+                        if frame.start is None:
+                            lo2 = 0
                         else:
-                            klo = None if frame.start is None \
-                                else k + frame.start
-                            khi = None if frame.end is None \
-                                else k + frame.end
-                            idxs = [x for x in range(m)
-                                    if ovals[x] is not None
-                                    and (klo is None or ovals[x] >= klo)
-                                    and (khi is None or ovals[x] <= khi)]
-                        grp = [rows[part[x]] for x in idxs]
+                            b = bound(frame.start)
+                            lo2 = 0
+                            while lo2 < m and ocmp(ovals[lo2], b) < 0:
+                                lo2 += 1
+                        if frame.end is None:
+                            hi2 = m - 1
+                        else:
+                            b = bound(frame.end)
+                            hi2 = m - 1
+                            while hi2 >= 0 and ocmp(ovals[hi2], b) > 0:
+                                hi2 -= 1
+                        grp = [rows[part[x]] for x in range(lo2, hi2 + 1)] \
+                            if lo2 <= hi2 else []
                         out[i] = self._agg_value(fn.agg, grp, ev)
                         continue
                     grp = [rows[part[x]] for x in range(lo, hi + 1)] \

@@ -342,7 +342,7 @@ class PlanMeta:
                 w = e.child if isinstance(e, Alias) else e
                 if isinstance(w, WindowExpression) and \
                         isinstance(w.function, WindowAgg):
-                    reason = unsupported_frame_reason(w.spec.frame)
+                    reason = unsupported_frame_reason(w.spec.frame, w.spec)
                     if reason:
                         self.will_not_work(reason)
         self._tag_dtype_hazards()

@@ -134,3 +134,33 @@ def test_legacy_rebase_preserves_tz_and_other_types(tmp_path):
     got = ParquetSource([p], rebase_mode="LEGACY").read_file(p)
     assert got.schema.field("ts_utc").type == pa.timestamp("us", tz="UTC")
     assert got.schema.field("x").type == pa.int64()
+
+
+def test_stats_pruning_disabled_for_legacy_rebase_files(tmp_path):
+    """Footer min/max stats are hybrid-calendar in legacy files; pruning
+    against rebased literals would drop MATCHING row groups (review
+    repro). LEGACY-mode scans must skip stats pruning for such files."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.io.source import ReaderType
+    # raw day -142427 == hybrid label 1580-01-19; LEGACY rebase re-encodes
+    # to proleptic -142437 (1580-01-09)
+    raw_days = np.full(10, -142427, np.int32)
+    t = pa.table({"d": pa.array(raw_days, pa.date32()),
+                  "v": pa.array(np.arange(10), pa.int64())})
+    p = str(tmp_path / "legacy.parquet")
+    pq.write_table(
+        t.replace_schema_metadata(
+            {b"org.apache.spark.legacyDateTime": b""}), p)
+    # predicate selects the REBASED value: d <= 1580-01-15
+    import datetime
+    src = ParquetSource([p],
+                        predicate=col("d") <= lit(datetime.date(1580, 1, 15)),
+                        reader_type=ReaderType.MULTITHREADED,
+                        rebase_mode="LEGACY")
+    got = pa.concat_tables(src.read_split(src.files))
+    assert src.row_groups_pruned == 0
+    assert got.num_rows == 10      # all rows rebase to -142437 <= -142431

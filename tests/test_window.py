@@ -109,24 +109,74 @@ def test_window_then_filter():
         .alias("rn")).where(col("rn") <= lit(3)))
 
 
-def test_unsupported_frame_falls_back():
-    """Bounded RANGE / bounded-end-unbounded-start frames are planner-tagged
-    for CPU fallback (reference: GpuWindowExecMeta), not runtime errors."""
-    from harness.asserts import assert_tpu_fallback_collect
-    assert_tpu_fallback_collect(
-        lambda: table(WT).window(
-            over(WindowAgg(Sum(col("v"))), partition_by=[col("k")],
-                 order_by=[asc(col("o"))],
-                 frame=WindowFrame(is_rows=False, start=-5, end=5))
-            .alias("s")),
-        "CpuFallback")
-    assert_tpu_fallback_collect(
-        lambda: table(WT).window(
-            over(WindowAgg(Min(col("v"))), partition_by=[col("k")],
-                 order_by=[asc(col("o"))],
-                 frame=WindowFrame(is_rows=True, start=None, end=2))
-            .alias("m")),
-        "CpuFallback")
+# ---- full frame matrix (round 4 — VERDICT r3 Next #3; reference grid:
+# integration_tests/src/main/python/window_function_test.py) ----
+
+ROWS_FRAMES = [(-2, None), (1, None), (None, -1), (None, 2), (-3, -1),
+               (1, 3), (-2, 2), (-100, 50), (None, None)]
+
+
+@pytest.mark.parametrize("start,end", ROWS_FRAMES)
+def test_rows_frame_matrix(start, end):
+    fr = WindowFrame(is_rows=True, start=start, end=end)
+    _q(lambda: table(WT).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")],
+             [asc(col("o")), asc(col("v"))], fr).alias("s"),
+        over(WindowAgg(Min(col("v"))), [col("k")],
+             [asc(col("o")), asc(col("v"))], fr).alias("mn"),
+        over(WindowAgg(Max(col("d"))), [col("k")],
+             [asc(col("o")), asc(col("v"))], fr).alias("mx"),
+        over(WindowAgg(Count(col("v"))), [col("k")],
+             [asc(col("o")), asc(col("v"))], fr).alias("c")))
+
+
+RANGE_FRAMES = [(-5, 5), (None, 3), (-4, None), (-5, -1), (2, 6), (0, 4),
+                (-3, 0)]
+
+
+@pytest.mark.parametrize("start,end", RANGE_FRAMES)
+def test_range_frame_matrix_asc(start, end):
+    fr = WindowFrame(is_rows=False, start=start, end=end)
+    _q(lambda: table(WT).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")], [asc(col("o"))], fr)
+        .alias("s"),
+        over(WindowAgg(Min(col("v"))), [col("k")], [asc(col("o"))], fr)
+        .alias("mn"),
+        over(WindowAgg(Count(col("v"))), [col("k")], [asc(col("o"))], fr)
+        .alias("c")))
+
+
+@pytest.mark.parametrize("start,end", [(-5, 5), (-4, None), (None, 3),
+                                       (1, 4)])
+def test_range_frame_matrix_desc(start, end):
+    fr = WindowFrame(is_rows=False, start=start, end=end)
+    _q(lambda: table(WT).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")], [desc(col("o"))], fr)
+        .alias("s"),
+        over(WindowAgg(Max(col("v"))), [col("k")], [desc(col("o"))], fr)
+        .alias("mx")))
+
+
+def test_range_frame_average_large_window():
+    # beyond the shift-fold cutoff: prefix-difference + sparse-table path
+    fr = WindowFrame(is_rows=True, start=-200, end=100)
+    _q(lambda: table(WT).window(
+        over(WindowAgg(Average(col("d"))), [col("k")],
+             [asc(col("o")), asc(col("v"))], fr).alias("a")))
+
+
+def test_multi_key_value_range_rejected():
+    """Value-bounded RANGE with multiple order keys is invalid SQL
+    (Spark's analyzer rejects it); both engines surface an error instead
+    of guessing semantics."""
+    from spark_rapids_tpu.plan import Session
+    q = table(WT).window(
+        over(WindowAgg(Sum(col("v"))), partition_by=[col("k")],
+             order_by=[asc(col("o")), asc(col("v"))],
+             frame=WindowFrame(is_rows=False, start=-5, end=5))
+        .alias("s"))
+    with pytest.raises(ValueError, match="exactly one order key"):
+        Session({}).collect(q)
 
 
 # ---- key batching (reference: GpuKeyBatchingIterator) ----
@@ -181,3 +231,20 @@ def test_window_key_batching_exec_in_plan():
         over(Rank(), [col("k")], [asc(col("o"))]).alias("r")))
     assert any("KeyBatching" in n for n in ses.executed_exec_names()), \
         ses.executed_exec_names()
+
+
+def test_desc_range_frame_int64_boundary_values():
+    """Descending value-bounded RANGE at INT64_MIN neighborhood: the rank
+    domain must stay bijective (value negation would merge INT64_MIN with
+    INT64_MIN+1 — found by review repro)."""
+    import pyarrow as pa
+    IMIN = -(1 << 63)
+    t = pa.table({
+        "k": pa.array([0] * 6, pa.int32()),
+        "o": pa.array([IMIN, IMIN + 1, IMIN + 2, IMIN + 6, 0, 5],
+                      pa.int64()),
+        "v": pa.array([1, 10, 100, 1000, 10000, 100000], pa.int64()),
+    })
+    _q(lambda: table(t).window(
+        over(WindowAgg(Sum(col("v"))), [col("k")], [desc(col("o"))],
+             WindowFrame(is_rows=False, start=-1, end=1)).alias("s")))
