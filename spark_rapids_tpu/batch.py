@@ -471,6 +471,9 @@ def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
     arrays = []
     for col, f in zip(batch.columns, schema):
         validity = np.asarray(col.validity[:n])
+        if f.dtype.kind is TypeKind.NULL:
+            arrays.append(pa.nulls(n))
+            continue
         if f.dtype.kind is TypeKind.STRING:
             mat = np.asarray(col.data[:n])
             lens = np.where(validity, np.asarray(col.lengths[:n]), 0)

@@ -102,10 +102,12 @@ class ProjectExec(UnaryExec):
         self.exprs = bind_all(exprs, child.output_schema)
         self._schema = schema_of(self.exprs)
 
-        def kernel(batch: ColumnarBatch):
+        def kernel(batch: ColumnarBatch, bseed):
             # errors dict is always live: ANSI rows report conditionally,
-            # CAPACITY_* budget overflows report unconditionally
-            ctx = EvalContext(self.ctx.ansi, {})
+            # CAPACITY_* budget overflows report unconditionally. bseed is
+            # a traced per-(partition, batch) scalar for stateless PRNG
+            # expressions (Rand) — traced, so no per-batch retraces.
+            ctx = EvalContext(self.ctx.ansi, {}, batch_seed=bseed)
             cols = tuple(e.eval(batch, ctx) for e in self.exprs)
             return ColumnarBatch(cols, batch.num_rows), _sum_errors(ctx)
 
@@ -116,8 +118,11 @@ class ProjectExec(UnaryExec):
         return self._schema
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        for batch in self.child.execute_partition(p):
-            out, errs = self._kernel(batch)
+        for i, batch in enumerate(self.child.execute_partition(p)):
+            # deterministic on re-execution: derived from position, not a
+            # global counter
+            out, errs = self._kernel(batch,
+                                     jnp.uint32((p << 16) ^ (i & 0xFFFF)))
             _raise_ansi(errs)
             yield out
 

@@ -58,10 +58,10 @@ class WindowExec(UnaryExec):
         # tags these for CPU fallback before ever constructing this exec;
         # without this guard a bounded RANGE frame would silently get ROWS
         # semantics from the shift-fold path
-        from ..expressions.window import WindowAgg as _WA, \
+        from ..expressions.window import NthValue as _NV, WindowAgg as _WA, \
             unsupported_frame_reason
         for w in self.exprs:
-            if isinstance(w.function, _WA):
+            if isinstance(w.function, (_WA, _NV)):
                 reason = unsupported_frame_reason(w.spec.frame, w.spec)
                 if reason:
                     raise NotImplementedError(reason)
@@ -147,6 +147,35 @@ class WindowExec(UnaryExec):
             else:
                 v = peer_start - seg_start + 1
             return DeviceColumn(v.astype(jnp.int32), live, None, T.INT32)
+        from ..expressions.window import CumeDist, NthValue, PercentRank
+        if isinstance(fn, PercentRank):
+            peer_start = segmented_scan(
+                jnp.where(peer_head, iota, 0), head, jnp.maximum)
+            rank = peer_start - seg_start + 1
+            seg_len = self._seg_len(head, tail, iota, cap)
+            v = jnp.where(seg_len > 1,
+                          (rank - 1).astype(jnp.float64) /
+                          jnp.maximum(seg_len - 1, 1).astype(jnp.float64),
+                          0.0)
+            return DeviceColumn(v, live, None, T.FLOAT64)
+        if isinstance(fn, CumeDist):
+            peer_tail = jnp.concatenate(
+                [peer_head[1:], jnp.ones(1, bool)]) | tail
+            pe = segmented_scan(jnp.where(peer_tail, iota, cap),
+                                peer_tail, jnp.minimum, reverse=True)
+            seg_len = self._seg_len(head, tail, iota, cap)
+            v = (pe - seg_start + 1).astype(jnp.float64) / \
+                jnp.maximum(seg_len, 1).astype(jnp.float64)
+            return DeviceColumn(v, live, None, T.FLOAT64)
+        if isinstance(fn, NthValue):
+            src = fn.child.eval(batch, self.ctx)
+            s = gather_column(src, perm)
+            lo, hi = self._frame_bounds(w.spec.frame, head, tail,
+                                        peer_head, live, iota, cap)
+            idx = lo + fn.n - 1
+            ok = (idx <= hi) & (idx >= lo) & live
+            v = gather_column(s, jnp.clip(idx, 0, cap - 1))
+            return v.replace(validity=v.validity & ok)
         if isinstance(fn, NTile):
             seg_len = self._seg_len(head, tail, iota, cap)
             b = jnp.int32(fn.buckets)

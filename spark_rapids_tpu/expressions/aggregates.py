@@ -1167,3 +1167,83 @@ def max_(e) -> Max:
 
 def avg(e) -> Average:
     return Average(e)
+
+
+@dataclass(frozen=True, eq=False)
+class PivotFirst(AggregateFunction):
+    """PivotFirst(pivot, value, pivot_values): per-group FIRST of
+    ``value`` for each literal pivot key, emitted as one array column the
+    planner's pivot projection indexes (reference: GpuPivotFirst,
+    GpuOverrides.scala:2022 — same array-of-buffers contract as Spark's
+    PivotFirst). Missing combos are NULL elements (per-element validity
+    rides the scalar-array data2 plane, consumed by element access)."""
+
+    child: Optional[Expression] = None          # the value expression
+    pivot: Optional[Expression] = None
+    pivot_values: Tuple = ()
+
+    @property
+    def children(self):
+        return (self.child, self.pivot)
+
+    def with_children(self, c):
+        return PivotFirst(c[0], c[1], self.pivot_values)
+
+    @property
+    def dtype(self):
+        return T.array(self.child.dtype, max(len(self.pivot_values), 1))
+
+    def buffer_types(self):
+        return [self.child.dtype, T.BOOLEAN] * len(self.pivot_values)
+
+    def _masks(self, pv_col, live):
+        out = []
+        for pv in self.pivot_values:
+            if pv is None:
+                out.append(live & ~pv_col.validity)
+            elif pv_col.lengths is not None:
+                # string pivot keys: canonical zero padding makes full-row
+                # byte equality string equality
+                b = str(pv).encode("utf-8")
+                ml = pv_col.data.shape[1]
+                padded = jnp.asarray(
+                    bytearray(b[:ml] + b"\0" * max(ml - len(b), 0)),
+                    jnp.uint8)
+                eq = jnp.all(pv_col.data == padded[None, :], axis=1) & \
+                    (len(b) <= ml)
+                out.append(live & pv_col.validity & eq)
+            else:
+                out.append(live & pv_col.validity &
+                           (pv_col.data == jnp.asarray(
+                               pv, pv_col.data.dtype)))
+        return out
+
+    def update(self, inputs, seg, live, cap):
+        val, pv = inputs
+        f = First(self.child)
+        bufs = []
+        for mask in self._masks(pv, live):
+            bufs.extend(f.update([val], seg, mask, cap))
+        return bufs
+
+    def merge(self, buffers, seg, live, cap):
+        f = First(self.child)
+        out = []
+        for k in range(len(self.pivot_values)):
+            v, has = buffers[2 * k], buffers[2 * k + 1]
+            present = live & has.data
+            out.extend(f.update([v], seg, present, cap))
+        return out
+
+    def evaluate(self, buffers, group_live):
+        K = len(self.pivot_values)
+        vals = [buffers[2 * k] for k in range(K)]
+        has = [buffers[2 * k + 1] for k in range(K)]
+        data = jnp.stack([v.data for v in vals], axis=1)
+        ev = jnp.stack([v.validity & h.data for v, h in zip(vals, has)],
+                       axis=1)
+        cap = data.shape[0]
+        return DeviceColumn(
+            jnp.where(ev, data, jnp.zeros((), data.dtype)),
+            group_live, jnp.where(group_live, K, 0),
+            self.dtype, ev)

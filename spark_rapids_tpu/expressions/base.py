@@ -41,6 +41,10 @@ class EvalContext:
 
     ansi: bool = False
     errors: object = None    # Optional[dict[str, list]]; trace-time collector
+    #: traced per-(partition, batch-ordinal) scalar folded into stateless
+    #: PRNG expressions (Rand) so batches draw DIFFERENT values while
+    #: re-executions stay deterministic; 0 when the exec doesn't plumb it
+    batch_seed: object = None
 
     def report(self, bad, kind: str = "ARITHMETIC_OVERFLOW",
                always: bool = False) -> None:

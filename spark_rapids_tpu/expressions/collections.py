@@ -218,6 +218,11 @@ class ElementAt(Expression):
                 ok[:, None], data, 0)
             return DeviceColumn(data, ok, lens, self.dtype)
         data = jnp.take_along_axis(a.data, safe[:, None], axis=1)[:, 0]
+        if a.data2 is not None and a.data2.dtype == jnp.bool_:
+            # scalar arrays carry OPTIONAL per-element validity in data2
+            # (PivotFirst's missing pivot combos are null elements)
+            ev = jnp.take_along_axis(a.data2, safe[:, None], axis=1)[:, 0]
+            ok = ok & ev
         return DeviceColumn(data, ok, None, self.dtype)
 
     def eval(self, batch, ctx=EvalContext()):
@@ -723,6 +728,10 @@ class MapKeys(Expression):
 
     def eval(self, batch, ctx=EvalContext()):
         m = self.child.eval(batch, ctx)
+        if m.data.ndim == 3:     # string keys: derive per-element lengths
+            from .strings import string_elem_lengths
+            return DeviceColumn(m.data, m.validity, m.lengths, self.dtype,
+                                string_elem_lengths(m.data))
         return DeviceColumn(m.data, m.validity, m.lengths, self.dtype)
 
 
@@ -740,7 +749,33 @@ class MapValues(MapKeys):
 
     def eval(self, batch, ctx=EvalContext()):
         m = self.child.eval(batch, ctx)
+        if m.data2.ndim == 3:    # string values: derive lengths; NULL
+            # entries (0xFF sentinel, see StringToMap) render as ""
+            # because the array layout has no per-element validity
+            from .strings import string_elem_lengths
+            sent = m.data2[:, :, 0] == 0xFF
+            d = m.data2.at[:, :, 0].set(
+                jnp.where(sent, jnp.uint8(0), m.data2[:, :, 0]))
+            return DeviceColumn(d, m.validity, m.lengths, self.dtype,
+                                string_elem_lengths(d))
         return DeviceColumn(m.data2, m.validity, m.lengths, self.dtype)
+
+
+def _string_elem_eq(elems3, probe):
+    """[n, E] equality of zero-padded string elements vs a probe string
+    column (canonical padding: full-row byte equality == string
+    equality)."""
+    ml = elems3.shape[-1]
+    pml = probe.data.shape[1]
+    if pml < ml:
+        p = jnp.pad(probe.data, ((0, 0), (0, ml - pml)))
+    else:
+        p = probe.data[:, :ml]
+    eq = jnp.all(elems3 == p[:, None, :], axis=2)
+    if pml > ml:
+        # probe longer than element budget: equal only if its tail is empty
+        eq = eq & jnp.all(probe.data[:, ml:] == 0, axis=1)[:, None]
+    return eq
 
 
 @dataclass(frozen=True, eq=False)
@@ -760,8 +795,13 @@ class GetMapValue(Expression):
 
     @property
     def dtype(self):
+        from ..types import TypeKind
         k, v = _require_map(self.map, "GetMapValue")
-        if self.key.dtype != k:
+        if self.key.dtype != k and not (
+                self.key.dtype.kind is TypeKind.STRING
+                and k.kind is TypeKind.STRING):
+            # string budgets may differ (probe literal vs map budget);
+            # _string_elem_eq pads/clips
             raise TypeError(f"map key {self.key.dtype} vs {k}")
         return v
 
@@ -774,12 +814,27 @@ class GetMapValue(Expression):
         k = self.key.eval(batch, ctx)
         me = m.data.shape[1]
         live = _elem_mask(m)
-        hit = live & (m.data == k.data[:, None])
+        if m.data.ndim == 3:
+            hit = live & _string_elem_eq(m.data, k)
+        else:
+            hit = live & (m.data == k.data[:, None])
         # last win: highest matching slot index
         slot = jnp.arange(me, dtype=jnp.int32)[None, :]
         best = jnp.max(jnp.where(hit, slot, jnp.int32(-1)), axis=1)
         found = best >= 0
         safe = jnp.clip(best, 0, me - 1)
+        if m.data.ndim == 3:
+            row = jnp.take_along_axis(
+                m.data2, safe[:, None, None], axis=1)[:, 0]
+            null_v = row[:, 0] == 0xFF        # StringToMap NULL sentinel
+            row = row.at[:, 0].set(
+                jnp.where(null_v, jnp.uint8(0), row[:, 0]))
+            from .strings import string_elem_lengths
+            ln = string_elem_lengths(row[:, None, :])[:, 0]
+            ok = m.validity & k.validity & found & ~null_v
+            return DeviceColumn(
+                jnp.where(ok[:, None], row, 0), ok,
+                jnp.where(ok, ln, 0), self.dtype)
         data = jnp.take_along_axis(m.data2, safe[:, None], axis=1)[:, 0]
         ok = m.validity & k.validity & found
         return DeviceColumn(jnp.where(ok, data, jnp.zeros((), data.dtype)),
@@ -808,7 +863,12 @@ class MapContainsKey(Expression):
     def eval(self, batch, ctx=EvalContext()):
         m = self.map.eval(batch, ctx)
         k = self.key.eval(batch, ctx)
-        hit = jnp.any(_elem_mask(m) & (m.data == k.data[:, None]), axis=1)
+        if m.data.ndim == 3:
+            eq = _string_elem_eq(m.data, k)
+            hit = jnp.any(_elem_mask(m) & eq, axis=1)
+        else:
+            hit = jnp.any(_elem_mask(m) & (m.data == k.data[:, None]),
+                          axis=1)
         return DeviceColumn(hit, m.validity & k.validity, None, T.BOOLEAN)
 
 
@@ -1517,3 +1577,38 @@ class ZipWith(Expression):
         data = jnp.where(live2, out.data.reshape(cap, me), 0)
         return DeviceColumn(data, validity, jnp.where(validity, n, 0),
                             self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class ReplicateRows(Expression):
+    """replicaterows(n): an [0..n) index array whose EXPLODE replicates
+    the row n times (reference: GpuReplicateRows,
+    GpuOverrides.scala:3181 — used by skewed FULL OUTER rewrites). The
+    planner pairs it with GenerateExec and drops the index column."""
+
+    n: Expression = None
+    max_repeat: int = 64
+
+    @property
+    def children(self):
+        return (self.n,)
+
+    def with_children(self, c):
+        return ReplicateRows(c[0], self.max_repeat)
+
+    @property
+    def dtype(self):
+        return T.array(T.INT32, self.max_repeat)
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.n.eval(batch, ctx)
+        n = c.data.astype(jnp.int32)
+        ctx.report((n > self.max_repeat) & c.validity,
+                   "CAPACITY_replicate_rows", always=True)
+        cap = batch.capacity
+        data = jnp.broadcast_to(
+            jnp.arange(self.max_repeat, dtype=jnp.int32)[None, :],
+            (cap, self.max_repeat))
+        lengths = jnp.clip(jnp.where(c.validity, n, 0), 0,
+                           self.max_repeat)
+        return DeviceColumn(data, c.validity, lengths, self.dtype)

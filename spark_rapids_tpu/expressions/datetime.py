@@ -869,3 +869,113 @@ def months_between(end, start, round_off=True):
 
 def next_day(e, day_name):
     return NextDay(e, day_name)
+
+
+# ---------------------------------------------------------------------------
+# Timezone conversions (reference: GpuFromUTCTimestamp/GpuToUTCTimestamp,
+# GpuOverrides.scala:1690; the GPU plugin ships a transition-table
+# GpuTimeZoneDB — same design here: host-built per-zone transition arrays,
+# device lookup = one searchsorted into a tiny constant table)
+# ---------------------------------------------------------------------------
+
+_TZ_CACHE: dict = {}
+
+
+def _tz_transitions(tz_name: str):
+    """(instants_us, offsets_us) int64 arrays: UTC transition instants and
+    the offset in force from each instant on. Covers 1900-2100 by probing
+    zoneinfo at 6h resolution (catches double-shift days) and bisecting
+    each change to the second."""
+    import datetime as dt
+    from zoneinfo import ZoneInfo
+    if tz_name in _TZ_CACHE:
+        return _TZ_CACHE[tz_name]
+    tz = ZoneInfo(tz_name)
+
+    def off_s(ts_s: int) -> int:
+        d = dt.datetime.fromtimestamp(ts_s, dt.timezone.utc).astimezone(tz)
+        return int(d.utcoffset().total_seconds())
+
+    start = int(dt.datetime(1900, 1, 1,
+                            tzinfo=dt.timezone.utc).timestamp())
+    end = int(dt.datetime(2100, 1, 1, tzinfo=dt.timezone.utc).timestamp())
+    step = 6 * 3600
+    trans = [-(1 << 62)]
+    offs = [off_s(start)]
+    prev, t = offs[0], start
+    while t < end:
+        nt = min(t + step, end)
+        o = off_s(nt)
+        if o != prev:
+            lo, hi = t, nt
+            while hi - lo > 1:
+                mid = (lo + hi) // 2
+                if off_s(mid) == prev:
+                    lo = mid
+                else:
+                    hi = mid
+            trans.append(hi * 1_000_000)
+            offs.append(off_s(hi))
+            prev = offs[-1]
+        t = nt
+    import numpy as np
+    out = (np.asarray(trans, np.int64),
+           np.asarray(offs, np.int64) * 1_000_000)
+    _TZ_CACHE[tz_name] = out
+    return out
+
+
+@dataclass(frozen=True, eq=False)
+class UTCTimestampConv(Expression):
+    """from_utc_timestamp / to_utc_timestamp with a LITERAL zone id (the
+    reference requires a literal zone too). ``to_utc`` resolves local
+    wall times with one fixed-point refinement: off = offset(local -
+    offset(local)) — Java's earlier-offset choice for overlaps, shifted
+    forward through gaps."""
+
+    child: Expression = None
+    tz: str = "UTC"
+    to_utc: bool = False
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return UTCTimestampConv(c[0], self.tz, self.to_utc)
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+    def device_unsupported_reason(self):
+        try:
+            _tz_transitions(self.tz)
+        except Exception:
+            return f"unknown time zone {self.tz!r}"
+        return None
+
+    def eval(self, batch, ctx=EvalContext()):
+        trans, offs = _tz_transitions(self.tz)
+        td = jnp.asarray(trans)
+        od = jnp.asarray(offs)
+        c = self.child.eval(batch, ctx)
+        ts = c.data.astype(jnp.int64)
+        if not self.to_utc:
+            ix = jnp.clip(jnp.searchsorted(td, ts, side="right") - 1,
+                          0, td.shape[0] - 1)
+            out = ts + jnp.take(od, ix)
+        else:
+            # local-domain cutover table: transition k's pre-offset stays
+            # in force for local times below T_k + max(o_{k-1}, o_k) —
+            # which IS Java's resolution (earlier offset in overlaps,
+            # shift-forward through gaps; both reduce to the
+            # pre-transition offset, verified against
+            # LocalDateTime.atZone semantics in the tests)
+            import numpy as np
+            cut = trans[1:] + np.maximum(offs[:-1], offs[1:])
+            cd = jnp.asarray(cut)
+            ix = jnp.clip(jnp.searchsorted(cd, ts, side="right"),
+                          0, od.shape[0] - 1)
+            out = ts - jnp.take(od, ix)
+        return numeric_column(out, c.validity, T.TIMESTAMP)

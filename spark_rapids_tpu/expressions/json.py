@@ -362,3 +362,21 @@ class JsonToStructs(Expression):
 
     def eval(self, batch, ctx=EvalContext()):
         raise JsonPathUnsupported("JsonToStructs has no direct device eval")
+
+
+def json_tuple(e, *fields):
+    """json_tuple(json, f1, ..., fk) -> k aliased extraction columns
+    (c0..c{k-1}), each a top-level key lookup. Spark's JsonTuple is a
+    1-row generator; field extraction is exactly get_json_object('$.f')
+    (reference: GpuJsonTuple, GpuOverrides.scala:3396 — it also lowers to
+    repeated path extraction on device)."""
+    from .base import lit
+    for f in fields:
+        if "'" in f:
+            raise ValueError(
+                f"json_tuple field {f!r}: quote characters are outside "
+                f"the supported path subset")
+    # bracket-quoted: field names with path metacharacters ('.', '[',
+    # '*') stay LITERAL top-level keys, like Spark's JsonTuple
+    return [GetJsonObject(e, lit(f"$['{f}']")).alias(f"c{i}")
+            for i, f in enumerate(fields)]

@@ -36,6 +36,9 @@ class UnaryMath(Expression):
         "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
         "cbrt": jnp.cbrt, "rint": jnp.round,
         "degrees": jnp.degrees, "radians": jnp.radians,
+        "cot": lambda x: 1.0 / jnp.tan(x),
+        "sec": lambda x: 1.0 / jnp.cos(x),
+        "csc": lambda x: 1.0 / jnp.sin(x),
     }
     # functions where non-positive input yields NULL (Spark behavior)
     _NULL_ON_NONPOS: ClassVar[Dict[str, Callable]] = {
@@ -201,3 +204,164 @@ class Signum(Expression):
         c = self.child.eval(batch, ctx)
         return numeric_column(jnp.sign(c.data.astype(jnp.float64)),
                               c.validity, T.FLOAT64)
+
+
+@dataclass(frozen=True, eq=False)
+class Hypot(Expression):
+    """hypot(a, b) = sqrt(a^2+b^2) without intermediate overflow
+    (reference: GpuHypot, GpuOverrides mathExpressions)."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return Hypot(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        l = self.left.eval(batch, ctx)
+        r = self.right.eval(batch, ctx)
+        y = jnp.hypot(l.data.astype(jnp.float64),
+                      r.data.astype(jnp.float64))
+        return numeric_column(y, and_validity([l, r]), T.FLOAT64)
+
+
+@dataclass(frozen=True, eq=False)
+class Logarithm(Expression):
+    """log(base, x) = ln(x)/ln(base); NULL for non-positive x or base
+    (reference: GpuLogarithm — same guard, GpuOverrides.scala Logarithm).
+    base == 1 follows IEEE through the division (±inf), like the JVM."""
+
+    base: Expression
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.base, self.child)
+
+    def with_children(self, c):
+        return Logarithm(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    def eval(self, batch, ctx=EvalContext()):
+        b = self.base.eval(batch, ctx)
+        x = self.child.eval(batch, ctx)
+        bd = b.data.astype(jnp.float64)
+        xd = x.data.astype(jnp.float64)
+        ok = (bd > 0.0) & (xd > 0.0)
+        y = jnp.log(jnp.where(ok, xd, 1.0)) / \
+            jnp.log(jnp.where(bd > 0.0, bd, 2.0))
+        return numeric_column(y, and_validity([b, x]) & ok, T.FLOAT64)
+
+
+@dataclass(frozen=True, eq=False)
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN, else a (reference: GpuNaNvl,
+    GpuOverrides.scala:1289). NULL a stays NULL."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, c):
+        return NaNvl(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.FLOAT64 if self.left.dtype.kind is not TypeKind.FLOAT32 \
+            or self.right.dtype.kind is not TypeKind.FLOAT32 else T.FLOAT32
+
+    def eval(self, batch, ctx=EvalContext()):
+        l = self.left.eval(batch, ctx)
+        r = self.right.eval(batch, ctx)
+        st = self.dtype.storage_dtype
+        ld = l.data.astype(st)
+        rd = r.data.astype(st)
+        nan = jnp.isnan(ld)
+        data = jnp.where(nan, rd, ld)
+        # nanvl(null, x) = null; nanvl(NaN, x) = x (null x -> null)
+        validity = jnp.where(nan & l.validity, r.validity, l.validity)
+        return numeric_column(data, validity, self.dtype)
+
+
+@dataclass(frozen=True, eq=False)
+class Rand(Expression):
+    """rand(seed): uniform [0,1) doubles, deterministic per (seed, row
+    position) via the counter-based threefry generator — re-executions and
+    overflow retries reproduce the same values, unlike a stateful stream.
+    INCOMPAT: the sequence differs from Spark's per-partition
+    XorShiftRandom (reference marks GpuRand compatible because it
+    reimplements xorshift; here determinism-under-retry is the priority
+    and the distribution is identical)."""
+
+    seed: int = 0
+
+    @property
+    def children(self):
+        return ()
+
+    def with_children(self, c):
+        return self
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, batch, ctx=EvalContext()):
+        import jax
+        cap = batch.capacity
+        key = jax.random.key(self.seed & 0x7FFFFFFF)
+        bs = ctx.batch_seed
+        if bs is not None:
+            # distinct draws per (partition, batch) — without this every
+            # batch would repeat one vector (perfectly correlated
+            # sampling across a multi-batch scan)
+            key = jax.random.fold_in(key, jnp.asarray(bs, jnp.uint32))
+        u = jax.random.uniform(key, (cap,), dtype=jnp.float64)
+        return numeric_column(u, jnp.ones(cap, bool), T.FLOAT64)
+
+
+@dataclass(frozen=True, eq=False)
+class RaiseError(Expression):
+    """raise_error(msg): fails the query when ANY live row evaluates it
+    (reference: GpuRaiseError). The failure rides the engine's existing
+    error channel and surfaces at the exec's materialization point, so
+    the fused/jitted program stays sync-free."""
+
+    child: Expression
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return RaiseError(c[0])
+
+    @property
+    def dtype(self):
+        return T.NULL
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        live = batch.row_mask()
+        ctx.report(live & c.validity, kind="USER_RAISED_ERROR",
+                   always=True)
+        return DeviceColumn(jnp.zeros(batch.capacity, jnp.int8),
+                            jnp.zeros(batch.capacity, bool), None, T.NULL)

@@ -1540,3 +1540,204 @@ class Conv(Expression):
                         jnp.pad(jnp.full((n, 1), ord("0"), jnp.uint8),
                                 ((0, 0), (0, 64))))
         return _string_column(out, out_len, validity, 65)
+
+
+@dataclass(frozen=True, eq=False)
+class FindInSet(Expression):
+    """find_in_set(str, set): 1-based index of ``str`` within the
+    comma-separated ``set``, 0 when absent or when ``str`` contains a
+    comma (reference: GpuStringFindInSet / stringFunctions.scala)."""
+
+    child: Expression = None
+    set: Expression = None
+
+    @property
+    def children(self):
+        return (self.child, self.set)
+
+    def with_children(self, c):
+        return FindInSet(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return T.INT32
+
+    def eval(self, batch, ctx=EvalContext()):
+        from .base import numeric_column
+        q = self.child.eval(batch, ctx)
+        s = self.set.eval(batch, ctx)
+        comma = jnp.uint8(ord(","))
+        n, mls = s.data.shape
+        mlq = q.data.shape[1]
+        pos = jnp.arange(mls)[None, :]
+        in_set = pos < s.lengths[:, None]
+        is_comma = (s.data == comma) & in_set
+        # dynamic-needle window equality: m[row, p] = set[p:p+qlen] == str
+        m = jnp.ones((n, mls), bool)
+        for j in range(mlq):
+            shifted = jnp.roll(s.data, -j, axis=1)
+            m = m & ((jnp.asarray(j) >= q.lengths[:, None])
+                     | (shifted == q.data[:, j:j + 1]))
+        # entry starts: position 0 or right after a comma
+        start = jnp.concatenate(
+            [jnp.ones((n, 1), bool), is_comma[:, :-1]], axis=1) & in_set
+        # entry must END exactly at p+qlen (comma or end of set)
+        endp = pos + q.lengths[:, None]
+        at_end = endp == s.lengths[:, None]
+        ml_idx = jnp.clip(endp, 0, mls - 1)
+        comma_at_end = jnp.take_along_axis(is_comma, ml_idx, axis=1) & \
+            (endp < mls)
+        hit = start & m & (at_end | comma_at_end) & \
+            (endp <= s.lengths[:, None])
+        entry_id = jnp.cumsum(is_comma.astype(jnp.int32), axis=1) - \
+            is_comma.astype(jnp.int32)
+        found = jnp.any(hit, axis=1)
+        first = jnp.argmax(hit, axis=1)
+        idx = jnp.take_along_axis(entry_id, first[:, None], axis=1)[:, 0] + 1
+        # the empty entry STARTING at position len(set) (empty set, or a
+        # trailing comma) lies outside the position grid: handle the
+        # virtual end slot for empty needles explicitly
+        n_entries = jnp.sum(is_comma.astype(jnp.int32), axis=1) + 1
+        last_ix = jnp.clip(s.lengths - 1, 0, mls - 1)
+        end_empty = (s.lengths == 0) | jnp.take_along_axis(
+            is_comma, last_ix[:, None], axis=1)[:, 0]
+        end_hit = (q.lengths == 0) & end_empty
+        idx = jnp.where(found, idx, jnp.where(end_hit, n_entries, 0))
+        found = found | end_hit
+        has_comma = jnp.any((q.data == comma) &
+                            (jnp.arange(mlq)[None, :] < q.lengths[:, None]),
+                            axis=1)
+        r = jnp.where(found & ~has_comma, idx, 0)
+        return numeric_column(r.astype(jnp.int32),
+                              q.validity & s.validity, T.INT32)
+
+
+@dataclass(frozen=True, eq=False)
+class Empty2Null(Expression):
+    """'' -> NULL (Spark inserts this around Hive text writes; reference:
+    GpuEmpty2Null)."""
+
+    child: Expression = None
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return Empty2Null(c[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch, ctx=EvalContext()):
+        c = self.child.eval(batch, ctx)
+        return c.replace(validity=c.validity & (c.lengths > 0))
+
+
+@dataclass(frozen=True, eq=False)
+class StringToMap(Expression):
+    """str_to_map(str, pair_delim, kv_delim) with LITERAL single-byte
+    delimiters -> map<string,string> (reference: GpuStringToMap,
+    GpuOverrides.scala:2507; same literal-delimiter restriction).
+
+    Device map layout for string elements: keys ride ``data`` and values
+    ``data2`` as [cap, max_entries, max_len] byte tensors, zero-padded so
+    element lengths are derivable from trailing zeros (the canonical
+    string padding _string_column already guarantees). Entries without a
+    kv delimiter get the whole entry as key and a NULL value, like Spark.
+    Value NULL-ness is encoded as an all-0xFF sentinel length marker in
+    the first byte... no: a value is NULL iff the entry had no kv_delim,
+    recorded by a 0xFF pad in data2's first byte being impossible — so
+    instead the kernel stores value length+1 in a trailing lane; see
+    ``MapStringOps`` consumers."""
+
+    child: Expression = None
+    pair_delim: str = ","
+    kv_delim: str = ":"
+    max_entries: int = 16
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return StringToMap(c[0], self.pair_delim, self.kv_delim,
+                           self.max_entries)
+
+    def device_unsupported_reason(self):
+        if len(self.pair_delim.encode()) != 1 or \
+                len(self.kv_delim.encode()) != 1:
+            return "str_to_map: delimiters must be single-byte literals"
+        return None
+
+    @property
+    def dtype(self):
+        ml = self.child.dtype.max_len or 64
+        return T.map_(T.string(ml), T.string(ml), self.max_entries)
+
+    def eval(self, batch, ctx=EvalContext()):
+        import jax
+        c = self.child.eval(batch, ctx)
+        pd = jnp.uint8(self.pair_delim.encode()[0])
+        kd = jnp.uint8(self.kv_delim.encode()[0])
+        n, ml = c.data.shape
+        E = self.max_entries
+        pos = jnp.arange(ml, dtype=jnp.int32)[None, :]
+        in_str = pos < c.lengths[:, None]
+        is_pd = (c.data == pd) & in_str
+        # entry index of each byte (delimiters belong to the PREVIOUS
+        # entry's boundary, not to either entry body)
+        entry_id = jnp.cumsum(is_pd.astype(jnp.int32), axis=1) - \
+            is_pd.astype(jnp.int32)
+        n_entries = jnp.where(
+            c.lengths > 0, entry_id[:, -1] + 1,
+            jnp.where(c.validity, 1, 0))
+        ctx.report((n_entries > E) & c.validity,
+                   "CAPACITY_str_to_map_entries", always=True)
+        # offset of each byte within its entry: pos - entry start
+        starts = jnp.where(is_pd, pos + 1, 0)
+        run_start = jax.lax.cummax(starts, axis=1)
+        off = pos - run_start
+        eid_c = jnp.clip(entry_id, 0, E - 1)
+        rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32)[:, None], ml, 1)
+        # first kv-delimiter offset per entry (ml+1 = none -> NULL value)
+        is_kd = (c.data == kd) & in_str & ~is_pd
+        kv_flat = jnp.full(n * E, ml + 1, jnp.int32).at[
+            jnp.where(is_kd, rows * E + eid_c, n * E).reshape(-1)
+        ].min(off.reshape(-1), mode="drop")
+        kv_off = kv_flat.reshape(n, E)
+        kv_here = jnp.take_along_axis(kv_off, eid_c, axis=1)
+        body = in_str & ~is_pd
+        is_key = body & (off < kv_here)
+        is_val = body & (off > kv_here)
+        voff = off - kv_here - 1
+        # dropped-target scatters: non-member bytes aim out of bounds
+        keys = jnp.zeros((n, E, ml), jnp.uint8).at[
+            rows, eid_c, jnp.where(is_key, off, ml)].set(
+            c.data, mode="drop")
+        vals = jnp.zeros((n, E, ml), jnp.uint8).at[
+            rows, eid_c, jnp.where(is_val, voff, ml)].set(
+            c.data, mode="drop")
+        # NULL value (entry without kv delimiter): 0xFF first-byte marker
+        # (0xFF never occurs in valid UTF-8, making the sentinel exact)
+        slot = jnp.arange(E, dtype=jnp.int32)[None, :]
+        no_kv = (kv_off > ml) & (slot < jnp.minimum(n_entries, E)[:, None])
+        vals = vals.at[:, :, 0].set(
+            jnp.where(no_kv, jnp.uint8(0xFF), vals[:, :, 0]))
+        lengths = jnp.where(c.validity, jnp.minimum(n_entries, E), 0)
+        return DeviceColumn(keys, c.validity, lengths, self.dtype, vals)
+
+
+def string_elem_lengths(b3):
+    """Derive per-element byte lengths of a [n, E, ml] zero-padded string
+    tensor (canonical padding; valid UTF-8 holds no NUL): length = 1 +
+    index of last nonzero byte."""
+    ml = b3.shape[-1]
+    nz = b3 != 0
+    last = ml - 1 - jnp.argmax(nz[..., ::-1].astype(jnp.int32), axis=-1)
+    return jnp.where(jnp.any(nz, axis=-1), last + 1, 0).astype(jnp.int32)

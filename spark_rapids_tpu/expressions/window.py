@@ -176,6 +176,73 @@ class NTile(WindowFunction):
 
 
 @dataclass(frozen=True, eq=False)
+class PercentRank(WindowFunction):
+    """percent_rank() = (rank - 1) / (partition rows - 1), 0.0 for
+    single-row partitions (reference: GpuPercentRank,
+    GpuOverrides.scala:973)."""
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def needs_order(self):
+        return True
+
+
+@dataclass(frozen=True, eq=False)
+class CumeDist(WindowFunction):
+    """cume_dist() = position of peer-group end / partition rows
+    (reference: GpuCumeDist)."""
+
+    @property
+    def dtype(self):
+        return T.FLOAT64
+
+    @property
+    def nullable(self):
+        return False
+
+    @property
+    def needs_order(self):
+        return True
+
+
+@dataclass(frozen=True, eq=False)
+class NthValue(WindowFunction):
+    """nth_value(col, n): value of the frame's n-th row (1-based), NULL
+    when the frame holds fewer than n rows (reference: GpuNthValue,
+    GpuOverrides.scala:2133; ignoreNulls unsupported, like the
+    reference)."""
+
+    child: Expression = None
+    n: int = 1
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return NthValue(c[0], self.n)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    @property
+    def needs_order(self):
+        return True
+
+
+@dataclass(frozen=True, eq=False)
 class LagLead(WindowFunction):
     child: Expression = None
     offset: int = 1

@@ -1491,6 +1491,17 @@ class Interpreter:
 
     def _agg_value(self, a, grp_rows, ev):
         name = type(a).__name__
+        if name == "PivotFirst":
+            out = []
+            for pv in a.pivot_values:
+                hit = None
+                for r in grp_rows:
+                    p = ev.eval(a.pivot, r)
+                    if p == pv or (p is None and pv is None):
+                        hit = ev.eval(a.child, r)
+                        break
+                out.append(hit)
+            return out
         child = a.children[0] if a.children else None
         xs = [ev.eval(child, r) for r in grp_rows] if child is not None \
             else [1] * len(grp_rows)
@@ -1636,6 +1647,29 @@ class Interpreter:
                 for j, i in enumerate(part):
                     out[i] = (j // (base + 1) if j < cut
                               else rem + (j - cut) // max(base, 1)) + 1
+            elif type(fn).__name__ == "PercentRank":
+                rank = 0
+                for j, i in enumerate(part):
+                    if j == 0 or okeys[j] != okeys[j - 1]:
+                        rank = j + 1
+                    out[i] = 0.0 if m <= 1 else (rank - 1) / (m - 1)
+            elif type(fn).__name__ == "CumeDist":
+                # peer-group END position (1-based) / partition size
+                ends = [0] * m
+                last = m - 1
+                for j in range(m - 1, -1, -1):
+                    if j < m - 1 and okeys[j] != okeys[j + 1]:
+                        last = j
+                    ends[j] = last
+                for j, i in enumerate(part):
+                    out[i] = (ends[j] + 1) / m
+            elif type(fn).__name__ == "NthValue":
+                for j, i in enumerate(part):
+                    lo, hi = self._frame_lo_hi(frame, spec, j, m, okeys,
+                                               rows, part, ev)
+                    ix = lo + fn.n - 1
+                    out[i] = ev.eval(fn.child, rows[part[ix]]) \
+                        if lo <= ix <= hi else None
             elif isinstance(fn, LagLead):
                 for j, i in enumerate(part):
                     src = j - fn.offset if fn.is_lag else j + fn.offset
@@ -1647,75 +1681,73 @@ class Interpreter:
                         out[i] = None
             elif isinstance(fn, WindowAgg):
                 for j, i in enumerate(part):
-                    if frame.is_full_partition:
-                        lo, hi = 0, m - 1
-                    elif frame.is_running and not frame.is_rows:
-                        lo = 0
-                        hi = j
-                        while hi + 1 < m and okeys[hi + 1] == okeys[j]:
-                            hi += 1
-                    elif frame.is_rows:
-                        lo = 0 if frame.start is None else j + frame.start
-                        hi = m - 1 if frame.end is None else j + frame.end
-                        lo, hi = max(lo, 0), min(hi, m - 1)
-                    else:
-                        # value-bounded RANGE: positional scan with bound
-                        # comparisons under the sort ordering (nulls take
-                        # their nulls-first/last rank; a null current row's
-                        # bound is null) — exactly Spark's
-                        # RangeBoundOrdering frame scan, which makes null
-                        # rows positional members of unbounded sides
-                        if len(spec.orders) != 1:
-                            raise ValueError(
-                                "value-bounded RANGE frames need exactly "
-                                "one order key")
-                        o0 = spec.orders[0]
-                        nf = o0.effective_nulls_first
-                        ovals = [ev.eval(o0.child, rows[part[x]])
-                                 for x in range(m)]
-                        k = ovals[j]
-
-                        def rk(v):
-                            return (0 if nf else 2) if v is None else 1
-
-                        def ocmp(a, b):
-                            ra, rb = rk(a), rk(b)
-                            if ra != rb:
-                                return -1 if ra < rb else 1
-                            if ra != 1 or a == b:
-                                return 0
-                            lt = a < b
-                            if o0.descending:
-                                lt = not lt
-                            return -1 if lt else 1
-
-                        def bound(delta):
-                            if k is None:
-                                return None
-                            return k - delta if o0.descending else k + delta
-
-                        if frame.start is None:
-                            lo2 = 0
-                        else:
-                            b = bound(frame.start)
-                            lo2 = 0
-                            while lo2 < m and ocmp(ovals[lo2], b) < 0:
-                                lo2 += 1
-                        if frame.end is None:
-                            hi2 = m - 1
-                        else:
-                            b = bound(frame.end)
-                            hi2 = m - 1
-                            while hi2 >= 0 and ocmp(ovals[hi2], b) > 0:
-                                hi2 -= 1
-                        grp = [rows[part[x]] for x in range(lo2, hi2 + 1)] \
-                            if lo2 <= hi2 else []
-                        out[i] = self._agg_value(fn.agg, grp, ev)
-                        continue
+                    lo, hi = self._frame_lo_hi(frame, spec, j, m, okeys,
+                                               rows, part, ev)
                     grp = [rows[part[x]] for x in range(lo, hi + 1)] \
                         if lo <= hi else []
                     out[i] = self._agg_value(fn.agg, grp, ev)
         return out
+
+    def _frame_lo_hi(self, frame, spec, j, m, okeys, rows, part, ev):
+        """[lo, hi] positional frame bounds of row j within its sorted
+        partition. Value-bounded RANGE runs the positional scan with
+        bound comparisons under the sort ordering (nulls take their
+        nulls-first/last rank; a null current row's bound is null) —
+        exactly Spark's RangeBoundOrdering frame scan, which makes null
+        rows positional members of unbounded sides."""
+        if frame.is_full_partition:
+            return 0, m - 1
+        if frame.is_running and not frame.is_rows:
+            hi = j
+            while hi + 1 < m and okeys[hi + 1] == okeys[j]:
+                hi += 1
+            return 0, hi
+        if frame.is_rows:
+            lo = 0 if frame.start is None else j + frame.start
+            hi = m - 1 if frame.end is None else j + frame.end
+            return max(lo, 0), min(hi, m - 1)
+        if len(spec.orders) != 1:
+            raise ValueError(
+                "value-bounded RANGE frames need exactly one order key")
+        o0 = spec.orders[0]
+        nf = o0.effective_nulls_first
+        ovals = [ev.eval(o0.child, rows[part[x]]) for x in range(m)]
+        k = ovals[j]
+
+        def rk(v):
+            return (0 if nf else 2) if v is None else 1
+
+        def ocmp(a, b):
+            ra, rb = rk(a), rk(b)
+            if ra != rb:
+                return -1 if ra < rb else 1
+            if ra != 1 or a == b:
+                return 0
+            lt = a < b
+            if o0.descending:
+                lt = not lt
+            return -1 if lt else 1
+
+        def bound(delta):
+            if k is None:
+                return None
+            return k - delta if o0.descending else k + delta
+
+        if frame.start is None:
+            lo = 0
+        else:
+            b = bound(frame.start)
+            lo = 0
+            while lo < m and ocmp(ovals[lo], b) < 0:
+                lo += 1
+        if frame.end is None:
+            hi = m - 1
+        else:
+            b = bound(frame.end)
+            hi = m - 1
+            while hi >= 0 and ocmp(ovals[hi], b) > 0:
+                hi -= 1
+        return lo, hi
 
     def _exec_LogicalJoin(self, p):
         lc, rc = p.children
@@ -2103,3 +2135,126 @@ def _install_breadth_rows(cls):
 
 
 _install_breadth_rows(RowEvaluator)
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth evaluators (VERDICT r3 Missing #2)
+# ---------------------------------------------------------------------------
+
+def _rw_hypot(self, e, row):
+    import math
+    a = self.eval(e.left, row)
+    b = self.eval(e.right, row)
+    if a is None or b is None:
+        return None
+    return math.hypot(float(a), float(b))
+
+
+def _rw_logarithm(self, e, row):
+    import math
+    b = self.eval(e.base, row)
+    x = self.eval(e.child, row)
+    if b is None or x is None or b <= 0 or x <= 0:
+        return None
+    lb = math.log(float(b))
+    if lb == 0.0:
+        return math.inf if x > 1 else (-math.inf if 0 < x < 1 else
+                                       math.nan)
+    return math.log(float(x)) / lb
+
+
+def _rw_nanvl(self, e, row):
+    import math
+    a = self.eval(e.left, row)
+    if a is None:
+        return None
+    if not math.isnan(float(a)):
+        return float(a)
+    b = self.eval(e.right, row)
+    return None if b is None else float(b)
+
+
+def _rw_raise_error(self, e, row):
+    v = self.eval(e.child, row)
+    if v is not None:
+        raise RuntimeError(f"[USER_RAISED_ERROR] {v}")
+    return None
+
+
+def _rw_find_in_set(self, e, row):
+    q = self.eval(e.child, row)
+    s = self.eval(e.set, row)
+    if q is None or s is None:
+        return None
+    if "," in q:
+        return 0
+    parts = s.split(",")
+    try:
+        return parts.index(q) + 1
+    except ValueError:
+        return 0
+
+
+def _rw_empty2null(self, e, row):
+    v = self.eval(e.child, row)
+    return None if v == "" else v
+
+
+def _rw_string_to_map(self, e, row):
+    v = self.eval(e.child, row)
+    if v is None:
+        return None
+    out = {}
+    for entry in v.split(e.pair_delim):
+        if e.kv_delim in entry:
+            k, _, val = entry.partition(e.kv_delim)
+            out[k] = val
+        else:
+            out[entry] = None
+    return out
+
+
+def _rw_rand(self, e, row):
+    # oracle-side rand is NOT value-comparable with the device (documented
+    # incompat); deterministic per seed for repeatable plans
+    import random
+    return random.Random(e.seed).random()
+
+
+def _rw_utc_conv(self, e, row):
+    import datetime as dt
+    from zoneinfo import ZoneInfo
+    v = self.eval(e.child, row)
+    if v is None:
+        return None
+    tz = ZoneInfo(e.tz)
+    if not e.to_utc:
+        # UTC instant -> wall clock in tz (naive)
+        aware = v.replace(tzinfo=dt.timezone.utc).astimezone(tz)
+        return aware.replace(tzinfo=None)
+    # naive wall clock in tz -> UTC instant (fold=0: earlier offset)
+    aware = v.replace(tzinfo=tz)
+    return aware.astimezone(dt.timezone.utc).replace(tzinfo=None)
+
+
+def _rw_replicate_rows(self, e, row):
+    n = self.eval(e.n, row)
+    if n is None:
+        return None
+    return list(range(max(int(n), 0)))
+
+
+def _install_round4_rows(cls):
+    cls._eval_Hypot = _rw_hypot
+    cls._eval_Logarithm = _rw_logarithm
+    cls._eval_NaNvl = _rw_nanvl
+    cls._eval_RaiseError = _rw_raise_error
+    cls._eval_FindInSet = _rw_find_in_set
+    cls._eval_Empty2Null = _rw_empty2null
+    cls._eval_StringToMap = _rw_string_to_map
+    cls._eval_Rand = _rw_rand
+    cls._eval_UTCTimestampConv = _rw_utc_conv
+    cls._eval_ReplicateRows = _rw_replicate_rows
+
+
+_install_round4_rows(RowEvaluator)
+

@@ -46,11 +46,14 @@ class TypeSig:
             if c.kind in (TypeKind.ARRAY, TypeKind.STRUCT, TypeKind.MAP):
                 return (f"{t} nested elements have no device layout")
         if t.kind is TypeKind.MAP:
+            # string keys/values ride zero-padded [cap, E, ml] byte
+            # tensors (StringToMap's layout; consumers derive lengths
+            # from canonical padding); nested entries have no layout
             for c in t.children:
-                if c.kind in (TypeKind.STRING, TypeKind.ARRAY,
-                              TypeKind.STRUCT, TypeKind.MAP):
-                    return (f"{t} needs variable-width entries; the "
-                            f"device map layout is fixed-width scalars")
+                if c.kind in (TypeKind.ARRAY, TypeKind.STRUCT,
+                              TypeKind.MAP):
+                    return (f"{t} nested map entries have no device "
+                            f"layout")
         for c in t.children:
             r = self.supports(c)
             if r:

@@ -1,0 +1,291 @@
+"""Round-4 expression breadth (VERDICT r3 Missing #2): hypot, log(base,x),
+nanvl, cot/sec/csc, find_in_set, empty2null, str_to_map + string-map
+consumers, raise_error, rand determinism, nth_value / percent_rank /
+cume_dist windows."""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec import InMemoryScanExec, ProjectExec
+from spark_rapids_tpu.exec.base import collect
+from spark_rapids_tpu.exec.sort import asc, desc
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Sum
+from spark_rapids_tpu.expressions.collections import (GetMapValue,
+                                                      MapContainsKey,
+                                                      MapKeys, MapValues)
+from spark_rapids_tpu.expressions.math import (Hypot, Logarithm, NaNvl,
+                                               RaiseError, Rand, UnaryMath)
+from spark_rapids_tpu.expressions.strings import (Empty2Null, FindInSet,
+                                                  StringToMap)
+from spark_rapids_tpu.expressions.window import (CumeDist, NthValue,
+                                                 PercentRank, WindowFrame,
+                                                 over)
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+from harness.data_gen import DoubleGen, IntegerGen, LongGen, gen_table
+
+
+def _project(t, exprs):
+    return collect(ProjectExec(exprs, InMemoryScanExec(t)))
+
+
+def test_hypot_logarithm_nanvl():
+    t = pa.table({
+        "a": pa.array([3.0, -4.0, 1e200, None, float("nan")], pa.float64()),
+        "b": pa.array([4.0, 3.0, 1e200, 1.0, 2.5], pa.float64()),
+    })
+    out = _project(t, [Hypot(col("a"), col("b")).alias("h"),
+                       Logarithm(col("b"), col("a")).alias("lg"),
+                       NaNvl(col("a"), col("b")).alias("nv")])
+    h = out.column("h").to_pylist()
+    assert h[0] == 5.0 and h[1] == 5.0
+    assert h[2] == pytest.approx(math.hypot(1e200, 1e200))  # no overflow
+    assert h[3] is None
+    lg = out.column("lg").to_pylist()
+    assert lg[0] == pytest.approx(math.log(3.0) / math.log(4.0))
+    assert lg[1] is None          # non-positive x -> null
+    assert lg[3] is None
+    nv = out.column("nv").to_pylist()
+    assert nv[0] == 3.0 and nv[3] is None and nv[4] == 2.5
+
+
+def test_cot_sec_csc():
+    t = pa.table({"x": pa.array([0.5, 1.2, -0.7], pa.float64())})
+    out = _project(t, [UnaryMath(col("x"), "cot").alias("cot"),
+                       UnaryMath(col("x"), "sec").alias("sec"),
+                       UnaryMath(col("x"), "csc").alias("csc")])
+    for i, x in enumerate([0.5, 1.2, -0.7]):
+        assert out.column("cot")[i].as_py() == pytest.approx(
+            1 / math.tan(x))
+        assert out.column("sec")[i].as_py() == pytest.approx(
+            1 / math.cos(x))
+        assert out.column("csc")[i].as_py() == pytest.approx(
+            1 / math.sin(x))
+
+
+def test_find_in_set():
+    t = pa.table({
+        "q": pa.array(["b", "c", "ab", "", "x,y", None, "b"]),
+        "s": pa.array(["a,b,c", "a,b,c", "abc,ab", "a,,b", "x,y",
+                       "a,b", None]),
+    })
+    out = _project(t, [FindInSet(col("q"), col("s")).alias("i")])
+    assert out.column("i").to_pylist() == [2, 3, 2, 2, 0, None, None]
+    # end-of-set empty entries (review repro): '' in '' -> 1; '' in 'a,' -> 2
+    t2 = pa.table({"q": pa.array(["", "", "", "b"]),
+                   "s": pa.array(["", "a,", "a,b", "a,b,"])})
+    out2 = _project(t2, [FindInSet(col("q"), col("s")).alias("i")])
+    assert out2.column("i").to_pylist() == [1, 2, 0, 2]
+
+
+def test_empty2null():
+    t = pa.table({"s": pa.array(["a", "", None, "b"])})
+    out = _project(t, [Empty2Null(col("s")).alias("x")])
+    assert out.column("x").to_pylist() == ["a", None, None, "b"]
+
+
+def test_str_to_map_and_consumers():
+    t = pa.table({"s": pa.array(["a:1,b:2", "k:v", "solo", "", None,
+                                 "x:1,x:9"])})
+    m = StringToMap(col("s"))
+    out = _project(t, [
+        GetMapValue(m, lit("a")).alias("va"),
+        GetMapValue(m, lit("x")).alias("vx"),
+        GetMapValue(m, lit("solo")).alias("vs"),
+        MapContainsKey(m, lit("b")).alias("cb"),
+    ])
+    assert out.column("va").to_pylist() == ["1", None, None, None, None,
+                                            None]
+    # duplicate keys: LAST_WIN read
+    assert out.column("vx").to_pylist() == [None, None, None, None, None,
+                                            "9"]
+    # entry without kv delimiter: key present, NULL value
+    assert out.column("vs").to_pylist() == [None, None, None, None, None,
+                                            None]
+    assert out.column("cb").to_pylist() == [True, False, False, False,
+                                            None, False]
+    keys = _project(t, [MapKeys(m).alias("k")]).column("k").to_pylist()
+    assert keys[0] == ["a", "b"] and keys[2] == ["solo"] and keys[1] == ["k"]
+    vals = _project(t, [MapValues(m).alias("v")]).column("v").to_pylist()
+    assert vals[0] == ["1", "2"]
+    # NULL value renders as "" through map_values (documented: the array
+    # layout has no per-element validity)
+    assert vals[2] == [""]
+
+
+def test_raise_error_fires_and_clean_passes():
+    t = pa.table({"s": pa.array(["boom"]), "ok": pa.array([1], pa.int64())})
+    with pytest.raises(Exception, match="USER_RAISED_ERROR"):
+        _project(t, [RaiseError(col("s")).alias("e")])
+    t2 = pa.table({"s": pa.array([None], pa.string())})
+    out = _project(t2, [RaiseError(col("s")).alias("e")])
+    assert out.column("e").to_pylist() == [None]
+
+
+def test_rand_deterministic_and_uniform():
+    t = pa.table({"x": pa.array(np.arange(4096), pa.int64())})
+    a = _project(t, [Rand(seed=42).alias("r")]).column("r").to_pylist()
+    b = _project(t, [Rand(seed=42).alias("r")]).column("r").to_pylist()
+    assert a == b                       # retry-deterministic
+    assert all(0.0 <= v < 1.0 for v in a)
+    assert 0.4 < sum(a) / len(a) < 0.6  # uniform-ish mean
+    c = _project(t, [Rand(seed=7).alias("r")]).column("r").to_pylist()
+    assert c != a
+
+
+def test_rand_varies_across_batches():
+    # multi-batch scans must draw DIFFERENT vectors per batch (review
+    # repro: one repeated vector = perfectly correlated sampling)
+    t = pa.table({"x": pa.array(np.arange(512), pa.int64())})
+    scan = InMemoryScanExec(t, batch_rows=128)
+    out = collect(ProjectExec([Rand(seed=3).alias("r")], scan))
+    vals = out.column("r").to_pylist()
+    batches = [vals[i * 128:(i + 1) * 128] for i in range(4)]
+    assert batches[0] != batches[1] and batches[1] != batches[2]
+    again = collect(ProjectExec([Rand(seed=3).alias("r")],
+                                InMemoryScanExec(t, batch_rows=128)))
+    assert again.column("r").to_pylist() == vals   # still deterministic
+
+
+WT = gen_table([("k", IntegerGen(min_val=0, max_val=6)),
+                ("o", IntegerGen(min_val=0, max_val=40)),
+                ("v", LongGen(min_val=-50, max_val=50))], n=300, seed=99)
+
+
+def _q(f):
+    assert_tpu_and_cpu_are_equal_collect(f)
+
+
+def test_percent_rank_and_cume_dist():
+    _q(lambda: table(WT).window(
+        over(PercentRank(), [col("k")], [asc(col("o"))]).alias("pr"),
+        over(CumeDist(), [col("k")], [asc(col("o"))]).alias("cd")))
+
+
+@pytest.mark.parametrize("n", [1, 2, 5])
+def test_nth_value_default_frame(n):
+    _q(lambda: table(WT).window(
+        over(NthValue(col("v"), n), [col("k")],
+             [asc(col("o")), asc(col("v"))]).alias("nv")))
+
+
+def test_nth_value_bounded_frame():
+    _q(lambda: table(WT).window(
+        over(NthValue(col("v"), 2), [col("k")],
+             [asc(col("o")), asc(col("v"))],
+             WindowFrame(is_rows=True, start=-2, end=2)).alias("nv")))
+
+
+def test_from_to_utc_timestamp():
+    import datetime as dt
+    from spark_rapids_tpu.expressions.datetime import UTCTimestampConv
+    vals = [dt.datetime(2024, 1, 15, 12, 0, 0),     # PST (-8)
+            dt.datetime(2024, 7, 15, 12, 0, 0),     # PDT (-7)
+            dt.datetime(1995, 3, 1, 0, 30, 0),
+            None]
+    t = pa.table({"ts": pa.array(vals, pa.timestamp("us"))})
+    out = _project(t, [
+        UTCTimestampConv(col("ts"), "America/Los_Angeles").alias("la"),
+        UTCTimestampConv(col("ts"), "America/Los_Angeles",
+                         to_utc=True).alias("utc"),
+        UTCTimestampConv(col("ts"), "Asia/Kolkata").alias("ist"),
+    ])
+    def naive(vals):
+        return [None if v is None else v.replace(tzinfo=None)
+                for v in vals]
+    la = naive(out.column("la").to_pylist())
+    assert la[0] == dt.datetime(2024, 1, 15, 4, 0, 0)
+    assert la[1] == dt.datetime(2024, 7, 15, 5, 0, 0)
+    assert la[3] is None
+    utc = naive(out.column("utc").to_pylist())
+    assert utc[0] == dt.datetime(2024, 1, 15, 20, 0, 0)
+    assert utc[1] == dt.datetime(2024, 7, 15, 19, 0, 0)
+    ist = naive(out.column("ist").to_pylist())
+    assert ist[0] == dt.datetime(2024, 1, 15, 17, 30, 0)   # +5:30
+    # differential vs the zoneinfo oracle across many instants
+    import random
+    rng = random.Random(5)
+    many = [dt.datetime(1960 + rng.randrange(120), rng.randrange(1, 13),
+                        rng.randrange(1, 28), rng.randrange(24),
+                        rng.randrange(60)) for _ in range(200)]
+    t2 = pa.table({"ts": pa.array(many, pa.timestamp("us"))})
+    got = naive(_project(t2, [UTCTimestampConv(
+        col("ts"), "Europe/Berlin").alias("x")]).column("x").to_pylist())
+    from zoneinfo import ZoneInfo
+    for v, g in zip(many, got):
+        exp = v.replace(tzinfo=dt.timezone.utc).astimezone(
+            ZoneInfo("Europe/Berlin")).replace(tzinfo=None)
+        assert g == exp, (v, g, exp)
+
+
+def test_replicate_rows_explode():
+    from spark_rapids_tpu.exec.generate import GenerateExec
+    from spark_rapids_tpu.expressions.collections import ReplicateRows
+    t = pa.table({"n": pa.array([2, 0, 3, None], pa.int64()),
+                  "v": pa.array([10, 20, 30, 40], pa.int64())})
+    out = collect(GenerateExec(ReplicateRows(col("n")),
+                               InMemoryScanExec(t)))
+    rows = sorted(zip(out.column("v").to_pylist(),
+                      out.column("col").to_pylist()))
+    assert rows == [(10, 0), (10, 1), (30, 0), (30, 1), (30, 2)]
+
+
+def test_json_tuple_sugar():
+    from spark_rapids_tpu.expressions.json import json_tuple
+    t = pa.table({"j": pa.array(['{"a": 1, "b": "x"}', '{"b": "y"}',
+                                 None])})
+    out = _project(t, json_tuple(col("j"), "a", "b"))
+    assert out.column("c0").to_pylist() == ["1", None, None]
+    assert out.column("c1").to_pylist() == ["x", "y", None]
+    # metacharacter field names stay LITERAL keys (review repro)
+    t2 = pa.table({"j": pa.array(['{"a.b": 7, "a": {"b": 1}}'])})
+    out2 = _project(t2, json_tuple(col("j"), "a.b"))
+    assert out2.column("c0").to_pylist() == ["7"]
+
+
+def test_pivot_first():
+    from spark_rapids_tpu.exec import AggregateMode, HashAggregateExec
+    from spark_rapids_tpu.expressions.aggregates import PivotFirst
+    from spark_rapids_tpu.expressions.collections import GetArrayItem
+    t = pa.table({
+        "g": pa.array([1, 1, 2, 2, 2], pa.int64()),
+        "p": pa.array(["x", "y", "x", "z", "x"]),
+        "v": pa.array([10, 20, 30, 40, 50], pa.int64()),
+    })
+    agg = HashAggregateExec(
+        [col("g")],
+        [PivotFirst(col("v"), col("p"), ("x", "y")).alias("pv")],
+        InMemoryScanExec(t), AggregateMode.COMPLETE)
+    out = collect(ProjectExec(
+        [col("g"),
+         GetArrayItem(col("pv"), lit(0)).alias("x"),
+         GetArrayItem(col("pv"), lit(1)).alias("y")], agg))
+    got = {g: (x, y) for g, x, y in zip(out.column("g").to_pylist(),
+                                        out.column("x").to_pylist(),
+                                        out.column("y").to_pylist())}
+    assert got == {1: (10, 20), 2: (30, None)}
+
+
+def test_utc_conversion_dst_gap_and_overlap():
+    """Java/Spark DST resolution (review repro): spring-forward gaps shift
+    forward; fall-back overlaps take the EARLIER offset — both equal the
+    pre-transition offset."""
+    import datetime as dt
+    from spark_rapids_tpu.expressions.datetime import UTCTimestampConv
+    t = pa.table({"ts": pa.array([dt.datetime(2026, 3, 8, 2, 30)],
+                                 pa.timestamp("us"))})
+    out = _project(t, [UTCTimestampConv(
+        col("ts"), "America/New_York", to_utc=True).alias("u")])
+    got = out.column("u").to_pylist()[0].replace(tzinfo=None)
+    assert got == dt.datetime(2026, 3, 8, 7, 30), got   # gap: forward
+    t2 = pa.table({"ts": pa.array([dt.datetime(2026, 10, 25, 2, 30)],
+                                  pa.timestamp("us"))})
+    out2 = _project(t2, [UTCTimestampConv(
+        col("ts"), "Europe/Berlin", to_utc=True).alias("u")])
+    got2 = out2.column("u").to_pylist()[0].replace(tzinfo=None)
+    assert got2 == dt.datetime(2026, 10, 25, 0, 30), got2  # overlap: earlier
