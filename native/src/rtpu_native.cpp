@@ -9,6 +9,10 @@
 //   rtpu_lz4_compress / rtpu_lz4_decompress
 //       LZ4 block format (greedy hash-table matcher), used by the batch
 //       serializer and the disk spill tier.
+//   rtpu_zstd_compress / rtpu_zstd_decompress
+//       libzstd (system library) — the reference ships nvcomp LZ4 AND
+//       ZSTD (TableCompressionCodec.scala); conf
+//       spark.rapids.tpu.shuffle.compression.codec selects.
 //   rtpu_strings_to_matrix / rtpu_matrix_to_strings
 //       Arrow offsets+bytes  <->  fixed-width padded byte matrix (the H2D
 //       string staging hot path in batch.py).
@@ -21,6 +25,7 @@
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
+#include <zstd.h>
 
 extern "C" {
 
@@ -243,6 +248,24 @@ void rtpu_murmur3_long(const int64_t* vals, const uint8_t* valid,
         h1 = mixh1(h1, (uint32_t)(v >> 32));
         out[i] = (int32_t)fmix(h1, 8);
     }
+}
+
+// ---------------------------------------------------------------------------
+// ZSTD (system libzstd; level 1 — the shuffle wire wants speed)
+// ---------------------------------------------------------------------------
+
+int64_t rtpu_zstd_compress(const uint8_t* src, int64_t n,
+                           uint8_t* dst, int64_t dst_cap) {
+    size_t r = ZSTD_compress(dst, (size_t)dst_cap, src, (size_t)n, 1);
+    if (ZSTD_isError(r)) return -1;
+    return (int64_t)r;
+}
+
+int64_t rtpu_zstd_decompress(const uint8_t* src, int64_t n,
+                             uint8_t* dst, int64_t dst_cap) {
+    size_t r = ZSTD_decompress(dst, (size_t)dst_cap, src, (size_t)n);
+    if (ZSTD_isError(r)) return -1;
+    return (int64_t)r;
 }
 
 }  // extern "C"

@@ -175,6 +175,89 @@ FUSION_ENABLED = conf("spark.rapids.tpu.sql.fusion.enabled").doc(
     "of Spark's whole-stage codegen; reference: GpuTieredProject / "
     "whole-stage pipelining, SURVEY.md §3.3).").boolean(True)
 
+# ---- per-format enables (reference: spark.rapids.sql.format.*.enabled) ----
+
+PARQUET_ENABLED = conf("spark.rapids.tpu.sql.format.parquet.enabled").doc(
+    "Accelerate parquet scans; disabled scans fall back to the CPU "
+    "interpreter (reference: spark.rapids.sql.format.parquet.enabled)."
+).boolean(True)
+
+ORC_ENABLED = conf("spark.rapids.tpu.sql.format.orc.enabled").doc(
+    "Accelerate ORC scans (reference: spark.rapids.sql.format.orc.enabled)."
+).boolean(True)
+
+CSV_ENABLED = conf("spark.rapids.tpu.sql.format.csv.enabled").doc(
+    "Accelerate CSV scans (reference: spark.rapids.sql.format.csv.enabled)."
+).boolean(True)
+
+JSON_ENABLED = conf("spark.rapids.tpu.sql.format.json.enabled").doc(
+    "Accelerate JSON-lines scans (reference: "
+    "spark.rapids.sql.format.json.enabled).").boolean(True)
+
+AVRO_ENABLED = conf("spark.rapids.tpu.sql.format.avro.enabled").doc(
+    "Accelerate Avro OCF scans (reference: "
+    "spark.rapids.sql.format.avro.enabled).").boolean(True)
+
+READER_BATCH_ROWS = conf("spark.rapids.tpu.sql.reader.batchSizeRows").doc(
+    "Row target per decoded host batch a scan emits (reference: "
+    "spark.rapids.sql.reader.batchSizeRows).").integer(1 << 20)
+
+MT_READER_MAX_TASKS = conf(
+    "spark.rapids.tpu.sql.format.multithreaded.maxTasksInFlight").doc(
+    "Bound on decode tasks submitted to the shared reader pool at once; "
+    "keeps many-file scans from queueing unbounded host memory "
+    "(reference: spark.rapids.sql.multiThreadedRead.maxNumFilesParallel)."
+).integer(64)
+
+COALESCING_PARALLEL_FILES = conf(
+    "spark.rapids.tpu.sql.format.coalescing.numFilesParallel").doc(
+    "Files decoded concurrently by the COALESCING reader before the "
+    "concat (reference: the coalescing reader's parallel footer+decode "
+    "stage).").integer(8)
+
+FILECACHE_ENABLED = conf("spark.rapids.tpu.filecache.enabled").doc(
+    "Cache decoded parquet blobs for re-reads within a session "
+    "(reference: spark.rapids.filecache.enabled).").boolean(True)
+
+SHUFFLE_MT_WRITER_THREADS = conf(
+    "spark.rapids.tpu.shuffle.multiThreaded.writer.threads").doc(
+    "Writer-side thread count of the MULTITHREADED shuffle (reference: "
+    "spark.rapids.shuffle.multiThreaded.writer.threads).").integer(8)
+
+SHUFFLE_MT_READER_THREADS = conf(
+    "spark.rapids.tpu.shuffle.multiThreaded.reader.threads").doc(
+    "Reader-side thread count of the MULTITHREADED shuffle (reference: "
+    "spark.rapids.shuffle.multiThreaded.reader.threads).").integer(8)
+
+SHUFFLE_MT_MAX_BYTES_IN_FLIGHT = conf(
+    "spark.rapids.tpu.shuffle.multiThreaded.maxBytesInFlight").doc(
+    "Serialized bytes a multithreaded shuffle keeps in flight before "
+    "writers block (reference: "
+    "spark.rapids.shuffle.multiThreaded.maxBytesInFlight)."
+).integer(512 << 20)
+
+CACHED_HEARTBEAT_INTERVAL_MS = conf(
+    "spark.rapids.tpu.shuffle.cached.heartbeatIntervalMs").doc(
+    "Executor heartbeat period feeding CACHED-shuffle peer liveness "
+    "(reference: spark.rapids.shuffle.ucx.managementServer heartbeats)."
+).integer(5000)
+
+CACHED_HEARTBEAT_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.shuffle.cached.heartbeatTimeoutMs").doc(
+    "Silence after which a CACHED-shuffle peer counts as dead and its "
+    "blocks are re-fetched elsewhere (reference: "
+    "RapidsShuffleHeartbeatManager timeout).").integer(30000)
+
+PYTHON_WORKER_PROCESSES = conf(
+    "spark.rapids.tpu.python.worker.processes").doc(
+    "Forked Python UDF worker processes per executor (reference: "
+    "python daemon pool sizing).").integer(4)
+
+GENERATE_MAX_REPEAT = conf(
+    "spark.rapids.tpu.sql.generate.maxRepeat").doc(
+    "Static per-row budget for ReplicateRows/explode fan-out on device."
+).integer(64)
+
 SHUFFLE_MODE = conf("spark.rapids.tpu.shuffle.mode").doc(
     "Shuffle manager mode: DEFAULT (serialized host batches), MULTITHREADED "
     "(thread-pooled writers/readers) or ICI (device-resident, collective "

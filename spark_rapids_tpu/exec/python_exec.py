@@ -78,7 +78,9 @@ class ArrowEvalPythonExec(UnaryExec):
                 # closures downgrade to in-process
                 out = worker_apply(_scalar_udf_on_table, table,
                                    (self.fn, self.input_cols, out_names),
-                                   use_daemon=self.use_daemon)
+                                   use_daemon=self.use_daemon,
+                                   pool_size=getattr(
+                                       self, "pool_size", None))
                 # cast to the declared output schema (pandas widens types)
                 from .. import types as T
                 target = pa.schema(
@@ -114,7 +116,9 @@ class MapInBatchExec(UnaryExec):
             with _python_semaphore.task():
                 table = to_arrow(batch, child_schema)
                 out = worker_apply(_map_udf_on_table, table, (self.fn,),
-                                   use_daemon=self.use_daemon)
+                                   use_daemon=self.use_daemon,
+                                   pool_size=getattr(
+                                       self, "pool_size", None))
                 out = out.select(self._schema.names).cast(target)
             if out.num_rows == 0:
                 continue

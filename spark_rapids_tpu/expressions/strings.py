@@ -109,6 +109,27 @@ def _case_tables():
 _UPPER_2B, _LOWER_2B = _case_tables()
 
 
+def _case_tables_3b():
+    """Single-char case maps for the 3-byte UTF-8 range (U+0800-U+FFFF:
+    Georgian, Cherokee, full-width Latin, Greek Extended, ...): identity
+    where the mapping changes char count or leaves the 3-byte range."""
+    import numpy as np
+    up = np.arange(0x10000, dtype=np.int32)
+    lo = np.arange(0x10000, dtype=np.int32)
+    for cp in range(0x800, 0x10000):
+        ch = chr(cp)
+        u = ch.upper()
+        if len(u) == 1 and 0x800 <= ord(u) < 0x10000:
+            up[cp] = ord(u)
+        l = ch.lower()
+        if len(l) == 1 and 0x800 <= ord(l) < 0x10000:
+            lo[cp] = ord(l)
+    return up, lo
+
+
+_UPPER_3B, _LOWER_3B = _case_tables_3b()
+
+
 @dataclass(frozen=True, eq=False)
 class Upper(Expression):
     """upper/lower: ASCII bytewise plus SIMPLE case mapping for every
@@ -142,20 +163,45 @@ class Upper(Expression):
             is_up = (d >= ord("A")) & (d <= ord("Z"))
             out = jnp.where(is_up, d + 32, d)
             table = jnp.asarray(_LOWER_2B)
+        def ahead(a, k):
+            """a shifted left by k columns (peek at byte position +k)."""
+            return jnp.concatenate(
+                [a[:, k:], jnp.zeros_like(a[:, :k])], axis=1)
+
+        def behind(a, k):
+            """a shifted right by k columns (value from position -k)."""
+            return jnp.concatenate(
+                [jnp.zeros_like(a[:, :k]), a[:, :-k]], axis=1)
+
+        def cont(b):
+            return (b >= 0x80) & (b < 0xC0)
+
         # 2-byte sequences: lead 0xC2-0xDF followed by a continuation
-        nxt = jnp.concatenate([d[:, 1:], jnp.zeros_like(d[:, :1])], axis=1)
-        lead2 = (d >= 0xC2) & (d <= 0xDF) & (nxt >= 0x80) & (nxt < 0xC0)
+        nxt = ahead(d, 1)
+        lead2 = (d >= 0xC2) & (d <= 0xDF) & cont(nxt)
         cp = ((d.astype(jnp.int32) & 0x1F) << 6) \
             | (nxt.astype(jnp.int32) & 0x3F)
         mapped = jnp.take(table, jnp.clip(cp, 0, 0x7FF))
-        new_lead = (0xC0 | (mapped >> 6)).astype(d.dtype)
-        new_cont = (0x80 | (mapped & 0x3F)).astype(d.dtype)
-        out = jnp.where(lead2, new_lead, out)
-        prev_lead2 = jnp.concatenate(
-            [jnp.zeros_like(lead2[:, :1]), lead2[:, :-1]], axis=1)
-        prev_cont = jnp.concatenate(
-            [jnp.zeros_like(new_cont[:, :1]), new_cont[:, :-1]], axis=1)
-        out = jnp.where(prev_lead2, prev_cont, out)
+        bytes2 = [(0xC0 | (mapped >> 6)).astype(d.dtype),
+                  (0x80 | (mapped & 0x3F)).astype(d.dtype)]
+        # 3-byte sequences (U+0800-U+FFFF: Georgian, Cherokee, full-width
+        # Latin, Greek Extended, ...): lead 0xE0-0xEF + two continuations
+        table3 = jnp.asarray(_UPPER_3B if self._upper else _LOWER_3B)
+        n2 = ahead(d, 2)
+        lead3 = (d >= 0xE0) & (d <= 0xEF) & cont(nxt) & cont(n2)
+        cp3 = ((d.astype(jnp.int32) & 0x0F) << 12) \
+            | ((nxt.astype(jnp.int32) & 0x3F) << 6) \
+            | (n2.astype(jnp.int32) & 0x3F)
+        m3 = jnp.take(table3, jnp.clip(cp3, 0, 0xFFFF))
+        bytes3 = [(0xE0 | (m3 >> 12)).astype(d.dtype),
+                  (0x80 | ((m3 >> 6) & 0x3F)).astype(d.dtype),
+                  (0x80 | (m3 & 0x3F)).astype(d.dtype)]
+        # write each sequence byte at its position: byte k of a sequence
+        # whose LEAD sat k columns back
+        for lead, seq in ((lead2, bytes2), (lead3, bytes3)):
+            out = jnp.where(lead, seq[0], out)
+            for k in range(1, len(seq)):
+                out = jnp.where(behind(lead, k), behind(seq[k], k), out)
         return DeviceColumn(out, c.validity, c.lengths, c.dtype)
 
 

@@ -27,15 +27,21 @@ class ReaderType(enum.Enum):
 # Shared host decode pool (reference: MultiFileReaderThreadPool:123 — one
 # pool per executor shared by all multi-file readers).
 _POOL: Optional[cf.ThreadPoolExecutor] = None
+_POOL_SIZE = 0
 _POOL_LOCK = threading.Lock()
 
 
 def reader_pool(num_threads: int = 8) -> cf.ThreadPoolExecutor:
-    global _POOL
+    """Shared executor-wide decode pool; grows (never shrinks) when a
+    session asks for more width — the old pool finishes its queue and is
+    collected."""
+    global _POOL, _POOL_SIZE
     with _POOL_LOCK:
-        if _POOL is None:
+        if _POOL is None or num_threads > _POOL_SIZE:
             _POOL = cf.ThreadPoolExecutor(
-                max_workers=num_threads, thread_name_prefix="multifile-read")
+                max_workers=max(num_threads, _POOL_SIZE),
+                thread_name_prefix="multifile-read")
+            _POOL_SIZE = max(num_threads, _POOL_SIZE)
         return _POOL
 
 
@@ -117,8 +123,8 @@ class FileSource:
                  columns: Optional[List[str]] = None,
                  predicate: Optional[Expression] = None,
                  reader_type: ReaderType = ReaderType.AUTO,
-                 batch_rows: int = 1 << 20,
-                 num_threads: int = 8,
+                 batch_rows: Optional[int] = None,
+                 num_threads: Optional[int] = None,
                  with_file_name: bool = False,
                  hive_partitions: bool = True):
         self.files = expand_paths(paths)
@@ -128,8 +134,12 @@ class FileSource:
         self._requested_columns = columns
         self.predicate = predicate
         self.reader_type = reader_type
-        self.batch_rows = batch_rows
-        self.num_threads = num_threads
+        # None = defaulted (a later apply_conf may override); an explicit
+        # constructor argument always wins over session conf
+        self._explicit_batch_rows = batch_rows is not None
+        self._explicit_threads = num_threads is not None
+        self.batch_rows = batch_rows if batch_rows is not None else 1 << 20
+        self.num_threads = num_threads if num_threads is not None else 8
         self.with_file_name = with_file_name
         self._schema = schema
         # hive-layout partition columns (reference: partition-values
@@ -138,6 +148,9 @@ class FileSource:
         self.partition_schema: List[tuple] = []
         self._pvalues: dict = {}
         self.files_pruned = 0
+        #: session-conf overrides (apply_conf); None = registry defaults
+        self._mt_max_tasks: Optional[int] = None
+        self._coalesce_par: Optional[int] = None
         if hive_partitions:
             self._discover_hive_partitions()
             if self.columns and self.partition_schema:
@@ -172,6 +185,20 @@ class FileSource:
                 kind = "string"
             self.partition_schema.append((name, kind))
             self._pvalues[name] = dict(zip(self.files, typed))
+
+    def apply_conf(self, conf) -> None:
+        """Planner hook: honor the session's reader confs (thread count,
+        batch rows, in-flight bounds) on this source."""
+        from ..config import (COALESCING_PARALLEL_FILES,
+                              MT_READER_MAX_TASKS,
+                              MULTITHREADED_READ_THREADS,
+                              READER_BATCH_ROWS)
+        if not self._explicit_threads:
+            self.num_threads = int(conf.get(MULTITHREADED_READ_THREADS.key))
+        if not self._explicit_batch_rows:
+            self.batch_rows = int(conf.get(READER_BATCH_ROWS.key))
+        self._mt_max_tasks = int(conf.get(MT_READER_MAX_TASKS.key))
+        self._coalesce_par = int(conf.get(COALESCING_PARALLEL_FILES.key))
 
     def partition_value(self, name: str, path: str):
         return self._pvalues[name][path]
@@ -262,9 +289,24 @@ class FileSource:
             for f in files:
                 yield self._decorate(self.read_file(f), f)
         elif mode is ReaderType.COALESCING:
-            # decode all files of the split, concat, re-chunk to batch_rows
+            # decode the split's files through the shared pool (bounded by
+            # coalescing.numFilesParallel), concat, re-chunk to batch_rows
             # (reference: coalescing reader assembles row groups before H2D)
-            tabs = [self._decorate(self.read_file(f), f) for f in files]
+            from ..config import COALESCING_PARALLEL_FILES, _REGISTRY
+            par = max(self._coalesce_par or
+                      int(_REGISTRY[COALESCING_PARALLEL_FILES.key].default),
+                      1)
+            pool = reader_pool(self.num_threads)
+            tabs = []
+            pending = []
+            i = 0
+            while i < len(files) or pending:
+                while i < len(files) and len(pending) < par:
+                    pending.append((files[i],
+                                    pool.submit(self.read_file, files[i])))
+                    i += 1
+                f, fu = pending.pop(0)
+                tabs.append(self._decorate(fu.result(), f))
             if not tabs:
                 return
             t = pa.concat_tables(tabs)
@@ -278,8 +320,20 @@ class FileSource:
             if tasks is None:
                 tasks = [(f, (lambda f=f: self.read_file(f)))
                          for f in files]
-            futures = [(f, pool.submit(fn)) for f, fn in tasks]
-            for f, fut in futures:
+            # windowed submission: maxTasksInFlight bounds queued decode
+            # output so a many-file scan cannot hold the whole dataset in
+            # host memory at once
+            from ..config import MT_READER_MAX_TASKS, _REGISTRY
+            win = max(self._mt_max_tasks or
+                      int(_REGISTRY[MT_READER_MAX_TASKS.key].default), 1)
+            pending = []
+            i = 0
+            while i < len(tasks) or pending:
+                while i < len(tasks) and len(pending) < win:
+                    f, fn = tasks[i]
+                    pending.append((f, pool.submit(fn)))
+                    i += 1
+                f, fut = pending.pop(0)
                 t = self._decorate(fut.result(), f)
                 for off in range(0, max(t.num_rows, 1), self.batch_rows):
                     yield t.slice(off, self.batch_rows)

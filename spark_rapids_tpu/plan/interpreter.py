@@ -527,17 +527,34 @@ class RowEvaluator:
         v = self.eval(e.children[0], row)
         return None if v is None else len(v)
 
+    @staticmethod
+    def _simple_case(v, upper: bool):
+        """The device contract: simple single-char mapping where the
+        counterpart stays in the same UTF-8 byte-length class (1/2/3
+        bytes); everything else passes through."""
+        out = []
+        for ch in v:
+            m = ch.upper() if upper else ch.lower()
+            if len(m) == 1:
+                c, r = ord(ch), ord(m)
+                same = any(lo <= c < hi and lo <= r < hi for lo, hi in
+                           ((0, 0x80), (0x80, 0x800), (0x800, 0x10000)))
+                out.append(m if same else ch)
+            else:
+                out.append(ch)
+        return "".join(out)
+
     def _eval_Upper(self, e, row):
         v = self.eval(e.children[0], row)
         if v is None:
             return None
-        return "".join(ch.upper() if "a" <= ch <= "z" else ch for ch in v)
+        return self._simple_case(v, True)
 
     def _eval_Lower(self, e, row):
         v = self.eval(e.children[0], row)
         if v is None:
             return None
-        return "".join(ch.lower() if "A" <= ch <= "Z" else ch for ch in v)
+        return self._simple_case(v, False)
 
     def _eval_Substring(self, e, row):
         v = self.eval(e.child, row)

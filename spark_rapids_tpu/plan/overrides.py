@@ -102,8 +102,10 @@ def _expr_rules() -> Dict[str, ExprRule]:
     # strings
     for n in ("Upper", "Lower"):
         r(n, TS.ALL_BASIC, incompat=True,
-          note="simple case mapping (ASCII + 2-byte Latin/Greek/Cyrillic); "
-               "length-changing and locale-special mappings pass through")
+          note="simple case mapping across ASCII + 2/3-byte planes "
+               "(Latin, Greek, Cyrillic, Georgian, Cherokee, full-width); "
+               "length-changing (ß→SS) and locale-special mappings pass "
+               "through")
     for n in ("Length", "Substring", "Concat",
               "StringPredicate", "StringLocate", "StringTrim", "StringPad",
               "StringRepeat", "StringReplace", "Translate", "InitCap",
@@ -291,6 +293,18 @@ class PlanMeta:
         """Per-node-type tagging beyond TypeSig — the reference's per-meta
         tagForGpu overrides (GpuWindowExecMeta, agg metas)."""
         n = self.node
+        if isinstance(n, L.LogicalScan) and n.source is not None:
+            # per-format enables (reference: spark.rapids.sql.format.*)
+            fmt = getattr(n.source, "format_name", None)
+            key = {
+                "parquet": "spark.rapids.tpu.sql.format.parquet.enabled",
+                "orc": "spark.rapids.tpu.sql.format.orc.enabled",
+                "csv": "spark.rapids.tpu.sql.format.csv.enabled",
+                "json": "spark.rapids.tpu.sql.format.json.enabled",
+                "avro": "spark.rapids.tpu.sql.format.avro.enabled",
+            }.get(fmt)
+            if key is not None and not self.conf.get(key):
+                self.will_not_work(f"{key} is false")
         if isinstance(n, (L.LogicalSort, L.LogicalJoin, L.LogicalAggregate)):
             # arrays/maps ride through sort/join/agg as PAYLOAD; as KEYS
             # they have no orderable/hashable scalar encoding on device
@@ -676,6 +690,8 @@ class Overrides:
                 if isinstance(n.source, CachedRelation):
                     return InMemoryRelationExec(n.source)
                 from ..io.scan import FileSourceScanExec
+                if hasattr(n.source, "apply_conf"):
+                    n.source.apply_conf(self.conf)
                 return FileSourceScanExec(n.source, n.num_slices)
             return InMemoryScanExec(n.data, schema=n._schema,
                                     num_slices=n.num_slices,
@@ -695,8 +711,14 @@ class Overrides:
         if isinstance(n, L.LogicalExpand):
             return ExpandExec(n.projections, ch[0])
         if isinstance(n, L.LogicalGenerate):
+            from ..config import GENERATE_MAX_REPEAT
             from ..exec.generate import GenerateExec
-            return GenerateExec(n.generator, ch[0], outer=n.outer,
+            from ..expressions.collections import ReplicateRows
+            gen = n.generator
+            if isinstance(gen, ReplicateRows):
+                gen = ReplicateRows(
+                    gen.n, int(self.conf.get(GENERATE_MAX_REPEAT.key)))
+            return GenerateExec(gen, ch[0], outer=n.outer,
                                 pos=n.pos, elem_name=n.elem_name,
                                 pos_name=n.pos_name,
                                 value_name=n.value_name, ctx=self._ctx())

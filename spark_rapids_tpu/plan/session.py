@@ -99,6 +99,9 @@ class Session:
     def cache(self, df: DataFrame) -> DataFrame:
         """Materialize as parquet-compressed cached partitions (reference:
         ParquetCachedBatchSerializer behind df.cache())."""
+        from ..config import FILECACHE_ENABLED
+        if not self.conf.get(FILECACHE_ENABLED.key):
+            return df      # caching disabled: keep the logical plan as-is
         from ..io.cache import CachedRelation
         from .logical import LogicalScan
         from .overrides import Overrides

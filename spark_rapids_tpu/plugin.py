@@ -170,11 +170,17 @@ class ExecutorRuntime:
         self._heartbeats[executor_id] = time.time()
 
     def start_heartbeat(self, executor_id: str,
-                        interval_s: float = 5.0) -> threading.Event:
+                        interval_s: Optional[float] = None
+                        ) -> threading.Event:
         """Background sender: stamp this executor's liveness every
-        interval (reference: RapidsShuffleHeartbeatEndpoint's executor →
+        interval (default: shuffle.cached.heartbeatIntervalMs conf;
+        reference: RapidsShuffleHeartbeatEndpoint's executor →
         driver ping loop). Returns the stop event; shutdown() sets it."""
         stop = threading.Event()
+        if interval_s is None:
+            from .config import CACHED_HEARTBEAT_INTERVAL_MS
+            interval_s = self.conf.get(
+                CACHED_HEARTBEAT_INTERVAL_MS.key) / 1000.0
 
         def loop():
             while not stop.is_set():
@@ -188,7 +194,12 @@ class ExecutorRuntime:
         t.start()
         return stop
 
-    def live_executors(self, timeout_s: float = 30.0) -> List[str]:
+    def live_executors(self, timeout_s: Optional[float] = None
+                       ) -> List[str]:
+        if timeout_s is None:
+            from .config import CACHED_HEARTBEAT_TIMEOUT_MS
+            timeout_s = self.conf.get(
+                CACHED_HEARTBEAT_TIMEOUT_MS.key) / 1000.0
         now = time.time()
         return [e for e, t in self._heartbeats.items()
                 if now - t <= timeout_s]

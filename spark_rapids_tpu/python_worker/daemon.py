@@ -160,24 +160,30 @@ _POOL: Optional[WorkerPool] = None
 _POOL_LOCK = threading.Lock()
 
 
-def shared_pool(size: int = 4) -> WorkerPool:
+def shared_pool(size: Optional[int] = None) -> WorkerPool:
     global _POOL
     with _POOL_LOCK:
         if _POOL is None:
+            if size is None:
+                from ..config import PYTHON_WORKER_PROCESSES, _REGISTRY
+                size = int(_REGISTRY[PYTHON_WORKER_PROCESSES.key].default)
             _POOL = WorkerPool(size)
         return _POOL
 
 
 def worker_apply(fn: Callable, table: pa.Table, extras: tuple = (),
-                 use_daemon: bool = True) -> pa.Table:
+                 use_daemon: bool = True,
+                 pool_size: Optional[int] = None) -> pa.Table:
     """Run ``fn(table, *extras) -> table`` in a worker when the payload
     pickles (ONE dumps serves both the check and the wire message);
-    otherwise in-process (lambdas/closures)."""
+    otherwise in-process (lambdas/closures). ``pool_size`` sizes the
+    shared pool on FIRST use (spark.rapids.tpu.python.worker.processes)."""
     if use_daemon:
         try:
             blob = pickle.dumps((fn, extras))
         except Exception:                           # noqa: BLE001
             blob = None
         if blob is not None:
-            return shared_pool().apply(fn, table, extras, blob=blob)
+            return shared_pool(pool_size).apply(fn, table, extras,
+                                                blob=blob)
     return fn(table, *extras)

@@ -39,6 +39,14 @@ class ShuffleManager:
             raise ValueError(
                 f"spark.rapids.tpu.shuffle.mode must be DEFAULT, "
                 f"MULTITHREADED, ICI or CACHED, got {self.mode!r}")
+        # validate the serialized-batch codec conf (none/lz4/zstd) HERE,
+        # not silently downstream; the value rides each exchange (no
+        # process-global mutation — two sessions with different codecs
+        # coexist, frames self-describe via per-column tags)
+        from ..config import SHUFFLE_COMPRESSION
+        from ..utils import native
+        self.codec = str(conf.get(SHUFFLE_COMPRESSION.key))
+        native.validate_codec(self.codec)
 
     def create_exchange(self, partitioning: Partitioning,
                         child: Exec) -> Exec:
@@ -48,8 +56,19 @@ class ShuffleManager:
         whole pipeline with one SPMD program when the plan shape allows,
         and the host exchange is the fallback for shapes it cannot fuse."""
         if self.mode == self.MULTITHREADED:
+            from ..config import (SHUFFLE_MT_MAX_BYTES_IN_FLIGHT,
+                                  SHUFFLE_MT_WRITER_THREADS)
             from .multithreaded import MultithreadedShuffleExchangeExec
-            return MultithreadedShuffleExchangeExec(partitioning, child)
+            from ..config import SHUFFLE_MT_READER_THREADS
+            return MultithreadedShuffleExchangeExec(
+                partitioning, child,
+                num_threads=int(self.conf.get(
+                    SHUFFLE_MT_WRITER_THREADS.key)),
+                reader_threads=int(self.conf.get(
+                    SHUFFLE_MT_READER_THREADS.key)),
+                max_bytes_in_flight=int(self.conf.get(
+                    SHUFFLE_MT_MAX_BYTES_IN_FLIGHT.key)),
+                codec=self.codec)
         if self.mode == self.CACHED:
             # device-resident blocks in the spillable cache, served P2P
             # (the reference's UCX cached mode)

@@ -54,14 +54,18 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
     def __init__(self, partitioning: Partitioning, child: Exec,
                  shuffle_dir: Optional[str] = None,
                  num_threads: int = 8,
+                 reader_threads: Optional[int] = None,
                  max_bytes_in_flight: int = 512 << 20,
                  ctx: Optional[EvalContext] = None,
-                 transport=None):
+                 transport=None,
+                 codec: Optional[str] = None):
         super().__init__(child, ctx)
         self.partitioning = partitioning.bind(child.output_schema)
         self.shuffle_dir = shuffle_dir or os.path.join(
             "/tmp/rapids_tpu_shuffle", uuid.uuid4().hex)
         self.num_threads = num_threads
+        self.reader_threads = reader_threads or num_threads
+        self.codec = codec
         self.limiter = BytesInFlightLimiter(max_bytes_in_flight)
         self._written = False
         self._write_lock = threading.Lock()
@@ -124,7 +128,8 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
 
     def _write_piece(self, piece: ColumnarBatch, schema: Schema,
                      map_id: int, reduce_id: int) -> None:
-        data = serialize_batch(piece, schema)   # D2H + frame + compress
+        data = serialize_batch(piece, schema,
+                               self.codec)   # D2H + frame + compress
         self.limiter.acquire(len(data))
         try:
             self.transport.publish(self.shuffle_id, map_id, reduce_id,
@@ -142,7 +147,7 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         if not blocks:
             return
         schema = self.output_schema
-        pool = cf.ThreadPoolExecutor(self.num_threads,
+        pool = cf.ThreadPoolExecutor(self.reader_threads,
                                      thread_name_prefix="shuffle-read")
         futures = [pool.submit(self.transport.fetch, s, m, r)
                    for s, m, r in blocks]

@@ -9,7 +9,7 @@ disk spill tier and the multithreaded shuffle, and as the DCN wire format.
 Frame layout (little-endian):
   magic 'RTPU' | u32 version | u32 ncols | i64 nrows
   per column:
-    u8 has_lengths | u8 codec(0=none,1=lz4,2=zlib) padding x2
+    u8 has_lengths | u8 codec(0=none,1=lz4,2=zlib,3=zstd) padding x2
     u32 name_len | name bytes
     u8  numpy dtype string len | dtype bytes | u32 extra(max_len)
     i64 raw_data_len | i64 comp_data_len | payload
@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import io
 import struct
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -31,12 +31,13 @@ from ..utils import native
 
 MAGIC = b"RTPU"
 VERSION = 1
-_CODEC = {"none": 0, "lz4": 1, "zlib": 2}
+_CODEC = {"none": 0, "lz4": 1, "zlib": 2, "zstd": 3}
 _CODEC_R = {v: k for k, v in _CODEC.items()}
 
 
-def _write_blob(out: io.BytesIO, raw: bytes) -> None:
-    payload, codec = native.compress(raw)
+def _write_blob(out: io.BytesIO, raw: bytes,
+                codec: Optional[str] = None) -> None:
+    payload, codec = native.compress(raw, codec)
     if len(payload) >= len(raw):
         payload, codec = raw, "none"
     out.write(struct.pack("<qqB", len(raw), len(payload), _CODEC[codec]))
@@ -51,8 +52,10 @@ def _read_blob(buf: memoryview, pos: int) -> Tuple[bytes, int]:
     return native.decompress(payload, _CODEC_R[codec], raw_len), pos
 
 
-def serialize_host(arrays: Dict[str, np.ndarray], num_rows: int) -> bytes:
-    """Serialize named host arrays (the spill-store / shuffle-write side)."""
+def serialize_host(arrays: Dict[str, np.ndarray], num_rows: int,
+                   codec: Optional[str] = None) -> bytes:
+    """Serialize named host arrays (the spill-store / shuffle-write side).
+    ``codec`` overrides the process default (per-session shuffle codec)."""
     out = io.BytesIO()
     out.write(MAGIC)
     out.write(struct.pack("<IIq", VERSION, len(arrays), num_rows))
@@ -67,7 +70,7 @@ def serialize_host(arrays: Dict[str, np.ndarray], num_rows: int) -> bytes:
         out.write(struct.pack("<B", arr.ndim))
         for s in arr.shape:
             out.write(struct.pack("<q", s))
-        _write_blob(out, arr.tobytes())
+        _write_blob(out, arr.tobytes(), codec)
     return out.getvalue()
 
 
@@ -99,7 +102,8 @@ def deserialize_host(data: bytes) -> Tuple[Dict[str, np.ndarray], int]:
     return arrays, num_rows
 
 
-def serialize_batch(batch: ColumnarBatch, schema: Schema) -> bytes:
+def serialize_batch(batch: ColumnarBatch, schema: Schema,
+                    codec: Optional[str] = None) -> bytes:
     """Device batch -> framed bytes (D2H then frame)."""
     import jax
     arrays: Dict[str, np.ndarray] = {}
@@ -110,7 +114,7 @@ def serialize_batch(batch: ColumnarBatch, schema: Schema) -> bytes:
             arrays[f"l{i}"] = np.asarray(jax.device_get(c.lengths))
         if c.data2 is not None:     # map values / string-array lengths
             arrays[f"m{i}"] = np.asarray(jax.device_get(c.data2))
-    return serialize_host(arrays, int(batch.num_rows))
+    return serialize_host(arrays, int(batch.num_rows), codec)
 
 
 def deserialize_batch(data: bytes, schema: Schema) -> ColumnarBatch:

@@ -71,6 +71,14 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.rtpu_lz4_decompress.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_void_p, ctypes.c_int64]
+            lib.rtpu_zstd_compress.restype = ctypes.c_int64
+            lib.rtpu_zstd_compress.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64]
+            lib.rtpu_zstd_decompress.restype = ctypes.c_int64
+            lib.rtpu_zstd_decompress.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64]
             lib.rtpu_strings_to_matrix.restype = ctypes.c_int32
             lib.rtpu_strings_to_matrix.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -90,18 +98,59 @@ def available() -> bool:
 
 
 # ---------------------------------------------------------------------------
-# LZ4 (fallback: zlib level 1)
+# Compression codecs: lz4 (in-tree block codec) and zstd (system libzstd),
+# matching the reference's nvcomp LZ4 + ZSTD pair
+# (TableCompressionCodec.scala). Fallback: zlib level 1.
 # ---------------------------------------------------------------------------
 
-def compress(data: bytes) -> Tuple[bytes, str]:
+CODECS = ("none", "lz4", "zstd")
+
+#: process default, set from spark.rapids.tpu.shuffle.compression.codec by
+#: the shuffle manager; serializers use it when no codec is passed
+_DEFAULT_CODEC = "lz4"
+
+
+def validate_codec(name: str) -> None:
+    if name not in CODECS:
+        raise ValueError(
+            f"unsupported compression codec {name!r}; pick one of "
+            f"{CODECS}")
+    if name == "zstd" and _load() is None:
+        raise ValueError(
+            "codec 'zstd' needs the native library, which failed to "
+            "build on this host")
+
+
+def set_default_codec(name: str) -> None:
+    """Process default for paths without a per-exchange codec (spill
+    tier); shuffle exchanges carry their session's codec explicitly."""
+    global _DEFAULT_CODEC
+    validate_codec(name)
+    _DEFAULT_CODEC = name
+
+
+def default_codec() -> str:
+    return _DEFAULT_CODEC
+
+
+def compress(data: bytes, codec: Optional[str] = None) -> Tuple[bytes, str]:
     """Returns (payload, codec_tag)."""
+    codec = codec or _DEFAULT_CODEC
+    if codec == "none":
+        return data, "none"
     lib = _load()
     if lib is None:
         import zlib
         return zlib.compress(data, 1), "zlib"
     src = np.frombuffer(data, np.uint8)
-    cap = len(data) + len(data) // 4 + 64
+    cap = len(data) + len(data) // 4 + 256
     dst = np.empty(cap, np.uint8)
+    if codec == "zstd":
+        n = lib.rtpu_zstd_compress(src.ctypes.data, len(data),
+                                   dst.ctypes.data, cap)
+        if n >= 0:
+            return dst[:n].tobytes(), "zstd"
+        return data, "none"    # zstd worst case exceeded cap: store raw
     n = lib.rtpu_lz4_compress(src.ctypes.data, len(data),
                               dst.ctypes.data, cap)
     if n < 0:
@@ -118,13 +167,17 @@ def decompress(payload: bytes, codec: str, out_size: int) -> bytes:
         return payload
     lib = _load()
     if lib is None:
-        raise RuntimeError("lz4 payload but native library unavailable")
+        raise RuntimeError(f"{codec} payload but native library unavailable")
     src = np.frombuffer(payload, np.uint8)
     dst = np.empty(out_size, np.uint8)
-    n = lib.rtpu_lz4_decompress(src.ctypes.data, len(payload),
-                                dst.ctypes.data, out_size)
+    if codec == "zstd":
+        n = lib.rtpu_zstd_decompress(src.ctypes.data, len(payload),
+                                     dst.ctypes.data, out_size)
+    else:
+        n = lib.rtpu_lz4_decompress(src.ctypes.data, len(payload),
+                                    dst.ctypes.data, out_size)
     if n != out_size:
-        raise ValueError(f"lz4 decompress: got {n}, want {out_size}")
+        raise ValueError(f"{codec} decompress: got {n}, want {out_size}")
     return dst.tobytes()
 
 

@@ -229,3 +229,23 @@ def test_parquet_predicate_on_unprojected_column(tmp_path):
         assert got.column_names == ["v"], (mode, got.column_names)
         assert sorted(got.column("v").to_pylist()) == [float(x) for x in
                                                        range(90, 100)], mode
+
+
+def test_per_format_enable_conf_falls_back(tmp_path):
+    """spark.rapids.tpu.sql.format.parquet.enabled=false keeps the scan on
+    the CPU interpreter (reference: per-format enables)."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.io.scan import read_parquet
+    from spark_rapids_tpu.plan import Session
+    from spark_rapids_tpu.plan.overrides import CpuFallbackExec
+    t = pa.table({"a": np.arange(20, dtype=np.int64)})
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(t, p)
+    ses = Session({"spark.rapids.tpu.sql.format.parquet.enabled": False})
+    out = ses.collect(read_parquet(p))
+    assert isinstance(ses.last_plan, CpuFallbackExec)
+    assert sorted(out.column("a").to_pylist()) == list(range(20))
+    ses2 = Session({})
+    ses2.collect(read_parquet(p))
+    assert not isinstance(ses2.last_plan, CpuFallbackExec)

@@ -192,3 +192,60 @@ def test_shuffle_manager_mode_selection():
     with pytest.raises(ValueError, match="shuffle.mode"):
         get_shuffle_manager(RapidsTpuConf(
             {"spark.rapids.tpu.shuffle.mode": "UCX"}))
+
+
+def test_compression_codecs_round_trip_and_conf():
+    """VERDICT r3 Next #7: the codec conf must be honored (zstd real, not
+    just documented) and bogus values rejected."""
+    import numpy as np
+    import pytest
+    from spark_rapids_tpu.config import RapidsTpuConf
+    from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+    from spark_rapids_tpu.utils import native
+    data = (b"spark-rapids-tpu " * 500) + bytes(np.random.default_rng(0)
+                                                .integers(0, 256, 2000)
+                                                .astype(np.uint8))
+    for codec in ("none", "lz4", "zstd"):
+        payload, tag = native.compress(data, codec)
+        assert native.decompress(payload, tag, len(data)) == data
+        if codec != "none":
+            assert len(payload) < len(data)
+    assert native.compress(data, "zstd")[1] == "zstd"
+    # manager validates + carries the codec per-exchange (no process-global
+    # mutation: sessions with different codecs coexist)
+    m = get_shuffle_manager(RapidsTpuConf(
+        {"spark.rapids.tpu.shuffle.compression.codec": "zstd",
+         "spark.rapids.tpu.shuffle.mode": "MULTITHREADED"}))
+    assert m.codec == "zstd"
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    from spark_rapids_tpu.expressions import col
+    t = pa.table({"k": np.arange(16, dtype=np.int64)})
+    ex = m.create_exchange(HashPartitioning([col("k")], 2),
+                           InMemoryScanExec(t))
+    assert ex.codec == "zstd"
+    assert native.default_codec() == "lz4"   # untouched
+    # ...and rejects values it cannot honor
+    with pytest.raises(ValueError, match="unsupported compression codec"):
+        get_shuffle_manager(RapidsTpuConf(
+            {"spark.rapids.tpu.shuffle.compression.codec": "snappy"}))
+
+
+def test_serializer_round_trip_zstd():
+    import numpy as np
+    from spark_rapids_tpu.batch import from_arrow, to_arrow
+    from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                     serialize_batch)
+    from spark_rapids_tpu.utils import native
+    t = pa.table({"a": np.arange(1000, dtype=np.int64),
+                  "s": pa.array([f"row-{i}" for i in range(1000)])})
+    b, schema = from_arrow(t)
+    native.set_default_codec("zstd")
+    try:
+        blob = serialize_batch(b, schema)
+        out = deserialize_batch(blob, schema)
+        got = to_arrow(out, schema)
+        assert got.column("a").to_pylist() == t.column("a").to_pylist()
+        assert got.column("s").to_pylist() == t.column("s").to_pylist()
+    finally:
+        native.set_default_codec("lz4")
