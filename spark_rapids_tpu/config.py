@@ -236,6 +236,17 @@ SHUFFLE_MT_MAX_BYTES_IN_FLIGHT = conf(
     "spark.rapids.shuffle.multiThreaded.maxBytesInFlight)."
 ).integer(512 << 20)
 
+CACHED_REGISTRY = conf(
+    "spark.rapids.tpu.shuffle.cached.registry").doc(
+    "host:port of the driver-side peer registry for the CACHED "
+    "shuffle's cross-host peer discovery; empty = single-process "
+    "(reference: RapidsShuffleHeartbeatManager endpoint table)."
+).text("")
+
+EXECUTOR_ID = conf("spark.rapids.tpu.executorId").doc(
+    "Numeric executor id for shuffle peer identity (reference: the "
+    "executor id UCX endpoints key on).").integer(0)
+
 CACHED_HEARTBEAT_INTERVAL_MS = conf(
     "spark.rapids.tpu.shuffle.cached.heartbeatIntervalMs").doc(
     "Executor heartbeat period feeding CACHED-shuffle peer liveness "
@@ -250,8 +261,10 @@ CACHED_HEARTBEAT_TIMEOUT_MS = conf(
 
 PYTHON_WORKER_PROCESSES = conf(
     "spark.rapids.tpu.python.worker.processes").doc(
-    "Forked Python UDF worker processes per executor (reference: "
-    "python daemon pool sizing).").integer(4)
+    "Default size of the process-wide forked Python UDF worker pool, "
+    "read when the pool is FIRST created; per-exec override via the "
+    "exec's pool_size attribute (reference: python daemon pool sizing)."
+).startup_only().integer(4)
 
 GENERATE_MAX_REPEAT = conf(
     "spark.rapids.tpu.sql.generate.maxRepeat").doc(

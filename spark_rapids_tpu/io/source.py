@@ -31,6 +31,27 @@ _POOL_SIZE = 0
 _POOL_LOCK = threading.Lock()
 
 
+def bounded_map(pool, items, fn, window: int):
+    """Submit ``fn(item)`` over the pool keeping at most ``window`` tasks
+    outstanding; yields (item, result) in input order — decoded output
+    stays bounded on many-file scans."""
+    from collections import deque
+    pending = deque()
+    it = iter(items)
+    exhausted = False
+    while pending or not exhausted:
+        while not exhausted and len(pending) < window:
+            try:
+                item = next(it)
+            except StopIteration:
+                exhausted = True
+                break
+            pending.append((item, pool.submit(fn, item)))
+        if pending:
+            item, fut = pending.popleft()
+            yield item, fut.result()
+
+
 def reader_pool(num_threads: int = 8) -> cf.ThreadPoolExecutor:
     """Shared executor-wide decode pool; grows (never shrinks) when a
     session asks for more width — the old pool finishes its queue and is
@@ -297,16 +318,9 @@ class FileSource:
                       int(_REGISTRY[COALESCING_PARALLEL_FILES.key].default),
                       1)
             pool = reader_pool(self.num_threads)
-            tabs = []
-            pending = []
-            i = 0
-            while i < len(files) or pending:
-                while i < len(files) and len(pending) < par:
-                    pending.append((files[i],
-                                    pool.submit(self.read_file, files[i])))
-                    i += 1
-                f, fu = pending.pop(0)
-                tabs.append(self._decorate(fu.result(), f))
+            tabs = [self._decorate(t, f)
+                    for f, t in bounded_map(pool, files, self.read_file,
+                                            par)]
             if not tabs:
                 return
             t = pa.concat_tables(tabs)
@@ -326,15 +340,9 @@ class FileSource:
             from ..config import MT_READER_MAX_TASKS, _REGISTRY
             win = max(self._mt_max_tasks or
                       int(_REGISTRY[MT_READER_MAX_TASKS.key].default), 1)
-            pending = []
-            i = 0
-            while i < len(tasks) or pending:
-                while i < len(tasks) and len(pending) < win:
-                    f, fn = tasks[i]
-                    pending.append((f, pool.submit(fn)))
-                    i += 1
-                f, fut = pending.pop(0)
-                t = self._decorate(fut.result(), f)
+            for (f, _fn), raw in bounded_map(
+                    pool, tasks, lambda task: task[1](), win):
+                t = self._decorate(raw, f)
                 for off in range(0, max(t.num_rows, 1), self.batch_rows):
                     yield t.slice(off, self.batch_rows)
                     if t.num_rows == 0:

@@ -2260,6 +2260,74 @@ def _rw_replicate_rows(self, e, row):
     return list(range(max(int(n), 0)))
 
 
+def _rw_memo(self, e, row):
+    # row oracle: no sharing concern, just pass through
+    return self.eval(e.child, row)
+
+
+def _rw_loop_budget(self, e, row):
+    still = self.eval(e.still, row)
+    if still:
+        raise RuntimeError(
+            "[CAPACITY_udf_while_budget] row exceeded the while-loop "
+            "unroll budget")
+    return self.eval(e.value, row)
+
+
+def _rw_slot_ref(self, e, row):
+    env = getattr(self, "_slot_env", None) or []
+    for token, slots in reversed(env):
+        if token is e.token:
+            return slots[e.idx]
+    raise RuntimeError("slot ref outside its while body")
+
+
+def _rw_while_out(self, e, row):
+    cache = getattr(self, "_while_cache", None)
+    if cache is None:
+        cache = {}
+        self._while_cache = cache
+    loop = e.loop
+    key = (id(loop), id(row))
+    if key not in cache:
+        from spark_rapids_tpu.udf.compiler import MAX_WHILE_ITERS
+        state = [self.eval(i, row) for i in loop.init]
+        returned, retval = False, None
+        env = getattr(self, "_slot_env", None)
+        if env is None:
+            env = []
+            self._slot_env = env
+        it = 0
+        # DO-WHILE order, mirroring the device kernel
+        while it < MAX_WHILE_ITERS:
+            env.append((loop.token, list(state)))
+            try:
+                if loop.ret is not None and not returned:
+                    ec = self.eval(loop.ret[0], row)
+                    if ec:
+                        returned = True
+                        retval = self.eval(loop.ret[1], row)
+                if not returned:
+                    state = [self.eval(b, row) for b in loop.body]
+                cond = (not returned) and bool(self.eval(loop.cond, row))
+            finally:
+                env.pop()
+            it += 1
+            if not cond:
+                break
+        else:
+            raise RuntimeError(
+                "[CAPACITY_udf_while_budget] row exceeded the while-loop "
+                "iteration budget")
+        cache[key] = (state, returned, retval)
+    state, returned, retval = cache[key]
+    if e.kind == "slot":
+        return state[e.idx]
+    if e.kind == "returned":
+        return returned
+    return retval
+
+
 def _install_round4_rows(cls):
     cls._eval_Hypot = _rw_hypot
     cls._eval_Logarithm = _rw_logarithm
@@ -2271,6 +2339,10 @@ def _install_round4_rows(cls):
     cls._eval_Rand = _rw_rand
     cls._eval_UTCTimestampConv = _rw_utc_conv
     cls._eval_ReplicateRows = _rw_replicate_rows
+    cls._eval__Memo = _rw_memo
+    cls._eval__LoopBudgetCheck = _rw_loop_budget
+    cls._eval__SlotRef = _rw_slot_ref
+    cls._eval__WhileOut = _rw_while_out
 
 
 _install_round4_rows(RowEvaluator)

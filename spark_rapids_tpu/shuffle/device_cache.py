@@ -28,10 +28,12 @@ from .transport import ShuffleTransport, TransportError
 class DeviceShuffleCache:
     """ShuffleBufferCatalog analogue over the spill catalog + transport."""
 
-    def __init__(self, transport, catalog=None):
+    def __init__(self, transport, catalog=None, codec=None):
         from ..memory import device_budget
         self.transport = transport
         self.catalog = catalog or device_budget()
+        #: serialization codec for P2P serves (session shuffle codec)
+        self.codec = codec
         self._blocks: Dict[Tuple[int, int, int], tuple] = {}
         self._lock = threading.Lock()
         transport.resolver = self._serve
@@ -67,7 +69,7 @@ class DeviceShuffleCache:
         sb, schema = ent
         batch = sb.get()
         try:
-            return serialize_batch(batch, schema)
+            return serialize_batch(batch, schema, self.codec)
         finally:
             sb.done_with()
 
@@ -101,12 +103,50 @@ _SHARED = None
 _SHARED_LOCK = threading.Lock()
 
 
-def shared_device_cache() -> DeviceShuffleCache:
-    """Process-wide cache over a lazily started TCP transport (peers come
-    from conf/heartbeats when the multi-process tier is configured)."""
+def shared_device_cache(conf=None) -> DeviceShuffleCache:
+    """Process-wide cache over a lazily started TCP transport. With
+    spark.rapids.tpu.shuffle.cached.registry set, the transport's peer
+    table is DISCOVERED through the driver registry (heartbeat-driven —
+    reference: RapidsShuffleHeartbeatManager feeding UCX endpoints);
+    otherwise peers must be injected explicitly (tests/single-process)."""
     global _SHARED
     with _SHARED_LOCK:
         if _SHARED is None:
             from .transport import TcpTransport
-            _SHARED = DeviceShuffleCache(TcpTransport())
+            registry_conf = ""
+            codec = None
+            if conf is not None:
+                from ..config import CACHED_REGISTRY, SHUFFLE_COMPRESSION
+                registry_conf = str(conf.get(CACHED_REGISTRY.key) or "")
+                codec = str(conf.get(SHUFFLE_COMPRESSION.key))
+            # cross-host peers must be able to reach the block server:
+            # bind wide when discovery is configured, loopback otherwise
+            transport = TcpTransport(
+                host="0.0.0.0" if registry_conf else "127.0.0.1")
+            if conf is not None:
+                from ..config import (CACHED_HEARTBEAT_INTERVAL_MS,
+                                      EXECUTOR_ID)
+                reg = registry_conf
+                if reg:
+                    from .discovery import RegistryClient
+                    host, _, port = reg.rpartition(":")
+                    client = RegistryClient(
+                        (host, int(port)),
+                        int(conf.get(EXECUTOR_ID.key)),
+                        (socket_host(), transport.address[1]),
+                        heartbeat_interval_s=conf.get(
+                            CACHED_HEARTBEAT_INTERVAL_MS.key) / 1000.0)
+                    transport.peer_source = client.peers
+                    transport._registry_client = client
+            _SHARED = DeviceShuffleCache(transport, codec=codec)
         return _SHARED
+
+
+def socket_host() -> str:
+    """Address peers can reach this host on (hostname IP, loopback as
+    the single-machine fallback)."""
+    import socket as _s
+    try:
+        return _s.gethostbyname(_s.gethostname())
+    except OSError:
+        return "127.0.0.1"

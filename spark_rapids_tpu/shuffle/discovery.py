@@ -1,0 +1,132 @@
+"""Heartbeat-registry peer discovery for the CACHED (device-resident)
+shuffle across REAL multi-host deployments.
+
+Reference: RapidsShuffleHeartbeatManager.scala:49,186 — executors
+heartbeat the driver and receive the full executor table, which feeds
+UCXShuffleTransport endpoint setup (UCXShuffleTransport.scala:47). Same
+shape here: a tiny driver-side TCP registry; executors REGISTER their
+block-server address, heartbeat on the conf interval, and LIST the live
+peer table, which the TcpTransport consumes as its dynamic peer source.
+
+Wire format: one JSON object per line over a short-lived connection
+(REGISTER / HEARTBEAT / LIST) — the registry is control-plane only; block
+bytes never pass through it.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+
+class _RegistryHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        try:
+            line = self.rfile.readline()
+            if not line:
+                return
+            msg = json.loads(line)
+            reg = self.server.registry       # type: ignore
+            op = msg.get("op")
+            if op in ("register", "heartbeat"):
+                reg._stamp(msg["id"], msg.get("host"), msg.get("port"))
+                self.wfile.write(b'{"ok": true}\n')
+            elif op == "list":
+                self.wfile.write(
+                    (json.dumps(reg.live_table()) + "\n").encode())
+            else:
+                self.wfile.write(b'{"error": "bad op"}\n')
+        except (OSError, ValueError, KeyError):
+            pass
+
+
+class _RegistryServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PeerRegistry:
+    """Driver-side executor table: id -> (host, port, last_seen)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 30.0):
+        self.timeout_s = timeout_s
+        self._table: Dict[int, Tuple[str, int, float]] = {}
+        self._lock = threading.Lock()
+        self._server = _RegistryServer((host, port), _RegistryHandler)
+        self._server.registry = self         # type: ignore
+        self.address = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="peer-registry")
+        self._thread.start()
+
+    def _stamp(self, exec_id: int, host: Optional[str],
+               port: Optional[int]) -> None:
+        with self._lock:
+            prev = self._table.get(exec_id)
+            if host is None or port is None:
+                if prev is None:
+                    return
+                host, port = prev[0], prev[1]
+            self._table[exec_id] = (host, int(port), time.time())
+
+    def live_table(self) -> Dict[str, Tuple[str, int]]:
+        now = time.time()
+        with self._lock:
+            return {str(i): (h, p)
+                    for i, (h, p, t) in self._table.items()
+                    if now - t <= self.timeout_s}
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RegistryClient:
+    """Executor-side: register the local block server, heartbeat on an
+    interval, and expose the live peer table (minus self) as the
+    TcpTransport's dynamic peer source."""
+
+    def __init__(self, registry_addr: Tuple[str, int], exec_id: int,
+                 block_addr: Tuple[str, int],
+                 heartbeat_interval_s: float = 5.0):
+        self.registry_addr = registry_addr
+        self.exec_id = exec_id
+        self.block_addr = block_addr
+        self._stop = threading.Event()
+        self._rpc({"op": "register", "id": exec_id,
+                   "host": block_addr[0], "port": block_addr[1]})
+        self._thread = threading.Thread(
+            target=self._beat, args=(heartbeat_interval_s,), daemon=True,
+            name=f"registry-heartbeat-{exec_id}")
+        self._thread.start()
+
+    def _rpc(self, msg: dict) -> dict:
+        with socket.create_connection(self.registry_addr, timeout=10) as s:
+            s.sendall((json.dumps(msg) + "\n").encode())
+            data = s.makefile().readline()
+        return json.loads(data) if data else {}
+
+    def _beat(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self._rpc({"op": "heartbeat", "id": self.exec_id})
+            except OSError:
+                pass    # registry unreachable: peers see us expire
+
+    def peers(self) -> Dict[int, Tuple[str, int]]:
+        """Live peer table EXCLUDING self — TcpTransport peer_source."""
+        try:
+            table = self._rpc({"op": "list"})
+        except OSError:
+            return {}
+        return {int(i): (h, p) for i, (h, p) in table.items()
+                if int(i) != self.exec_id}
+
+    def close(self) -> None:
+        self._stop.set()

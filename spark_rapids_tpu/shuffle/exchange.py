@@ -408,11 +408,12 @@ class CachedShuffleExchangeExec(UnaryExec):
     device-resident exchange."""
 
     def __init__(self, partitioning: Partitioning, child: Exec,
-                 ctx: Optional[EvalContext] = None, cache=None):
+                 ctx: Optional[EvalContext] = None, cache=None, conf=None):
         super().__init__(child, ctx)
         self.partitioning = partitioning.bind(child.output_schema)
         self._shuffle_id = next(_cached_shuffle_ids)
         self._cache = cache
+        self._conf = conf
         self._written = False
         self._write_lock = threading.Lock()
         self._slice_jit = jax.jit(
@@ -423,7 +424,7 @@ class CachedShuffleExchangeExec(UnaryExec):
     def _get_cache(self):
         if self._cache is None:
             from .device_cache import shared_device_cache
-            self._cache = shared_device_cache()
+            self._cache = shared_device_cache(getattr(self, "_conf", None))
         return self._cache
 
     @property

@@ -73,7 +73,8 @@ class ShuffleManager:
             # device-resident blocks in the spillable cache, served P2P
             # (the reference's UCX cached mode)
             from .exchange import CachedShuffleExchangeExec
-            return CachedShuffleExchangeExec(partitioning, child)
+            return CachedShuffleExchangeExec(partitioning, child,
+                                             conf=self.conf)
         return ShuffleExchangeExec(
             partitioning, child,
             adaptive=self.conf.get(ADAPTIVE_ENABLED.key),

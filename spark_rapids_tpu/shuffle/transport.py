@@ -193,7 +193,7 @@ class TcpTransport(ShuffleTransport):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
-                 retries: int = 3, liveness=None):
+                 retries: int = 3, liveness=None, peer_source=None):
         self._local: Dict[Tuple[int, int, int], bytes] = {}
         self._index: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
         #: optional (s, m, r) -> bytes|None hook serving LAZY blocks whose
@@ -207,6 +207,12 @@ class TcpTransport(ShuffleTransport):
         # Peers missing from it are skipped WITHOUT paying a socket
         # timeout; None = treat every configured peer as live.
         self.liveness = liveness
+        # peer_source: () -> {id: (host, port)} — DYNAMIC discovery
+        # (RegistryClient.peers); merged over the static table each
+        # listing, so executors that join after this transport started
+        # are still consulted (reference: heartbeat-driven endpoint
+        # table updates)
+        self.peer_source = peer_source
         self._server = _BlockServer((host, port), _Handler)
         self._server.transport = self       # type: ignore
         self.address = self._server.server_address
@@ -232,10 +238,13 @@ class TcpTransport(ShuffleTransport):
             return sorted(self._index.get((s, r), []))
 
     def _live_peers(self) -> Dict:
+        peers = dict(self.peers)
+        if self.peer_source is not None:
+            peers.update(self.peer_source())
         if self.liveness is None:
-            return self.peers
+            return peers
         live = set(self.liveness())
-        return {pid: a for pid, a in self.peers.items() if pid in live}
+        return {pid: a for pid, a in peers.items() if pid in live}
 
     def list_blocks(self, s: int, r: int):
         """Local blocks UNION every LIVE peer's blocks (the shuffle

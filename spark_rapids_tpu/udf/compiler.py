@@ -11,9 +11,18 @@ Mirrors the reference's three stages (udf-compiler/, SURVEY.md §2.13):
 
 Supported surface: arithmetic/comparison/boolean operators, ternaries,
 `is None` checks, abs/min/max, math.* calls, str methods
-(upper/lower/strip/startswith/endswith/replace…), len, constants, nested
-calls of already-compiled UDFs. Anything else raises CompileError and the
-planner leaves the UDF on the CPU row path.
+(upper/lower/strip/startswith/endswith/replace/ljust/rjust…), len,
+constants, tuple/list/dict locals with constant subscripts, counted
+range() for-loops (statically unrolled, incl. for-in-for and
+for-inside-while), `while` loops compiled to ONE jax.lax.while_loop over
+per-row carry slots — trip counts up to MAX_WHILE_ITERS at RUNTIME (no
+unrolling), with `break`/`return` inside the body via path-composed exit
+conditions and a loud per-row budget error past the cap — and nested
+calls of compilable Python functions. A while nested inside another
+loop is outside the subset (the mixed exit-to-outer-loop/return shape;
+same reducible-CFG restriction the reference applies) and, like
+everything else unsupported, raises CompileError so the planner leaves
+the UDF on the CPU row path.
 """
 
 from __future__ import annotations
@@ -41,6 +50,12 @@ class CompileError(Exception):
 #: body's expression tree; beyond this the tree blows up the trace)
 MAX_LOOP_TRIP = 64
 
+#: RUNTIME iteration budget for while loops: they compile to ONE traced
+#: body under jax.lax.while_loop (no unrolling — the tree and the XLA
+#: program stay small no matter the trip count), so the budget is a
+#: device-side counter; rows still running at the cap fail loudly
+MAX_WHILE_ITERS = 65536
+
 
 class _RangeIter:
     """A concrete range(...) iterator discovered at compile time."""
@@ -50,12 +65,284 @@ class _RangeIter:
 
 
 class _State:
-    """Mid-loop machine state returned when execution reaches the loop's
-    back-edge (JUMP_BACKWARD to the FOR_ITER head)."""
+    """Mid-loop machine state returned when execution reaches a loop's
+    back-edge (JUMP_BACKWARD to a FOR_ITER or while head)."""
 
-    def __init__(self, stack, locals_):
+    def __init__(self, stack, locals_, head=None):
         self.stack = stack
         self.locals = locals_
+        self.head = head          # back-edge target offset
+
+
+class _Partial:
+    """A fork whose arms mix 'function returned' with 'loop continues':
+    rows where ``exit_cond`` holds leave the loop with ``value``; the rest
+    carry ``state`` into the next iteration. This is how while-loop exits
+    and `return` inside loop bodies compile."""
+
+    def __init__(self, exit_cond, value, state: "_State"):
+        self.exit_cond = exit_cond
+        self.value = value
+        self.state = state
+
+
+_SLOT_ENV: list = []
+
+
+class _SlotRef(Expression):
+    """Placeholder for a while-loop carry slot: the loop body/condition
+    are compiled ONCE over these, and eval reads the current carry arrays
+    published by _WhileLoop.run for the body trace. ``token`` scopes the
+    lookup to the OWNING loop so nested loops don't collide."""
+
+    def __init__(self, idx, dtype, nullable, token=None):
+        object.__setattr__(self, "idx", idx)
+        object.__setattr__(self, "_dtype", dtype)
+        object.__setattr__(self, "_nullable", nullable)
+        object.__setattr__(self, "token", token)
+
+    @property
+    def children(self):
+        return ()
+
+    def with_children(self, c):
+        return self
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def eval(self, batch, ctx=EB.EvalContext()):
+        for token, slots in reversed(_SLOT_ENV):
+            if token is self.token:
+                data, validity = slots[self.idx]
+                return EB.DeviceColumn(data, validity, None, self._dtype)
+        raise CompileError("slot reference outside its while-loop body")
+
+
+class _WhileLoop:
+    """Compile artifact: a while loop as ONE lax.while_loop over per-row
+    carry slots (reference compiles loops via CFG reconstruction —
+    CFG.scala; the TPU-native form keeps the trace size independent of
+    the trip count). ``ret`` is the optional (exit_cond, value) pair for
+    `return`/`break` inside the body."""
+
+    def __init__(self, init_exprs, cond, body_exprs, slot_types,
+                 token, ret=None):
+        self.init = init_exprs          # per-slot initial Expressions
+        self.cond = cond                # continue condition over _SlotRefs
+        self.body = body_exprs          # per-slot next values over refs
+        self.slot_types = slot_types    # [(dtype, nullable)]
+        self.token = token              # slot-env scope key
+        self.ret = ret                  # None | (exit_cond, value_expr)
+
+    def run(self, batch, ctx):
+        """Returns (slot_cols, returned_mask, ret_col|None); memoized per
+        (loop, batch) on the context so every _WhileOut shares one
+        execution."""
+        import jax
+        import jax.numpy as jnp
+        memo = getattr(ctx, "_udf_memo", None)
+        if memo is None:
+            memo = {}
+            object.__setattr__(ctx, "_udf_memo", memo)
+        key = ("while", id(self), id(batch))
+        hit = memo.get(key)
+        if hit is not None and hit[0] is batch:
+            return hit[1]
+        init_cols = [e.eval(batch, ctx) for e in self.init]
+        datas = tuple(
+            c.data.astype(t.storage_dtype)
+            for c, (t, _) in zip(init_cols, self.slot_types))
+        valids = tuple(c.validity for c in init_cols)
+        active0 = batch.row_mask()
+        if self.ret is not None:
+            rt = self.ret[1].dtype
+            ret0 = (jnp.zeros(batch.capacity, rt.storage_dtype),
+                    jnp.zeros(batch.capacity, bool))
+        else:
+            ret0 = (jnp.zeros(batch.capacity, jnp.int8),
+                    jnp.zeros(batch.capacity, bool))
+        returned0 = jnp.zeros(batch.capacity, bool)
+
+        def cond_fn(carry):
+            _, _, active, _, _, it = carry
+            return jnp.any(active) & (it < MAX_WHILE_ITERS)
+
+        def body_fn(carry):
+            # DO-WHILE order: CPython places the loop test at the bottom
+            # (a duplicated top guard gates ENTRY, which the simulator
+            # resolved as an ordinary fork before building this loop), so
+            # extraction composes both the continue condition and any
+            # early-exit condition over PRE-body slot values — apply the
+            # body to every active row, then test
+            datas, valids, active, returned, ret, it = carry
+            _SLOT_ENV.append((self.token, list(zip(datas, valids))))
+            try:
+                bctx = EB.EvalContext(False, None)
+                upd = active
+                if self.ret is not None:
+                    ec = self.ret[0].eval(batch, bctx)
+                    rv = self.ret[1].eval(batch, bctx)
+                    hit = active & ec.data & ec.validity
+                    ret = (jnp.where(hit, rv.data, ret[0]),
+                           jnp.where(hit, rv.validity, ret[1]))
+                    returned = returned | hit
+                    upd = active & ~hit
+                new = [e.eval(batch, bctx) for e in self.body]
+                c = self.cond.eval(batch, bctx)
+            finally:
+                _SLOT_ENV.pop()
+            nd = tuple(jnp.where(upd, n.data.astype(d.dtype), d)
+                       for n, d in zip(new, datas))
+            nv = tuple(jnp.where(upd, n.validity, v)
+                       for n, v in zip(new, valids))
+            nxt = upd & c.data & c.validity
+            return nd, nv, nxt, returned, ret, it + 1
+
+        datas, valids, active, returned, ret, it = jax.lax.while_loop(
+            cond_fn, body_fn,
+            (datas, valids, active0, returned0, ret0, jnp.int32(0)))
+        # rows still wanting another iteration at the cap fail loudly
+        ctx.report(active, "CAPACITY_udf_while_budget", always=True)
+        out = ([EB.DeviceColumn(d, v, None, t)
+                for d, v, (t, _) in zip(datas, valids, self.slot_types)],
+               returned,
+               EB.DeviceColumn(ret[0], ret[1], None, self.ret[1].dtype)
+               if self.ret is not None else None)
+        if len(memo) > 128:
+            memo.clear()
+        memo[key] = (batch, out)
+        return out
+
+
+class _WhileOut(Expression):
+    """Slot i of a finished _WhileLoop (or its return value/flag)."""
+
+    def __init__(self, loop, kind, idx, dtype, nullable):
+        object.__setattr__(self, "loop", loop)
+        object.__setattr__(self, "kind", kind)   # slot | ret | returned
+        object.__setattr__(self, "idx", idx)
+        object.__setattr__(self, "_dtype", dtype)
+        object.__setattr__(self, "_nullable", nullable)
+
+    @property
+    def children(self):
+        # the loop's init expressions ARE the dependency edge (binding
+        # rewrites etc. never descend into loop internals — compiled
+        # trees are already bound)
+        return ()
+
+    def with_children(self, c):
+        return self
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def eval(self, batch, ctx=EB.EvalContext()):
+        import jax.numpy as jnp
+        slots, returned, ret = self.loop.run(batch, ctx)
+        if self.kind == "slot":
+            return slots[self.idx]
+        if self.kind == "returned":
+            return EB.DeviceColumn(returned,
+                                   jnp.ones(returned.shape[0], bool),
+                                   None, self._dtype)
+        return ret
+
+
+class _Memo(Expression):
+    """Trace-time memoization wrapper. Loop unrolling produces DAGs (each
+    pass's condition, value and next-state all reference the previous
+    state); Expression.eval walks trees, so shared nodes would re-trace
+    exponentially. One eval per (node, batch) per trace through the
+    context's memo dict."""
+
+    def __init__(self, child):
+        object.__setattr__(self, "child", child)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, c):
+        return _Memo(c[0])
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    def eval(self, batch, ctx=EB.EvalContext()):
+        memo = getattr(ctx, "_udf_memo", None)
+        if memo is None:
+            memo = {}
+            object.__setattr__(ctx, "_udf_memo", memo)
+        key = (id(self), id(batch))
+        hit = memo.get(key)
+        # entries carry the batch to defeat id() reuse: a freed batch's
+        # address can be recycled by a DIFFERENT batch, and returning the
+        # stale column would silently corrupt results
+        if hit is not None and hit[0] is batch:
+            return hit[1]
+        if len(memo) > 128:          # bound the default-context cache
+            memo.clear()             # (entries pin their batches)
+        out = self.child.eval(batch, ctx)
+        memo[key] = (batch, out)
+        return out
+
+
+def _memo(v):
+    if isinstance(v, Expression) and not isinstance(v, (Literal, _Memo)):
+        return _Memo(v)
+    if isinstance(v, tuple):
+        return tuple(_memo(x) for x in v)
+    if isinstance(v, dict):
+        return {k: _memo(x) for k, x in v.items()}
+    return v
+
+
+class _LoopBudgetCheck(Expression):
+    """Wraps a while-loop result: rows whose loop condition STILL holds
+    after the unroll budget fail the query through the engine's error
+    channel (never a silently wrong value)."""
+
+    def __init__(self, still_running, value):
+        object.__setattr__(self, "still", still_running)
+        object.__setattr__(self, "value", value)
+
+    @property
+    def children(self):
+        return (self.still, self.value)
+
+    def with_children(self, c):
+        return _LoopBudgetCheck(c[0], c[1])
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def nullable(self):
+        return self.value.nullable
+
+    def eval(self, batch, ctx=EB.EvalContext()):
+        cond = self.still.eval(batch, ctx)
+        ctx.report(cond.data & cond.validity & batch.row_mask(),
+                   "CAPACITY_udf_while_budget", always=True)
+        return self.value.eval(batch, ctx)
 
 
 def _py_mod(l, r):
@@ -122,41 +409,58 @@ class _Simulator:
         self.instructions = list(dis.get_instructions(code))
         self.by_offset = {i.offset: idx
                           for idx, i in enumerate(self.instructions)}
+        # while-loop heads: JUMP_BACKWARD targets that are NOT FOR_ITER
+        self.while_heads = set()
+        for i in self.instructions:
+            if i.opname == "JUMP_BACKWARD":
+                tgt = self.by_offset.get(i.argval)
+                if tgt is not None and \
+                        self.instructions[tgt].opname != "FOR_ITER":
+                    self.while_heads.add(i.argval)
         self.code = code
         self.globals = globals_
         self.closure = closure
         self.arg_exprs = arg_exprs
         self.nargs = len(arg_exprs)
+        #: while-extraction table: head offset -> (continue_cond, exit_idx)
+        self._wx = {}
 
     def run(self) -> Expression:
         locals_: Dict[int, Any] = dict(enumerate(self.arg_exprs))
         out = self._exec(0, [], locals_, depth=0)
-        if isinstance(out, _State):
+        if not isinstance(out, Expression):
             raise CompileError("dangling loop state (malformed CFG)")
         return out
+
+    def _merge_val(self, cond, x, y):
+        if x is y:
+            return x
+        if isinstance(x, tuple) and isinstance(y, tuple) and \
+                len(x) == len(y):
+            return tuple(self._merge_val(cond, a, b) for a, b in zip(x, y))
+        if isinstance(x, dict) and isinstance(y, dict) and \
+                set(x) == set(y):
+            return {k: self._merge_val(cond, x[k], y[k]) for k in x}
+        return ECOND.If(cond, self._expr(x), self._expr(y))
 
     def _merge_states(self, cond, a: "_State", b: "_State") -> "_State":
         """Join two loop-body arms: per-slot If() where they diverge."""
         if len(a.stack) != len(b.stack):
             raise CompileError("loop arms leave different stack depths")
-        stack = []
-        for x, y in zip(a.stack, b.stack):
-            stack.append(x if x is y
-                         else ECOND.If(cond, self._expr(x), self._expr(y)))
-        locals_ = {}
-        for k in set(a.locals) & set(b.locals):
-            x, y = a.locals[k], b.locals[k]
-            if x is y:
-                locals_[k] = x
-            else:
-                locals_[k] = ECOND.If(cond, self._expr(x), self._expr(y))
-        return _State(stack, locals_)
+        if a.head != b.head:
+            raise CompileError("unstructured control flow across loops")
+        stack = [self._merge_val(cond, x, y)
+                 for x, y in zip(a.stack, b.stack)]
+        locals_ = {k: self._merge_val(cond, a.locals[k], b.locals[k])
+                   for k in set(a.locals) & set(b.locals)}
+        return _State(stack, locals_, a.head)
 
     # ------------------------------------------------------------------
 
     def _exec(self, idx: int, stack: List[Any], locals_: Dict[int, Any],
-              depth: int, loop_head: Optional[int] = None):
-        if depth > 40:
+              depth: int, loop_heads: Tuple[int, ...] = (),
+              extract: Optional[int] = None):
+        if depth > 60:
             raise CompileError("branch nesting too deep")
         stack = list(stack)
         locals_ = dict(locals_)
@@ -164,6 +468,10 @@ class _Simulator:
         while idx < n:
             ins = self.instructions[idx]
             op = ins.opname
+            if ins.offset in self.while_heads and \
+                    ins.offset not in loop_heads:
+                return self._run_while(idx, stack, locals_, depth,
+                                       loop_heads)
             if op in ("RESUME", "NOP", "CACHE", "PRECALL", "PUSH_NULL",
                       "COPY_FREE_VARS", "MAKE_CELL"):
                 idx += 1
@@ -176,8 +484,12 @@ class _Simulator:
                 locals_[ins.arg] = stack.pop()
                 idx += 1
             elif op == "LOAD_CONST":
+                v = ins.argval
                 try:
-                    stack.append(lit(ins.argval))
+                    if isinstance(v, tuple):
+                        stack.append(tuple(lit(x) for x in v))
+                    else:
+                        stack.append(lit(v))
                 except TypeError as ex:
                     raise CompileError(str(ex))
                 idx += 1
@@ -264,26 +576,21 @@ class _Simulator:
                 else:
                     cond = EC.IsNull(self._expr(tos))
                 then_e = self._exec(idx + 1, stack, locals_, depth + 1,
-                                    loop_head)
+                                    loop_heads, extract)
                 else_e = self._exec(self.by_offset[ins.argval], stack,
-                                    locals_, depth + 1, loop_head)
-                if isinstance(then_e, _State) and isinstance(else_e, _State):
-                    return self._merge_states(cond, then_e, else_e)
-                if isinstance(then_e, _State) or isinstance(else_e, _State):
-                    raise CompileError(
-                        "return inside a loop body is not compilable")
-                return ECOND.If(cond, then_e, else_e)
+                                    locals_, depth + 1, loop_heads, extract)
+                return self._join_fork(cond, then_e, else_e, loop_heads)
             elif op in ("JUMP_FORWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
                 tgt = self.by_offset.get(ins.argval)
                 if tgt is None or tgt <= idx and op != "JUMP_FORWARD":
                     raise CompileError("backward jump (loop) unsupported")
                 idx = tgt
             elif op == "JUMP_BACKWARD":
-                if loop_head is not None and ins.argval == loop_head:
-                    return _State(stack, locals_)
+                if ins.argval in loop_heads:
+                    return _State(stack, locals_, head=ins.argval)
                 raise CompileError(
-                    "only counted range() for-loops are compilable "
-                    "(while loops and generators stay on the CPU path)")
+                    "backward jump outside any active loop (generators "
+                    "and unstructured flow stay on the CPU path)")
             elif op == "GET_ITER":
                 tos = stack.pop()
                 if not isinstance(tos, _RangeIter):
@@ -301,12 +608,18 @@ class _Simulator:
                 # the trip count is static so unrolling is exact)
                 cur = _State(list(stack), dict(locals_))
                 for v in it.values:
+                    cur = _State([_memo(x) for x in cur.stack],
+                                 {k: _memo(x)
+                                  for k, x in cur.locals.items()},
+                                 cur.head)
                     body_stack = list(cur.stack) + [lit(v)]
                     r = self._exec(idx + 1, body_stack, cur.locals,
-                                   depth + 1, loop_head=ins.offset)
+                                   depth + 1,
+                                   loop_heads=loop_heads + (ins.offset,))
                     if not isinstance(r, _State):
                         raise CompileError(
-                            "return inside a loop body is not compilable")
+                            "return inside a for-loop body is not "
+                            "compilable (use while)")
                     cur = r
                 # exhausted: fall to the loop exit (END_FOR pops the iter)
                 idx = self.by_offset[ins.argval]
@@ -328,9 +641,278 @@ class _Simulator:
                 idx += 1
             elif op == "TO_BOOL":
                 idx += 1
+            elif op in ("BUILD_TUPLE", "BUILD_LIST"):
+                vals = [stack.pop() for _ in range(ins.arg)][::-1]
+                stack.append(tuple(vals))
+                idx += 1
+            elif op == "BUILD_MAP":
+                pairs = [stack.pop() for _ in range(2 * ins.arg)][::-1]
+                d = {}
+                for k, v in zip(pairs[0::2], pairs[1::2]):
+                    if not isinstance(k, Literal):
+                        raise CompileError("dict keys must be constants")
+                    d[k.value] = v
+                stack.append(d)
+                idx += 1
+            elif op == "BUILD_CONST_KEY_MAP":
+                keys = stack.pop()
+                vals = [stack.pop() for _ in range(ins.arg)][::-1]
+                kt = [k.value if isinstance(k, Literal) else k
+                      for k in (keys.value if isinstance(keys, Literal)
+                                else keys)]
+                stack.append(dict(zip(kt, vals)))
+                idx += 1
+            elif op == "UNPACK_SEQUENCE":
+                seq = stack.pop()
+                if not isinstance(seq, tuple) or len(seq) != ins.arg:
+                    raise CompileError("unpack of a non-tuple value")
+                for v in reversed(seq):
+                    stack.append(v)
+                idx += 1
+            elif op == "BINARY_SUBSCR":
+                key = stack.pop()
+                cont = stack.pop()
+                if not isinstance(key, Literal):
+                    raise CompileError("subscripts must be constants")
+                if isinstance(cont, tuple):
+                    try:
+                        stack.append(cont[key.value])
+                    except (IndexError, TypeError) as ex:
+                        raise CompileError(f"tuple index: {ex}")
+                elif isinstance(cont, dict):
+                    if key.value not in cont:
+                        raise CompileError(f"missing dict key {key.value!r}")
+                    stack.append(cont[key.value])
+                else:
+                    raise CompileError("subscript of a non-container")
+                idx += 1
+            elif op == "STORE_SUBSCR":
+                key = stack.pop()
+                cont = stack.pop()
+                val = stack.pop()
+                if not (isinstance(cont, dict) and isinstance(key, Literal)):
+                    raise CompileError(
+                        "item assignment needs a dict local and a "
+                        "constant key")
+                new = dict(cont)
+                new[key.value] = val
+                # containers are immutable values here: rebind every
+                # alias so forked arms never share mutated state
+                for slot, lv in list(locals_.items()):
+                    if lv is cont:
+                        locals_[slot] = new
+                for i2, sv in enumerate(stack):
+                    if sv is cont:
+                        stack[i2] = new
+                idx += 1
             else:
                 raise CompileError(f"unsupported opcode {op}")
         raise CompileError("fell off the end of the bytecode")
+
+    def _join_fork(self, cond, a, b, loop_heads):
+        """Join the two arms of a conditional. cond = 'arm a taken'.
+        Arms may be final Expressions, continuing _States of the
+        INNERMOST active loop, exit _States of an outer loop, or
+        _Partials — any combination joins into the weakest common
+        shape."""
+        if isinstance(a, Expression) and isinstance(b, Expression):
+            return ECOND.If(cond, a, b)
+        cur = loop_heads[-1] if loop_heads else None
+
+        def is_cont(x):
+            return isinstance(x, _State) and x.head == cur
+
+        if isinstance(a, _State) and isinstance(b, _State) and \
+                a.head == b.head:
+            return self._merge_states(cond, a, b)
+
+        def as_partial(x, other_state, other_value):
+            if isinstance(x, _Partial):
+                return x
+            if is_cont(x):
+                return _Partial(lit(False), other_value, x)
+            # exit payload: a returned Expression or an outer-loop state
+            return _Partial(lit(True), x, other_state)
+
+        val = next((x.value if isinstance(x, _Partial) else x
+                    for x in (a, b)
+                    if isinstance(x, _Partial) or not is_cont(x)), None)
+        st = next((x.state if isinstance(x, _Partial) else x
+                   for x in (a, b)
+                   if isinstance(x, _Partial) or is_cont(x)), None)
+        if val is None or st is None:
+            raise CompileError(
+                "mixed function-return and outer-loop exits (a while "
+                "nested in another loop) are outside the compilable "
+                "subset")
+        pa = as_partial(a, st, val)
+        pb = as_partial(b, st, val)
+        if pa.state.head != pb.state.head:
+            raise CompileError("unstructured control flow across loops")
+        if pa.value is pb.value:
+            value = pa.value
+        elif isinstance(pa.value, _State) and isinstance(pb.value, _State):
+            if pa.value.head != pb.value.head:
+                raise CompileError("exits target different loops")
+            value = self._merge_states(cond, pa.value, pb.value)
+        elif isinstance(pa.value, _State) or isinstance(pb.value, _State):
+            # one arm exits to an outer loop, the other arm's exit payload
+            # is a masked dummy: keep the real state payload
+            value = pa.value if isinstance(pa.value, _State) else pb.value
+        else:
+            value = ECOND.If(cond, self._expr(pa.value),
+                             self._expr(pb.value))
+        return _Partial(
+            ECOND.If(cond, self._expr(pa.exit_cond),
+                     self._expr(pb.exit_cond)),
+            value,
+            self._merge_states(cond, pa.state, pb.state))
+
+    def _run_while(self, head_idx: int, stack, locals_, depth: int,
+                   loop_heads):
+        """Bounded while-loop unrolling. Each pass symbolically executes
+        from the condition head: rows that exit carry their final value
+        (the REST of the program evaluated at exit state); the rest loop.
+        After MAX_LOOP_TRIP passes, still-running rows fail loudly via
+        _LoopBudgetCheck (reference: CFG.scala loop support; the trip
+        budget mirrors the for-loop unroll budget)."""
+        head_off = self.instructions[head_idx].offset
+        slot = self._try_slot_mode(head_idx, head_off, stack, locals_,
+                                   depth, loop_heads)
+        if slot is not None:
+            return slot
+        state = _State(list(stack), dict(locals_), head=head_off)
+        exits = []                # (exit_cond, payload) per pass
+
+        def memo_state(st):
+            return _State([_memo(v) for v in st.stack],
+                          {k: _memo(v) for k, v in st.locals.items()},
+                          head=head_off)
+
+        def fold(last):
+            """Fold accumulated exits over the final payload."""
+            out = last
+            for c, v in reversed(exits):
+                if isinstance(v, _State) or isinstance(out, _State):
+                    if not (isinstance(v, _State) and
+                            isinstance(out, _State) and
+                            v.head == out.head):
+                        raise CompileError(
+                            "mixed return/continue exits from one loop")
+                    out = self._merge_states(self._expr(c), v, out)
+                else:
+                    out = ECOND.If(self._expr(c), self._expr(v), out)
+            return out
+
+        for _ in range(MAX_LOOP_TRIP):
+            r = self._exec(head_idx, state.stack, state.locals, depth + 1,
+                           loop_heads + (head_off,))
+            if isinstance(r, Expression) or (isinstance(r, _State)
+                                             and r.head != head_off):
+                # no continuing rows are possible: fold accumulated exits
+                return fold(r)
+            if isinstance(r, _State):
+                # body made no exit this pass (e.g. `while True` prefix)
+                state = memo_state(r)
+                continue
+            state = memo_state(r.state)
+            exits.append((_memo(self._expr(r.exit_cond)), r.value))
+        # budget exhausted: one more pass determines the residual rows
+        r = self._exec(head_idx, state.stack, state.locals, depth + 1,
+                       loop_heads + (head_off,))
+        if isinstance(r, Expression) or (isinstance(r, _State)
+                                         and r.head != head_off):
+            return fold(r)
+        if isinstance(r, _Partial) and not isinstance(r.value, _State):
+            return fold(_LoopBudgetCheck(EC.Not(self._expr(r.exit_cond)),
+                                         self._expr(r.value)))
+        raise CompileError(
+            f"while loop never exits within the unroll budget "
+            f"({MAX_LOOP_TRIP})")
+
+    def _try_slot_mode(self, head_idx, head_off, stack, locals_, depth,
+                       loop_heads):
+        """Compile the while loop as ONE lax.while_loop over carry slots
+        (trace size independent of the trip count; runtime budget
+        MAX_WHILE_ITERS). One symbolic pass from the head yields a
+        _Partial whose PATH-COMPOSED exit condition covers every way out
+        (the loop test, `break`, `return`) and whose value is the rest of
+        the function over the loop state — so the runtime is uniform:
+        test the exit first, apply the body to survivors. None = shape
+        outside slot mode; the caller falls back to bounded unrolling."""
+        from .. import types as TT
+        if stack:
+            return None
+        flat = (TT.TypeKind.INT8, TT.TypeKind.INT16, TT.TypeKind.INT32,
+                TT.TypeKind.INT64, TT.TypeKind.FLOAT32, TT.TypeKind.FLOAT64,
+                TT.TypeKind.BOOLEAN, TT.TypeKind.DATE, TT.TypeKind.TIMESTAMP)
+        slot_ids = []
+        for k, v in locals_.items():
+            if isinstance(v, Expression):
+                try:
+                    if v.dtype.kind not in flat:
+                        return None
+                except Exception:       # noqa: BLE001
+                    return None
+                slot_ids.append(k)
+        slot_ids.sort()
+        types = [(locals_[k].dtype, locals_[k].nullable) for k in slot_ids]
+        token = object()
+        for _ in range(3):              # dtype fixed point (int -> float)
+            refs = {k: _SlotRef(i, t, nl, token)
+                    for i, (k, (t, nl)) in enumerate(zip(slot_ids, types))}
+            ref_locals = dict(locals_)
+            ref_locals.update(refs)
+            try:
+                r = self._exec(head_idx, [], ref_locals, depth + 1,
+                               loop_heads + (head_off,))
+            except CompileError:
+                return None
+            if not isinstance(r, _Partial) or r.state.head != head_off \
+                    or not isinstance(r.value, Expression):
+                return None
+            st = r.state
+            body_vals = []
+            new_types = []
+            ok = True
+            for k, (t, nl) in zip(slot_ids, types):
+                v = st.locals.get(k)
+                if not isinstance(v, Expression):
+                    ok = False
+                    break
+                try:
+                    vt, vn = v.dtype, v.nullable or nl
+                except Exception:       # noqa: BLE001
+                    ok = False
+                    break
+                if vt.kind not in flat:
+                    ok = False
+                    break
+                body_vals.append(v)
+                new_types.append((vt, vn))
+            if not ok:
+                return None
+            # containers must come through the body UNCHANGED (they are
+            # loop constants in slot mode)
+            for k, v in locals_.items():
+                if not isinstance(v, Expression) and \
+                        st.locals.get(k) is not v:
+                    return None
+            if new_types == types:
+                break
+            types = new_types
+        else:
+            return None                 # dtypes never stabilized
+        from ..expressions.cast import Cast
+        init = []
+        for k, (t, _) in zip(slot_ids, types):
+            e = locals_[k]
+            init.append(e if e.dtype == t else Cast(e, t))
+        ret = (self._expr(r.exit_cond), self._expr(r.value))
+        loop = _WhileLoop(init, lit(True), body_vals, types, token, ret)
+        # every row exits through ret (the loop test is one of its
+        # paths); the loop's return value IS the function's remainder
+        return _WhileOut(loop, "ret", 0, ret[1].dtype, True)
 
     # ------------------------------------------------------------------
 
@@ -339,6 +921,10 @@ class _Simulator:
             return v
         if isinstance(v, (int, float, str, bool)) or v is None:
             return lit(v)
+        if isinstance(v, (tuple, dict)):
+            raise CompileError(
+                "tuple/dict values may be stored and indexed but not "
+                "returned or used as scalars")
         raise CompileError(f"non-expression on stack: {v!r}")
 
     def _call(self, fn, args):
@@ -427,6 +1013,10 @@ class _Simulator:
         if name == "find":
             return EA.Subtract(ES.StringLocate(obj, self._expr(args[0])),
                                lit(1))
+        if name in ("ljust", "rjust"):
+            pad = self._expr(args[1]) if len(args) > 1 else lit(" ")
+            return ES.StringPad(obj, self._expr(args[0]), pad,
+                                left=(name == "rjust"))
         raise CompileError(f"string method {name}")
 
 

@@ -1,0 +1,64 @@
+"""Executor-1 process for the end-to-end CACHED-shuffle discovery test:
+registers with the driver's peer registry (argv[1] = registry port),
+publishes its half of a hash-shuffled join's map outputs as DEVICE
+batches, FORCES one block to spill, and serves peers over TCP."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pyarrow as pa
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from spark_rapids_tpu.batch import from_arrow
+from spark_rapids_tpu.expressions import col
+from spark_rapids_tpu.shuffle.device_cache import DeviceShuffleCache
+from spark_rapids_tpu.shuffle.discovery import RegistryClient
+from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+from spark_rapids_tpu.shuffle.transport import TcpTransport
+
+
+def main():
+    registry_port = int(sys.argv[1])
+    n_reduce = int(sys.argv[2])
+    # executor 1's half of the fact table: odd keys
+    rng = np.random.default_rng(21)
+    t = pa.table({"k": np.arange(1, 2000, 2, dtype=np.int64),
+                  "v": rng.integers(0, 100, 1000).astype(np.int64)})
+    batch, schema = from_arrow(t)
+    part = HashPartitioning([col("k")], n_reduce).bind(schema)
+    pids = jax.jit(lambda b: part.partition_ids(b))(batch)
+
+    transport = TcpTransport()
+    cache = DeviceShuffleCache(transport)
+    from spark_rapids_tpu.exec.common import compact
+    slicer = jax.jit(lambda b, p: compact(b, pids == p), static_argnums=1)
+    for r in range(n_reduce):
+        piece = slicer(batch, r)
+        if int(piece.num_rows) > 0:
+            cache.add_batch(11, 1, r, piece, schema)
+    # FORCE the registered blocks off-device: peers must still fetch
+    # them (the cache re-materializes from the spill tier)
+    spilled = cache.catalog.synchronous_spill(1 << 40)
+    from spark_rapids_tpu.memory.catalog import StorageTier
+    with cache._lock:
+        some = next(iter(cache._blocks.values()))
+    assert cache.catalog.tier_of(some[0].hid) is not StorageTier.DEVICE, \
+        "forced spill did not leave the device tier"
+    print(f"SPILLED {spilled}", flush=True)
+    client = RegistryClient(("127.0.0.1", registry_port), 1,
+                            ("127.0.0.1", transport.address[1]),
+                            heartbeat_interval_s=0.5)
+    print("READY", flush=True)
+    sys.stdin.readline()
+    client.close()
+    cache.close()
+    transport.close()
+
+
+if __name__ == "__main__":
+    main()

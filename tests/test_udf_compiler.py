@@ -25,8 +25,15 @@ T1 = gen_table([("x", IntegerGen(min_val=-100, max_val=100,
                n=300, seed=140)
 
 
+def _bound(c):
+    from spark_rapids_tpu.batch import schema_from_arrow
+    return col(c).bind(schema_from_arrow(T1.schema))
+
+
 def run_compiled(fn, *cols_, conf=None):
-    expr = compile_udf(fn, [col(c) for c in cols_])
+    # bound argument refs let while loops take the lax.while_loop slot
+    # mode (typed carries); unbound args still compile via unrolling
+    expr = compile_udf(fn, [_bound(c) for c in cols_])
     ses = Session(conf or {"spark.rapids.tpu.sql.incompatibleOps.enabled":
                            True})
     return ses.collect(table(T1).select(expr.alias("r"))), ses
@@ -188,14 +195,15 @@ def test_nested_loops():
     check(nested, "x")
 
 
-def test_while_loop_rejected():
+def test_while_loop_compiles():
+    # round 4: bounded while loops unroll with per-row exit tracking
     def w(x):
         acc = 0
         while acc < x:
             acc = acc + 1
         return acc
-    with pytest.raises(CompileError):
-        compile_udf(w, [col("x")])
+    expr = compile_udf(w, [col("x")])
+    assert expr is not None
 
 
 def test_huge_trip_count_rejected():
@@ -206,3 +214,258 @@ def test_huge_trip_count_rejected():
         return acc
     with pytest.raises(CompileError):
         compile_udf(big, [col("x")])
+
+
+# ---------------------------------------------------------------------------
+# Round-4 surface (VERDICT r3 Next #8): while loops, tuple/dict locals,
+# chained methods — a slice of the reference's OpcodeSuite pattern matrix.
+# T1's y column is 1..50, safely under the MAX_LOOP_TRIP=64 budget.
+# ---------------------------------------------------------------------------
+
+def _diff(fn, *cols_):
+    check(fn, *cols_)
+
+
+def test_while_countdown():
+    def f(y):
+        acc = 0
+        while y > 0:
+            acc = acc + y
+            y = y - 1
+        return acc
+    _diff(f, "y")
+
+
+def test_while_with_condition_in_body():
+    def f(y):
+        acc = 0
+        i = 0
+        while i < y:
+            if i % 2 == 0:
+                acc = acc + i
+            i = i + 1
+        return acc
+    _diff(f, "y")
+
+
+def test_while_collatz_bounded():
+    def f(y):
+        steps = 0
+        n = y
+        while n > 1 and steps < 20:
+            if n % 2 == 0:
+                n = n // 2
+            else:
+                n = 3 * n + 1
+            steps = steps + 1
+        return steps
+    _diff(f, "y")
+
+
+def test_while_return_inside_body():
+    def f(y):
+        i = 0
+        while i < 60:
+            if i * i >= y:
+                return i
+            i = i + 1
+        return -1
+    _diff(f, "y")
+
+
+def test_while_budget_exceeded_fails_loud():
+    # needs more iterations than the 65536 runtime cap -> loud per-row
+    # failure, never a silently wrong value
+    def f(x):
+        acc = 0
+        while acc < 10 ** 9:
+            acc = acc + abs(x) + 1
+        return acc
+    import pyarrow as pa
+    expr = compile_udf(f, [_bound("x")])
+    ses = Session({})
+    small = pa.table({"x": pa.array([1], pa.int64())})
+    with pytest.raises(Exception, match="udf_while_budget"):
+        ses.collect(table(small).select(expr.alias("r")))
+
+
+def test_while_long_trip_count_runs():
+    # 5000 iterations: far beyond any unroll budget, fine at runtime
+    def f(x):
+        acc = 0
+        i = 0
+        while i < 5000:
+            acc = acc + 1
+            i = i + 1
+        return acc + x
+    check(f, "x")
+
+
+def test_nested_while_rejected_cleanly():
+    # while-in-while is outside the compilable subset (mixed
+    # exit-to-outer/return shapes); must fail as a clean CompileError so
+    # the planner can fall back to the CPU row path
+    def f(y):
+        total = 0
+        i = 0
+        while i < 5:
+            j = 0
+            while j < 4:
+                total = total + i * j + y
+                j = j + 1
+            i = i + 1
+        return total
+    with pytest.raises(CompileError):
+        compile_udf(f, [_bound("y")])
+
+
+def test_for_inside_while_compiles():
+    def f(y):
+        total = 0
+        i = 0
+        while i < 5:
+            for j in range(4):
+                total = total + i * j + y
+            i = i + 1
+        return total
+    _diff(f, "y")
+
+
+def test_while_inside_for_rejected_cleanly():
+    def f(y):
+        total = 0
+        for i in range(4):
+            k = i
+            while k > 0:
+                total = total + k + y
+                k = k - 1
+        return total
+    with pytest.raises(CompileError):
+        compile_udf(f, [_bound("y")])
+
+
+def test_tuple_local_pack_unpack():
+    def f(x, y):
+        p = (x + 1, y * 2)
+        a, b = p
+        return a + b
+    _diff(f, "x", "y")
+
+
+def test_tuple_swap_idiom():
+    def f(x, y):
+        a, b = x, y
+        a, b = b, a
+        return a - b
+    _diff(f, "x", "y")
+
+
+def test_tuple_constant_index():
+    def f(x, y):
+        t = (x, y, x + y)
+        return t[2] - t[0]
+    _diff(f, "x", "y")
+
+
+def test_dict_local_literal_keys():
+    def f(x, y):
+        d = {"a": x, "b": y}
+        return d["a"] * d["b"]
+    _diff(f, "x", "y")
+
+
+def test_dict_store_subscr():
+    def f(x):
+        d = {"acc": 0}
+        for i in range(3):
+            d["acc"] = d["acc"] + x + i
+        return d["acc"]
+    _diff(f, "x")
+
+
+def test_dict_mutation_in_branch():
+    def f(x):
+        d = {"v": x}
+        if x > 0:
+            d["v"] = x * 10
+        return d["v"]
+    _diff(f, "x")
+
+
+def test_tuple_in_loop_accumulator():
+    def f(y):
+        s = (0, 1)
+        for i in range(5):
+            s = (s[0] + i * y, s[1] + 1)
+        return s[0] + s[1]
+    _diff(f, "y")
+
+
+def test_chained_str_methods():
+    check(lambda s: s.strip().upper().replace("A", "Z"), "s")
+
+
+def test_str_ljust_rjust():
+    def f(s):
+        return s.rjust(12, "*")
+    _diff(f, "s")
+
+
+def test_while_accumulating_float():
+    def f(d):
+        acc = 0.0
+        i = 0
+        while i < 8:
+            acc = acc + d / (i + 1)
+            i = i + 1
+        return acc
+    _diff(f, "d")
+
+
+def test_while_with_break_shape():
+    # `break` compiles as a jump to the loop exit: rows exit via the
+    # residual-condition machinery
+    def f(y):
+        i = 0
+        acc = 0
+        while i < 50:
+            acc = acc + i
+            if acc > y:
+                break
+            i = i + 1
+        return acc
+    _diff(f, "y")
+
+
+def test_dict_of_tuples():
+    def f(x, y):
+        d = {"p": (x, y)}
+        a, b = d["p"]
+        return a * 10 + b
+    _diff(f, "x", "y")
+
+
+def test_while_min_max_mix():
+    def f(x, y):
+        lo = min(x, y)
+        hi = max(x, y)
+        n = 0
+        while lo < hi and n < 60:
+            lo = lo + 1
+            n = n + 1
+        return n
+    _diff(f, "x", "y")
+
+
+def test_return_tuple_rejected():
+    with pytest.raises(CompileError):
+        compile_udf(lambda x: (x, x + 1), [col("x")])
+
+
+def test_unbounded_while_true_rejected():
+    def f(x):
+        while True:
+            x = x + 1
+        return x
+    with pytest.raises(CompileError):
+        compile_udf(f, [col("x")])
