@@ -3,5 +3,5 @@
 # import if the shared object is missing).
 set -e
 cd "$(dirname "$0")"
-g++ -O3 -fPIC -shared -std=c++17 -o librtpu_native.so src/rtpu_native.cpp -lzstd
+g++ -O3 -fPIC -shared -std=c++17 -o librtpu_native.so src/rtpu_native.cpp src/rtpu_parquet.cpp -lzstd
 echo "built $(pwd)/librtpu_native.so"

@@ -6,9 +6,19 @@ Enable 64-bit mode up front: SQL engines are bigint/double-centric and Spark
 semantics require true int64/float64 — jax defaults to 32-bit otherwise.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# This deployment's site config force-registers the tunneled TPU platform
+# regardless of JAX_PLATFORMS (tests/conftest.py documents the same quirk),
+# and module-level jnp constants would then initialize that backend at
+# import. Honor an explicit CPU request here so device-less processes
+# (tests, plan-server drivers, tooling) never touch the tunnel.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 from . import types  # noqa: E402,F401
 from .batch import ColumnarBatch, DeviceColumn, Field, Schema  # noqa: E402,F401

@@ -97,9 +97,18 @@ class Schema:
 
 @flax.struct.dataclass
 class DeviceColumn:
-    """One column resident in HBM: payload + validity (+ lengths for strings)."""
+    """One column resident in HBM: payload + validity (+ lengths for strings).
 
-    data: jax.Array                 # [cap] or [cap, max_len] uint8 for strings
+    STRUCT columns (reference carries structs through every operator —
+    GpuColumnVector.java struct paths, complexTypeExtractors.scala:355)
+    hold a TUPLE of child DeviceColumns in ``data`` — one lane-set per leaf
+    field — plus the struct-level validity lane. The tuple is a pytree
+    node, so struct columns trace through jit like any other column;
+    generic primitives (gather/compact/concat) recurse into the children.
+    """
+
+    data: jax.Array                 # [cap] | [cap, max_len] uint8 strings
+    #                               | tuple[DeviceColumn, ...] for structs
     validity: jax.Array             # bool[cap]; False beyond num_rows
     lengths: Optional[jax.Array] = None   # int32[cap], strings/arrays/maps
     dtype: SqlType = flax.struct.field(pytree_node=False, default=T.INT32)
@@ -110,12 +119,25 @@ class DeviceColumn:
 
     @property
     def capacity(self) -> int:
-        return self.data.shape[0]
+        # validity is always a flat [cap] lane, even for structs where
+        # ``data`` is a tuple of child columns
+        return self.validity.shape[0]
+
+    @property
+    def is_struct(self) -> bool:
+        return isinstance(self.data, tuple)
+
+    @property
+    def struct_fields(self) -> Tuple["DeviceColumn", ...]:
+        return self.data
 
     def with_validity(self, validity: jax.Array) -> "DeviceColumn":
         return self.replace(validity=validity)
 
     def size_bytes(self) -> int:
+        if self.is_struct:
+            return (sum(c.size_bytes() for c in self.data)
+                    + self.validity.size)
         n = self.data.size * self.data.dtype.itemsize + self.validity.size
         if self.lengths is not None:
             n += self.lengths.size * 4
@@ -316,6 +338,19 @@ def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
     else:
         validity = np.ones(n, dtype=bool)
 
+    if dtype.kind is TypeKind.STRUCT:
+        # one lane-set per leaf field + struct-level validity; a field of
+        # a null struct is null (validity AND), struct-of-struct recurses
+        pval = np.zeros(capacity, dtype=bool)
+        pval[:n] = validity
+        pval_dev = jnp.asarray(pval)
+        kids = []
+        for i, ct in enumerate(dtype.children):
+            kid = column_from_arrow(arr.field(i), ct, capacity,
+                                    truncate_strings)
+            kids.append(kid.with_validity(kid.validity & pval_dev))
+        return DeviceColumn(tuple(kids), pval_dev, None, dtype)
+
     if dtype.kind is TypeKind.STRING:
         mat, lengths = _strings_to_matrix(arr, dtype.max_len, truncate_strings)
         return make_column(mat, validity, dtype, capacity, lengths)
@@ -434,16 +469,21 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None,
     return ColumnarBatch(tuple(cols), jnp.asarray(n, jnp.int32)), schema
 
 
+def empty_column(dtype: SqlType, capacity: int = MIN_CAPACITY
+                 ) -> DeviceColumn:
+    validity = jnp.zeros(capacity, bool)
+    if dtype.kind is TypeKind.STRUCT:
+        kids = tuple(empty_column(c, capacity) for c in dtype.children)
+        return DeviceColumn(kids, validity, None, dtype)
+    if dtype.kind is TypeKind.STRING:
+        return DeviceColumn(jnp.zeros((capacity, dtype.max_len), jnp.uint8),
+                            validity, jnp.zeros(capacity, jnp.int32), dtype)
+    return DeviceColumn(jnp.zeros(capacity, dtype.storage_dtype),
+                        validity, None, dtype)
+
+
 def empty_batch(schema: Schema, capacity: int = MIN_CAPACITY) -> ColumnarBatch:
-    cols = []
-    for f in schema:
-        if f.dtype.kind is TypeKind.STRING:
-            data = jnp.zeros((capacity, f.dtype.max_len), jnp.uint8)
-            lengths = jnp.zeros(capacity, jnp.int32)
-        else:
-            data = jnp.zeros(capacity, f.dtype.storage_dtype)
-            lengths = None
-        cols.append(DeviceColumn(data, jnp.zeros(capacity, bool), lengths, f.dtype))
+    cols = [empty_column(f.dtype, capacity) for f in schema]
     return ColumnarBatch(tuple(cols), jnp.asarray(0, jnp.int32))
 
 
@@ -468,118 +508,123 @@ def _storage_to_arrow(flat: np.ndarray, dtype: SqlType) -> pa.Array:
 
 def to_arrow(batch: ColumnarBatch, schema: Schema) -> pa.Table:
     n = int(batch.num_rows)
-    arrays = []
-    for col, f in zip(batch.columns, schema):
-        validity = np.asarray(col.validity[:n])
-        if f.dtype.kind is TypeKind.NULL:
-            arrays.append(pa.nulls(n))
-            continue
-        if f.dtype.kind is TypeKind.STRING:
-            mat = np.asarray(col.data[:n])
-            lens = np.where(validity, np.asarray(col.lengths[:n]), 0)
-            # vectorized: row-major masked bytes ARE the arrow data buffer
-            mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
-            flat = np.ascontiguousarray(mat)[mask]
-            offsets = np.zeros(n + 1, np.int32)
-            np.cumsum(lens, out=offsets[1:])
-            sa = pa.StringArray.from_buffers(
-                n, pa.py_buffer(offsets.tobytes()),
-                pa.py_buffer(flat.tobytes()),
-                pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
-                if not validity.all() else None)
-            arrays.append(sa)
-            continue
-        if f.dtype.kind is TypeKind.ARRAY:
-            mat = np.asarray(col.data[:n])
-            counts = np.where(validity, np.asarray(col.lengths[:n]), 0)
-            if counts.size and int(counts.max()) > mat.shape[1]:
-                raise CapacityError(
-                    f"array column '{f.name}' holds a list of "
-                    f"{int(counts.max())} elements but the device budget is "
-                    f"{mat.shape[1]}; raise max_elems (collect_list/set) or "
-                    f"fall back to CPU")
-            mask2 = np.arange(mat.shape[1])[None, :] < counts[:, None]
-            offsets = np.zeros(n + 1, np.int32)
-            np.cumsum(counts, out=offsets[1:])
-            elem_t = T.to_arrow(f.dtype.children[0])
-            if f.dtype.children[0].kind is TypeKind.STRING:
-                # 3D byte tensor [n, me, max_len]; per-element byte lengths
-                # ride in data2
-                el_lens = np.asarray(col.data2[:n])
-                live_el = mat[mask2]                     # [k, max_len]
-                live_lens = el_lens[mask2]
-                bmask = np.arange(mat.shape[2])[None, :] < live_lens[:, None]
-                str_offsets = np.zeros(len(live_lens) + 1, np.int32)
-                np.cumsum(live_lens, out=str_offsets[1:])
-                values = pa.StringArray.from_buffers(
-                    len(live_lens),
-                    pa.py_buffer(str_offsets.tobytes()),
-                    pa.py_buffer(np.ascontiguousarray(live_el)[bmask]
-                                 .tobytes()))
-            else:
-                values = _storage_to_arrow(mat[mask2],
-                                           f.dtype.children[0])
-            la = pa.ListArray.from_arrays(pa.array(offsets, pa.int32()),
-                                          values)
-            if not validity.all():
-                # rebuild with a null mask (from_arrays has no mask param
-                # for offsets-based construction)
-                la = pa.ListArray.from_arrays(
-                    pa.array(offsets, pa.int32()), values)
-                pl = la.to_pylist()
-                la = pa.array([v if ok else None
-                               for v, ok in zip(pl, validity)],
-                              type=pa.list_(elem_t))
-            arrays.append(la)
-            continue
-        if f.dtype.kind is TypeKind.MAP:
-            kmat = np.asarray(col.data[:n])
-            vmat = np.asarray(col.data2[:n])
-            counts = np.where(validity, np.asarray(col.lengths[:n]), 0)
-            mask2 = np.arange(kmat.shape[1])[None, :] < counts[:, None]
-            offsets = np.zeros(n + 1, np.int32)
-            np.cumsum(counts, out=offsets[1:])
-            key_t, val_t = f.dtype.children
-            ma = pa.MapArray.from_arrays(
-                pa.array(offsets, pa.int32()),
-                _storage_to_arrow(kmat[mask2], key_t),
-                _storage_to_arrow(vmat[mask2], val_t))
-            if not validity.all():
-                pl = ma.to_pylist()
-                ma = pa.array([v if ok else None
-                               for v, ok in zip(pl, validity)],
-                              type=pa.map_(T.to_arrow(key_t),
-                                           T.to_arrow(val_t)))
-            arrays.append(ma)
-            continue
-        data = np.asarray(col.data[:n])
-        if f.dtype.kind is TypeKind.DECIMAL:
-            import decimal as pydec
-            with pydec.localcontext() as lctx:
-                lctx.prec = 60       # exact: default context rounds at 28
-                if f.dtype.precision > 18:
-                    from .expressions.decimal128 import from_limbs_np
-                    ints = from_limbs_np(data)
-                    vals = [pydec.Decimal(v).scaleb(-f.dtype.scale)
-                            if ok else None
-                            for v, ok in zip(ints, validity)]
-                else:
-                    vals = [pydec.Decimal(int(v)).scaleb(-f.dtype.scale)
-                            if ok else None
-                            for v, ok in zip(data, validity)]
-            arrays.append(pa.array(vals, type=T.to_arrow(f.dtype)))
-            continue
-        if f.dtype.kind is TypeKind.TIMESTAMP:
-            arrays.append(pa.array(data.astype("datetime64[us]"),
-                                   type=T.to_arrow(f.dtype),
-                                   mask=~validity))
-            continue
-        if f.dtype.kind is TypeKind.DATE:
-            arrays.append(pa.array(data.astype("datetime64[D]"),
-                                   type=T.to_arrow(f.dtype), mask=~validity))
-            continue
-        arrays.append(pa.array(data, type=T.to_arrow(f.dtype), mask=~validity))
+    arrays = [_col_to_arrow(col, f.dtype, f.name, n)
+              for col, f in zip(batch.columns, schema)]
     return pa.table(arrays, names=schema.names)
+
+
+def _col_to_arrow(col: DeviceColumn, dtype: SqlType, name: str,
+                  n: int) -> pa.Array:
+    """One device column → one arrow array (recursive for structs)."""
+    validity = np.asarray(col.validity[:n])
+    if dtype.kind is TypeKind.NULL:
+        return pa.nulls(n)
+    if dtype.kind is TypeKind.STRUCT:
+        names = dtype.names or tuple(
+            f"f{i}" for i in range(len(dtype.children)))
+        kids = [_col_to_arrow(c, ct, f"{name}.{nm}", n)
+                for c, ct, nm in zip(col.struct_fields,
+                                     dtype.children, names)]
+        return pa.StructArray.from_arrays(
+            kids, names=list(names),
+            mask=pa.array(~validity) if not validity.all() else None)
+    if dtype.kind is TypeKind.STRING:
+        mat = np.asarray(col.data[:n])
+        lens = np.where(validity, np.asarray(col.lengths[:n]), 0)
+        # vectorized: row-major masked bytes ARE the arrow data buffer
+        mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
+        flat = np.ascontiguousarray(mat)[mask]
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        return pa.StringArray.from_buffers(
+            n, pa.py_buffer(offsets.tobytes()),
+            pa.py_buffer(flat.tobytes()),
+            pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+            if not validity.all() else None)
+    if dtype.kind is TypeKind.ARRAY:
+        mat = np.asarray(col.data[:n])
+        counts = np.where(validity, np.asarray(col.lengths[:n]), 0)
+        if counts.size and int(counts.max()) > mat.shape[1]:
+            raise CapacityError(
+                f"array column '{f.name}' holds a list of "
+                f"{int(counts.max())} elements but the device budget is "
+                f"{mat.shape[1]}; raise max_elems (collect_list/set) or "
+                f"fall back to CPU")
+        mask2 = np.arange(mat.shape[1])[None, :] < counts[:, None]
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(counts, out=offsets[1:])
+        elem_t = T.to_arrow(dtype.children[0])
+        if dtype.children[0].kind is TypeKind.STRING:
+            # 3D byte tensor [n, me, max_len]; per-element byte lengths
+            # ride in data2
+            el_lens = np.asarray(col.data2[:n])
+            live_el = mat[mask2]                     # [k, max_len]
+            live_lens = el_lens[mask2]
+            bmask = np.arange(mat.shape[2])[None, :] < live_lens[:, None]
+            str_offsets = np.zeros(len(live_lens) + 1, np.int32)
+            np.cumsum(live_lens, out=str_offsets[1:])
+            values = pa.StringArray.from_buffers(
+                len(live_lens),
+                pa.py_buffer(str_offsets.tobytes()),
+                pa.py_buffer(np.ascontiguousarray(live_el)[bmask]
+                             .tobytes()))
+        else:
+            values = _storage_to_arrow(mat[mask2],
+                                       dtype.children[0])
+        la = pa.ListArray.from_arrays(pa.array(offsets, pa.int32()),
+                                      values)
+        if not validity.all():
+            # rebuild with a null mask (from_arrays has no mask param
+            # for offsets-based construction)
+            la = pa.ListArray.from_arrays(
+                pa.array(offsets, pa.int32()), values)
+            pl = la.to_pylist()
+            la = pa.array([v if ok else None
+                           for v, ok in zip(pl, validity)],
+                          type=pa.list_(elem_t))
+        return la
+    if dtype.kind is TypeKind.MAP:
+        kmat = np.asarray(col.data[:n])
+        vmat = np.asarray(col.data2[:n])
+        counts = np.where(validity, np.asarray(col.lengths[:n]), 0)
+        mask2 = np.arange(kmat.shape[1])[None, :] < counts[:, None]
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(counts, out=offsets[1:])
+        key_t, val_t = dtype.children
+        ma = pa.MapArray.from_arrays(
+            pa.array(offsets, pa.int32()),
+            _storage_to_arrow(kmat[mask2], key_t),
+            _storage_to_arrow(vmat[mask2], val_t))
+        if not validity.all():
+            pl = ma.to_pylist()
+            ma = pa.array([v if ok else None
+                           for v, ok in zip(pl, validity)],
+                          type=pa.map_(T.to_arrow(key_t),
+                                       T.to_arrow(val_t)))
+        return ma
+    data = np.asarray(col.data[:n])
+    if dtype.kind is TypeKind.DECIMAL:
+        import decimal as pydec
+        with pydec.localcontext() as lctx:
+            lctx.prec = 60       # exact: default context rounds at 28
+            if dtype.precision > 18:
+                from .expressions.decimal128 import from_limbs_np
+                ints = from_limbs_np(data)
+                vals = [pydec.Decimal(v).scaleb(-dtype.scale)
+                        if ok else None
+                        for v, ok in zip(ints, validity)]
+            else:
+                vals = [pydec.Decimal(int(v)).scaleb(-dtype.scale)
+                        if ok else None
+                        for v, ok in zip(data, validity)]
+        return pa.array(vals, type=T.to_arrow(dtype))
+    if dtype.kind is TypeKind.TIMESTAMP:
+        return pa.array(data.astype("datetime64[us]"),
+                        type=T.to_arrow(dtype), mask=~validity)
+    if dtype.kind is TypeKind.DATE:
+        return pa.array(data.astype("datetime64[D]"),
+                        type=T.to_arrow(dtype), mask=~validity)
+    return pa.array(data, type=T.to_arrow(dtype), mask=~validity)
 
 
 def to_pandas(batch: ColumnarBatch, schema: Schema):

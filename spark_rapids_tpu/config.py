@@ -168,6 +168,16 @@ READER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
     "PERFILE, COALESCING, MULTITHREADED or AUTO (reference: "
     "spark.rapids.sql.format.parquet.reader.type).").text("AUTO")
 
+PARQUET_NATIVE_DECODE = conf(
+    "spark.rapids.tpu.sql.format.parquet.nativeDecode.enabled").doc(
+    "Decode parquet column chunks with the native C++ decoder "
+    "(native/src/rtpu_parquet.cpp: thrift footer parse + "
+    "PLAIN/RLE_DICTIONARY page decode, SNAPPY/ZSTD) instead of pyarrow; "
+    "files outside the native subset (nested schemas, INT96, exotic "
+    "codecs) silently fall back per row group (reference: the JNI footer "
+    "parse + libcudf readParquet device path, "
+    "GpuParquetScan.scala:539-597).").boolean(True)
+
 FUSION_ENABLED = conf("spark.rapids.tpu.sql.fusion.enabled").doc(
     "Whole-stage fusion: compile an eligible linear single-batch stage "
     "(scan/filter/project/join/sort/topN/aggregate) into ONE XLA program "

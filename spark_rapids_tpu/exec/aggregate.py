@@ -80,6 +80,10 @@ def _unalias(e: Expression) -> Tuple[AggregateFunction, str]:
 
 
 class HashAggregateExec(UnaryExec):
+    def coalesce_goal_for_child(self, i):
+        from .coalesce import TargetSize
+        return TargetSize()
+
     def __init__(self, group_exprs: Sequence[Expression],
                  agg_exprs: Sequence[Expression], child: Exec,
                  mode: AggregateMode = AggregateMode.COMPLETE,

@@ -91,15 +91,35 @@ class Exec:
         for p in range(self.num_partitions):
             yield from self.execute_partition(p)
 
+    # ---- coalesce-goal contract (GpuCoalesceBatches.scala:156-228) ----
+    def coalesce_goal_for_child(self, i: int):
+        """The batch-size contract this operator declares for child ``i``:
+        None (no requirement), TargetSize (feed me batches near the
+        configured size) or RequireSingleBatch (I need the whole partition
+        in one batch). The planner's transition pass inserts
+        CoalesceBatchesExec to meet declared goals and verifies them."""
+        return None
+
+    @property
+    def produces_single_batch(self) -> bool:
+        """True when every partition of this exec yields at most ONE batch
+        (satisfies RequireSingleBatch without a coalesce)."""
+        return False
+
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         """Iterate one partition, maintaining the op's metrics: batch and
         row counts plus opTime (ns spent INSIDE this operator's iterator,
         including its children — the reference's NS_TIMING convention)."""
+        from ..utils import tracing
         it = self.do_execute_partition(p)
         while True:
             t0 = time.perf_counter_ns()
             try:
-                batch = next(it)
+                # metric-linked profiler range: the slice name in xprof is
+                # the same exec name collect_metrics() reports (the
+                # reference wraps operators in NVTX ranges the same way)
+                with tracing.op_range(self.name):
+                    batch = next(it)
             except StopIteration:
                 self.metrics["opTime"].add(time.perf_counter_ns() - t0)
                 return

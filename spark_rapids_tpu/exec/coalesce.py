@@ -58,6 +58,11 @@ class CoalesceBatchesExec(UnaryExec):
         cap = bucket_capacity(sum(b.capacity for b in pending))
         return concat_batches(pending, cap)
 
+    @property
+    def produces_single_batch(self) -> bool:
+        return isinstance(self.goal, RequireSingleBatch) \
+            or self.child.produces_single_batch
+
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         pending: List[ColumnarBatch] = []
         pending_bytes = 0
@@ -65,6 +70,8 @@ class CoalesceBatchesExec(UnaryExec):
         for batch in self.child.execute_partition(p):
             self.metrics["numInputBatches"].add(1)
             b = batch.size_bytes()
+            # RequireSingleBatch (target is None) never flushes mid-stream:
+            # the whole partition concatenates into one output batch
             if target is not None and pending and (
                     pending_bytes + b > target
                     or sum(p.capacity for p in pending) + batch.capacity
@@ -75,3 +82,24 @@ class CoalesceBatchesExec(UnaryExec):
             pending_bytes += b
         if pending:
             yield self._flush(pending)
+
+
+class CoalesceGoalError(RuntimeError):
+    """A declared coalesce goal is not met by the converted plan."""
+
+
+def verify_coalesce_goals(plan: Exec) -> None:
+    """Planner-side verification (the 'verify' half of the contract): every
+    child position whose parent declares RequireSingleBatch must be served
+    by a single-batch producer (a RequireSingleBatch coalesce, or an exec
+    that guarantees one batch per partition)."""
+    for i, c in enumerate(plan.children):
+        goal = plan.coalesce_goal_for_child(i)
+        if isinstance(goal, RequireSingleBatch) and \
+                not c.produces_single_batch:
+            raise CoalesceGoalError(
+                f"{plan.name} child {i} declares RequireSingleBatch but "
+                f"{c.name} may emit multiple batches")
+        verify_coalesce_goals(c)
+    for extra in getattr(plan, "child_execs", []):
+        verify_coalesce_goals(extra)

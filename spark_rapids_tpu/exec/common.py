@@ -30,6 +30,15 @@ from ..types import SqlType, TypeKind
 # Gather / compact / concat
 # ---------------------------------------------------------------------------
 
+def _and_validity_deep(col: DeviceColumn, mask: jax.Array) -> DeviceColumn:
+    """AND ``mask`` into a column's validity (struct children included, so
+    padded/OOB rows read as null at every nesting level)."""
+    if col.is_struct:
+        kids = tuple(_and_validity_deep(c, mask) for c in col.data)
+        return col.replace(data=kids, validity=col.validity & mask)
+    return col.replace(validity=col.validity & mask)
+
+
 def gather_column(col: DeviceColumn, indices: jax.Array,
                   row_valid: Optional[jax.Array] = None) -> DeviceColumn:
     """Gather rows of ``col`` at ``indices`` (int32[out_cap]).
@@ -38,6 +47,8 @@ def gather_column(col: DeviceColumn, indices: jax.Array,
     outside it become null (the cudf gather-map convention where an OOB index
     yields null — used by outer joins).
     """
+    if col.is_struct:
+        return gather_columns([col], indices, row_valid)[0]
     idx = jnp.clip(indices, 0, col.capacity - 1)
     data = jnp.take(col.data, idx, axis=0)
     validity = jnp.take(col.validity, idx, axis=0)
@@ -81,32 +92,15 @@ def gather_columns(cols: Sequence[DeviceColumn], indices: jax.Array,
         return []
     cap = cols[0].capacity
     idx = jnp.clip(indices, 0, cap - 1)
-    flat: List[jax.Array] = []
-    slots = []      # (col_i, field_name) per flat entry
-    for i, c in enumerate(cols):
-        flat.append(c.data)
-        slots.append((i, "data"))
-        flat.append(c.validity)
-        slots.append((i, "validity"))
-        if c.lengths is not None:
-            flat.append(c.lengths)
-            slots.append((i, "lengths"))
-        if c.data2 is not None:
-            flat.append(c.data2)
-            slots.append((i, "data2"))
-    taken = _batched_takes(flat, idx)
-    parts: List[dict] = [{} for _ in cols]
-    for (i, name), arr in zip(slots, taken):
-        parts[i][name] = arr
-    out = []
-    for i, c in enumerate(cols):
-        validity = parts[i]["validity"]
-        if row_valid is not None:
-            validity = validity & row_valid
-        out.append(DeviceColumn(parts[i]["data"], validity,
-                                parts[i].get("lengths"), c.dtype,
-                                parts[i].get("data2")))
-    return out
+    # every array lane (incl. struct leaf lanes — DeviceColumn is a
+    # pytree and struct children are pytree nodes) flattens into one
+    # batched-take set; unflatten restores the column structure
+    leaves, treedef = jax.tree_util.tree_flatten(list(cols))
+    taken = _batched_takes(leaves, idx)
+    out = jax.tree_util.tree_unflatten(treedef, taken)
+    if row_valid is not None:
+        out = [_and_validity_deep(c, row_valid) for c in out]
+    return list(out)
 
 
 def gather(batch: ColumnarBatch, indices: jax.Array, num_rows: jax.Array,
@@ -145,6 +139,18 @@ def concat_columns(cols: Sequence[DeviceColumn], counts: Sequence[jax.Array],
     piece. Counts are traced, so offsets are traced too.
     """
     first = cols[0]
+    if first.is_struct:
+        kids = tuple(
+            concat_columns([c.data[j] for c in cols], counts, capacity)
+            for j in range(len(first.data)))
+        validity = jnp.zeros(capacity, bool)
+        offset = jnp.asarray(0, jnp.int32)
+        for col, n in zip(cols, counts):
+            src = jnp.arange(col.capacity, dtype=jnp.int32)
+            dest = jnp.where(src < n, src + offset, capacity)
+            validity = validity.at[dest].set(col.validity, mode="drop")
+            offset = offset + jnp.asarray(n, jnp.int32)
+        return DeviceColumn(kids, validity, None, first.dtype)
     is_var = first.lengths is not None     # strings / arrays / maps
     if first.data.ndim > 1:
         data = jnp.zeros((capacity,) + first.data.shape[1:],
@@ -213,6 +219,9 @@ def orderable_words(col: DeviceColumn) -> List[jax.Array]:
     the column's SQL ascending order. Strings produce several word operands."""
     d = col.dtype
     k = d.kind
+    if k is TypeKind.STRUCT:
+        raise TypeError("struct sort/partition keys have no device order "
+                        "(planner tags them for CPU fallback)")
     if k is TypeKind.STRING:
         # big-endian packed padded bytes: byte-wise lexicographic == uint64
         # word-wise lexicographic; zero padding sorts shorter strings first,

@@ -76,6 +76,8 @@ def _keys_equal(a: List[DeviceColumn], b: List[DeviceColumn]) -> jnp.ndarray:
     for x, y in zip(a, b):
         if x.lengths is not None:
             e = jnp.all(x.data == y.data, axis=1) & (x.lengths == y.lengths)
+        elif x.data.ndim > 1:      # decimal128 limb matrices
+            e = jnp.all(x.data == y.data, axis=1)
         else:
             e = x.data == y.data
         e = e & x.validity & y.validity
@@ -103,6 +105,13 @@ class HashJoinExec(BinaryExec):
     """Equi-join; left child streams, right child builds (the planner swaps
     children to put the smaller side on the right, like the reference's
     build-side selection in GpuShuffledHashJoinExec)."""
+
+    def coalesce_goal_for_child(self, i):
+        # stream side wants sized batches; the build side is concatenated
+        # whole (RequireSingleBatch — reference: GpuShuffledHashJoinExec
+        # build-side single-batch contract)
+        from .coalesce import RequireSingleBatch, TargetSize
+        return TargetSize() if i == 0 else RequireSingleBatch()
 
     def __init__(self, left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression], join_type: JoinType,
@@ -743,6 +752,13 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
     """Cross / conditional nested-loop join (reference:
     GpuBroadcastNestedLoopJoinExec). Tiles the build side so each expansion
     stays inside a bounded capacity."""
+
+    def coalesce_goal_for_child(self, i):
+        # stream side wants sized batches; the build side is concatenated
+        # whole (RequireSingleBatch — reference: GpuShuffledHashJoinExec
+        # build-side single-batch contract)
+        from .coalesce import RequireSingleBatch, TargetSize
+        return TargetSize() if i == 0 else RequireSingleBatch()
 
     def __init__(self, join_type: JoinType, left: Exec, right: Exec,
                  condition: Optional[Expression] = None,

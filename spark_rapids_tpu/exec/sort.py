@@ -72,6 +72,14 @@ def sort_batch(batch: ColumnarBatch, orders: Sequence[SortOrder],
 
 
 class SortExec(UnaryExec):
+    def coalesce_goal_for_child(self, i):
+        from .coalesce import TargetSize
+        return TargetSize()
+
+    @property
+    def produces_single_batch(self):
+        return self.global_sort
+
     def __init__(self, orders: Sequence[SortOrder], child: Exec,
                  global_sort: bool = True, ctx: Optional[EvalContext] = None,
                  max_rows: int = 1 << 22):
@@ -138,6 +146,14 @@ class SortExec(UnaryExec):
 class TakeOrderedAndProjectExec(UnaryExec):
     """TopN: per-batch sort+limit, tournament across batches, final project
     (reference: GpuTakeOrderedAndProjectExec, GpuOverrides.scala:3735)."""
+
+    def coalesce_goal_for_child(self, i):
+        from .coalesce import TargetSize
+        return TargetSize()
+
+    @property
+    def produces_single_batch(self):
+        return True
 
     def __init__(self, limit: int, orders: Sequence[SortOrder],
                  project: Optional[Sequence[Expression]], child: Exec,

@@ -41,6 +41,10 @@ class WindowExec(UnaryExec):
     multi-spec projections into a chain of WindowExecs, like the reference's
     GpuWindowExec partitioning of window ops)."""
 
+    def coalesce_goal_for_child(self, i):
+        from .coalesce import TargetSize
+        return TargetSize()
+
     def __init__(self, window_exprs: Sequence[Expression], child: Exec,
                  ctx: Optional[EvalContext] = None):
         super().__init__(child, ctx)

@@ -203,8 +203,12 @@ class Expression:
         return IsNotNull(self)
 
     def astuple(self):
-        return tuple(getattr(self, f.name) for f in
-                     self.__dataclass_fields__.values())  # type: ignore
+        """Constructor arguments in positional order (used by the wire
+        codec). ``dataclasses.fields`` — NOT ``__dataclass_fields__``,
+        which also lists ClassVar pseudo-fields like ``_registry``."""
+        import dataclasses
+        return tuple(getattr(self, f.name)
+                     for f in dataclasses.fields(self))
 
 
 def lit_if_needed(v: Any) -> Expression:
@@ -355,6 +359,16 @@ def _infer_literal_type(v: Any) -> SqlType:
         return T.FLOAT64
     if isinstance(v, str):
         return T.string(max(8, len(v.encode("utf-8"))))
+    import decimal as pydec
+    if isinstance(v, pydec.Decimal):
+        # Spark Literal decimal typing: scale = fraction digits,
+        # precision = all digits (integer part widened by the exponent)
+        _, digits, exp = v.as_tuple()
+        if not isinstance(exp, int):
+            raise TypeError(f"cannot type non-finite decimal {v!r}")
+        scale = max(-exp, 0)
+        precision = max(len(digits) + max(exp, 0), scale + 1)
+        return T.decimal(min(precision, 38), scale)
     if isinstance(v, dt.datetime):
         return T.TIMESTAMP
     if isinstance(v, dt.date):

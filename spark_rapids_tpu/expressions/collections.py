@@ -350,14 +350,16 @@ class ArrayMax(_MinMaxArray):
 
 
 # ---------------------------------------------------------------------------
-# Struct create/extract (fold-at-bind; structs never materialize on device)
+# Struct create/extract (structs materialize as per-leaf lane sets —
+# DeviceColumn struct layout in batch.py; reference carries structs through
+# every operator via GpuColumnVector.java)
 # ---------------------------------------------------------------------------
 
 @dataclass(frozen=True, eq=False)
 class CreateStruct(Expression):
-    """named_struct(...) — only consumable by GetStructField, which folds
-    the pair away at bind time; a struct that would need device STORAGE
-    (materialized output) is unsupported → CPU fallback."""
+    """named_struct(...) — evaluates to a struct DeviceColumn whose children
+    are the element columns (one lane-set per leaf). GetStructField over a
+    CreateStruct still folds away at bind time."""
 
     elems: Tuple[Expression, ...] = ()
     names: Tuple[str, ...] = ()
@@ -371,12 +373,17 @@ class CreateStruct(Expression):
 
     @property
     def dtype(self):
-        return T.struct(*(e.dtype for e in self.elems))
+        names = self.names or tuple(f"col{i + 1}"
+                                    for i in range(len(self.elems)))
+        return T.struct(*(e.dtype for e in self.elems), names=names)
+
+    @property
+    def nullable(self):
+        return False      # Spark CreateNamedStruct is never null itself
 
     def eval(self, batch, ctx=EvalContext()):
-        raise CollectionUnsupported(
-            "struct values have no device storage; only field extraction "
-            "is supported (folds at bind time)")
+        kids = tuple(e.eval(batch, ctx) for e in self.elems)
+        return DeviceColumn(kids, batch.row_mask(), None, self.dtype)
 
 
 @dataclass(frozen=True, eq=False)
@@ -420,8 +427,11 @@ class GetStructField(Expression):
         return self.child.dtype.children[self.ordinal]
 
     def eval(self, batch, ctx=EvalContext()):
-        raise CollectionUnsupported(
-            "struct columns have no device storage (CPU fallback)")
+        s = self.child.eval(batch, ctx)
+        f = s.struct_fields[self.ordinal]
+        # a field of a null struct is null (child validity already carries
+        # this for stored columns; AND again for computed structs)
+        return f.with_validity(f.validity & s.validity)
 
 
 # ---------------------------------------------------------------------------

@@ -187,6 +187,68 @@ def _hash_string(col: DeviceColumn, seed):
     return _fmix(h1, lengths.astype(jnp.uint32))
 
 
+_BITLEN_TABLE = None
+
+
+def _hash_dec128(col: DeviceColumn, seed) -> jnp.ndarray:
+    """Spark murmur3 of DECIMAL128: precision > 18 hashes the MINIMAL
+    big-endian two's-complement byte array of the unscaled value
+    (HashExpression: BigInteger.toByteArray → hashUnsafeBytes), so the
+    byte count is data-dependent (1..16). Vectorized over the 4×32-bit
+    limb lanes: build the 16 BE bytes, derive the minimal length from the
+    bit length of v (or ~v when negative), shift the live bytes to the
+    front, then run the 4-word + tail-byte mix predicated per row.
+
+    Reference parity: spark-rapids-jni murmur3 decimal128 kernel
+    (SURVEY §2.9 DecimalUtils); oracle = utils/murmur3.hash_decimal.
+    """
+    global _BITLEN_TABLE
+    if _BITLEN_TABLE is None:
+        _BITLEN_TABLE = jnp.asarray([x.bit_length() for x in range(256)],
+                                    jnp.int32)
+    limbs = col.data                       # int64[cap, 4], l0 least sig.
+    neg = ((limbs[:, 3] >> 31) & 1) == 1
+    # ~v (128-bit) == per-limb xor 0xFFFFFFFF; bit length of max(v, ~v)
+    # gives Java BigInteger.bitLength()
+    w = jnp.where(neg[:, None], limbs ^ jnp.int64(0xFFFFFFFF), limbs)
+
+    def be_bytes(lanes):
+        cols = []
+        for j in range(16):            # j = 0 is the most significant byte
+            li, sh = (15 - j) // 4, 8 * ((15 - j) % 4)
+            cols.append(((lanes[:, li] >> sh) &
+                         jnp.int64(0xFF)).astype(jnp.int32))
+        return jnp.stack(cols, axis=1)       # int32[cap, 16] in [0, 255]
+
+    wb = be_bytes(w)
+    nz = wb != 0
+    any_nz = jnp.any(nz, axis=1)
+    j0 = jnp.argmax(nz, axis=1)              # first significant byte
+    msb = jnp.take_along_axis(wb, j0[:, None], axis=1)[:, 0]
+    msb_bits = jnp.take(_BITLEN_TABLE, msb)
+    s = jnp.where(any_nz, (15 - j0) * 8 + msb_bits, 0)   # bitLength()
+    n = s // 8 + 1                           # toByteArray length, 1..16
+    vb = be_bytes(limbs)
+    idx = (16 - n)[:, None] + jnp.arange(16, dtype=n.dtype)[None, :]
+    seq = jnp.take_along_axis(vb, jnp.clip(idx, 0, 15), axis=1)
+    h1 = seed
+    nwords = n // 4
+    useq = seq.astype(jnp.uint32)
+    for wd in range(4):
+        k = (useq[:, 4 * wd]
+             | (useq[:, 4 * wd + 1] << 8)
+             | (useq[:, 4 * wd + 2] << 16)
+             | (useq[:, 4 * wd + 3] << 24))
+        h1 = jnp.where(wd < nwords, _mix_h1(h1, k), h1)
+    for i in range(16):
+        b = seq[:, i]
+        sb = jnp.where(b > 127, b - 256, b).astype(jnp.int32) \
+                .view(jnp.uint32)
+        in_tail = (i >= nwords * 4) & (i < n)
+        h1 = jnp.where(in_tail, _mix_h1(h1, sb), h1)
+    return _fmix(h1, n.astype(jnp.uint32))
+
+
 def hash_column(col: DeviceColumn, seed) -> jnp.ndarray:
     """Hash one column with the running per-row seed; nulls pass seed through."""
     k = col.dtype.kind
@@ -206,8 +268,11 @@ def hash_column(col: DeviceColumn, seed) -> jnp.ndarray:
     elif k is TypeKind.BOOLEAN:
         h = hash_int(col.data.astype(jnp.int32), seed)
     elif k is TypeKind.DECIMAL:
-        # Spark hashes small decimals as their unscaled long
-        h = hash_long(col.data, seed)
+        if col.dtype.precision > 18:
+            h = _hash_dec128(col, seed)
+        else:
+            # Spark hashes small decimals as their unscaled long
+            h = hash_long(col.data, seed)
     else:  # int8/16/32, date
         h = hash_int(col.data.astype(jnp.int32), seed)
     return jnp.where(col.validity, h, seed)

@@ -34,7 +34,16 @@ _POOL_LOCK = threading.Lock()
 def bounded_map(pool, items, fn, window: int):
     """Submit ``fn(item)`` over the pool keeping at most ``window`` tasks
     outstanding; yields (item, result) in input order — decoded output
-    stays bounded on many-file scans."""
+    stays bounded on many-file scans.
+
+    Single-core hosts run inline: a thread pool cannot overlap anything
+    there, and futures + GIL handoff measurably tax the decode hot loop
+    (the reference sizes its multi-file pool to the executor's cores the
+    same way)."""
+    if window <= 1 or (os.cpu_count() or 1) <= 1:
+        for item in items:
+            yield item, fn(item)
+        return
     from collections import deque
     pending = deque()
     it = iter(items)

@@ -150,14 +150,10 @@ class BufferCatalog:
         return freed
 
     def _spill_to_host(self, e: _Entry) -> None:
+        from ..shuffle.serializer import _col_to_arrays
         host = {}
         for i, c in enumerate(e.batch.columns):
-            host[f"d{i}"] = np.asarray(jax.device_get(c.data))
-            host[f"v{i}"] = np.asarray(jax.device_get(c.validity))
-            if c.lengths is not None:
-                host[f"l{i}"] = np.asarray(jax.device_get(c.lengths))
-            if c.data2 is not None:     # map values / string-array lengths
-                host[f"m{i}"] = np.asarray(jax.device_get(c.data2))
+            _col_to_arrays(c, str(i), host)   # struct leaves recurse
         host["n"] = np.asarray(jax.device_get(e.batch.num_rows))
         # ONE contiguous allocation per spilled batch (reference:
         # contiguous-split packed tables / MetaUtils TableMeta) — the
@@ -222,16 +218,10 @@ class BufferCatalog:
 
     def _host_to_device(self, e: _Entry) -> ColumnarBatch:
         import jax.numpy as jnp
+        from ..shuffle.serializer import _col_from_arrays
         host = e.host.arrays()      # zero-copy views into ONE buffer
-        cols = []
-        for i, f in enumerate(e.schema):
-            lengths = jnp.asarray(host[f"l{i}"]) if f"l{i}" in host \
-                else None
-            data2 = jnp.asarray(host[f"m{i}"]) if f"m{i}" in host \
-                else None
-            cols.append(DeviceColumn(jnp.asarray(host[f"d{i}"]),
-                                     jnp.asarray(host[f"v{i}"]),
-                                     lengths, f.dtype, data2))
+        cols = [_col_from_arrays(f.dtype, str(i), host)
+                for i, f in enumerate(e.schema)]
         return ColumnarBatch(tuple(cols),
                              jnp.asarray(host["n"], jnp.int32))
 

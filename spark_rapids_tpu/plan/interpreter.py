@@ -185,6 +185,11 @@ class RowEvaluator:
             return (0, v.encode("utf-8"))
         if isinstance(v, bytes):
             return (0, v)
+        if isinstance(v, dict):    # struct rows: field-wise (Spark struct
+            # equality/grouping); tuple form is hashable + orderable
+            return (0, tuple(RowEvaluator._ordkey(x) for x in v.values()))
+        if isinstance(v, (list, tuple)):
+            return (0, tuple(RowEvaluator._ordkey(x) for x in v))
         return (0, v)
 
     def _eval_EqualTo(self, e, row):
@@ -1223,6 +1228,11 @@ class RowEvaluator:
             return None
         vals = [x for x in a if x is not None]
         return max(vals) if vals else None
+
+    def _eval_CreateStruct(self, e, row):
+        names = e.names or tuple(f"col{i + 1}"
+                                 for i in range(len(e.elems)))
+        return {n: self.eval(x, row) for n, x in zip(names, e.elems)}
 
     def _eval_GetStructField(self, e, row):
         from ..expressions.collections import CreateStruct

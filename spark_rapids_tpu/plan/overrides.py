@@ -57,6 +57,9 @@ class ExprRule:
     sig: TypeSig
     incompat: bool = False
     note: str = ""
+    #: per-argument signatures (TypeChecks.scala per-param TypeSig algebra);
+    #: None falls back to checking every child against ``sig``
+    params: Optional[TS.Params] = None
 
     @property
     def conf_key(self) -> str:
@@ -66,12 +69,12 @@ class ExprRule:
 def _expr_rules() -> Dict[str, ExprRule]:
     rules = {}
 
-    def r(name, sig, incompat=False, note=""):
-        rules[name] = ExprRule(name, sig, incompat, note)
+    def r(name, sig, incompat=False, note="", params=None):
+        rules[name] = ExprRule(name, sig, incompat, note, params)
 
     # passthroughs admit every type that has a device layout
     for n in ("BoundReference", "UnresolvedColumn", "Literal", "Alias"):
-        r(n, TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP)
+        r(n, TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP + TS.STRUCT)
     for n in ("Add", "Subtract", "Multiply", "UnaryMinus", "Abs"):
         r(n, TS.NUMERIC)
     for n in ("Divide", "IntegralDivide", "Remainder", "Pmod"):
@@ -86,9 +89,13 @@ def _expr_rules() -> Dict[str, ExprRule]:
         r(n, TS.BOOLEAN + TS.ALL_BASIC)
     # validity-only kernels are type-agnostic: every device layout passes
     for n in ("IsNull", "IsNotNull"):
-        r(n, TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP)
+        r(n, TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP + TS.STRUCT)
     r("IsNaN", TS.ALL_BASIC)
-    for n in ("If", "CaseWhen", "Coalesce", "LeastGreatest"):
+    r("If", TS.ALL_BASIC,
+      params=TS.params(TS.p("predicate", TS.BOOLEAN),
+                       TS.p("trueValue", TS.ALL_BASIC),
+                       TS.p("falseValue", TS.ALL_BASIC)))
+    for n in ("CaseWhen", "Coalesce", "LeastGreatest"):
         r(n, TS.ALL_BASIC)
     r("Cast", TS.ALL_BASIC)
     # float transcendentals differ from JVM StrictMath in ULPs: incompat,
@@ -106,17 +113,49 @@ def _expr_rules() -> Dict[str, ExprRule]:
                "(Latin, Greek, Cyrillic, Georgian, Cherokee, full-width); "
                "length-changing (ß→SS) and locale-special mappings pass "
                "through")
-    for n in ("Length", "Substring", "Concat",
-              "StringPredicate", "StringLocate", "StringTrim", "StringPad",
-              "StringRepeat", "StringReplace", "Translate", "InitCap",
-              "FormatNumber", "Reverse", "Ascii", "Chr", "OctetLength",
+    for n in ("Length", "Concat",
+              "StringPredicate", "StringTrim", "InitCap",
+              "Reverse", "Ascii", "OctetLength",
               "Levenshtein", "Soundex"):
         r(n, TS.ALL_BASIC)
+    # per-parameter signatures (TypeChecks.scala per-param algebra): each
+    # argument position declares its own admitted types and literal-ness
+    r("Substring", TS.ALL_BASIC,
+      params=TS.params(TS.p("str", TS.STRING), TS.p("pos", TS.INTEGRAL),
+                       TS.p("len", TS.INTEGRAL)))
+    r("StringLocate", TS.ALL_BASIC,
+      params=TS.params(TS.p("str", TS.STRING), TS.p("substr", TS.STRING),
+                       repeat=TS.p("start", TS.INTEGRAL)))
+    r("StringPad", TS.ALL_BASIC,
+      params=TS.params(TS.p("str", TS.STRING), TS.p("len", TS.INTEGRAL),
+                       TS.p("pad", TS.STRING, lit=True)))
+    r("StringRepeat", TS.ALL_BASIC,
+      params=TS.params(TS.p("str", TS.STRING),
+                       TS.p("repeatTimes", TS.INTEGRAL)))
+    r("StringReplace", TS.ALL_BASIC,
+      params=TS.params(TS.p("src", TS.STRING),
+                       TS.p("search", TS.STRING, lit=True),
+                       TS.p("replace", TS.STRING, lit=True)))
+    # Translate/FormatNumber carry from/to/d as STATIC fields in this
+    # dialect (non-literal forms are unrepresentable), so only the data
+    # argument is a checked child
+    r("Translate", TS.ALL_BASIC,
+      params=TS.params(TS.p("input", TS.STRING)))
+    r("FormatNumber", TS.ALL_BASIC,
+      params=TS.params(TS.p("x", TS.NUMERIC)))
+    r("Chr", TS.ALL_BASIC,
+      params=TS.params(TS.p("input", TS.INTEGRAL)))
     # datetime
-    for n in ("ExtractDatePart", "DateAddSub", "DateDiff", "AddMonths",
+    for n in ("ExtractDatePart", "DateDiff",
               "LastDay", "UnixTimestampConv", "DateFormat", "FromUnixtime",
               "TruncDateTime", "MonthsBetween", "NextDay"):
         r(n, TS.DATETIME + TS.INTEGRAL)
+    r("DateAddSub", TS.DATETIME + TS.INTEGRAL,
+      params=TS.params(TS.p("startDate", TS.DATETIME),
+                       TS.p("days", TS.INTEGRAL)))
+    r("AddMonths", TS.DATETIME + TS.INTEGRAL,
+      params=TS.params(TS.p("startDate", TS.DATETIME),
+                       TS.p("numMonths", TS.INTEGRAL)))
     # parses STRING input (to_date/to_timestamp/unix_timestamp)
     r("ParseDateTime", TS.STRING)
     r("InterleaveBits", TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
@@ -151,22 +190,41 @@ def _expr_rules() -> Dict[str, ExprRule]:
     # collections + HOFs (reference: collectionOperations.scala,
     # higherOrderFunctions.scala; device layout = fixed-budget matrices)
     r("Size", TS.ALL_BASIC + TS.ARRAY + TS.MAP)
-    for n in ("CreateArray", "ArrayContains", "ElementAt",
-              "GetArrayItem", "SortArray", "ArrayMin", "ArrayMax",
-              "CreateStruct", "GetStructField", "LambdaVariable",
+    for n in ("CreateArray", "ArrayContains",
+              "SortArray", "ArrayMin", "ArrayMax",
+              "LambdaVariable",
               "TransformArray", "FilterArray", "ExistsArray", "ForallArray",
               "AggregateArray"):
         r(n, TS.ALL_BASIC + TS.ARRAY)
+    r("ElementAt", TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+      params=TS.params(TS.p("collection", TS.ARRAY + TS.MAP),
+                       TS.p("key", TS.ALL_BASIC)))
+    r("GetArrayItem", TS.ALL_BASIC + TS.ARRAY,
+      params=TS.params(TS.p("array", TS.ARRAY),
+                       TS.p("ordinal", TS.INTEGRAL)))
+    # structs materialize as per-leaf lane sets (batch.py struct layout)
+    for n in ("CreateStruct", "GetStructField"):
+        r(n, TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT
+          + TS.DECIMAL_128)
     # maps: zipped fixed-budget key/value matrices
-    for n in ("MapKeys", "MapValues", "GetMapValue", "MapContainsKey",
+    for n in ("MapKeys", "MapValues", "MapContainsKey",
               "MapFromArrays"):
         r(n, TS.ALL_BASIC + TS.ARRAY + TS.MAP)
+    r("GetMapValue", TS.ALL_BASIC + TS.MAP,
+      params=TS.params(TS.p("map", TS.MAP), TS.p("key", TS.ALL_BASIC)))
     # round-3 breadth (VERDICT r2 Missing #3)
-    r("Shift", TS.INTEGRAL)
+    r("Shift", TS.INTEGRAL,
+      params=TS.params(TS.p("value", TS.INTEGRAL),
+                       TS.p("amount", TS.INTEGRAL)))
     r("XxHash64", TS.ALL_BASIC)
-    r("ConcatWs", TS.STRING, note="literal separator")
+    r("ConcatWs", TS.STRING, note="literal separator",
+      params=TS.params(TS.p("sep", TS.STRING, lit=True),
+                       repeat=TS.p("str", TS.STRING)))
     r("SubstringIndex", TS.STRING + TS.INTEGRAL,
-      note="literal delimiter and count")
+      note="literal delimiter and count",
+      params=TS.params(TS.p("str", TS.STRING),
+                       TS.p("delim", TS.STRING, lit=True),
+                       TS.p("count", TS.INTEGRAL, lit=True)))
     r("Hex", TS.INTEGRAL + TS.STRING)
     r("Bin", TS.INTEGRAL)
     r("Conv", TS.STRING + TS.INTEGRAL, note="literal bases 2..36")
@@ -184,7 +242,6 @@ def _expr_rules() -> Dict[str, ExprRule]:
       note="lowers to repeated get_json_object path extraction (the "
            "reference device impl does the same)")
     r("PivotFirst", TS.NUMERIC + TS.DATETIME + TS.BOOLEAN)
-    r("Logarithm", TS.NUMERIC)
     r("NaNvl", TS.FP)
     r("Rand", TS.NUMERIC, incompat=True,
       note="counter-based threefry sequence, not Spark's XorShiftRandom; "
@@ -196,9 +253,12 @@ def _expr_rules() -> Dict[str, ExprRule]:
       note="literal single-byte delimiters; NULL map values render as "
            "empty strings through map_values (no per-element validity)")
     r("ArrayRepeat", TS.ALL_BASIC + TS.ARRAY,
-      note="literal count (static element budget)")
+      note="literal count (static element budget)",
+      params=TS.params(TS.p("value", TS.ALL_BASIC),
+                       TS.p("count", TS.INTEGRAL, lit=True)))
     r("Sequence", TS.INTEGRAL + TS.ARRAY,
-      note="rows beyond the element budget fail loud (CAPACITY_sequence)")
+      note="rows beyond the element budget fail loud (CAPACITY_sequence)",
+      params=TS.params(repeat=TS.p("bound", TS.INTEGRAL)))
     r("Flatten", TS.ARRAY,
       note="flatten(array(...)) only; nested-array columns fall back")
     for n in ("TransformKeys", "TransformValues", "MapFilter"):
@@ -206,7 +266,11 @@ def _expr_rules() -> Dict[str, ExprRule]:
     r("ZipWith", TS.ALL_BASIC + TS.ARRAY,
       note="body must be provably non-null over the shorter side's padding")
     r("GetJsonObject", TS.STRING,
-      note="literal $.a.b[i] paths; \\uXXXX escapes null the row")
+      note="literal $.a.b[i] paths; \\uXXXX escapes null the row",
+      params=TS.params(TS.p("json", TS.STRING),
+                       TS.p("path", TS.STRING, lit=True)))
+    r("Logarithm", TS.NUMERIC,
+      params=TS.params(TS.p("base", TS.NUMERIC), TS.p("x", TS.NUMERIC)))
     r("JsonToStructs", TS.STRING + TS.ALL_BASIC,
       note="device via field-projection rewrite to get_json_object")
     return rules
@@ -316,7 +380,6 @@ class PlanMeta:
             else:
                 keys = list(n.left_keys) + list(n.right_keys)
             schemas = [c.schema() for c in n.children]
-            hash_routed = isinstance(n, (L.LogicalJoin, L.LogicalAggregate))
             for k in keys:
                 for sch in schemas:
                     try:
@@ -328,13 +391,9 @@ class PlanMeta:
                         self.will_not_work(
                             f"{kd} cannot be a sort/join key on device "
                             f"(no scalar ordering/hash encoding)")
-                    elif hash_routed and kd.kind is TypeKind.DECIMAL and \
-                            kd.precision > 18:
-                        # dec128 sorts (limb order keys) but has no
-                        # murmur3/hash-exchange encoding yet
-                        self.will_not_work(
-                            f"{kd} join/group keys need a 128-bit hash "
-                            f"path; only dec128 VALUES run on device")
+                    # dec128 keys: limb order keys sort/group them and the
+                    # 128-bit murmur3 path (expressions/hashing.py
+                    # _hash_dec128) routes hash exchanges — no gate needed
                     break
         if isinstance(n, L.LogicalGenerate):
             from ..types import TypeKind
@@ -426,17 +485,26 @@ class PlanMeta:
         # arithmetic/hash over DECIMAL128 limbs
         rule = EXPR_RULES.get(name)
         if rule is not None:
-            for c in e.children:
+            for i, c in enumerate(e.children):
                 try:
                     cd = c.dtype
                 except Exception:
                     continue
-                r = rule.sig.supports(cd)
+                ps = rule.params.sig_for(i) if rule.params else None
+                if ps is not None:
+                    r = ps.check(c, cd)
+                else:
+                    r = rule.sig.supports(cd)
                 if r:
                     self.will_not_work(f"{name} input: {r}")
         child = e.children[0] if e.children else None
         if child is not None:
-            kind = child.dtype.kind
+            try:
+                kind = child.dtype.kind
+            except Exception:
+                # mistyped trees (e.g. element_at over a scalar) raise in
+                # dtype; the per-param gate above already recorded why
+                kind = None
             # sum over decimal widens to min(p+10, 38); DECIMAL128 limb
             # storage (expressions/decimal128.py) covers the whole range
             if name == "Average" and kind is TypeKind.DECIMAL:
@@ -491,17 +559,24 @@ def _walk(meta: PlanMeta):
 
 
 EXEC_SIGS: Dict[str, TypeSig] = {
-    "Scan": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
-    "Project": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
-    "Filter": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    # structs ride scan/project/filter/join/sort/exchange as stored
+    # columns and payload (keys stay gated — no scalar order/hash);
+    # reference parity: GpuColumnVector.java struct paths
+    "Scan": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT + TS.DECIMAL_128,
+    "Project": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT
+               + TS.DECIMAL_128,
+    "Filter": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT
+              + TS.DECIMAL_128,
     "Aggregate": TS.GROUPABLE + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
-    "Join": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
-    "Sort": TS.ORDERABLE + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
-    "Limit": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
-    "Union": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.DECIMAL_128,
+    "Join": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT + TS.DECIMAL_128,
+    "Sort": TS.ORDERABLE + TS.ARRAY + TS.MAP + TS.STRUCT + TS.DECIMAL_128,
+    "Limit": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT
+             + TS.DECIMAL_128,
+    "Union": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT
+             + TS.DECIMAL_128,
     "Range": TS.ALL_BASIC,
-    "Expand": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
-    "Sample": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
+    "Expand": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT,
+    "Sample": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT,
     "Window": TS.ALL_BASIC,
     "Generate": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
 }
@@ -579,13 +654,13 @@ def insert_coalesce_transitions(plan: Exec, target_bytes: int) -> Exec:
     CoalesceBatchesExec wherever the consumer declares a coalesce goal
     (GpuCoalesceBatches.scala:156-228 TargetSize semantics), so filters and
     joins emitting many small batches cannot starve the MXU downstream."""
-    from ..exec.coalesce import CoalesceBatchesExec, TargetSize
-    from ..exec.sort import SortExec, TakeOrderedAndProjectExec
-    from ..exec.window import WindowExec
+    from ..exec.coalesce import (CoalesceBatchesExec, RequireSingleBatch,
+                                 TargetSize, verify_coalesce_goals)
 
+    # producers that can fragment a partition into many small batches;
+    # TargetSize goals only insert a coalesce above these (wrapping a
+    # single-batch producer would be a pass-through iterator)
     fragmenting = (FilterExec, HashJoinExec, BroadcastNestedLoopJoinExec)
-    wants_target = (HashAggregateExec, SortExec, TakeOrderedAndProjectExec,
-                    WindowExec, HashJoinExec, BroadcastNestedLoopJoinExec)
 
     def rewrite(node: Exec) -> Exec:
         if isinstance(node, CpuFallbackExec):
@@ -594,17 +669,22 @@ def insert_coalesce_transitions(plan: Exec, target_bytes: int) -> Exec:
         new_children = []
         for i, c in enumerate(node.children):
             c = rewrite(c)
-            is_build_side = isinstance(
-                node, (HashJoinExec, BroadcastNestedLoopJoinExec)) and i == 1
-            if isinstance(node, wants_target) and \
-                    isinstance(c, fragmenting) and not is_build_side:
-                # build sides are concatenated whole by the join itself
+            # declaration-driven (each exec states its CoalesceGoal —
+            # the reference's GpuCoalesceBatches goal contract)
+            goal = node.coalesce_goal_for_child(i)
+            if isinstance(goal, RequireSingleBatch) and \
+                    not c.produces_single_batch:
+                c = CoalesceBatchesExec(c, goal)
+            elif isinstance(goal, TargetSize) and \
+                    isinstance(c, fragmenting):
                 c = CoalesceBatchesExec(c, TargetSize(target_bytes))
             new_children.append(c)
         node.children = tuple(new_children)
         return node
 
-    return rewrite(plan)
+    out = rewrite(plan)
+    verify_coalesce_goals(out)   # the contract's 'verify' half
+    return out
 
 
 def estimate_bytes(node: L.LogicalPlan) -> Optional[int]:

@@ -61,6 +61,53 @@ class TypeSig:
         return None
 
 
+@dataclass(frozen=True)
+class ParamSig:
+    """One argument position's contract: admitted types + whether the
+    argument must be a foldable literal (reference: TypeChecks.scala's
+    per-param ``TypeSig`` + ``lit()`` markers driving both fallback and
+    the generated supported_ops docs)."""
+
+    name: str
+    sig: "TypeSig"
+    lit_required: bool = False
+
+    def check(self, expr, dtype) -> Optional[str]:
+        from ..expressions.base import Literal
+        if self.lit_required and not isinstance(expr, Literal):
+            return f"parameter '{self.name}' must be a literal"
+        r = self.sig.supports(dtype)
+        if r:
+            return f"parameter '{self.name}': {r}"
+        return None
+
+
+@dataclass(frozen=True)
+class Params:
+    """Positional parameter signatures for an expression rule.
+
+    ``fixed`` covers the leading arguments; when an expression has more
+    children than fixed entries, ``repeat`` (if set) covers the rest —
+    the varargs tail (Coalesce, CaseWhen branches, ConcatWs...).
+    """
+
+    fixed: tuple = ()
+    repeat: Optional[ParamSig] = None
+
+    def sig_for(self, i: int) -> Optional[ParamSig]:
+        if i < len(self.fixed):
+            return self.fixed[i]
+        return self.repeat
+
+
+def params(*fixed, repeat: Optional[ParamSig] = None) -> Params:
+    return Params(tuple(fixed), repeat)
+
+
+def p(name: str, sig: "TypeSig", lit: bool = False) -> ParamSig:
+    return ParamSig(name, sig, lit)
+
+
 def _sig(*kinds: TypeKind) -> TypeSig:
     return TypeSig(frozenset(kinds))
 
@@ -78,6 +125,10 @@ ORDERABLE = ALL_BASIC       # everything basic sorts via key normalization
 GROUPABLE = ALL_BASIC
 ARRAY = _sig(TypeKind.ARRAY)          # fixed-budget scalar-element arrays
 MAP = _sig(TypeKind.MAP)              # zipped key/value fixed-budget arrays
+# structs store as one lane-set per leaf field + a struct validity lane
+# (batch.py DeviceColumn struct layout); children may be anything storable,
+# including nested structs
+STRUCT = _sig(TypeKind.STRUCT)
 # DECIMAL128: 4×32-bit limb storage (expressions/decimal128.py). Adding
 # this sig raises a rule's decimal ceiling from DECIMAL64 to 38 digits.
 DECIMAL_128 = TypeSig(frozenset({TypeKind.DECIMAL}),

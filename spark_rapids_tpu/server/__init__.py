@@ -1,0 +1,19 @@
+"""Network protocol front-end: accept plans from an EXTERNAL driver.
+
+The reference is a *plugin* — physical plans arrive from a separate Spark
+driver process (reference: sql-plugin/.../Plugin.scala:44-51 installing
+GpuOverrides as preColumnarTransitions; com.nvidia.spark.SQLPlugin). This
+package is that integration seam re-shaped for the standalone TPU engine: a
+driver process serializes its logical plan to the wire dialect
+(``plandoc``), ships referenced tables as Arrow IPC streams, and the plan
+server runs planning (tagging/fallback/explain) + execution server-side,
+streaming Arrow results back.
+
+Run a server:  ``python -m spark_rapids_tpu.server --port 9099``
+Connect:       ``PlanClient("127.0.0.1", 9099).collect(df)``
+"""
+
+from .client import PlanClient
+from .server import PlanServer
+
+__all__ = ["PlanClient", "PlanServer"]

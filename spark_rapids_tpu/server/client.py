@@ -1,0 +1,96 @@
+"""Driver-side client: build DataFrames locally, execute them remotely.
+
+The client process needs only the plan-builder surface (logical plan +
+expressions + pyarrow) — no JAX, no device. ``collect`` walks the plan,
+ships every in-memory scan table as an Arrow IPC stream (deduplicated per
+connection), submits the serialized plan, and decodes the Arrow result.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from ..plan.logical import DataFrame
+from . import plandoc, protocol
+
+
+class PlanServerError(RuntimeError):
+    def __init__(self, message: str, remote_traceback: str = ""):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+
+class PlanClient:
+    def __init__(self, host: str, port: int,
+                 conf: Optional[dict] = None, timeout: float = 600.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._known: Dict[str, pa.Table] = {}    # tables the server holds
+        #: plan-capture info from the last collect (test harness surface)
+        self.last_execs: List[str] = []
+        self.last_fell_back: List[str] = []
+        protocol.send_preamble(self._sock)
+        version = protocol.recv_preamble(self._sock)
+        if version != protocol.PROTOCOL_VERSION:
+            raise PlanServerError(
+                f"protocol version mismatch: server {version}, "
+                f"client {protocol.PROTOCOL_VERSION}")
+        self._request({"msg": "hello", "conf": conf or {}})
+
+    # ---- lifecycle ----
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- core ----
+    def _request(self, header: dict, body: bytes = b""):
+        protocol.send_msg(self._sock, header, body)
+        reply, reply_body = protocol.recv_msg(self._sock)
+        if reply.get("msg") == "error":
+            raise PlanServerError(reply.get("error", "server error"),
+                                  reply.get("traceback", ""))
+        return reply, reply_body
+
+    def _ship_tables(self, tables: Dict[str, pa.Table]) -> None:
+        for name, t in tables.items():
+            self._request({"msg": "table", "name": name},
+                          protocol.table_to_ipc(t))
+
+    def _serialize(self, df: DataFrame) -> dict:
+        # seed the registry with every table the server already holds so
+        # plan_to_doc's identity dedupe reuses their names; ship only the
+        # newly-registered ones
+        registry: Dict[str, pa.Table] = dict(self._known)
+        doc, registry = plandoc.plan_to_doc(df.plan, registry)
+        fresh = {n: t for n, t in registry.items() if n not in self._known}
+        self._ship_tables(fresh)
+        self._known.update(fresh)
+        return doc
+
+    # ---- public surface ----
+    def collect(self, df: DataFrame, conf: Optional[dict] = None
+                ) -> pa.Table:
+        doc = self._serialize(df)
+        reply, body = self._request(
+            {"msg": "plan", "mode": "collect", "plan": doc,
+             "conf": conf or {}})
+        self.last_execs = reply.get("execs", [])
+        self.last_fell_back = reply.get("fell_back", [])
+        return protocol.ipc_to_table(body)
+
+    def explain(self, df: DataFrame, conf: Optional[dict] = None) -> str:
+        doc = self._serialize(df)
+        _, body = self._request(
+            {"msg": "plan", "mode": "explain", "plan": doc,
+             "conf": conf or {}})
+        return body.decode("utf-8")

@@ -1,0 +1,89 @@
+"""Length-framed message protocol for the plan server.
+
+Connection preamble: client sends ``RTPU`` + u16 protocol version; server
+answers with the same (version handshake — the reference refuses to start
+on a version mismatch, Plugin.scala:300-324; so does this seam).
+
+Every message after that is one frame:
+
+    u32 header_len | header (UTF-8 JSON object) | u64 body_len | body
+
+Headers are small JSON dicts with a ``msg`` discriminator; bodies carry
+Arrow IPC streams (tables, results) so the columnar payload never touches
+JSON.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+import pyarrow as pa
+
+MAGIC = b"RTPU"
+PROTOCOL_VERSION = 1
+
+_MAX_HEADER = 64 << 20
+_MAX_BODY = 16 << 30
+
+
+class ProtocolError(ConnectionError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ProtocolError(
+                f"peer closed mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_preamble(sock: socket.socket) -> None:
+    sock.sendall(MAGIC + struct.pack("<H", PROTOCOL_VERSION))
+
+
+def recv_preamble(sock: socket.socket) -> int:
+    head = _recv_exact(sock, len(MAGIC) + 2)
+    if head[:len(MAGIC)] != MAGIC:
+        raise ProtocolError(f"bad magic {head[:len(MAGIC)]!r}")
+    (version,) = struct.unpack("<H", head[len(MAGIC):])
+    return version
+
+
+def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
+    h = json.dumps(header).encode("utf-8")
+    sock.sendall(struct.pack("<I", len(h)) + h
+                 + struct.pack("<Q", len(body)))
+    if body:
+        sock.sendall(body)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if hlen > _MAX_HEADER:
+        raise ProtocolError(f"header too large: {hlen}")
+    header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    (blen,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    if blen > _MAX_BODY:
+        raise ProtocolError(f"body too large: {blen}")
+    body = _recv_exact(sock, blen) if blen else b""
+    return header, body
+
+
+def table_to_ipc(table: pa.Table) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+def ipc_to_table(data: bytes) -> pa.Table:
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        return r.read_all()

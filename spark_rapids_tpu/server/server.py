@@ -1,0 +1,174 @@
+"""Plan server: the engine side of the external-driver seam.
+
+Each connection is an isolated driver session: its own conf (sent with
+``hello``), its own table registry, one query at a time. Planning
+(tagging/fallback/CBO/mesh lowering) and execution both happen here, via
+the same ``Session`` every in-process caller uses — so a plan submitted
+over the wire takes exactly the code path of ``Session.collect``, and the
+response carries the executed exec names + fallback list the way the
+reference's plan-capture listener exposes them to its test harness
+(ExecutionPlanCaptureCallback.scala:31).
+
+Run standalone:  python -m spark_rapids_tpu.server --port 9099
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import sys
+import threading
+import traceback
+from typing import Dict, Optional
+
+import pyarrow as pa
+
+from ..plan.logical import DataFrame
+from ..plan.session import Session
+from . import plandoc, protocol
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock: socket.socket = self.request
+        sock.settimeout(self.server.idle_timeout)   # type: ignore[attr-defined]
+        try:
+            version = protocol.recv_preamble(sock)
+            protocol.send_preamble(sock)
+            if version != protocol.PROTOCOL_VERSION:
+                protocol.send_msg(sock, {
+                    "msg": "error", "fatal": True,
+                    "error": f"protocol version mismatch: client {version}, "
+                             f"server {protocol.PROTOCOL_VERSION}"})
+                return
+        except (protocol.ProtocolError, OSError, socket.timeout):
+            return
+        tables: Dict[str, pa.Table] = {}
+        conf = dict(self.server.base_conf)          # type: ignore[attr-defined]
+        while True:
+            try:
+                header, body = protocol.recv_msg(sock)
+            except (protocol.ProtocolError, OSError, socket.timeout):
+                return
+            try:
+                reply, reply_body = self._dispatch(
+                    header, body, tables, conf)
+            except Exception as e:   # per-request isolation: report, keep conn
+                reply = {"msg": "error", "error": f"{type(e).__name__}: {e}",
+                         "traceback": traceback.format_exc()}
+                reply_body = b""
+            try:
+                protocol.send_msg(sock, reply, reply_body)
+            except OSError:
+                return
+            if reply.get("fatal"):
+                return
+
+    def _dispatch(self, header, body, tables, conf):
+        msg = header.get("msg")
+        if msg == "hello":
+            conf.update(header.get("conf") or {})
+            return {"msg": "hello_ack",
+                    "server": "spark-rapids-tpu",
+                    "version": protocol.PROTOCOL_VERSION}, b""
+        if msg == "table":
+            name = header["name"]
+            tables[name] = protocol.ipc_to_table(body)
+            return {"msg": "table_ack", "name": name,
+                    "rows": tables[name].num_rows}, b""
+        if msg == "drop_table":
+            tables.pop(header["name"], None)
+            return {"msg": "table_ack", "name": header["name"]}, b""
+        if msg == "plan":
+            plan = plandoc.doc_to_plan(header["plan"], tables)
+            df = DataFrame(plan)
+            ses = Session(dict(conf, **(header.get("conf") or {})))
+            mode = header.get("mode", "collect")
+            if mode == "explain":
+                return {"msg": "explained"}, ses.explain(df).encode("utf-8")
+            if mode != "collect":
+                raise ValueError(f"unknown plan mode {mode!r}")
+            result = ses.collect(df)
+            return ({"msg": "result",
+                     "rows": result.num_rows,
+                     "execs": ses.executed_exec_names(),
+                     "fell_back": ses.fell_back()},
+                    protocol.table_to_ipc(result))
+        raise ValueError(f"unknown message {msg!r}")
+
+
+class _ThreadingServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class PlanServer:
+    """Embeddable server handle (tests embed it; production runs the
+    module entry point as its own process)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 conf: Optional[dict] = None, idle_timeout: float = 600.0):
+        self._server = _ThreadingServer((host, port), _Handler)
+        self._server.base_conf = dict(conf or {})     # type: ignore[attr-defined]
+        self._server.idle_timeout = idle_timeout      # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        return self._server.server_address
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "PlanServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="plan-server",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the deployment env force-registers the TPU platform regardless of
+        # JAX_PLATFORMS (tests/conftest.py documents this); honor an
+        # explicit CPU request so the server can run device-less
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    p = argparse.ArgumentParser(
+        description="spark-rapids-tpu plan server")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9099)
+    p.add_argument("--conf", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="base session conf (repeatable)")
+    args = p.parse_args(argv)
+    conf = {}
+    for kv in args.conf:
+        k, _, v = kv.partition("=")
+        conf[k] = v
+    server = PlanServer(args.host, args.port, conf)
+    # the port line is the readiness signal for wrapping process managers
+    print(f"spark-rapids-tpu plan server listening on "
+          f"{server.address[0]}:{server.port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

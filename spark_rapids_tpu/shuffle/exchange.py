@@ -63,6 +63,10 @@ class ShuffleExchangeExec(UnaryExec):
     partition is consumed.
     """
 
+    @property
+    def produces_single_batch(self):
+        return True
+
     def __init__(self, partitioning: Partitioning, child: Exec,
                  ctx: Optional[EvalContext] = None, adaptive: bool = False,
                  target_rows: int = 1 << 20,
@@ -210,13 +214,16 @@ class ShuffleExchangeExec(UnaryExec):
                       for b in self.child.execute_partition(cp))
         cat = self._cat()
         spill0 = cat.spilled_to_host + cat.spilled_to_disk
+        from ..utils import tracing
         for batch in stream:
-            if n == 1:
-                self._register(out, 0, batch)
-                continue
-            pids = self._pids_jit(batch)
-            for p in range(n):
-                self._register(out, p, self._slice_jit(batch, pids, p))
+            with tracing.op_range(f"{self.name}.write"):
+                if n == 1:
+                    self._register(out, 0, batch)
+                    continue
+                pids = self._pids_jit(batch)
+                for p in range(n):
+                    self._register(out, p,
+                                   self._slice_jit(batch, pids, p))
         from ..exec.base import DEBUG, Metric
         self.metrics.setdefault(
             "spillBytes", Metric("spillBytes", DEBUG)).add(
@@ -341,6 +348,10 @@ class BroadcastExchangeExec(UnaryExec):
 
     The cached relation is catalog-registered (spillable between reads)
     and bounded by spark.rapids.tpu.broadcast.maxBytes."""
+
+    @property
+    def produces_single_batch(self):
+        return True
 
     def __init__(self, child: Exec, ctx: Optional[EvalContext] = None,
                  max_bytes: Optional[int] = None,

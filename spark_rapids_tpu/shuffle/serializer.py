@@ -102,18 +102,44 @@ def deserialize_host(data: bytes) -> Tuple[Dict[str, np.ndarray], int]:
     return arrays, num_rows
 
 
+def _col_to_arrays(c: DeviceColumn, key: str,
+                   arrays: Dict[str, np.ndarray]) -> None:
+    """Flatten one column's device lanes under path-encoded keys; struct
+    children recurse as ``{key}.{j}`` (the schema drives reassembly)."""
+    import jax
+    arrays[f"v{key}"] = np.asarray(jax.device_get(c.validity))
+    if c.is_struct:
+        for j, kid in enumerate(c.struct_fields):
+            _col_to_arrays(kid, f"{key}.{j}", arrays)
+        return
+    arrays[f"d{key}"] = np.asarray(jax.device_get(c.data))
+    if c.lengths is not None:
+        arrays[f"l{key}"] = np.asarray(jax.device_get(c.lengths))
+    if c.data2 is not None:     # map values / string-array lengths
+        arrays[f"m{key}"] = np.asarray(jax.device_get(c.data2))
+
+
+def _col_from_arrays(dtype, key: str,
+                     arrays: Dict[str, np.ndarray]) -> DeviceColumn:
+    import jax.numpy as jnp
+    from ..types import TypeKind
+    validity = jnp.asarray(arrays[f"v{key}"])
+    if dtype.kind is TypeKind.STRUCT:
+        kids = tuple(_col_from_arrays(ct, f"{key}.{j}", arrays)
+                     for j, ct in enumerate(dtype.children))
+        return DeviceColumn(kids, validity, None, dtype)
+    lengths = jnp.asarray(arrays[f"l{key}"]) if f"l{key}" in arrays else None
+    data2 = jnp.asarray(arrays[f"m{key}"]) if f"m{key}" in arrays else None
+    return DeviceColumn(jnp.asarray(arrays[f"d{key}"]), validity,
+                        lengths, dtype, data2)
+
+
 def serialize_batch(batch: ColumnarBatch, schema: Schema,
                     codec: Optional[str] = None) -> bytes:
     """Device batch -> framed bytes (D2H then frame)."""
-    import jax
     arrays: Dict[str, np.ndarray] = {}
     for i, c in enumerate(batch.columns):
-        arrays[f"d{i}"] = np.asarray(jax.device_get(c.data))
-        arrays[f"v{i}"] = np.asarray(jax.device_get(c.validity))
-        if c.lengths is not None:
-            arrays[f"l{i}"] = np.asarray(jax.device_get(c.lengths))
-        if c.data2 is not None:     # map values / string-array lengths
-            arrays[f"m{i}"] = np.asarray(jax.device_get(c.data2))
+        _col_to_arrays(c, str(i), arrays)
     return serialize_host(arrays, int(batch.num_rows), codec)
 
 
@@ -122,9 +148,5 @@ def deserialize_batch(data: bytes, schema: Schema) -> ColumnarBatch:
     arrays, num_rows = deserialize_host(data)
     cols: List[DeviceColumn] = []
     for i, f in enumerate(schema):
-        lengths = jnp.asarray(arrays[f"l{i}"]) if f"l{i}" in arrays else None
-        data2 = jnp.asarray(arrays[f"m{i}"]) if f"m{i}" in arrays else None
-        cols.append(DeviceColumn(jnp.asarray(arrays[f"d{i}"]),
-                                 jnp.asarray(arrays[f"v{i}"]),
-                                 lengths, f.dtype, data2))
+        cols.append(_col_from_arrays(f.dtype, str(i), arrays))
     return ColumnarBatch(tuple(cols), jnp.asarray(num_rows, jnp.int32))

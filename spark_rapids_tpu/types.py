@@ -75,8 +75,10 @@ class SqlType:
     scale: int = 0
     # string parameter: max encoded byte length (static per column)
     max_len: int = 0
-    # nested element types (round 1: carried for planning/fallback only)
+    # nested element types (arrays/maps/structs)
     children: Tuple["SqlType", ...] = field(default_factory=tuple)
+    # struct field names (parallel to children; empty for non-structs)
+    names: Tuple[str, ...] = field(default_factory=tuple)
 
     # ---- predicates -------------------------------------------------
     @property
@@ -122,6 +124,12 @@ class SqlType:
             return f"array<{self.children[0]}>"
         if self.kind is TypeKind.MAP:
             return f"map<{self.children[0]},{self.children[1]}>"
+        if self.kind is TypeKind.STRUCT:
+            names = self.names or tuple(
+                f"f{i}" for i in range(len(self.children)))
+            inner = ", ".join(f"{n}: {c}"
+                              for n, c in zip(names, self.children))
+            return f"struct<{inner}>"
         return self.kind.value
 
 
@@ -157,8 +165,17 @@ def array(elem: SqlType, max_elems: int = 256) -> SqlType:
     return SqlType(TypeKind.ARRAY, max_len=max_elems, children=(elem,))
 
 
-def struct(*fields: SqlType) -> SqlType:
-    return SqlType(TypeKind.STRUCT, children=tuple(fields))
+def struct(*fields: SqlType, names: Optional[Tuple[str, ...]] = None
+           ) -> SqlType:
+    """struct<name: type, ...> — stored on device as one lane-set per leaf
+    field plus a struct-level validity lane (a null struct nulls every
+    field; Spark's reverse inference does not apply)."""
+    if names is None:
+        names = tuple(f"f{i}" for i in range(len(fields)))
+    if len(names) != len(fields):
+        raise ValueError("struct names/fields length mismatch")
+    return SqlType(TypeKind.STRUCT, children=tuple(fields),
+                   names=tuple(names))
 
 
 def map_(key: SqlType, value: SqlType, max_elems: int = 256) -> SqlType:
@@ -234,7 +251,8 @@ def from_arrow(arrow_type: Any, max_len: int = 64) -> SqlType:
     if pa.types.is_list(arrow_type):
         return array(from_arrow(arrow_type.value_type, max_len))
     if pa.types.is_struct(arrow_type):
-        return struct(*(from_arrow(f.type, max_len) for f in arrow_type))
+        return struct(*(from_arrow(f.type, max_len) for f in arrow_type),
+                      names=tuple(f.name for f in arrow_type))
     if pa.types.is_null(arrow_type):
         return NULL
     raise TypeError(f"unsupported arrow type {arrow_type}")
@@ -262,6 +280,10 @@ def to_arrow(t: SqlType):
         return pa.list_(to_arrow(t.children[0]))
     if t.kind is TypeKind.MAP:
         return pa.map_(to_arrow(t.children[0]), to_arrow(t.children[1]))
+    if t.kind is TypeKind.STRUCT:
+        names = t.names or tuple(f"f{i}" for i in range(len(t.children)))
+        return pa.struct([pa.field(n, to_arrow(c), nullable=True)
+                          for n, c in zip(names, t.children)])
     return m[t.kind]
 
 

@@ -69,6 +69,18 @@ def hash_bytes(data: bytes, seed: int) -> int:
     return _i32(_fmix(h1, n))
 
 
+def hash_decimal(unscaled: int, precision: int, seed: int) -> int:
+    """Spark Murmur3Hash of a decimal: unscaled long when precision <= 18,
+    else hashUnsafeBytes over BigInteger.toByteArray() — the MINIMAL
+    big-endian two's-complement encoding."""
+    if precision <= 18:
+        return hash_long(unscaled, seed)
+    v = unscaled
+    bit_length = v.bit_length() if v >= 0 else (-v - 1).bit_length()
+    blen = bit_length // 8 + 1
+    return hash_bytes(v.to_bytes(blen, "big", signed=True), seed)
+
+
 def spark_hash_row(values, types, seed: int = 42) -> int:
     """Fold a row like Spark's Murmur3Hash expression (nulls skip)."""
     import struct

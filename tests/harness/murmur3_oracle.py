@@ -1,5 +1,6 @@
 """Scalar Spark-compatible murmur3 oracle (re-exported from the package's
 host-side utils so the interpreter and the test harness share one copy)."""
 
-from spark_rapids_tpu.utils.murmur3 import (hash_bytes, hash_int, hash_long,
+from spark_rapids_tpu.utils.murmur3 import (hash_bytes, hash_decimal,
+                                            hash_int, hash_long,
                                             spark_hash_row)

@@ -1,0 +1,122 @@
+"""Coalesce-goal contract (VERDICT r4 Next #10).
+
+Reference: GpuCoalesceBatches.scala:156-228 — operators declare
+TargetSize / RequireSingleBatch goals; the planner's transition pass
+inserts CoalesceBatchesExec to meet them and verifies the result. These
+tests drive MULTI-BATCH partitions (small scan batch_rows) through
+agg/join/window and check both placement and differential correctness.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec.coalesce import (CoalesceBatchesExec,
+                                            CoalesceGoalError,
+                                            RequireSingleBatch, TargetSize,
+                                            verify_coalesce_goals)
+from spark_rapids_tpu.exec.join import JoinType
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Count, Sum
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+def big_table(n=3000, seed=5):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 20, n).astype(np.int32),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+        "f": rng.uniform(0, 1, n),
+    })
+
+
+def small_dim():
+    return pa.table({"d": np.arange(20, dtype=np.int32),
+                     "w": np.arange(20, dtype=np.int64) * 7})
+
+
+@pytest.mark.smoke
+def test_multibatch_agg_matches():
+    # batch_rows=256 → ~12 batches per partition feeding the aggregate
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: (table(big_table(), batch_rows=256)
+                 .where(col("v") > lit(-50))
+                 .group_by("k")
+                 .agg(Sum(col("v")).alias("s"), Count().alias("c"))),
+        ignore_order=True)
+
+
+def test_multibatch_join_build_side_single_batch():
+    # multi-batch BUILD side must be coalesced to ONE batch
+    # (RequireSingleBatch declared by HashJoinExec for child 1)
+    def q():
+        return (table(big_table(), batch_rows=256)
+                .join(table(small_dim(), batch_rows=4), ["k"], ["d"],
+                      JoinType.INNER)
+                .group_by("k").agg(Sum(col("w")).alias("sw")))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    ses = Session()
+    ses.collect(q())
+    verify_coalesce_goals(ses.last_plan)
+
+
+def test_multibatch_window_matches():
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.expressions.window import (RowNumber,
+                                                     WindowExpression,
+                                                     WindowSpec)
+
+    def q():
+        spec = WindowSpec(partition_keys=(col("k"),),
+                          orders=(asc(col("v")),))
+        return (table(big_table(), batch_rows=256)
+                .window(WindowExpression(RowNumber(), spec).alias("rn"))
+                .group_by("k").agg(Count().alias("c")))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_require_single_batch_accumulates_everything():
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    t = big_table(1000)
+    scan = InMemoryScanExec(t, batch_rows=100)
+    co = CoalesceBatchesExec(scan, RequireSingleBatch())
+    assert co.produces_single_batch
+    batches = list(co.execute_partition(0))
+    assert len(batches) == 1
+    assert int(batches[0].num_rows) == 1000
+
+
+def test_target_size_splits_stream():
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    t = big_table(2000)
+    scan = InMemoryScanExec(t, batch_rows=100)
+    co = CoalesceBatchesExec(scan, TargetSize(8 << 10))
+    batches = list(co.execute_partition(0))
+    assert len(batches) > 1                       # split by byte target
+    assert sum(int(b.num_rows) for b in batches) == 2000
+    assert not co.produces_single_batch
+
+
+def test_verify_rejects_unmet_goal():
+    from spark_rapids_tpu.exec import HashJoinExec, InMemoryScanExec
+    left = InMemoryScanExec(big_table(500), batch_rows=100)
+    right = InMemoryScanExec(big_table(500, seed=6), batch_rows=100)
+    join = HashJoinExec([col("k")], [col("k")], JoinType.INNER, left, right)
+    with pytest.raises(CoalesceGoalError):
+        verify_coalesce_goals(join)
+    fixed = HashJoinExec([col("k")], [col("k")], JoinType.INNER, left,
+                         CoalesceBatchesExec(right, RequireSingleBatch()))
+    verify_coalesce_goals(fixed)
+
+
+def test_planner_satisfies_declared_goals():
+    # every planner-converted plan passes its own verification (the pass
+    # runs inside insert_coalesce_transitions; re-run it explicitly)
+    ses = Session()
+    df = (table(big_table(), batch_rows=256)
+          .join(table(small_dim()), ["k"], ["d"], JoinType.LEFT_OUTER)
+          .order_by("k").limit(50))
+    ses.collect(df)
+    verify_coalesce_goals(ses.last_plan)

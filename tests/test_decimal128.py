@@ -101,11 +101,64 @@ def test_first_last():
             Count().alias("c")))
 
 
-def test_dec128_group_key_falls_back():
-    """dec128 GROUP KEYS need a 128-bit hash path → clean CPU fallback."""
-    assert_tpu_fallback_collect(
+def test_dec128_murmur3_vs_oracle():
+    """Bit-exactness of the 128-bit murmur3 path (VERDICT r4 Next #5)
+    against the scalar Java-faithful oracle, across byte-length edges."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.expressions.hashing import murmur3_batch
+    from harness.murmur3_oracle import hash_decimal
+
+    edge = [0, 1, -1, 127, 128, -128, -129, 255, 256, -256,
+            2**31 - 1, 2**31, -(2**31), 2**32 - 1, 2**32, -(2**32),
+            2**63 - 1, 2**63, -(2**63), 10**37, -(10**37),
+            3 * 10**37, -(3 * 10**37), 2**96 + 12345, -(2**96) - 99]
+    rng = random.Random(11)
+    vals = edge + [rng.randrange(-(10**37), 10**37) for _ in range(200)]
+    with d.localcontext() as lctx:
+        lctx.prec = 60      # the default 28-digit context ROUNDS scaleb
+        decs = [d.Decimal(v).scaleb(-4) for v in vals]
+    t = pa.table({"w": pa.array(decs, pa.decimal128(38, 4))})
+    batch, schema = from_arrow(t)
+    got = np.asarray(murmur3_batch(
+        [batch.columns[0]])[:t.num_rows]).tolist()
+    expected = [_i32(hash_decimal(v, 38, 42)) for v in vals]
+    assert got == expected
+
+
+def _i32(x):
+    x &= 0xFFFFFFFF
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+def test_dec128_group_key_on_device():
+    """dec128 GROUP KEYS run on device via limb order keys + the 128-bit
+    hash exchange path (the r4 fallback tag is gone)."""
+    assert_tpu_and_cpu_are_equal_collect(
         lambda: table(wide_table()).group_by("w").agg(Count().alias("c")),
-        "Aggregate")
+        ignore_order=True)
+    s = Session()
+    s.collect(table(wide_table()).group_by("w").agg(Count().alias("c")))
+    assert not s.fell_back(), s.fell_back()
+
+
+def test_dec128_join_key_on_device():
+    def q():
+        left = table(wide_table(seed=7))
+        right = table(wide_table(seed=7)).group_by("w").agg(
+            Count().alias("n"))
+        return left.join(right, [col("w")], [col("w")],
+                         __import__("spark_rapids_tpu.exec.join",
+                                    fromlist=["JoinType"]).JoinType.INNER)
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_dec128_key_through_exchange():
+    """multi-slice scan → hash exchange routes dec128 keys by the
+    Spark-bit-exact 128-bit murmur3 (shuffle placement compatibility)."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: table(wide_table(), num_slices=3).group_by("w").agg(
+            Count().alias("c"), Min(col("w")).alias("mn")),
+        ignore_order=True)
 
 
 def test_dec128_arithmetic_falls_back():

@@ -1,0 +1,172 @@
+"""Native parquet decoder differential tests (VERDICT r4 Next #3).
+
+Oracle = pyarrow reading the SAME files. Coverage axes: physical types,
+nulls, codecs (snappy/zstd/uncompressed), encodings (dict + plain), page
+versions (v1/v2), multiple row groups, and the per-row-group pyarrow
+fallback for files outside the native subset.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.io.parquet_native import (open_native,
+                                                read_row_group_native)
+
+
+def sample_table(n=5000, seed=3, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    def maybe_null(arr, t):
+        if not with_nulls:
+            return pa.array(arr, type=t)
+        mask = rng.random(len(arr)) < 0.15
+        return pa.array([None if m else v
+                         for v, m in zip(arr.tolist(), mask)], type=t)
+    strings = rng.choice(
+        ["", "a", "bb", "hello world", "x" * 40, "уникод", "z"], n)
+    return pa.table({
+        "i32": maybe_null(rng.integers(-10**6, 10**6, n).astype(np.int32),
+                          pa.int32()),
+        "i64": maybe_null(rng.integers(-10**12, 10**12, n), pa.int64()),
+        "f32": maybe_null(rng.normal(size=n).astype(np.float32),
+                          pa.float32()),
+        "f64": maybe_null(rng.normal(size=n) * 1e6, pa.float64()),
+        "b": maybe_null(rng.integers(0, 2, n).astype(bool), pa.bool_()),
+        "s": maybe_null(strings, pa.string()),
+        "d": maybe_null(rng.integers(0, 20000, n).astype(np.int32),
+                        pa.date32()),
+        "ts": maybe_null(rng.integers(0, 10**15, n), pa.timestamp("us")),
+    })
+
+
+def _roundtrip(tmp_path, t, **write_kw):
+    p = str(tmp_path / "f.parquet")
+    pq.write_table(t, p, **write_kw)
+    pf = pq.ParquetFile(p)
+    schema = pq.read_schema(p)
+    cols = t.column_names
+    for rg in range(pf.metadata.num_row_groups):
+        expected = pf.read_row_group(rg, columns=cols, use_threads=False)
+        got = read_row_group_native(p, rg, cols, schema)
+        assert got is not None, "native decode unexpectedly fell back"
+        assert got.select(cols).equals(expected.select(cols)), \
+            f"row group {rg} mismatch"
+
+
+@pytest.mark.smoke
+def test_snappy_dict_default(tmp_path):
+    _roundtrip(tmp_path, sample_table(), row_group_size=1500)
+
+
+def test_plain_encoding(tmp_path):
+    _roundtrip(tmp_path, sample_table(seed=5), use_dictionary=False,
+               row_group_size=2000)
+
+
+def test_uncompressed(tmp_path):
+    _roundtrip(tmp_path, sample_table(seed=7), compression="none")
+
+
+def test_zstd(tmp_path):
+    _roundtrip(tmp_path, sample_table(seed=9), compression="zstd")
+
+
+def test_data_page_v2(tmp_path):
+    _roundtrip(tmp_path, sample_table(seed=11),
+               data_page_version="2.0", row_group_size=1000)
+
+
+def test_data_page_v2_plain_uncompressed(tmp_path):
+    _roundtrip(tmp_path, sample_table(seed=13), use_dictionary=False,
+               data_page_version="2.0", compression="none")
+
+
+def test_no_nulls(tmp_path):
+    _roundtrip(tmp_path, sample_table(seed=15, with_nulls=False))
+
+
+def test_tiny_and_empty_strings(tmp_path):
+    t = pa.table({"s": pa.array(["", "", None, "q", ""]),
+                  "i": pa.array([1, 2, 3, 4, 5], type=pa.int32())})
+    _roundtrip(tmp_path, t)
+
+
+def test_small_page_sizes(tmp_path):
+    # many pages per chunk exercises the page loop + mid-chunk dict reuse
+    _roundtrip(tmp_path, sample_table(seed=17),
+               data_page_size=2048, row_group_size=2500)
+
+
+def test_nested_falls_back(tmp_path):
+    t = pa.table({"a": pa.array([[1, 2], [3]], pa.list_(pa.int64())),
+                  "i": pa.array([1, 2], type=pa.int64())})
+    p = str(tmp_path / "nested.parquet")
+    pq.write_table(t, p)
+    schema = pq.read_schema(p)
+    assert read_row_group_native(p, 0, ["a"], schema) is None
+    # flat sibling column still decodes natively
+    got = read_row_group_native(p, 0, ["i"], schema)
+    assert got is not None and got.column("i").to_pylist() == [1, 2]
+
+
+def test_gzip_falls_back(tmp_path):
+    t = sample_table(300)
+    p = str(tmp_path / "gz.parquet")
+    pq.write_table(t, p, compression="gzip")
+    assert read_row_group_native(p, 0, ["i64"], pq.read_schema(p)) is None
+
+
+def test_footer_stats(tmp_path):
+    t = pa.table({"k": pa.array(np.arange(1000, dtype=np.int64))})
+    p = str(tmp_path / "stats.parquet")
+    pq.write_table(t, p, row_group_size=250)
+    f = open_native(p)
+    assert f is not None and f.num_row_groups == 4
+    mn, mx, nulls = f.chunk_stats(1, "k")
+    assert int.from_bytes(mn, "little", signed=True) == 250
+    assert int.from_bytes(mx, "little", signed=True) == 499
+    assert nulls == 0
+
+
+def test_decimal_stats_never_prune(tmp_path):
+    """Review finding: decimal footer stats are UNSCALED ints; using them
+    against logical Decimal literals would prune MATCHING groups. The
+    native stats path must decline decimal columns."""
+    import decimal as d
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.io.source import ReaderType
+    t = pa.table({"x": pa.array([d.Decimal("1.00"), d.Decimal("5.00")],
+                                pa.decimal128(9, 2))})
+    p = str(tmp_path / "dec.parquet")
+    pq.write_table(t, p)
+    f = open_native(p)
+    assert f is not None
+    assert f.decoded_stats(0, "x") is None
+    src = ParquetSource([p], predicate=col("x") < lit(d.Decimal("50")),
+                        reader_type=ReaderType.MULTITHREADED)
+    out = pa.concat_tables(list(src.read_split(src.files)))
+    assert out.num_rows == 2          # both rows match; nothing pruned
+    assert src.row_groups_pruned == 0
+
+
+def test_source_integration_native_vs_pyarrow(tmp_path):
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.io.source import ReaderType
+    t = sample_table(4000, seed=21)
+    p = str(tmp_path / "part.parquet")
+    pq.write_table(t, p, row_group_size=1000)
+    pred = col("i32") > lit(0)
+
+    def read(native):
+        src = ParquetSource([p], columns=["i32", "i64", "s"],
+                            predicate=pred,
+                            reader_type=ReaderType.MULTITHREADED)
+        src._native = native
+        tables = list(src.read_split(src.files))
+        return pa.concat_tables(tables)
+    a, b = read(True), read(False)
+    assert a.equals(b)
+    assert a.num_rows > 0

@@ -1,0 +1,224 @@
+"""Protocol front-end tests (VERDICT r4 Next #2).
+
+The reference's whole shape is "plans arrive from an external driver
+process" (Plugin.scala:44-51). These tests check that seam: the wire codec
+round-trips plans exactly, and a SEPARATE server process (no shared Python
+state) produces bit-identical results to in-process Session.collect.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.exec.join import JoinType
+from spark_rapids_tpu.exec.sort import asc, desc
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Average, Count, Sum
+from spark_rapids_tpu.plan import Session, table
+from spark_rapids_tpu.plan.logical import DataFrame
+from spark_rapids_tpu.server import PlanClient, PlanServer
+from spark_rapids_tpu.server import plandoc
+from spark_rapids_tpu.server.client import PlanServerError
+
+
+def _orders_table():
+    rng = np.random.default_rng(17)
+    n = 500
+    return pa.table({
+        "o_id": np.arange(n, dtype=np.int64),
+        "cust": rng.integers(0, 40, n).astype(np.int32),
+        "amount": rng.uniform(1.0, 500.0, n),
+        "flag": rng.integers(0, 2, n).astype(np.int32),
+    })
+
+
+def _cust_table():
+    return pa.table({
+        "c_id": np.arange(40, dtype=np.int32),
+        "region": (np.arange(40, dtype=np.int32) % 5).astype(np.int32),
+    })
+
+
+def _query(orders_df, cust_df):
+    return (orders_df
+            .where((col("amount") > lit(50.0)) & (col("flag") == lit(1)))
+            .join(cust_df, ["cust"], ["c_id"], JoinType.INNER)
+            .group_by("region")
+            .agg(Sum(col("amount")).alias("total"),
+                 Average(col("amount")).alias("avg_amount"),
+                 Count().alias("n"))
+            .order_by(asc(col("region"))))
+
+
+# ---------------------------------------------------------------------------
+# codec round-trip (no sockets)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+def test_plandoc_roundtrip_identical_results():
+    orders, cust = _orders_table(), _cust_table()
+    df = _query(table(orders), table(cust))
+    doc, tables = plandoc.plan_to_doc(df.plan)
+    wire = json.dumps(doc)                 # must be pure JSON
+    plan2 = plandoc.doc_to_plan(json.loads(wire), tables)
+    ses = Session()
+    expected = ses.collect(df)
+    actual = Session().collect(DataFrame(plan2))
+    assert actual.equals(expected)
+
+
+def test_plandoc_expression_breadth():
+    from spark_rapids_tpu import types as T
+    t = pa.table({"s": ["ab", "xyz", None, "q"],
+                  "x": pa.array([1, 2, None, 4], type=pa.int64()),
+                  "d": pa.array([1.5, -3.25, 2.0, None],
+                                type=pa.float64())})
+    from spark_rapids_tpu.expressions.strings import Upper
+    df = (table(t)
+          .select(Upper(col("s")).alias("u"),
+                  (col("x") * lit(3) + lit(1)).alias("y"),
+                  col("d").cast(T.FLOAT32).alias("f"),
+                  col("x").is_null().alias("isn")))
+    doc, tables = plandoc.plan_to_doc(df.plan)
+    plan2 = plandoc.doc_to_plan(json.loads(json.dumps(doc)), tables)
+    assert Session().collect(DataFrame(plan2)).equals(Session().collect(df))
+
+
+def test_plandoc_nonfinite_and_odd_scalars():
+    import math
+    for v in (math.nan, math.inf, -math.inf, b"\x00\xff", (1, "a"),
+              {"k": 2}):
+        enc = json.loads(json.dumps(plandoc.encode_value(v)))
+        dec = plandoc.decode_value(enc)
+        if isinstance(v, float) and math.isnan(v):
+            assert math.isnan(dec)
+        else:
+            assert dec == v
+
+
+def test_plandoc_sort_window_generate():
+    t = pa.table({"k": pa.array([1, 1, 2, 2], type=pa.int32()),
+                  "v": pa.array([3, 1, 4, 2], type=pa.int64()),
+                  "arr": pa.array([[1, 2], [3], None, [4, 5]],
+                                  type=pa.list_(pa.int64()))})
+    df = table(t).explode(col("arr"), alias="e").order_by(
+        desc(col("v")), asc(col("k")))
+    doc, tables = plandoc.plan_to_doc(df.plan)
+    plan2 = plandoc.doc_to_plan(json.loads(json.dumps(doc)), tables)
+    assert Session().collect(DataFrame(plan2)).equals(Session().collect(df))
+
+
+def test_plandoc_dedupes_shared_tables():
+    orders = _orders_table()
+    df = table(orders).join(table(orders), ["o_id"], ["o_id"],
+                            JoinType.LEFT_SEMI)
+    doc, tables = plandoc.plan_to_doc(df.plan)
+    assert len(tables) == 1
+
+
+# ---------------------------------------------------------------------------
+# embedded server (same process, real sockets)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+def test_embedded_server_collect_and_capture():
+    server = PlanServer().start()
+    try:
+        orders, cust = _orders_table(), _cust_table()
+        df = _query(table(orders), table(cust))
+        expected = Session().collect(df)
+        with PlanClient("127.0.0.1", server.port) as client:
+            got = client.collect(df)
+            assert got.equals(expected)
+            assert any("Agg" in n for n in client.last_execs)
+            # repeated query over the same table objects: no re-ship, and
+            # the result is stable
+            assert client.collect(df).equals(expected)
+            text = client.explain(df)
+            assert "Tpu" in text or "*" in text
+    finally:
+        server.stop()
+
+
+def test_embedded_server_error_keeps_connection():
+    server = PlanServer().start()
+    try:
+        t = pa.table({"x": [1, 2, 3]})
+        with PlanClient("127.0.0.1", server.port) as client:
+            bad = table(t).select(col("nope"))
+            with pytest.raises(PlanServerError) as ei:
+                client.collect(bad)
+            assert "nope" in str(ei.value)
+            good = table(t).select((col("x") + lit(1)).alias("y"))
+            out = client.collect(good)
+            assert out.column("y").to_pylist() == [2, 3, 4]
+    finally:
+        server.stop()
+
+
+def test_embedded_server_session_conf():
+    server = PlanServer().start()
+    try:
+        t = pa.table({"x": [1, 2, 3]})
+        df = table(t).select((col("x") + lit(1)).alias("y"))
+        with PlanClient("127.0.0.1", server.port,
+                        conf={"spark.rapids.tpu.sql.enabled": False}) as c:
+            out = c.collect(df)
+            assert out.column("y").to_pylist() == [2, 3, 4]
+            assert c.last_execs == []     # interpreter path: no exec plan
+    finally:
+        server.stop()
+
+
+def test_file_source_plan_over_wire(tmp_path):
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.io.scan import read_parquet
+    t = pa.table({"k": np.arange(100, dtype=np.int64),
+                  "v": np.arange(100, dtype=np.float64)})
+    pq.write_table(t.slice(0, 50), str(tmp_path / "a.parquet"))
+    pq.write_table(t.slice(50, 50), str(tmp_path / "b.parquet"))
+    df = read_parquet(str(tmp_path), predicate=col("k") >= lit(90))
+    expected = Session().collect(df)
+    server = PlanServer().start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            got = client.collect(df)
+        assert got.equals(expected)
+        assert expected.num_rows == 10
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the VERDICT "done" criterion: a genuinely external server process
+# ---------------------------------------------------------------------------
+
+def test_external_process_server_bit_identical():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spark_rapids_tpu.server", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, text=True)
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"listening on [\d.]+:(\d+)", line)
+        assert m, f"no readiness line: {line!r}"
+        port = int(m.group(1))
+        orders, cust = _orders_table(), _cust_table()
+        df = _query(table(orders), table(cust))
+        expected = Session().collect(df)
+        with PlanClient("127.0.0.1", port) as client:
+            got = client.collect(df)
+        assert got.equals(expected)       # bit-identical Arrow tables
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,173 @@
+"""Struct columns end-to-end (VERDICT r4 Next #4).
+
+Device layout: one lane-set per leaf field + struct-level validity
+(batch.py DeviceColumn struct storage; reference carries structs through
+every operator — GpuColumnVector.java, complexTypeExtractors.scala:355).
+Differential coverage: storage roundtrip, scan→filter→join→agg with struct
+payload, struct-of-struct, sort carry, CreateStruct materialization, and
+the key-gating fallbacks.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.batch import from_arrow, to_arrow
+from spark_rapids_tpu.exec.join import JoinType
+from spark_rapids_tpu.exec.sort import asc, desc
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Count, Sum
+from spark_rapids_tpu.expressions.collections import (CreateStruct,
+                                                      GetStructField)
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import (assert_tpu_and_cpu_are_equal_collect,
+                             assert_tpu_fallback_collect)
+
+
+def struct_table(seed=41, n=120):
+    rng = np.random.default_rng(seed)
+    ids = np.arange(n, dtype=np.int64)
+    xs = rng.integers(-50, 50, n).astype(np.int32)
+    ys = rng.uniform(0, 10, n)
+    tags = rng.choice(["red", "green", "blue"], n)
+    s = pa.StructArray.from_arrays(
+        [pa.array(xs), pa.array(ys), pa.array(tags)],
+        names=["x", "y", "tag"],
+        mask=pa.array(ids % 7 == 3))           # some null structs
+    grp = rng.integers(0, 8, n).astype(np.int32)
+    return pa.table({"id": ids, "grp": grp, "s": s})
+
+
+def nested_struct_table(n=60):
+    rng = np.random.default_rng(43)
+    inner = pa.StructArray.from_arrays(
+        [pa.array(rng.integers(0, 5, n).astype(np.int32)),
+         pa.array(rng.uniform(-1, 1, n))],
+        names=["a", "b"])
+    outer = pa.StructArray.from_arrays(
+        [inner, pa.array(np.arange(n, dtype=np.int64))],
+        names=["inner", "seq"],
+        mask=pa.array(np.arange(n) % 9 == 4))
+    return pa.table({"k": np.arange(n, dtype=np.int32), "o": outer})
+
+
+@pytest.mark.smoke
+def test_struct_storage_roundtrip():
+    t = struct_table()
+    batch, schema = from_arrow(t)
+    out = to_arrow(batch, schema)
+    assert out.equals(t)
+
+
+def test_struct_of_struct_roundtrip():
+    t = nested_struct_table()
+    batch, schema = from_arrow(t)
+    assert to_arrow(batch, schema).equals(t)
+
+
+@pytest.mark.smoke
+def test_struct_field_extraction_filter_agg():
+    # scan → filter on a struct field → group-by → agg of a struct field
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda: (table(struct_table())
+                 .where(GetStructField(col("s"), 0) > lit(0))
+                 .group_by("grp")
+                 .agg(Sum(GetStructField(col("s"), 0)).alias("sx"),
+                      Count().alias("n"))),
+        ignore_order=True)
+
+
+def test_struct_payload_through_join_and_agg():
+    # struct column carried THROUGH a join, then a field aggregated
+    dims = pa.table({"d": np.arange(8, dtype=np.int32),
+                     "w": np.arange(8, dtype=np.int64) * 10})
+
+    def q():
+        return (table(struct_table())
+                .join(table(dims), ["grp"], ["d"], JoinType.INNER)
+                .group_by("grp")
+                .agg(Sum(GetStructField(col("s"), 0)).alias("sx"),
+                     Sum(col("w")).alias("sw")))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_struct_sort_carry():
+    # struct payload carried through an order-by (gather permutation)
+    def q():
+        return (table(struct_table())
+                .order_by(desc(col("id")))
+                .limit(20)
+                .select(col("id"), GetStructField(col("s"), 2).alias("tag"),
+                        col("s")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_struct_of_struct_extraction():
+    def q():
+        inner = GetStructField(col("o"), 0)
+        return (table(nested_struct_table())
+                .select(col("k"),
+                        GetStructField(inner, 0).alias("a"),
+                        (GetStructField(col("o"), 1) + lit(1)).alias("s1"),
+                        col("o").is_null().alias("on")))
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_create_struct_materializes():
+    t = pa.table({"x": pa.array([1, None, 3], type=pa.int32()),
+                  "y": pa.array([1.5, 2.5, None], type=pa.float64())})
+
+    def q():
+        return (table(t)
+                .select(CreateStruct((col("x"), col("y")),
+                                     ("x", "y")).alias("st"),
+                        col("x")))
+    ses = Session()
+    out = ses.collect(q())
+    assert ses.fell_back() == []
+    assert out.column("st").to_pylist() == [
+        {"x": 1, "y": 1.5}, {"x": None, "y": 2.5}, {"x": 3, "y": None}]
+
+
+def test_struct_through_exchange():
+    # multi-slice scan forces a shuffle exchange; struct rides the
+    # serialized frames (shuffle/serializer.py struct leaf recursion)
+    def q():
+        return (table(struct_table(), num_slices=3)
+                .group_by("grp")
+                .agg(Sum(GetStructField(col("s"), 0)).alias("sx")))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+
+
+def test_struct_key_falls_back_with_reason():
+    assert_tpu_fallback_collect(
+        lambda: (table(struct_table())
+                 .group_by(col("s"))
+                 .agg(Count().alias("n"))),
+        "CpuFallback", ignore_order=True)
+
+
+def test_struct_join_key_falls_back():
+    t = struct_table()
+    assert_tpu_fallback_collect(
+        lambda: (table(t).join(table(t), [col("s")], [col("s")],
+                               JoinType.LEFT_SEMI)),
+        "CpuFallback", ignore_order=True)
+
+
+def test_struct_sort_key_falls_back():
+    assert_tpu_fallback_collect(
+        lambda: table(struct_table()).order_by(asc(col("s"))),
+        "CpuFallback", ignore_order=True)
+
+
+def test_struct_spill_roundtrip():
+    # host-spill carrier: flatten/restore through the packed table
+    from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                     serialize_batch)
+    t = nested_struct_table()
+    batch, schema = from_arrow(t)
+    blob = serialize_batch(batch, schema, codec="lz4")
+    out = deserialize_batch(blob, schema)
+    assert to_arrow(out, schema).equals(t)

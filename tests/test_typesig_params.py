@@ -1,0 +1,259 @@
+"""Per-parameter TypeSig gating (VERDICT r4 Next #6).
+
+Reference: TypeChecks.scala:171 — per-op/per-param TypeSig algebra drives
+CPU fallback with recorded reasons and the generated docs. Every test here
+asserts that a MIS-TYPED or non-literal argument position tags its node off
+the device with a parameter-specific reason, while the result still matches
+the CPU oracle (fallback correctness, not just fallback placement).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+def base_table():
+    return pa.table({
+        "s": pa.array(["alpha", "beta,x", None, "d,e,f"]),
+        "i": pa.array([1, 2, 3, None], type=pa.int32()),
+        "f": pa.array([1.5, -2.0, None, 0.25], type=pa.float64()),
+        "b": pa.array([True, False, True, None]),
+        "d": pa.array([0, 100, None, 20000], type=pa.int32()).cast(
+            pa.date32()),
+        "arr": pa.array([[1, 2], [3], None, [4, 5, 6]],
+                        type=pa.list_(pa.int64())),
+    })
+
+
+def _fallback_reason(df, needle, run=False):
+    """Assert the node is tagged off the device with a reason containing
+    ``needle``. ``run=True`` additionally checks CPU-fallback parity —
+    only for queries that are VALID Spark (literal-requirement gates);
+    mis-TYPED arguments would fail Spark analysis too, so there is no
+    result to compare."""
+    ses = Session()
+    from spark_rapids_tpu.plan.overrides import ExplainMode
+    text = ses.explain(df, ExplainMode.ALL)
+    assert needle in text, f"expected {needle!r} in:\n{text}"
+    if run:
+        out = ses.collect(df)
+        oracle = Session(
+            {"spark.rapids.tpu.sql.enabled": False}).collect(df)
+        from harness.asserts import assert_tables_equal
+        assert_tables_equal(out, oracle)
+
+
+# ---- wrong-typed parameter positions ---------------------------------
+
+@pytest.mark.smoke
+def test_substring_pos_must_be_integral():
+    from spark_rapids_tpu.expressions.strings import Substring
+    _fallback_reason(
+        table(base_table()).select(
+            Substring(col("s"), lit("x"), lit(2)).alias("r")),
+        "parameter 'pos'")
+
+
+def test_substring_str_must_be_string():
+    from spark_rapids_tpu.expressions.strings import Substring
+    _fallback_reason(
+        table(base_table()).select(
+            Substring(col("i"), lit(1), lit(2)).alias("r")),
+        "parameter 'str'")
+
+
+def test_if_predicate_must_be_boolean():
+    from spark_rapids_tpu.expressions.conditional import If
+    _fallback_reason(
+        table(base_table()).select(
+            If(col("i"), lit(1), lit(0)).alias("r")),
+        "parameter 'predicate'")
+
+
+def test_shift_amount_must_be_integral():
+    from spark_rapids_tpu.expressions.arithmetic import Shift
+    _fallback_reason(
+        table(base_table()).select(
+            Shift(col("i"), col("f"), "left").alias("r")),
+        "parameter 'amount'")
+
+
+def test_date_add_days_must_be_integral():
+    from spark_rapids_tpu.expressions.datetime import DateAddSub
+    _fallback_reason(
+        table(base_table()).select(
+            DateAddSub(col("d"), col("f")).alias("r")),
+        "parameter 'days'")
+
+
+def test_date_add_start_must_be_datetime():
+    from spark_rapids_tpu.expressions.datetime import DateAddSub
+    _fallback_reason(
+        table(base_table()).select(
+            DateAddSub(col("s"), col("i")).alias("r")),
+        "parameter 'startDate'")
+
+
+def test_get_array_item_ordinal_must_be_integral():
+    from spark_rapids_tpu.expressions.collections import GetArrayItem
+    _fallback_reason(
+        table(base_table()).select(
+            GetArrayItem(col("arr"), col("f")).alias("r")),
+        "parameter 'ordinal'")
+
+
+def test_get_array_item_needs_array():
+    from spark_rapids_tpu.expressions.collections import GetArrayItem
+    _fallback_reason(
+        table(base_table()).select(
+            GetArrayItem(col("s"), lit(0)).alias("r")),
+        "parameter 'array'")
+
+
+def test_element_at_needs_collection():
+    from spark_rapids_tpu.expressions.collections import ElementAt
+    _fallback_reason(
+        table(base_table()).select(
+            ElementAt(col("i"), lit(1)).alias("r")),
+        "parameter 'collection'")
+
+
+def test_string_locate_substr_must_be_string():
+    from spark_rapids_tpu.expressions.strings import StringLocate
+    _fallback_reason(
+        table(base_table()).select(
+            StringLocate(col("s"), col("i")).alias("r")),
+        "parameter 'substr'")
+
+
+def test_string_repeat_times_must_be_integral():
+    from spark_rapids_tpu.expressions.strings import StringRepeat
+    _fallback_reason(
+        table(base_table()).select(
+            StringRepeat(col("s"), col("f")).alias("r")),
+        "parameter 'repeatTimes'")
+
+
+def test_format_number_x_must_be_numeric():
+    from spark_rapids_tpu.expressions.strings import FormatNumber
+    _fallback_reason(
+        table(base_table()).select(
+            FormatNumber(col("s"), lit(2)).alias("r")),
+        "parameter 'x'")
+
+
+def test_chr_input_must_be_integral():
+    from spark_rapids_tpu.expressions.strings import Chr
+    _fallback_reason(
+        table(base_table()).select(Chr(col("s")).alias("r")),
+        "parameter 'input'")
+
+
+def test_logarithm_base_must_be_numeric():
+    from spark_rapids_tpu.expressions.math import Logarithm
+    _fallback_reason(
+        table(base_table()).select(
+            Logarithm(col("s"), col("f")).alias("r")),
+        "parameter 'base'")
+
+
+# ---- literal-required parameter positions ----------------------------
+
+@pytest.mark.smoke
+def test_string_replace_search_must_be_literal():
+    from spark_rapids_tpu.expressions.strings import StringReplace
+    _fallback_reason(
+        table(base_table()).select(
+            StringReplace(col("s"), col("s"), lit("x")).alias("r")),
+        "parameter 'search' must be a literal", run=True)
+
+
+def test_string_replace_replacement_must_be_literal():
+    from spark_rapids_tpu.expressions.strings import StringReplace
+    _fallback_reason(
+        table(base_table()).select(
+            StringReplace(col("s"), lit("a"), col("s")).alias("r")),
+        "parameter 'replace' must be a literal", run=True)
+
+
+def test_translate_input_must_be_string():
+    from spark_rapids_tpu.expressions.strings import Translate
+    _fallback_reason(
+        table(base_table()).select(
+            Translate(col("i"), "ab", "xy").alias("r")),
+        "parameter 'input'")
+
+
+def test_pad_pad_must_be_literal():
+    from spark_rapids_tpu.expressions.strings import StringPad
+    _fallback_reason(
+        table(base_table()).select(
+            StringPad(col("s"), lit(8), col("s")).alias("r")),
+        "parameter 'pad' must be a literal", run=True)
+
+
+def test_concat_ws_separator_must_be_literal():
+    from spark_rapids_tpu.expressions.strings import ConcatWs
+    _fallback_reason(
+        table(base_table()).select(
+            ConcatWs(col("s"), (col("s"), col("s"))).alias("r")),
+        "parameter 'sep' must be a literal", run=True)
+
+
+def test_substring_index_delim_must_be_literal():
+    from spark_rapids_tpu.expressions.strings import SubstringIndex
+    _fallback_reason(
+        table(base_table()).select(
+            SubstringIndex(col("s"), col("s"), lit(1)).alias("r")),
+        "parameter 'delim' must be a literal", run=True)
+
+
+def test_pad_len_must_be_integral():
+    from spark_rapids_tpu.expressions.strings import StringPad
+    _fallback_reason(
+        table(base_table()).select(
+            StringPad(col("s"), col("s"), lit("*")).alias("r")),
+        "parameter 'len'")
+
+
+def test_sequence_bounds_must_be_integral():
+    from spark_rapids_tpu.expressions.collections import Sequence
+    _fallback_reason(
+        table(base_table()).select(
+            Sequence(col("i"), col("f")).alias("r")),
+        "parameter 'bound'")
+
+
+def test_array_repeat_count_must_be_literal():
+    from spark_rapids_tpu.expressions.collections import ArrayRepeat
+    _fallback_reason(
+        table(base_table()).select(
+            ArrayRepeat(col("i"), col("i")).alias("r")),
+        "parameter 'count' must be a literal")
+
+
+# ---- positive control: well-typed calls stay on device ----------------
+
+@pytest.mark.smoke
+def test_well_typed_params_run_on_device():
+    from spark_rapids_tpu.expressions.strings import (StringPad,
+                                                      StringReplace,
+                                                      Substring)
+    ses = Session()
+    df = table(base_table()).select(
+        Substring(col("s"), lit(2), lit(3)).alias("sub"),
+        StringReplace(col("s"), lit("a"), lit("@")).alias("rep"),
+        StringPad(col("s"), lit(8), lit("*")).alias("pad"))
+    ses.collect(df)
+    assert ses.fell_back() == []
+
+
+def test_docs_include_param_signatures():
+    import tools.generate_docs as g
+    md = g.supported_ops_md()
+    assert "pos: " in md and "(lit)" in md
