@@ -24,6 +24,7 @@
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <mutex>
 #include <string>
 #include <vector>
 #include <zstd.h>
@@ -1048,6 +1049,9 @@ static int64_t decode_chunk(DecodeCtx& c, const uint8_t* chunk,
 // handle registry
 // ---------------------------------------------------------------------------
 
+// ctypes releases the GIL around native calls and the reader pool opens
+// footers concurrently — the registry needs its own lock
+std::mutex g_footers_mutex;
 std::map<int64_t, Footer*> g_footers;
 int64_t g_next_handle = 1;
 
@@ -1061,20 +1065,27 @@ int64_t rtpu_pq_footer_open(const uint8_t* buf, int64_t len) {
     // column count consistency
     for (auto& rg : f->rgs)
         if (rg.size() != f->cols.size()) { delete f; return ERR_MALFORMED; }
+    std::lock_guard<std::mutex> g(g_footers_mutex);
     int64_t h = g_next_handle++;
     g_footers[h] = f;
     return h;
 }
 
 void rtpu_pq_footer_free(int64_t h) {
-    auto it = g_footers.find(h);
-    if (it != g_footers.end()) {
-        delete it->second;
-        g_footers.erase(it);
+    Footer* doomed = nullptr;
+    {
+        std::lock_guard<std::mutex> g(g_footers_mutex);
+        auto it = g_footers.find(h);
+        if (it != g_footers.end()) {
+            doomed = it->second;
+            g_footers.erase(it);
+        }
     }
+    delete doomed;
 }
 
 static Footer* get(int64_t h) {
+    std::lock_guard<std::mutex> g(g_footers_mutex);
     auto it = g_footers.find(h);
     return it == g_footers.end() ? nullptr : it->second;
 }

@@ -546,7 +546,7 @@ def _col_to_arrow(col: DeviceColumn, dtype: SqlType, name: str,
         counts = np.where(validity, np.asarray(col.lengths[:n]), 0)
         if counts.size and int(counts.max()) > mat.shape[1]:
             raise CapacityError(
-                f"array column '{f.name}' holds a list of "
+                f"array column '{name}' holds a list of "
                 f"{int(counts.max())} elements but the device budget is "
                 f"{mat.shape[1]}; raise max_elems (collect_list/set) or "
                 f"fall back to CPU")

@@ -168,6 +168,22 @@ READER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
     "PERFILE, COALESCING, MULTITHREADED or AUTO (reference: "
     "spark.rapids.sql.format.parquet.reader.type).").text("AUTO")
 
+TRANSPORT_WINDOW_BYTES = conf(
+    "spark.rapids.tpu.shuffle.transport.windowBytes").doc(
+    "Staging-window size for large-block transport fetches: blocks above "
+    "this stream as fixed-size range reads over the persistent peer "
+    "connection instead of one giant frame (reference: bounce buffers + "
+    "WindowedBlockIterator in the UCX shuffle, "
+    "BounceBufferManager.scala).").integer(4 << 20)
+
+TRANSPORT_MAX_IN_FLIGHT = conf(
+    "spark.rapids.tpu.shuffle.transport.maxInFlightFetches").doc(
+    "Bound on concurrently outstanding block fetches in the pipelined "
+    "shuffle read (transport.fetch_many) — decode overlaps the wire "
+    "while memory stays bounded (reference: "
+    "spark.rapids.shuffle.ucx.activeMessages / maxBytesInFlight "
+    "pipelining).").integer(4)
+
 PARQUET_NATIVE_DECODE = conf(
     "spark.rapids.tpu.sql.format.parquet.nativeDecode.enabled").doc(
     "Decode parquet column chunks with the native C++ decoder "
@@ -207,6 +223,19 @@ JSON_ENABLED = conf("spark.rapids.tpu.sql.format.json.enabled").doc(
 AVRO_ENABLED = conf("spark.rapids.tpu.sql.format.avro.enabled").doc(
     "Accelerate Avro OCF scans (reference: "
     "spark.rapids.sql.format.avro.enabled).").boolean(True)
+
+HIVE_TEXT_ENABLED = conf(
+    "spark.rapids.tpu.sql.format.hiveText.enabled").doc(
+    "Accelerate Hive delimited-text (LazySimpleSerDe) scans (reference: "
+    "spark.rapids.sql.format.hive.text.enabled / "
+    "GpuHiveTableScanExec).").boolean(True)
+
+REGEXP_ENABLED = conf("spark.rapids.tpu.sql.regexp.enabled").doc(
+    "Master switch for device regular expressions (RLike, regexp_extract, "
+    "regexp_replace, split): disabled, every regex expression falls back "
+    "to the CPU interpreter — large/pathological patterns can be slower "
+    "on accelerators (reference: spark.rapids.sql.regexp.enabled)."
+).boolean(True)
 
 READER_BATCH_ROWS = conf("spark.rapids.tpu.sql.reader.batchSizeRows").doc(
     "Row target per decoded host batch a scan emits (reference: "

@@ -62,13 +62,16 @@ class CsvSource(FileSource):
             parse_options=pacsv.ParseOptions(delimiter=self.sep))
         return t.schema
 
+    def _parse_options(self):
+        return pacsv.ParseOptions(delimiter=self.sep)
+
     def read_file(self, path: str) -> pa.Table:
         s = self._arrow_schema()
         names = s.names if s is not None else None
         t = pacsv.read_csv(
             path,
             read_options=self._read_options(names),
-            parse_options=pacsv.ParseOptions(delimiter=self.sep),
+            parse_options=self._parse_options(),
             convert_options=self._convert_options(s))
         if self.columns:
             t = t.select(self.columns)
@@ -82,13 +85,26 @@ class CsvSource(FileSource):
 
 class HiveTextSource(CsvSource):
     """Hive delimited text (reference: GpuHiveTableScanExec — ^A-separated,
-    \\N nulls, headerless)."""
+    \\N nulls, headerless, LazySimpleSerDe dialect: NO quoting/escaping,
+    and ONLY the \\N marker is null — a literal "null" string is data)."""
 
     format_name = "hive-text"
 
     def __init__(self, paths, schema=None, sep: str = "\x01", **kw):
         super().__init__(paths, schema=schema, header=False, sep=sep,
                          null_value="\\N", **kw)
+
+    def _parse_options(self):
+        return pacsv.ParseOptions(delimiter=self.sep, quote_char=False,
+                                  double_quote=False, escape_char=False)
+
+    def _convert_options(self, arrow_schema):
+        return pacsv.ConvertOptions(
+            column_types=dict(zip(arrow_schema.names, arrow_schema.types))
+            if arrow_schema else None,
+            null_values=[self.null_value],
+            strings_can_be_null=True,
+            quoted_strings_can_be_null=False)
 
 
 def read_hive_text(paths, schema, sep: str = "\x01", num_slices: int = 1,

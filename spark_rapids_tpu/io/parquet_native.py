@@ -118,10 +118,15 @@ class NativeParquetFile:
             if rc < 0:
                 raise _Unsupported("column name overflow")
             lib.rtpu_pq_col_info(h, c, info)
-            self.columns[name_buf.value.decode("utf-8")] = c
             # (physical type, max_def, flat, is_decimal)
             self._col_info.append((int(info[0]), int(info[1]),
                                    bool(info[2]), bool(info[3])))
+            # only FLAT leaves are addressable: the footer stores bare
+            # leaf names, and a nested leaf sharing a top-level column's
+            # name must not shadow it (stats pruning would read the
+            # wrong chunk — review finding)
+            if bool(info[2]):
+                self.columns[name_buf.value.decode("utf-8")] = c
 
     def close(self):
         if getattr(self, "_h", None) is not None:
@@ -291,8 +296,9 @@ def _binary_array(arrow_type, rows: int, offsets: np.ndarray,
                   data: np.ndarray, validity: np.ndarray) -> pa.Array:
     nulls = _validity_buffer(validity)
     used = int(offsets[rows])
-    base = pa.string() if not pa.types.is_large_string(arrow_type) \
-        else pa.string()
+    # int32 offsets force the small-string base; the cast below widens to
+    # large_string when the file schema asks for it
+    base = pa.string()
     arr = pa.Array.from_buffers(
         base, rows, [nulls, pa.py_buffer(offsets),
                      pa.py_buffer(np.ascontiguousarray(data[:used]))])

@@ -31,16 +31,18 @@ _POOL_SIZE = 0
 _POOL_LOCK = threading.Lock()
 
 
-def bounded_map(pool, items, fn, window: int):
+def bounded_map(pool, items, fn, window: int, force_parallel: bool = False):
     """Submit ``fn(item)`` over the pool keeping at most ``window`` tasks
     outstanding; yields (item, result) in input order — decoded output
     stays bounded on many-file scans.
 
-    Single-core hosts run inline: a thread pool cannot overlap anything
-    there, and futures + GIL handoff measurably tax the decode hot loop
-    (the reference sizes its multi-file pool to the executor's cores the
-    same way)."""
-    if window <= 1 or (os.cpu_count() or 1) <= 1:
+    Single-core hosts run CPU-bound work inline: a thread pool cannot
+    overlap anything there, and futures + GIL handoff measurably tax the
+    decode hot loop (the reference sizes its multi-file pool to the
+    executor's cores the same way). ``force_parallel`` keeps the pool for
+    I/O-bound work (network fetches overlap even on one core)."""
+    if not force_parallel and (
+            window <= 1 or (os.cpu_count() or 1) <= 1):
         for item in items:
             yield item, fn(item)
         return

@@ -366,6 +366,8 @@ class PlanMeta:
                 "csv": "spark.rapids.tpu.sql.format.csv.enabled",
                 "json": "spark.rapids.tpu.sql.format.json.enabled",
                 "avro": "spark.rapids.tpu.sql.format.avro.enabled",
+                "hive-text":
+                    "spark.rapids.tpu.sql.format.hiveText.enabled",
             }.get(fmt)
             if key is not None and not self.conf.get(key):
                 self.will_not_work(f"{key} is false")
@@ -474,11 +476,19 @@ class PlanMeta:
                 continue   # join right-keys etc. bind elsewhere
             self._check_dtype_tree(bound, TypeKind)
 
+    _REGEX_EXPRS = ("RLike", "RegexpExtract", "RegexpReplace",
+                    "StringSplit")
+
     def _check_dtype_tree(self, e: Expression, TypeKind) -> None:
         name = type(e).__name__
         reason = e.device_unsupported_reason()
         if reason:
             self.will_not_work(reason)
+        if name in self._REGEX_EXPRS:
+            from ..config import REGEXP_ENABLED
+            if not self.conf.get(REGEXP_ENABLED.key):
+                self.will_not_work(
+                    f"{REGEXP_ENABLED.key} is false (regex master switch)")
         # INPUT-type gating against the expression's TypeSig (the
         # reference's TypeChecks input sigs): an op whose rule does not
         # admit a child's dtype has no device kernel for it — e.g.

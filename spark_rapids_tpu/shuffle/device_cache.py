@@ -121,8 +121,14 @@ def shared_device_cache(conf=None) -> DeviceShuffleCache:
                 codec = str(conf.get(SHUFFLE_COMPRESSION.key))
             # cross-host peers must be able to reach the block server:
             # bind wide when discovery is configured, loopback otherwise
+            window = None
+            if conf is not None:
+                from ..config import TRANSPORT_WINDOW_BYTES
+                window = int(conf.get(TRANSPORT_WINDOW_BYTES.key))
+            from .transport import DEFAULT_WINDOW_BYTES
             transport = TcpTransport(
-                host="0.0.0.0" if registry_conf else "127.0.0.1")
+                host="0.0.0.0" if registry_conf else "127.0.0.1",
+                window_bytes=window or DEFAULT_WINDOW_BYTES)
             if conf is not None:
                 from ..config import (CACHED_HEARTBEAT_INTERVAL_MS,
                                       EXECUTOR_ID)

@@ -57,7 +57,8 @@ class ShuffleManager:
         and the host exchange is the fallback for shapes it cannot fuse."""
         if self.mode == self.MULTITHREADED:
             from ..config import (SHUFFLE_MT_MAX_BYTES_IN_FLIGHT,
-                                  SHUFFLE_MT_WRITER_THREADS)
+                                  SHUFFLE_MT_WRITER_THREADS,
+                                  TRANSPORT_MAX_IN_FLIGHT)
             from .multithreaded import MultithreadedShuffleExchangeExec
             from ..config import SHUFFLE_MT_READER_THREADS
             return MultithreadedShuffleExchangeExec(
@@ -66,6 +67,8 @@ class ShuffleManager:
                     SHUFFLE_MT_WRITER_THREADS.key)),
                 reader_threads=int(self.conf.get(
                     SHUFFLE_MT_READER_THREADS.key)),
+                max_in_flight_fetches=int(self.conf.get(
+                    TRANSPORT_MAX_IN_FLIGHT.key)),
                 max_bytes_in_flight=int(self.conf.get(
                     SHUFFLE_MT_MAX_BYTES_IN_FLIGHT.key)),
                 codec=self.codec)

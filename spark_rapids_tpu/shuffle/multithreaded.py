@@ -55,6 +55,7 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
                  shuffle_dir: Optional[str] = None,
                  num_threads: int = 8,
                  reader_threads: Optional[int] = None,
+                 max_in_flight_fetches: Optional[int] = None,
                  max_bytes_in_flight: int = 512 << 20,
                  ctx: Optional[EvalContext] = None,
                  transport=None,
@@ -65,6 +66,10 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
             "/tmp/rapids_tpu_shuffle", uuid.uuid4().hex)
         self.num_threads = num_threads
         self.reader_threads = reader_threads or num_threads
+        #: bound on concurrently outstanding transport fetches
+        #: (spark.rapids.tpu.shuffle.transport.maxInFlightFetches)
+        self.max_in_flight_fetches = \
+            max_in_flight_fetches or self.reader_threads
         self.codec = codec
         self.limiter = BytesInFlightLimiter(max_bytes_in_flight)
         self._written = False
@@ -147,12 +152,12 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         if not blocks:
             return
         schema = self.output_schema
-        pool = cf.ThreadPoolExecutor(self.reader_threads,
-                                     thread_name_prefix="shuffle-read")
-        futures = [pool.submit(self.transport.fetch, s, m, r)
-                   for s, m, r in blocks]
-        batches = [deserialize_batch(f.result(), schema) for f in futures]
-        pool.shutdown()
+        # pipelined fetch: decode each block the moment its bytes land
+        # while later fetches keep streaming (transport.fetch_many)
+        batches = [deserialize_batch(data, schema)
+                   for _, data in self.transport.fetch_many(
+                       blocks,
+                       max_in_flight=self.max_in_flight_fetches)]
         total = sum(int(b.num_rows) for b in batches)
         if total == 0:
             return

@@ -36,6 +36,12 @@ _VERSION = 1
 
 # ops
 _HELLO, _FETCH, _OK, _MISSING, _ERROR, _LIST = 1, 2, 3, 4, 5, 6
+# windowed-block streaming (reference: WindowedBlockIterator +
+# BounceBufferManager — large blocks move in fixed-size staging windows)
+_SIZE, _FETCH_AT = 7, 8
+
+#: default staging window for large-block fetches (one bounce buffer)
+DEFAULT_WINDOW_BYTES = 4 << 20
 
 
 class TransportError(RuntimeError):
@@ -62,6 +68,12 @@ class ShuffleTransport:
         """All published (shuffle, map, reduce) blocks for a reducer,
         including remote peers' blocks."""
         raise NotImplementedError
+
+    def fetch_many(self, ids, max_in_flight: int = 4):
+        """Yield (block_id, bytes) for many blocks; subclasses with a
+        wire pipeline overlap the fetches."""
+        for b in ids:
+            yield b, self.fetch(*b)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         """Drop every local block of one shuffle (end-of-query cleanup)."""
@@ -165,15 +177,28 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send_frame(self.request, _OK,
                                 struct.pack(f"<{len(maps)}q", *maps))
                     continue
+                if op == _SIZE:
+                    s, m, r = struct.unpack("<qqq", payload)
+                    blk = store._resolve(s, m, r)
+                    if blk is None:
+                        _send_frame(self.request, _MISSING, b"")
+                    else:
+                        _send_frame(self.request, _OK,
+                                    struct.pack("<q", len(blk)))
+                    continue
+                if op == _FETCH_AT:
+                    s, m, r, off, ln = struct.unpack("<qqqqq", payload)
+                    blk = store._resolve(s, m, r)
+                    if blk is None or off < 0 or off + ln > len(blk):
+                        _send_frame(self.request, _MISSING, b"")
+                    else:
+                        _send_frame(self.request, _OK, blk[off:off + ln])
+                    continue
                 if op != _FETCH:
                     _send_frame(self.request, _ERROR, b"bad op")
                     return
                 s, m, r = struct.unpack("<qqq", payload)
-                blk = store._local.get((s, m, r))
-                if blk is None and store.resolver is not None:
-                    # lazy block: device-resident until a peer asks
-                    # (DeviceShuffleCache serializes on demand)
-                    blk = store.resolver(s, m, r)
+                blk = store._resolve(s, m, r)
                 if blk is None:
                     _send_frame(self.request, _MISSING, b"")
                 else:
@@ -193,8 +218,23 @@ class TcpTransport(ShuffleTransport):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
-                 retries: int = 3, liveness=None, peer_source=None):
+                 retries: int = 3, liveness=None, peer_source=None,
+                 window_bytes: int = DEFAULT_WINDOW_BYTES):
         self._local: Dict[Tuple[int, int, int], bytes] = {}
+        #: staging window for large-block fetches (the bounce-buffer
+        #: size); blocks above it stream as _FETCH_AT range reads
+        self.window_bytes = max(64 << 10, window_bytes)
+        # persistent per-peer connections (reference: UCX keeps endpoints
+        # alive; connection-per-request was the r4 design's weakness)
+        self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        self._conn_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._conns_guard = threading.Lock()
+        #: small FIFO cache of lazily-resolved blocks so a windowed read
+        #: does not re-serialize the device batch per window — sized for
+        #: several INTERLEAVED readers (a single slot would thrash when
+        #: two reducers stream two large blocks concurrently)
+        self._resolved_cache: Dict[Tuple[int, int, int], bytes] = {}
+        self._resolved_cache_slots = 8
         self._index: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
         #: optional (s, m, r) -> bytes|None hook serving LAZY blocks whose
         #: payload lives elsewhere (the device-resident shuffle cache)
@@ -236,6 +276,29 @@ class TcpTransport(ShuffleTransport):
     def local_blocks(self, s: int, r: int):
         with self._lock:
             return sorted(self._index.get((s, r), []))
+
+    def _resolve(self, s: int, m: int, r: int) -> Optional[bytes]:
+        """Materialized bytes of a local block: published payload, or the
+        lazy resolver's output (cached one slot so windowed range reads
+        serialize the device batch once)."""
+        blk = self._local.get((s, m, r))
+        if blk is not None:
+            return blk
+        if self.resolver is None:
+            return None
+        with self._lock:
+            blk = self._resolved_cache.get((s, m, r))
+        if blk is not None:
+            return blk
+        blk = self.resolver(s, m, r)
+        if blk is not None:
+            with self._lock:
+                while len(self._resolved_cache) >= \
+                        self._resolved_cache_slots:
+                    self._resolved_cache.pop(
+                        next(iter(self._resolved_cache)))
+                self._resolved_cache[(s, m, r)] = blk
+        return blk
 
     def _live_peers(self) -> Dict:
         peers = dict(self.peers)
@@ -293,34 +356,114 @@ class TcpTransport(ShuffleTransport):
         raise TransportError(f"block s{s}-m{m}-r{r} not found on any peer"
                              + (f" (last: {last})" if last else ""))
 
-    def _list_from(self, addr, s: int, r: int) -> List[int]:
-        with socket.create_connection(addr, timeout=30) as sock:
+    # ---- persistent per-peer connections --------------------------------
+    def _conn_of(self, addr):
+        """(socket, lock) for ``addr``; connects + handshakes once and
+        keeps the connection for the transport's lifetime (the reference
+        keeps UCX endpoints alive the same way)."""
+        with self._conns_guard:
+            sock = self._conns.get(addr)
+            lock = self._conn_locks.setdefault(addr, threading.Lock())
+        if sock is not None:
+            return sock, lock
+        sock = socket.create_connection(addr, timeout=30)
+        try:
             _send_frame(sock, _HELLO, struct.pack("<I", _VERSION))
             op, payload = _recv_frame(sock)
             if op != _HELLO:
                 raise TransportError(f"handshake failed: {payload!r}")
-            _send_frame(sock, _LIST, struct.pack("<qq", s, r))
-            op, payload = _recv_frame(sock)
-            if op != _OK:
-                raise TransportError(f"list failed: {payload!r}")
-            k = len(payload) // 8
-            return list(struct.unpack(f"<{k}q", payload))
+        except BaseException:
+            sock.close()
+            raise
+        with self._conns_guard:
+            # lost the race: keep the winner's connection
+            existing = self._conns.get(addr)
+            if existing is not None:
+                sock.close()
+                return existing, lock
+            self._conns[addr] = sock
+        return sock, lock
+
+    def _drop_conn(self, addr, sock) -> None:
+        with self._conns_guard:
+            if self._conns.get(addr) is sock:
+                del self._conns[addr]
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _transact(self, addr, op: int, payload: bytes):
+        """One request/response on the persistent connection; a transport
+        failure drops the connection so retries reconnect."""
+        sock, lock = self._conn_of(addr)
+        try:
+            with lock:
+                _send_frame(sock, op, payload)
+                return _recv_frame(sock)
+        except (TransportError, ConnectionError, OSError):
+            self._drop_conn(addr, sock)
+            raise
+
+    def _list_from(self, addr, s: int, r: int) -> List[int]:
+        op, payload = self._transact(addr, _LIST,
+                                     struct.pack("<qq", s, r))
+        if op != _OK:
+            raise TransportError(f"list failed: {payload!r}")
+        k = len(payload) // 8
+        return list(struct.unpack(f"<{k}q", payload))
 
     def _fetch_from(self, addr, s: int, m: int, r: int) -> bytes:
-        with socket.create_connection(addr, timeout=30) as sock:
-            _send_frame(sock, _HELLO, struct.pack("<I", _VERSION))
-            op, payload = _recv_frame(sock)
-            if op != _HELLO:
-                raise TransportError(f"handshake failed: {payload!r}")
-            _send_frame(sock, _FETCH, struct.pack("<qqq", s, m, r))
-            op, payload = _recv_frame(sock)
+        # size probe decides plain vs windowed streaming
+        op, payload = self._transact(addr, _SIZE,
+                                     struct.pack("<qqq", s, m, r))
+        if op == _MISSING:
+            raise TransportError("missing block")
+        if op != _OK:
+            raise TransportError(f"peer error: {payload!r}")
+        (total,) = struct.unpack("<q", payload)
+        if total <= self.window_bytes:
+            op, payload = self._transact(addr, _FETCH,
+                                         struct.pack("<qqq", s, m, r))
             if op == _OK:
                 return payload
             if op == _MISSING:
                 raise TransportError("missing block")
             raise TransportError(f"peer error: {payload!r}")
+        # windowed streaming: fixed-size range reads into one buffer
+        # (WindowedBlockIterator over bounce-buffer-sized steps)
+        buf = bytearray(total)
+        for off in range(0, total, self.window_bytes):
+            ln = min(self.window_bytes, total - off)
+            op, payload = self._transact(
+                addr, _FETCH_AT, struct.pack("<qqqqq", s, m, r, off, ln))
+            if op != _OK or len(payload) != ln:
+                raise TransportError(
+                    f"windowed read failed at {off} ({op})")
+            buf[off:off + ln] = payload
+        return bytes(buf)
+
+    def fetch_many(self, ids, max_in_flight: int = 4):
+        """Pipelined fetch of many blocks: yields (id, bytes) in input
+        order while later fetches proceed in the background, so device
+        decode overlaps the wire (the reference's windowed pending-fetch
+        pipeline). Different peers progress in parallel; one peer's
+        frames serialize on its connection."""
+        from ..io.source import bounded_map, reader_pool
+        pool = reader_pool(max(2, max_in_flight))
+        yield from bounded_map(pool, list(ids),
+                               lambda b: self.fetch(*b), max_in_flight,
+                               force_parallel=True)
 
     def close(self) -> None:
+        with self._conns_guard:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
         self._server.shutdown()
         self._server.server_close()
         self._local.clear()

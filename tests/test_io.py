@@ -164,6 +164,21 @@ def test_hive_text_scan(tmp_path):
     assert got == [(1, "alpha", 2.5), (2, None, 3.5), (3, "gamma", None)]
 
 
+def test_hive_text_serde_dialect(tmp_path):
+    """LazySimpleSerDe semantics: quotes are DATA (no quoting dialect) and
+    only the \\N marker is null — literal 'null'/'NULL' strings survive
+    (reference: GpuHiveTableScanExec text parsing)."""
+    from spark_rapids_tpu.io.csv import read_hive_text
+    path = str(tmp_path / "hive2.txt")
+    with open(path, "w") as f:
+        f.write('say "hi"\x01null\n')
+        f.write('\\N\x01NULL\n')
+        f.write('plain\x01\\N\n')
+    schema = Schema([Field("a", T.string(16)), Field("b", T.string(16))])
+    got = rows_of(Session().collect(read_hive_text(path, schema)))
+    assert got == [('say "hi"', "null"), (None, "NULL"), ("plain", None)]
+
+
 def test_input_file_name_column(tmp_path):
     """input_file_name() parity: scans can attach the source path column
     (reference: GpuInputFileName / InputFileBlockRule)."""

@@ -199,3 +199,38 @@ def test_coalesce_transition_inserted():
         co.metrics["numInputBatches"].value
     assert co.metrics["numOutputBatches"].value == 1, \
         co.metrics["numOutputBatches"].value
+
+
+def test_regexp_master_switch():
+    """spark.rapids.tpu.sql.regexp.enabled=false sends every regex
+    expression to the CPU with a recorded reason (reference:
+    spark.rapids.sql.regexp.enabled)."""
+    import pyarrow as pa
+    from spark_rapids_tpu.expressions.regex import RLike
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.plan import Session, table
+    t = pa.table({"s": ["ab", "zz", None]})
+    df = table(t).select(RLike(col("s"), "a.").alias("m"))
+    on = Session()
+    assert on.collect(df).column("m").to_pylist() == [True, False, None]
+    assert on.fell_back() == []
+    off = Session({"spark.rapids.tpu.sql.regexp.enabled": False})
+    assert off.collect(df).column("m").to_pylist() == [True, False, None]
+    assert off.fell_back() != []
+    assert "regexp.enabled" in off.explain(df)
+
+
+def test_hive_text_format_switch(tmp_path):
+    from spark_rapids_tpu.io.csv import read_hive_text
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.batch import Field, Schema
+    from spark_rapids_tpu.plan import Session
+    p = str(tmp_path / "h.txt")
+    with open(p, "w") as f:
+        f.write("1\x01a\n2\x01b\n")
+    schema = Schema([Field("i", T.INT32), Field("s", T.string(8))])
+    df = read_hive_text(p, schema)
+    off = Session({"spark.rapids.tpu.sql.format.hiveText.enabled": False})
+    out = off.collect(df)
+    assert out.column("i").to_pylist() == [1, 2]
+    assert off.fell_back() != []

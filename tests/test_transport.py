@@ -275,3 +275,96 @@ def test_heartbeat_sender_keeps_executor_live():
     time.sleep(0.2)
     assert runtime._heartbeats["exec-auto"] == last    # sender stopped
     assert "exec-auto" not in runtime.live_executors(timeout_s=0.1)
+
+
+# ---------------------------------------------------------------------------
+# round-5: windowed-block streaming + persistent connections (VERDICT #7)
+# ---------------------------------------------------------------------------
+
+def test_windowed_large_block_roundtrip():
+    """Blocks larger than the staging window stream as range reads and
+    reassemble byte-exact (WindowedBlockIterator/bounce-buffer design)."""
+    server = TcpTransport(window_bytes=1 << 16)
+    big = bytes(bytearray((i * 7 + 13) & 0xFF for i in range(1 << 20)))
+    server.publish(1, 0, 0, big)
+    client = TcpTransport(peers={1: server.address},
+                          window_bytes=1 << 16)
+    try:
+        assert client.fetch(1, 0, 0) == big
+    finally:
+        client.close()
+        server.close()
+
+
+def test_persistent_connection_reused():
+    """Many fetches ride ONE connection (one handshake), not
+    connection-per-request."""
+    server = TcpTransport()
+    for m in range(20):
+        server.publish(2, m, 0, bytes([m]) * 100)
+    client = TcpTransport(peers={1: server.address})
+    try:
+        for m in range(20):
+            assert client.fetch(2, m, 0) == bytes([m]) * 100
+        assert len(client._conns) == 1     # one persistent peer conn
+    finally:
+        client.close()
+        server.close()
+
+
+def test_connection_recovers_after_broken_socket():
+    """A dead persistent connection is dropped and re-established
+    transparently by the retry wrapper."""
+    server = TcpTransport()
+    server.publish(3, 0, 0, b"first")
+    server.publish(3, 1, 0, b"second")
+    client = TcpTransport(peers={1: server.address}, retries=3)
+    try:
+        assert client.fetch(3, 0, 0) == b"first"
+        # break the cached connection underneath the client
+        (addr, sock), = client._conns.items()
+        sock.close()
+        assert client.fetch(3, 1, 0) == b"second"
+        assert len(client._conns) == 1        # reconnected, one conn
+    finally:
+        client.close()
+        server.close()
+
+
+def test_fetch_many_pipelines_and_orders():
+    server = TcpTransport(window_bytes=1 << 14)
+    blocks = []
+    for m in range(8):
+        payload = bytes([m]) * ((1 << 15) + m)    # above window: streams
+        server.publish(4, m, 0, payload)
+        blocks.append((4, m, 0))
+    client = TcpTransport(peers={1: server.address},
+                          window_bytes=1 << 14)
+    try:
+        out = list(client.fetch_many(blocks, max_in_flight=3))
+        assert [b for b, _ in out] == blocks       # input order kept
+        for (s, m, r), data in out:
+            assert data == bytes([m]) * ((1 << 15) + m)
+    finally:
+        client.close()
+        server.close()
+
+
+def test_windowed_fetch_of_lazy_block_serializes_once():
+    server = TcpTransport(window_bytes=1 << 10)
+    calls = []
+
+    def resolver(s, m, r):
+        calls.append((s, m, r))
+        return bytes(5000)
+    server.resolver = resolver
+    server.publish_lazy(5, 0, 0)
+    client = TcpTransport(peers={1: server.address},
+                          window_bytes=1 << 10)
+    try:
+        assert client.fetch(5, 0, 0) == bytes(5000)
+        # size probe + 5 windows served from ONE resolver call
+        assert len(calls) == 1
+    finally:
+        client.close()
+        server.close()
