@@ -48,7 +48,7 @@ import numpy as np
 # Overall wall-clock budget for the whole bench (the round-4 driver budget
 # observed was ~25 min); per-config and probe budgets fit inside it.
 OVERALL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 1260))
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 150))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
 PROBE_TRIES = 2
 CONFIG_TIMEOUT_S = float(os.environ.get("BENCH_CONFIG_TIMEOUT_S", 330))
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),

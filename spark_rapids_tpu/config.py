@@ -168,6 +168,24 @@ READER_TYPE = conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
     "PERFILE, COALESCING, MULTITHREADED or AUTO (reference: "
     "spark.rapids.sql.format.parquet.reader.type).").text("AUTO")
 
+AGG_MAX_RESULT_ROWS = conf("spark.rapids.tpu.sql.agg.maxResultRows").doc(
+    "Device row budget for one aggregation's result layout; aggregations "
+    "whose distinct-group estimate exceeds it take the sort-based "
+    "out-of-core fallback (reference: the merge/sort-fallback sizing in "
+    "aggregate.scala computeTargetBatchSize).").integer(1 << 22)
+
+COALESCE_MAX_ROWS = conf("spark.rapids.tpu.sql.coalesce.maxRows").doc(
+    "Row cap per coalesced output batch in CoalesceBatchesExec — bounds "
+    "the concat kernel's capacity bucket even when batchSizeBytes would "
+    "admit more rows (reference: the row-count guard in "
+    "GpuCoalesceBatches' TargetSize goal).").integer(1 << 22)
+
+TRANSPORT_RETRIES = conf(
+    "spark.rapids.tpu.shuffle.transport.retries").doc(
+    "Connection attempts per peer before a fetch/list fails over or "
+    "errors (reference: the UCX transport's connection retry policy)."
+).integer(3)
+
 TRANSPORT_WINDOW_BYTES = conf(
     "spark.rapids.tpu.shuffle.transport.windowBytes").doc(
     "Staging-window size for large-block transport fetches: blocks above "

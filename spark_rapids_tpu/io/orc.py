@@ -5,10 +5,11 @@ The round-1 reader materialized the WHOLE file and then filtered
 (VERDICT r1 weak #10). It now decodes STRIPE BY STRIPE: each stripe reads
 only the needed columns (projection ∪ predicate columns), the predicate
 drops rows before the next stripe is touched, and the projection is
-applied last — peak memory is one stripe plus survivors. pyarrow does
-not expose ORC stripe statistics, so stat-based stripe SKIPPING (the
-reference's searchArgument pushdown) is not possible on this decoder;
-early filtering is the available half of that optimization.
+applied last — peak memory is one stripe plus survivors. pyarrow exposes
+no stripe statistics, so stat-based stripe SKIPPING (the reference's
+searchArgument pushdown) comes from this package's OWN ORC tail parser
+(orc_meta.py — the metadata section is plain protobuf): stripes whose
+min/max provably exclude the predicate are never decoded.
 """
 
 from __future__ import annotations
@@ -37,6 +38,12 @@ def _pred_columns(e) -> Set[str]:
 class OrcSource(FileSource):
     format_name = "orc"
 
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        #: stripes skipped on footer min/max stats (the reference's
+        #: searchArgument stripe pushdown, GpuOrcScan.scala)
+        self.stripes_pruned = 0
+
     def infer_arrow_schema(self) -> pa.Schema:
         return paorc.ORCFile(self.files[0]).schema
 
@@ -50,8 +57,22 @@ class OrcSource(FileSource):
             if filt is not None and read_cols is not None:
                 need = set(read_cols) | _pred_columns(self.predicate)
                 read_cols = [c for c in f.schema.names if c in need]
+        stripe_stats = None
+        if self.predicate is not None:
+            from .orc_meta import parse_stripe_stats
+            stripe_stats = parse_stripe_stats(path)
+            if stripe_stats is not None and \
+                    len(stripe_stats) != f.nstripes:
+                stripe_stats = None       # tail mismatch: never prune
         pieces = []
         for s in range(f.nstripes):
+            if stripe_stats is not None:
+                from .parquet import _rg_can_match
+                stats = stripe_stats[s]
+                if not _rg_can_match(None, list(stats), self.predicate,
+                                     stats_for=stats.get):
+                    self.stripes_pruned += 1
+                    continue
             t = f.read_stripe(s, columns=read_cols)
             if isinstance(t, pa.RecordBatch):
                 t = pa.Table.from_batches([t])

@@ -1,0 +1,248 @@
+"""Minimal ORC tail parser: stripe-level column statistics for pruning.
+
+Reference: GpuOrcScan.scala pushes search arguments into the native ORC
+reader so whole stripes are skipped on min/max stats. pyarrow's ORC
+binding exposes no stripe statistics, so this module parses the file tail
+itself — ORC metadata is plain protobuf wire format (postscript → footer
+→ metadata sections), which a ~150-line reader covers for the stats we
+need. Decode stays with pyarrow; only the SKIP decision comes from here.
+
+Supported: UNCOMPRESSED and ZLIB (raw-deflate chunk) tails — the common
+writer configs (pyarrow default = uncompressed, Spark default = zlib).
+Anything else returns None and the scan keeps every stripe (pruning is an
+optimization, never a semantics change).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+_MAGIC = b"ORC"
+
+# PostScript compression enum
+_NONE, _ZLIB = 0, 1
+
+
+class _Pb:
+    """Protobuf wire-format reader (varint / 64-bit / length-delimited /
+    32-bit), bounds-checked."""
+
+    def __init__(self, data: bytes):
+        self.d = data
+        self.p = 0
+
+    def at_end(self) -> bool:
+        return self.p >= len(self.d)
+
+    def varint(self) -> int:
+        v = 0
+        shift = 0
+        while True:
+            if self.p >= len(self.d):
+                raise ValueError("truncated varint")
+            b = self.d[self.p]
+            self.p += 1
+            v |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return v
+            shift += 7
+            if shift > 70:
+                raise ValueError("varint overflow")
+
+    def key(self) -> Tuple[int, int]:
+        k = self.varint()
+        return k >> 3, k & 7
+
+    def bytes_(self) -> bytes:
+        n = self.varint()
+        if self.p + n > len(self.d):
+            raise ValueError("truncated bytes")
+        out = self.d[self.p:self.p + n]
+        self.p += n
+        return out
+
+    def skip(self, wt: int) -> None:
+        if wt == 0:
+            self.varint()
+        elif wt == 1:
+            self.p += 8
+        elif wt == 2:
+            self.bytes_()
+        elif wt == 5:
+            self.p += 4
+        else:
+            raise ValueError(f"wire type {wt}")
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _decompress_section(data: bytes, compression: int) -> Optional[bytes]:
+    """ORC compressed sections are chunked: 3-byte LE header
+    ``(len << 1) | isOriginal`` then len bytes per chunk."""
+    if compression == _NONE:
+        return data
+    if compression != _ZLIB:
+        return None
+    out = bytearray()
+    p = 0
+    while p + 3 <= len(data):
+        h = data[p] | (data[p + 1] << 8) | (data[p + 2] << 16)
+        p += 3
+        n = h >> 1
+        if p + n > len(data):
+            return None
+        chunk = data[p:p + n]
+        p += n
+        if h & 1:
+            out.extend(chunk)
+        else:
+            try:
+                out.extend(zlib.decompress(chunk, -15))
+            except zlib.error:
+                return None
+    return bytes(out)
+
+
+def _parse_column_stats(data: bytes) -> Tuple[Optional[object],
+                                              Optional[object]]:
+    """(min, max) of one ColumnStatistics, or (None, None)."""
+    pb = _Pb(data)
+    mn = mx = None
+    while not pb.at_end():
+        f, wt = pb.key()
+        if f == 2 and wt == 2:            # intStatistics
+            s = _Pb(pb.bytes_())
+            while not s.at_end():
+                f2, wt2 = s.key()
+                if f2 == 1 and wt2 == 0:
+                    mn = _zigzag(s.varint())
+                elif f2 == 2 and wt2 == 0:
+                    mx = _zigzag(s.varint())
+                else:
+                    s.skip(wt2)
+        elif f == 3 and wt == 2:          # doubleStatistics
+            s = _Pb(pb.bytes_())
+            while not s.at_end():
+                f2, wt2 = s.key()
+                if f2 in (1, 2) and wt2 == 1:
+                    v = struct.unpack("<d", s.d[s.p:s.p + 8])[0]
+                    s.p += 8
+                    if f2 == 1:
+                        mn = v
+                    else:
+                        mx = v
+                else:
+                    s.skip(wt2)
+        elif f == 4 and wt == 2:          # stringStatistics
+            s = _Pb(pb.bytes_())
+            while not s.at_end():
+                f2, wt2 = s.key()
+                if f2 in (1, 2) and wt2 == 2:
+                    v = s.bytes_().decode("utf-8", "replace")
+                    if f2 == 1:
+                        mn = v
+                    else:
+                        mx = v
+                else:
+                    s.skip(wt2)
+        else:
+            pb.skip(wt)
+    return mn, mx
+
+
+def parse_stripe_stats(path: str) -> Optional[List[Dict[str, tuple]]]:
+    """Per-stripe {column_name: (min, max)} for FLAT top-level columns, or
+    None when the tail is outside the supported subset."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            tail_len = min(size, 256 << 10)
+            f.seek(size - tail_len)
+            tail = f.read(tail_len)
+        ps_len = tail[-1]
+        ps = _Pb(tail[-1 - ps_len:-1])
+        footer_len = metadata_len = 0
+        compression = _NONE
+        while not ps.at_end():
+            fld, wt = ps.key()
+            if fld == 1 and wt == 0:
+                footer_len = ps.varint()
+            elif fld == 2 and wt == 0:
+                compression = ps.varint()
+            elif fld == 5 and wt == 0:
+                metadata_len = ps.varint()
+            else:
+                ps.skip(wt)
+        need = footer_len + metadata_len + ps_len + 1
+        if need > tail_len:
+            return None                   # enormous tail: skip pruning
+        foot_raw = tail[-1 - ps_len - footer_len:-1 - ps_len]
+        meta_raw = tail[-1 - ps_len - footer_len - metadata_len:
+                        -1 - ps_len - footer_len]
+        footer = _decompress_section(foot_raw, compression)
+        metadata = _decompress_section(meta_raw, compression)
+        if footer is None or metadata is None:
+            return None
+        # footer → root type's field names (flat schemas only)
+        pb = _Pb(footer)
+        types: List[Tuple[int, List[str]]] = []   # (kind, fieldNames)
+        while not pb.at_end():
+            fld, wt = pb.key()
+            if fld == 4 and wt == 2:      # Type
+                t = _Pb(pb.bytes_())
+                kind = -1
+                names: List[str] = []
+                while not t.at_end():
+                    f2, wt2 = t.key()
+                    if f2 == 1 and wt2 == 0:
+                        kind = t.varint()
+                    elif f2 == 3 and wt2 == 2:
+                        names.append(t.bytes_().decode("utf-8"))
+                    else:
+                        t.skip(wt2)
+                types.append((kind, names))
+            else:
+                pb.skip(wt)
+        if not types or types[0][0] != 12:    # root must be STRUCT
+            return None
+        root_names = types[0][1]
+        # flat column i (1-based type id) ↔ root_names[i-1]; nested
+        # subtrees would shift ids, so bail out unless every child type
+        # is primitive. ORC Type.Kind: 0-7 bool..string, 8 binary,
+        # 9 timestamp, 14 decimal, 15 date, 16 varchar, 17 char,
+        # 18 timestamp_instant (10-13 = list/map/struct/union are nested)
+        primitive = {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 14, 15, 16, 17, 18}
+        if len(types) != len(root_names) + 1 or any(
+                k not in primitive for k, _ in types[1:]):
+            return None
+        # metadata → per-stripe stats
+        out: List[Dict[str, tuple]] = []
+        mb = _Pb(metadata)
+        while not mb.at_end():
+            fld, wt = mb.key()
+            if fld == 1 and wt == 2:      # StripeStatistics
+                sb = _Pb(mb.bytes_())
+                col_stats: List[tuple] = []
+                while not sb.at_end():
+                    f2, wt2 = sb.key()
+                    if f2 == 1 and wt2 == 2:
+                        col_stats.append(_parse_column_stats(sb.bytes_()))
+                    else:
+                        sb.skip(wt2)
+                stripe: Dict[str, tuple] = {}
+                for i, name in enumerate(root_names):
+                    if i + 1 < len(col_stats):
+                        mn, mx = col_stats[i + 1]
+                        if mn is not None and mx is not None:
+                            stripe[name] = (mn, mx)
+                out.append(stripe)
+            else:
+                mb.skip(wt)
+        return out or None
+    except (ValueError, IndexError, OSError, struct.error):
+        return None

@@ -311,6 +311,9 @@ class ParquetSource(FileSource):
         return pq.read_schema(self.files[0])
 
     def read_file(self, path: str) -> pa.Table:
+        t = self._native_read_file(path)
+        if t is not None:
+            return t
         filt = expression_to_arrow_filter(self.predicate) \
             if self.predicate is not None else None
         if filt is not None:
@@ -320,6 +323,55 @@ class ParquetSource(FileSource):
         else:
             t = pq.read_table(path, columns=self.columns)
         return rebase_legacy_datetimes(t, self.rebase_mode, path)
+
+    def _native_read_file(self, path: str) -> Optional[pa.Table]:
+        """Whole-file native decode for the PERFILE/COALESCING readers:
+        every row group through the C++ decoder, predicate applied as a
+        compute mask. None → pyarrow path."""
+        from .parquet_native import open_native
+        if not self._native:
+            return None
+        nf = open_native(path)
+        if nf is None or nf.num_row_groups == 0:
+            return None
+        # the predicate may reference columns outside the projection:
+        # read them for the filter, drop them after (dataset-path parity)
+        read_cols = self.columns
+        if self.predicate is not None and self.columns is not None:
+            extra = [c for c in _referenced_columns(self.predicate)
+                     if c not in self.columns]
+            if extra:
+                read_cols = list(self.columns) + extra
+        tables = []
+        names = list(nf.columns.keys())
+        for rg in range(nf.num_row_groups):
+            if self.predicate is not None and not _rg_can_match(
+                    None, names, self.predicate,
+                    stats_for=lambda n, rg=rg: nf.decoded_stats(rg, n)):
+                self.row_groups_pruned += 1
+                continue
+            t = self._native_read(path, rg, read_cols)
+            if t is None:
+                return None
+            tables.append(t)
+        if not tables:
+            schema = self._arrow_schemas.get(path) or pq.read_schema(path)
+            keep = read_cols if read_cols is not None else schema.names
+            t = pa.table({c: pa.array([], type=schema.field(c).type)
+                          for c in keep})
+        else:
+            t = pa.concat_tables(tables)
+        if self.predicate is not None:
+            mask = predicate_mask(self.predicate, t)
+            if mask is not None:
+                t = t.filter(mask)
+            else:
+                filt = expression_to_arrow_filter(self.predicate)
+                if filt is not None:
+                    t = t.filter(filt)
+        if read_cols is not self.columns and self.columns is not None:
+            t = t.select(self.columns)
+        return t
 
     def row_group_counts(self, path: str) -> List[int]:
         f = pq.ParquetFile(path)

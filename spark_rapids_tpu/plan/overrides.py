@@ -171,7 +171,10 @@ def _expr_rules() -> Dict[str, ExprRule]:
               "WindowAgg", "NthValue", "PercentRank", "CumeDist"):
         r(n, TS.ALL_BASIC)
     # aggregates
-    r("Count", TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP)
+    # count is a validity-only kernel: structs pass (their validity lane
+    # is the only thing the segment count reads)
+    r("Count", TS.ALL_BASIC + TS.DECIMAL_128 + TS.ARRAY + TS.MAP
+      + TS.STRUCT)
     for n in ("Min", "Max"):
         r(n, TS.ALL_BASIC + TS.DECIMAL_128)
     # first/last are pure gathers; any layout rides through
@@ -371,14 +374,24 @@ class PlanMeta:
             }.get(fmt)
             if key is not None and not self.conf.get(key):
                 self.will_not_work(f"{key} is false")
-        if isinstance(n, (L.LogicalSort, L.LogicalJoin, L.LogicalAggregate)):
-            # arrays/maps ride through sort/join/agg as PAYLOAD; as KEYS
-            # they have no orderable/hashable scalar encoding on device
+        if isinstance(n, (L.LogicalSort, L.LogicalJoin, L.LogicalAggregate,
+                          L.LogicalWindow)):
+            # arrays/maps/structs ride through sort/join/agg/window as
+            # PAYLOAD; as KEYS they have no orderable/hashable scalar
+            # encoding on device
             from ..types import TypeKind
             if isinstance(n, L.LogicalSort):
                 keys = [o.child for o in n.orders]
             elif isinstance(n, L.LogicalAggregate):
                 keys = list(n.group_exprs)
+            elif isinstance(n, L.LogicalWindow):
+                from ..expressions.window import WindowExpression
+                keys = []
+                for e in n.window_exprs:
+                    w = e.child if isinstance(e, Alias) else e
+                    if isinstance(w, WindowExpression):
+                        keys.extend(w.spec.partition_keys)
+                        keys.extend(o.child for o in w.spec.orders)
             else:
                 keys = list(n.left_keys) + list(n.right_keys)
             schemas = [c.schema() for c in n.children]
@@ -587,7 +600,7 @@ EXEC_SIGS: Dict[str, TypeSig] = {
     "Range": TS.ALL_BASIC,
     "Expand": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT,
     "Sample": TS.ALL_BASIC + TS.ARRAY + TS.MAP + TS.STRUCT,
-    "Window": TS.ALL_BASIC,
+    "Window": TS.ALL_BASIC + TS.STRUCT,
     "Generate": TS.ALL_BASIC + TS.ARRAY + TS.MAP,
 }
 
@@ -658,7 +671,8 @@ def _with_children(node: L.LogicalPlan, children) -> L.LogicalPlan:
 # Conversion (convertIfNeeded + transition insertion)
 # ---------------------------------------------------------------------------
 
-def insert_coalesce_transitions(plan: Exec, target_bytes: int) -> Exec:
+def insert_coalesce_transitions(plan: Exec, target_bytes: int,
+                                max_rows: int = 1 << 22) -> Exec:
     """Post-conversion transition pass (reference:
     GpuTransitionOverrides.scala:41): wrap batch-fragmenting producers in
     CoalesceBatchesExec wherever the consumer declares a coalesce goal
@@ -684,10 +698,11 @@ def insert_coalesce_transitions(plan: Exec, target_bytes: int) -> Exec:
             goal = node.coalesce_goal_for_child(i)
             if isinstance(goal, RequireSingleBatch) and \
                     not c.produces_single_batch:
-                c = CoalesceBatchesExec(c, goal)
+                c = CoalesceBatchesExec(c, goal, max_rows=max_rows)
             elif isinstance(goal, TargetSize) and \
                     isinstance(c, fragmenting):
-                c = CoalesceBatchesExec(c, TargetSize(target_bytes))
+                c = CoalesceBatchesExec(c, TargetSize(target_bytes),
+                                        max_rows=max_rows)
             new_children.append(c)
         node.children = tuple(new_children)
         return node
@@ -743,8 +758,10 @@ class Overrides:
             CostBasedOptimizer(self.conf).optimize(meta)
         self.last_meta = meta
         converted = self._convert(meta)
-        return insert_coalesce_transitions(converted,
-                                           self.conf.batch_size_bytes)
+        from ..config import COALESCE_MAX_ROWS
+        return insert_coalesce_transitions(
+            converted, self.conf.batch_size_bytes,
+            max_rows=int(self.conf.get(COALESCE_MAX_ROWS.key)))
 
     def explain(self, logical: L.LogicalPlan,
                 mode: ExplainMode = ExplainMode.ALL) -> str:
@@ -827,6 +844,8 @@ class Overrides:
         Spark's planner gives the reference; SURVEY.md §3.3). Aggregates
         that cannot decompose (percentile) exchange RAW rows by key and run
         COMPLETE (Spark's ObjectHashAggregate single-stage shape)."""
+        from ..config import AGG_MAX_RESULT_ROWS
+        agg_rows = int(self.conf.get(AGG_MAX_RESULT_ROWS.key))
         from ..expressions.base import Alias as _Alias
         raw_aggs = [e.child if isinstance(e, _Alias) else e
                     for e in n.agg_exprs]
@@ -839,9 +858,11 @@ class Overrides:
                 else:
                     child = self._exchange(SinglePartitioning(), child)
             return HashAggregateExec(n.group_exprs, n.agg_exprs, child,
-                                     AggregateMode.COMPLETE)
+                                     AggregateMode.COMPLETE,
+                                     max_result_rows=agg_rows)
         partial = HashAggregateExec(n.group_exprs, n.agg_exprs, child,
-                                    AggregateMode.PARTIAL)
+                                    AggregateMode.PARTIAL,
+                                    max_result_rows=agg_rows)
         if n.group_exprs and child.num_partitions > 1:
             from ..expressions.base import col
             key_cols = [col(f.name) for f in partial.key_fields]
@@ -853,7 +874,8 @@ class Overrides:
         else:
             ex = partial
         return HashAggregateExec(n.group_exprs, n.agg_exprs, ex,
-                                 AggregateMode.FINAL)
+                                 AggregateMode.FINAL,
+                                 max_result_rows=agg_rows)
 
     def _convert_window(self, n: L.LogicalWindow, child: Exec) -> Exec:
         from ..exec.window import WindowExec

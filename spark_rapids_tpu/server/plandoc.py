@@ -72,6 +72,20 @@ _PLAN_NODES: Dict[str, type] = {
 _ENUMS: Dict[str, type] = {"JoinType": JoinType, "ReaderType": ReaderType}
 
 
+_PLAIN_DATACLASSES: Dict[str, type] = {}
+
+
+def _plain_dataclasses() -> Dict[str, type]:
+    """Non-Expression frozen dataclasses that ride expression trees
+    (window specs); encoded positionally like expressions. Cached —
+    encode_value consults this per value on the server hot path."""
+    if not _PLAIN_DATACLASSES:
+        from ..expressions.window import WindowFrame, WindowSpec
+        _PLAIN_DATACLASSES.update(WindowSpec=WindowSpec,
+                                  WindowFrame=WindowFrame)
+    return _PLAIN_DATACLASSES
+
+
 def _file_sources() -> Dict[str, type]:
     from ..io.avro import AvroSource
     from ..io.csv import CsvSource
@@ -114,6 +128,12 @@ def encode_value(v: Any) -> Any:
         if name not in _ENUMS:
             raise PlanDecodeError(f"unregistered enum type {name}")
         return {"$enum": [name, v.name]}
+    dc_cls = _plain_dataclasses().get(type(v).__name__)
+    if dc_cls is not None and type(v) is dc_cls:
+        import dataclasses
+        return {"$dc": [type(v).__name__]
+                + [encode_value(getattr(v, f.name))
+                   for f in dataclasses.fields(v)]}
     if isinstance(v, (list, tuple)):
         return {"$l": [encode_value(x) for x in v]}
     if isinstance(v, dict):
@@ -163,6 +183,12 @@ def decode_value(v: Any) -> Any:
         if cls is None:
             raise PlanDecodeError(f"unknown enum type {name}")
         return cls[member]
+    if tag == "$dc":
+        name, *args = payload
+        cls = _plain_dataclasses().get(name)
+        if cls is None:
+            raise PlanDecodeError(f"unknown dataclass {name}")
+        return cls(*[decode_value(a) for a in args])
     if tag == "$l":
         return tuple(decode_value(x) for x in payload)
     if tag == "$d":

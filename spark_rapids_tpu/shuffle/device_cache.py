@@ -122,12 +122,16 @@ def shared_device_cache(conf=None) -> DeviceShuffleCache:
             # cross-host peers must be able to reach the block server:
             # bind wide when discovery is configured, loopback otherwise
             window = None
+            retries = 3
             if conf is not None:
-                from ..config import TRANSPORT_WINDOW_BYTES
+                from ..config import (TRANSPORT_RETRIES,
+                                      TRANSPORT_WINDOW_BYTES)
                 window = int(conf.get(TRANSPORT_WINDOW_BYTES.key))
+                retries = int(conf.get(TRANSPORT_RETRIES.key))
             from .transport import DEFAULT_WINDOW_BYTES
             transport = TcpTransport(
                 host="0.0.0.0" if registry_conf else "127.0.0.1",
+                retries=retries,
                 window_bytes=window or DEFAULT_WINDOW_BYTES)
             if conf is not None:
                 from ..config import (CACHED_HEARTBEAT_INTERVAL_MS,

@@ -59,3 +59,72 @@ def test_cached_relation_is_compressed():
     cached = CachedRelation.build(plan)
     raw = 5000 * len("same-string")
     assert cached.size_bytes() < raw
+
+
+def test_orc_stripe_stat_pruning(tmp_path):
+    """Stripe-stat pushdown (VERDICT r4 weak #6): stripes whose min/max
+    exclude the predicate are skipped without decoding, for uncompressed
+    AND zlib tails; results match the unpruned read."""
+    import numpy as np
+    import pyarrow.orc as paorc
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.orc import OrcSource
+    n = 1 << 17
+    t = pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),      # sorted: prunable
+        "v": pa.array(np.arange(n, dtype=np.float64) * 0.5),
+        "s": pa.array((np.arange(n) % 7).astype("U1")),
+    })
+    for comp in ("uncompressed", "zlib"):
+        p = str(tmp_path / f"{comp}.orc")
+        paorc.write_table(t, p, stripe_size=64 << 10, compression=comp)
+        if paorc.ORCFile(p).nstripes < 2:
+            continue      # writer merged stripes; nothing to assert
+        src = OrcSource([p], columns=["k", "v"],
+                        predicate=col("k") >= lit(n - 100))
+        out = pa.concat_tables(list(src.read_split(src.files)))
+        assert out.num_rows == 100
+        assert src.stripes_pruned > 0, comp
+        assert out.column("k").to_pylist() == list(range(n - 100, n))
+
+
+def test_orc_stripe_stats_parser(tmp_path):
+    import numpy as np
+    import pyarrow.orc as paorc
+    from spark_rapids_tpu.io.orc_meta import parse_stripe_stats
+    n = 1 << 17
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64)),
+                  "s": pa.array((np.arange(n) % 3).astype("U1"))})
+    p = str(tmp_path / "stats.orc")
+    paorc.write_table(t, p, stripe_size=64 << 10)
+    stats = parse_stripe_stats(p)
+    f = paorc.ORCFile(p)
+    if f.nstripes < 2:
+        return
+    assert stats is not None and len(stats) == f.nstripes
+    mn, mx = stats[0]["k"]
+    assert mn == 0 and 0 < mx < n - 1       # first stripe covers a prefix
+    assert stats[-1]["k"][1] == n - 1
+
+
+def test_orc_pruning_survives_date_columns(tmp_path):
+    """Review finding: DATE (kind 15) is primitive — its presence must
+    not disable stripe pruning for the whole file."""
+    import numpy as np
+    import pyarrow.orc as paorc
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.orc import OrcSource
+    n = 1 << 17
+    t = pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "d": pa.array((np.arange(n) % 1000).astype(np.int32)).cast(
+            pa.date32()),
+    })
+    p = str(tmp_path / "dates.orc")
+    paorc.write_table(t, p, stripe_size=64 << 10)
+    if paorc.ORCFile(p).nstripes < 2:
+        return
+    src = OrcSource([p], predicate=col("k") >= lit(n - 10))
+    out = pa.concat_tables(list(src.read_split(src.files)))
+    assert out.num_rows == 10
+    assert src.stripes_pruned > 0

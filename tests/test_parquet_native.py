@@ -129,6 +129,28 @@ def test_footer_stats(tmp_path):
     assert nulls == 0
 
 
+def test_perfile_native_with_out_of_projection_predicate(tmp_path):
+    """PERFILE reader, predicate on a column OUTSIDE the projection: the
+    native whole-file path must read it for the filter and drop it after
+    (the pyarrow dataset path's semantics)."""
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.io.source import ReaderType
+    t = sample_table(2000, seed=31)
+    p = str(tmp_path / "pf.parquet")
+    pq.write_table(t, p, row_group_size=700)
+
+    def read(native):
+        src = ParquetSource([p], columns=["i64", "s"],
+                            predicate=col("i32") > lit(0),
+                            reader_type=ReaderType.PERFILE)
+        src._native = native
+        return pa.concat_tables(list(src.read_split(src.files)))
+    a, b = read(True), read(False)
+    assert a.column_names == b.column_names
+    assert a.equals(b)
+
+
 def test_decimal_stats_never_prune(tmp_path):
     """Review finding: decimal footer stats are UNSCALED ints; using them
     against logical Decimal literals would prune MATCHING groups. The
@@ -170,3 +192,24 @@ def test_source_integration_native_vs_pyarrow(tmp_path):
     a, b = read(True), read(False)
     assert a.equals(b)
     assert a.num_rows > 0
+
+
+def test_perfile_native_prunes_row_groups(tmp_path):
+    """Review finding: the PERFILE native path must keep footer-stats
+    row-group pruning (the dataset path it replaces pruned internally)."""
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.io.source import ReaderType
+    t = pa.table({"k": np.arange(4000, dtype=np.int64)})
+    p = str(tmp_path / "sorted.parquet")
+    pq.write_table(t, p, row_group_size=1000)
+    src = ParquetSource([p], predicate=col("k") >= lit(3900),
+                        reader_type=ReaderType.PERFILE)
+    out = pa.concat_tables(list(src.read_split(src.files)))
+    assert out.column("k").to_pylist() == list(range(3900, 4000))
+    assert src.row_groups_pruned == 3
+    # fully-pruned file yields an empty, correctly-typed table
+    src2 = ParquetSource([p], predicate=col("k") >= lit(10**6),
+                         reader_type=ReaderType.PERFILE)
+    out2 = pa.concat_tables(list(src2.read_split(src2.files)))
+    assert out2.num_rows == 0 and out2.column_names == ["k"]

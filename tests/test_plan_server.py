@@ -222,3 +222,22 @@ def test_external_process_server_bit_identical():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_plandoc_window_expression():
+    """Window specs (plain dataclasses riding the expression tree) must
+    cross the wire; VERDICT's front-end must cover the full dialect."""
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.expressions.window import (RowNumber,
+                                                     WindowExpression,
+                                                     WindowFrame,
+                                                     WindowSpec)
+    t = pa.table({"k": pa.array([1, 1, 2, 2], type=pa.int32()),
+                  "v": pa.array([3.0, 1.0, 4.0, 2.0])})
+    spec = WindowSpec(partition_keys=(col("k"),),
+                      orders=(asc(col("v")),),
+                      frame=WindowFrame(is_rows=True, start=None, end=0))
+    df = table(t).window(WindowExpression(RowNumber(), spec).alias("rn"))
+    doc, tables = plandoc.plan_to_doc(df.plan)
+    plan2 = plandoc.doc_to_plan(json.loads(json.dumps(doc)), tables)
+    assert Session().collect(DataFrame(plan2)).equals(Session().collect(df))

@@ -171,3 +171,50 @@ def test_struct_spill_roundtrip():
     blob = serialize_batch(batch, schema, codec="lz4")
     out = deserialize_batch(blob, schema)
     assert to_arrow(out, schema).equals(t)
+
+
+def test_count_struct_column_on_device():
+    """count(struct_col) is validity-only — runs on device."""
+    def q():
+        return (table(struct_table())
+                .group_by("grp")
+                .agg(Count(col("s")).alias("cs"), Count().alias("c")))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    ses = Session()
+    ses.collect(q())
+    assert ses.fell_back() == []
+
+
+def test_struct_carry_through_window():
+    """struct columns ride window partitioning/sorting as payload
+    (gather-based machinery recurses into struct leaves)."""
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.expressions.window import (RowNumber,
+                                                     WindowExpression,
+                                                     WindowSpec)
+
+    def q():
+        spec = WindowSpec(partition_keys=(col("grp"),),
+                          orders=(asc(col("id")),))
+        return (table(struct_table())
+                .window(WindowExpression(RowNumber(), spec).alias("rn"))
+                .select(col("id"), col("rn"),
+                        GetStructField(col("s"), 0).alias("x")))
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=True)
+    ses = Session()
+    ses.collect(q())
+    assert ses.fell_back() == []
+
+
+def test_struct_window_key_falls_back():
+    """struct PARTITION/ORDER keys in a window have no device order —
+    clean fallback, not a runtime TypeError (review finding)."""
+    from spark_rapids_tpu.expressions.window import (RowNumber,
+                                                     WindowExpression,
+                                                     WindowSpec)
+    spec = WindowSpec(partition_keys=(col("s"),))
+    assert_tpu_fallback_collect(
+        lambda: (table(struct_table())
+                 .window(WindowExpression(RowNumber(), spec).alias("rn"))
+                 .select(col("id"), col("rn"))),
+        "CpuFallback", ignore_order=True)
