@@ -64,6 +64,29 @@ def _elem_mask(col: DeviceColumn) -> jnp.ndarray:
             col.lengths[:, None]) & col.validity[:, None]
 
 
+def _elem_equals_value(a: DeviceColumn, v: DeviceColumn) -> jnp.ndarray:
+    """bool[cap, me]: per-element equality of an array column's elements
+    against a per-row scalar value. String elements compare byte-wise over
+    width-aligned matrices (zero padding is canonical in both layouts) +
+    byte lengths; scalar elements compare directly."""
+    if a.data.ndim == 3:        # array<string>: [cap, me, ml] + data2 lens
+        wa, wv = a.data.shape[2], v.data.shape[1]
+        w = max(wa, wv)
+        da = jnp.pad(a.data, ((0, 0), (0, 0), (0, w - wa))) \
+            if wa < w else a.data
+        dv = jnp.pad(v.data, ((0, 0), (0, w - wv))) if wv < w else v.data
+        same = jnp.all(da == dv[:, None, :], axis=2)
+        return same & (a.data2 == v.lengths[:, None])
+    return a.data == v.data[:, None]
+
+
+def _strings_compatible(value_t: SqlType, elem_t: SqlType) -> bool:
+    """Two string types differing only in device max_len are the same SQL
+    type (widths are a storage parameter, not a type)."""
+    return value_t.kind is TypeKind.STRING and \
+        elem_t.kind is TypeKind.STRING
+
+
 # ---------------------------------------------------------------------------
 # Basic array ops
 # ---------------------------------------------------------------------------
@@ -166,19 +189,17 @@ class ArrayContains(Expression):
     @property
     def dtype(self):
         et = _require_array(self.arr, "array_contains")
-        if self.value.dtype != et:
+        if self.value.dtype != et and \
+                not _strings_compatible(self.value.dtype, et):
             raise TypeError(f"array_contains value {self.value.dtype} vs "
                             f"element {et}")
         return T.BOOLEAN
-
-    def device_unsupported_reason(self):
-        return _scalar_elems_reason(self.arr, "array_contains")
 
     def eval(self, batch, ctx=EvalContext()):
         a = self.arr.eval(batch, ctx)
         v = self.value.eval(batch, ctx)
         live = _elem_mask(a)
-        hit = jnp.any(live & (a.data == v.data[:, None]), axis=1)
+        hit = jnp.any(live & _elem_equals_value(a, v), axis=1)
         return DeviceColumn(hit, a.validity & v.validity, None, T.BOOLEAN)
 
 
@@ -1134,9 +1155,6 @@ class ArrayRemove(Expression):
     def with_children(self, c):
         return ArrayRemove(c[0], c[1])
 
-    def device_unsupported_reason(self):
-        return _scalar_elems_reason(self.child, "array_remove")
-
     @property
     def dtype(self):
         return self.child.dtype
@@ -1146,9 +1164,21 @@ class ArrayRemove(Expression):
         v = self.value.eval(batch, ctx)
         me = a.data.shape[1]
         live = jnp.arange(me)[None, :] < a.lengths[:, None]
-        keep = live & ~(a.data == v.data[:, None])
-        out, ln = _compact_elems(a.data, keep)
+        keep = live & ~_elem_equals_value(a, v)
         validity = a.validity & v.validity
+        if a.data.ndim == 3:    # string elements: permute whole elements
+            order = jnp.argsort(jnp.where(keep, 0, 1), axis=1,
+                                stable=True)
+            ln = jnp.sum(keep.astype(jnp.int32), axis=1)
+            data = jnp.take_along_axis(a.data, order[:, :, None], axis=1)
+            lens2 = jnp.take_along_axis(a.data2, order, axis=1)
+            slot_live = jnp.arange(me)[None, :] < ln[:, None]
+            data = jnp.where(slot_live[:, :, None], data, 0)
+            lens2 = jnp.where(slot_live, lens2, 0)
+            return DeviceColumn(data, validity,
+                                jnp.where(validity, ln, 0), self.dtype,
+                                lens2)
+        out, ln = _compact_elems(a.data, keep)
         return DeviceColumn(out, validity, jnp.where(validity, ln, 0),
                             self.dtype)
 
@@ -1167,9 +1197,6 @@ class ArrayPosition(Expression):
     def with_children(self, c):
         return ArrayPosition(c[0], c[1])
 
-    def device_unsupported_reason(self):
-        return _scalar_elems_reason(self.child, "array_position")
-
     @property
     def dtype(self):
         return T.INT64
@@ -1179,7 +1206,7 @@ class ArrayPosition(Expression):
         v = self.value.eval(batch, ctx)
         me = a.data.shape[1]
         live = jnp.arange(me)[None, :] < a.lengths[:, None]
-        hit = live & (a.data == v.data[:, None])
+        hit = live & _elem_equals_value(a, v)
         pos = jnp.where(jnp.any(hit, axis=1),
                         jnp.argmax(hit, axis=1).astype(jnp.int64) + 1,
                         jnp.int64(0))

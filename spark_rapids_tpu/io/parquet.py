@@ -342,20 +342,29 @@ class ParquetSource(FileSource):
                      if c not in self.columns]
             if extra:
                 read_cols = list(self.columns) + extra
+        schema = self._arrow_schemas.get(path)
+        if schema is None:
+            schema = pq.read_schema(path)
+            self._arrow_schemas[path] = schema
+        if read_cols is not None and \
+                any(c not in schema.names for c in read_cols):
+            return None      # partition/virtual columns: pyarrow path
         tables = []
         names = list(nf.columns.keys())
+        pruned = 0           # applied to the metric only on SUCCESS — a
+        # later native-subset fallback re-reads everything via pyarrow
         for rg in range(nf.num_row_groups):
             if self.predicate is not None and not _rg_can_match(
                     None, names, self.predicate,
                     stats_for=lambda n, rg=rg: nf.decoded_stats(rg, n)):
-                self.row_groups_pruned += 1
+                pruned += 1
                 continue
             t = self._native_read(path, rg, read_cols)
             if t is None:
                 return None
             tables.append(t)
+        self.row_groups_pruned += pruned
         if not tables:
-            schema = self._arrow_schemas.get(path) or pq.read_schema(path)
             keep = read_cols if read_cols is not None else schema.names
             t = pa.table({c: pa.array([], type=schema.field(c).type)
                           for c in keep})

@@ -66,16 +66,24 @@ class ParamSig:
     """One argument position's contract: admitted types + whether the
     argument must be a foldable literal (reference: TypeChecks.scala's
     per-param ``TypeSig`` + ``lit()`` markers driving both fallback and
-    the generated supported_ops docs)."""
+    the generated supported_ops docs).
+
+    ``outer`` restricts the TOP-LEVEL kind separately from ``sig`` (which
+    TypeSig.supports also applies to nested element types): a collection
+    argument declares outer=ARRAY+MAP with sig admitting the element
+    kinds too."""
 
     name: str
     sig: "TypeSig"
     lit_required: bool = False
+    outer: Optional["TypeSig"] = None
 
     def check(self, expr, dtype) -> Optional[str]:
         from ..expressions.base import Literal
         if self.lit_required and not isinstance(expr, Literal):
             return f"parameter '{self.name}' must be a literal"
+        if self.outer is not None and dtype.kind not in self.outer.kinds:
+            return f"parameter '{self.name}': {dtype} is not supported"
         r = self.sig.supports(dtype)
         if r:
             return f"parameter '{self.name}': {r}"
@@ -104,8 +112,9 @@ def params(*fixed, repeat: Optional[ParamSig] = None) -> Params:
     return Params(tuple(fixed), repeat)
 
 
-def p(name: str, sig: "TypeSig", lit: bool = False) -> ParamSig:
-    return ParamSig(name, sig, lit)
+def p(name: str, sig: "TypeSig", lit: bool = False,
+      outer: Optional["TypeSig"] = None) -> ParamSig:
+    return ParamSig(name, sig, lit, outer)
 
 
 def _sig(*kinds: TypeKind) -> TypeSig:

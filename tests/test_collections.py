@@ -256,3 +256,61 @@ def test_get_map_value_nullable_gates_create_array():
             CreateArray((GetMapValue(col("m"), lit(np.int32(99))),)
                         ).alias("a")),
         "Project")
+
+
+# ---------------------------------------------------------------------------
+# round-5: array<string> kernels beyond access/explode
+# ---------------------------------------------------------------------------
+
+def str_arr_table():
+    return pa.table({
+        "a": pa.array([["b", "a"], ["c"], None, ["a", "a", "d"], []],
+                      type=pa.list_(pa.string())),
+        "v": pa.array(["a", "c", "a", "a", "x"]),
+    })
+
+
+def test_array_contains_strings_on_device():
+    def q():
+        return table(str_arr_table()).select(
+            ArrayContains(col("a"), lit("a")).alias("lit_hit"),
+            ArrayContains(col("a"), col("v")).alias("col_hit"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    s = Session()
+    s.collect(q())
+    assert s.fell_back() == []
+
+
+def test_array_position_strings_on_device():
+    from spark_rapids_tpu.expressions.collections import ArrayPosition
+    def q():
+        return table(str_arr_table()).select(
+            ArrayPosition(col("a"), lit("a")).alias("p"),
+            ArrayPosition(col("a"), col("v")).alias("pv"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    s = Session()
+    s.collect(q())
+    assert s.fell_back() == []
+
+
+def test_array_remove_strings_on_device():
+    from spark_rapids_tpu.expressions.collections import ArrayRemove
+    def q():
+        return table(str_arr_table()).select(
+            ArrayRemove(col("a"), lit("a")).alias("r"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    s = Session()
+    s.collect(q())
+    assert s.fell_back() == []
+
+
+def test_element_at_strings_on_device():
+    """regression: the r5 per-param sigs must not reject array<string>
+    collections (TypeSig element recursion)."""
+    def q():
+        return table(str_arr_table()).select(
+            ElementAt(col("a"), lit(1)).alias("e"))
+    assert_tpu_and_cpu_are_equal_collect(q)
+    s = Session()
+    s.collect(q())
+    assert s.fell_back() == []
