@@ -31,6 +31,9 @@ class PlanClient:
         #: plan-capture info from the last collect (test harness surface)
         self.last_execs: List[str] = []
         self.last_fell_back: List[str] = []
+        #: operator metrics of the last collect (server-side
+        #: Session.metrics(), the reference's SQLMetrics roll-up)
+        self.last_metrics: dict = {}
         protocol.send_preamble(self._sock)
         version = protocol.recv_preamble(self._sock)
         if version != protocol.PROTOCOL_VERSION:
@@ -86,6 +89,7 @@ class PlanClient:
              "conf": conf or {}})
         self.last_execs = reply.get("execs", [])
         self.last_fell_back = reply.get("fell_back", [])
+        self.last_metrics = reply.get("metrics", {})
         return protocol.ipc_to_table(body)
 
     def explain(self, df: DataFrame, conf: Optional[dict] = None) -> str:

@@ -92,7 +92,11 @@ class _Handler(socketserver.BaseRequestHandler):
             return ({"msg": "result",
                      "rows": result.num_rows,
                      "execs": ses.executed_exec_names(),
-                     "fell_back": ses.fell_back()},
+                     "fell_back": ses.fell_back(),
+                     # operator metrics ride back to the driver the way
+                     # the reference posts SQLMetrics to the Spark UI
+                     "metrics": {k: int(v)
+                                 for k, v in ses.metrics().items()}},
                     protocol.table_to_ipc(result))
         raise ValueError(f"unknown message {msg!r}")
 

@@ -138,6 +138,10 @@ def test_embedded_server_collect_and_capture():
             got = client.collect(df)
             assert got.equals(expected)
             assert any("Agg" in n for n in client.last_execs)
+            # operator metrics ride back (SQLMetrics-to-driver analogue)
+            assert any("numOutputRows" in k for k in client.last_metrics)
+            assert all(isinstance(v, int)
+                       for v in client.last_metrics.values())
             # repeated query over the same table objects: no re-ship, and
             # the result is stable
             assert client.collect(df).equals(expected)
