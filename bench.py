@@ -457,6 +457,54 @@ def _run_sub(argv, timeout_s):
     return None, f"no JSON output (rc={proc.returncode})"
 
 
+def _last_good_configs():
+    """Most recent committed BENCH_r*.json whose parsed payload contains
+    VERIFIED per-config speedups. Returns (source_filename, configs) or
+    (None, None). The driver wraps bench stdout under "parsed"; a raw
+    bench JSON (no wrapper) is accepted too."""
+    import glob
+    import re
+    best = (None, None)
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(
+        glob.glob(os.path.join(here, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = data.get("parsed", data) if isinstance(data, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        configs = [c for c in parsed.get("configs", [])
+                   if isinstance(c, dict) and "speedup_vs_pyarrow" in c]
+        if configs:
+            best = (os.path.basename(path), configs)   # later round wins
+    return best
+
+
+def _stale_results(probe_note):
+    """Last-good sidecar (VERDICT r5 weak #1): with the device down, the
+    round reports the PREVIOUS verified per-config numbers tagged
+    "stale": true instead of zeroing every config. No last-good artifact
+    -> plain per-config errors, as before."""
+    src, configs = _last_good_configs()
+    err = f"device probe failed: {probe_note}"
+    if configs is None:
+        return [{"config": n, "error": err} for n in CONFIGS], None
+    by_name = {c.get("config"): c for c in configs}
+    out = []
+    for name in CONFIGS:
+        if name in by_name:
+            out.append({**by_name[name], "stale": True,
+                        "stale_source": src, "error": err})
+        else:
+            out.append({"config": name, "error": err})
+    return out, src
+
+
 def main():
     t_start = time.perf_counter()
 
@@ -476,9 +524,9 @@ def main():
             break
 
     results = []
+    stale_source = None
     if probe is None:
-        results = [{"config": n, "error": f"device probe failed: {probe_note}"}
-                   for n in CONFIGS]
+        results, stale_source = _stale_results(probe_note)
     else:
         for name in CONFIGS:
             rem = remaining()
@@ -505,7 +553,7 @@ def main():
         if mt_speedups else 0.0
     headline = next((r for r in results if r["config"] == "q1_stage"
                      and "device_Mrows_per_s" in r), None)
-    print(json.dumps({
+    out = {
         "metric": "five_config_geomean_speedup_vs_pyarrow_oracle",
         "value": round(geomean, 3),
         "unit": "x (geomean over configs; oracle = single-thread pyarrow)",
@@ -514,11 +562,21 @@ def main():
             "device_Mrows_per_s"),
         "geomean_vs_mt_oracle": round(mt_geomean, 3),
         "host_cores": os.cpu_count(),
-        "completed_configs": len(speedups),
+        "completed_configs": len([r for r in results
+                                  if "speedup_vs_pyarrow" in r
+                                  and not r.get("stale")]),
         "platform": (probe or {}).get("platform"),
         "elapsed_s": round(time.perf_counter() - t_start, 1),
         "configs": results,
-    }), flush=True)
+    }
+    if stale_source is not None:
+        # honest labeling: the headline number is the LAST VERIFIED round,
+        # not this one — readers (and the driver) must see the flag
+        out["stale"] = True
+        out["stale_source"] = stale_source
+        out["probe_error"] = probe_note
+        out["unit"] += f" [STALE: last verified round, {stale_source}]"
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

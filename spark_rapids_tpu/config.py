@@ -255,6 +255,20 @@ REGEXP_ENABLED = conf("spark.rapids.tpu.sql.regexp.enabled").doc(
     "on accelerators (reference: spark.rapids.sql.regexp.enabled)."
 ).boolean(True)
 
+PREFETCH_ENABLED = conf("spark.rapids.tpu.prefetch.enabled").doc(
+    "Pipelined host prefetch (spark_rapids_tpu/pipeline.py): scans decode "
+    "batch N+1 on a background thread while batch N is in device_put/"
+    "compute, and exchange serialization D2H-stages partition P+1 while "
+    "partition P is framed/compressed (reference: pinned-memory prefetch, "
+    "GpuMultiFileReader.scala:441 + PinnedMemoryPool). Disabling "
+    "reproduces the synchronous path bit for bit; single-core hosts skip "
+    "the thread handoff automatically.").boolean(True)
+
+PREFETCH_DEPTH = conf("spark.rapids.tpu.prefetch.depth").doc(
+    "Bounded look-ahead of each prefetch pipeline stage (items buffered "
+    "ahead of the consumer). 2 = double buffering; 0 disables, identical "
+    "to prefetch.enabled=false.").integer(2)
+
 READER_BATCH_ROWS = conf("spark.rapids.tpu.sql.reader.batchSizeRows").doc(
     "Row target per decoded host batch a scan emits (reference: "
     "spark.rapids.sql.reader.batchSizeRows).").integer(1 << 20)

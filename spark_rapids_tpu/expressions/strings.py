@@ -13,9 +13,13 @@ rectangular VPU ops:
   all-equal reduction, vectorized over every (row, shift) pair at once.
 
 Unicode: lengths/substr index by CODEPOINT (UTF-8 lead-byte cumsum), like
-Spark. upper/lower map ASCII only — the full simple-case-mapping table is a
-planned lookup; non-ASCII case mapping is tagged incompat in the planner
-(the reference ships the same caveat for some locales).
+Spark. upper/lower map ASCII bytewise plus SIMPLE (single-char,
+length-preserving) case tables for the 2-byte (U+0080-U+07FF) and 3-byte
+(U+0800-U+FFFF) UTF-8 ranges — Latin/Greek/Cyrillic through Georgian,
+Cherokee, full-width Latin. Length-changing mappings (ß→SS), cross-width
+mappings and 4-byte scripts pass through unchanged; that residue is why
+Upper/Lower stay default-incompat in the planner (the reference gates
+locale-sensitive case the same way).
 """
 
 from __future__ import annotations
@@ -133,10 +137,12 @@ _UPPER_3B, _LOWER_3B = _case_tables_3b()
 @dataclass(frozen=True, eq=False)
 class Upper(Expression):
     """upper/lower: ASCII bytewise plus SIMPLE case mapping for every
-    2-byte UTF-8 codepoint whose counterpart is also 2-byte (Latin-1/
-    Extended, Greek, Cyrillic). Length-changing mappings (ß→SS) and
-    3/4-byte scripts pass through — the rule is default-incompat for that
-    residue (reference gates locale-sensitive case the same way)."""
+    2-byte codepoint whose counterpart is also 2-byte (Latin-1/Extended,
+    Greek, Cyrillic) and every 3-byte codepoint whose counterpart is also
+    3-byte (Georgian, Cherokee, full-width Latin, Greek Extended).
+    Length-changing mappings (ß→SS), cross-width mappings and 4-byte
+    scripts pass through — the rule is default-incompat for that residue
+    (reference gates locale-sensitive case the same way)."""
 
     child: Expression
     _upper = True

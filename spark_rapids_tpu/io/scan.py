@@ -17,6 +17,12 @@ from .source import FileSource
 class FileSourceScanExec(LeafExec):
     def __init__(self, source: FileSource, num_slices: int = 1):
         super().__init__()
+        from ..exec.base import DEBUG, MODERATE, Metric
+        # prefetch pipeline visibility (reference: the multi-file reader's
+        # bufferTime/filterTime metric split): overlapTime = decode work
+        # hidden behind this exec's device_put/compute
+        self.metrics["overlapTime"] = Metric("overlapTime", MODERATE)
+        self.metrics["prefetchWaitTime"] = Metric("prefetchWaitTime", DEBUG)
         self.source = source
         #: per-PLAN file list: DPP prunes THIS copy, never the shared
         #: FileSource (a pruned source would corrupt later queries)
@@ -60,10 +66,18 @@ class FileSourceScanExec(LeafExec):
                 if i % self._num_slices == p]
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
-        for host_table in self.source.read_split(self._files_for(p)):
-            batch, _ = from_arrow(host_table, schema=self._schema)
-            self.metrics["numOutputRows"].add(host_table.num_rows)
-            yield batch
+        from ..pipeline import close_iterator
+        it = self.source.read_split(self._files_for(p),
+                                    metrics=self.metrics)
+        try:
+            for host_table in it:
+                batch, _ = from_arrow(host_table, schema=self._schema)
+                self.metrics["numOutputRows"].add(host_table.num_rows)
+                yield batch
+        finally:
+            # consumer abort (limit early-exit) must cancel the prefetch
+            # producer promptly — no decode running past the query
+            close_iterator(it)
 
 
 # ---------------------------------------------------------------------------

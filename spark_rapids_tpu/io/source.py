@@ -183,6 +183,7 @@ class FileSource:
         #: session-conf overrides (apply_conf); None = registry defaults
         self._mt_max_tasks: Optional[int] = None
         self._coalesce_par: Optional[int] = None
+        self._prefetch_depth: Optional[int] = None
         if hive_partitions:
             self._discover_hive_partitions()
             if self.columns and self.partition_schema:
@@ -224,6 +225,7 @@ class FileSource:
         from ..config import (COALESCING_PARALLEL_FILES,
                               MT_READER_MAX_TASKS,
                               MULTITHREADED_READ_THREADS,
+                              PREFETCH_DEPTH, PREFETCH_ENABLED,
                               READER_BATCH_ROWS)
         if not self._explicit_threads:
             self.num_threads = int(conf.get(MULTITHREADED_READ_THREADS.key))
@@ -231,6 +233,8 @@ class FileSource:
             self.batch_rows = int(conf.get(READER_BATCH_ROWS.key))
         self._mt_max_tasks = int(conf.get(MT_READER_MAX_TASKS.key))
         self._coalesce_par = int(conf.get(COALESCING_PARALLEL_FILES.key))
+        self._prefetch_depth = int(conf.get(PREFETCH_DEPTH.key)) \
+            if conf.get(PREFETCH_ENABLED.key) else 0
 
     def partition_value(self, name: str, path: str):
         return self._pvalues[name][path]
@@ -314,8 +318,44 @@ class FileSource:
                   for f in self.files]
         return pa.concat_tables(tables) if tables else None
 
-    def read_split(self, files: Sequence[str]) -> Iterator[pa.Table]:
-        """Host-side table stream for a subset of files, by strategy."""
+    def prefetch_depth(self) -> int:
+        """Effective prefetch look-ahead: session conf via apply_conf,
+        registry defaults otherwise (0 = synchronous)."""
+        if self._prefetch_depth is not None:
+            return self._prefetch_depth
+        from ..config import PREFETCH_DEPTH, PREFETCH_ENABLED, _REGISTRY
+        if not _REGISTRY[PREFETCH_ENABLED.key].default:
+            return 0
+        return int(_REGISTRY[PREFETCH_DEPTH.key].default)
+
+    def read_split(self, files: Sequence[str],
+                   metrics=None) -> Iterator[pa.Table]:
+        """Host-side table stream for a subset of files, by strategy,
+        produced ``prefetch.depth`` batches ahead of the consumer on a
+        background thread (reference: GpuMultiFileReader.scala:441
+        prefetch) so decode overlaps the consumer's device_put/compute.
+        ``metrics`` (an exec's metric dict) receives overlapTime /
+        prefetchWaitTime when present. depth=0 (or a single-core host)
+        yields the decode generator itself — the synchronous path.
+
+        MULTITHREADED skips the extra stage: its bounded_map window IS a
+        decode-ahead pipeline (futures stay in flight between pulls), and
+        measurement shows a second handoff stage only costs there
+        (docs/profiling.md "prefetch pipeline"). PERFILE/COALESCING
+        decode/concat on the consumer thread, which is exactly the serial
+        work the prefetch stage hides."""
+        it = self._decode_split(files)
+        if self.effective_reader() is ReaderType.MULTITHREADED:
+            return it
+        from ..pipeline import prefetched
+        # dedicated thread, NOT the shared reader pool: the producer holds
+        # its worker for the whole scan, and the decode tasks it drives
+        # submit into that same pool (pool-of-producers deadlock)
+        return prefetched(it, self.prefetch_depth(),
+                          metrics=metrics, name=f"{self.format_name}-scan")
+
+    def _decode_split(self, files: Sequence[str]) -> Iterator[pa.Table]:
+        """The undecorated decode stream (strategy dispatch)."""
         mode = self.effective_reader()
         if mode is ReaderType.PERFILE:
             for f in files:

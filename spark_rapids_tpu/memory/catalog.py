@@ -150,10 +150,8 @@ class BufferCatalog:
         return freed
 
     def _spill_to_host(self, e: _Entry) -> None:
-        from ..shuffle.serializer import _col_to_arrays
-        host = {}
-        for i, c in enumerate(e.batch.columns):
-            _col_to_arrays(c, str(i), host)   # struct leaves recurse
+        from ..shuffle.serializer import batch_to_arrays
+        host = batch_to_arrays(e.batch)       # struct leaves recurse
         host["n"] = np.asarray(jax.device_get(e.batch.num_rows))
         # ONE contiguous allocation per spilled batch (reference:
         # contiguous-split packed tables / MetaUtils TableMeta) — the
@@ -179,10 +177,12 @@ class BufferCatalog:
                 break
             os.makedirs(self.spill_dir, exist_ok=True)
             path = os.path.join(self.spill_dir, f"buf-{e.handle_id}.rtpu")
-            from ..shuffle.serializer import serialize_host
-            arrays = e.host.arrays()
+            # serialize-once: frame straight from the packed host buffer
+            # (the pack at spill time WAS the serialization; re-flattening
+            # per array here doubled the host-boundary copies)
+            from ..shuffle.serializer import frame_packed
             with open(path, "wb") as f:
-                f.write(serialize_host(arrays, e.host.meta.num_rows))
+                f.write(frame_packed(e.host))
             e.path = path
             e.host = None
             e.tier = StorageTier.DISK
@@ -252,6 +252,14 @@ class BufferCatalog:
     def tier_of(self, hid: int) -> StorageTier:
         return self._entries[hid].tier
 
+    def host_view(self, hid: int):
+        """The handle's PackedTable when it lives on the HOST tier, else
+        None. Wire exporters frame spilled pieces straight from this view
+        (serialize-once) instead of round-tripping them through HBM."""
+        with self._lock:
+            e = self._entries[hid]
+            return e.host if e.tier is StorageTier.HOST else None
+
     # ------------------------------------------------------------------
     # leak detection (reference: cudf MemoryCleaner shutdown check +
     # Plugin.scala shutdown-hook ordering)
@@ -298,6 +306,12 @@ class SpillableBatch:
     def get(self) -> ColumnarBatch:
         assert self._open
         return self.catalog.acquire(self.hid)
+
+    def host_view(self):
+        """PackedTable view when spilled to host, else None (see
+        BufferCatalog.host_view)."""
+        assert self._open
+        return self.catalog.host_view(self.hid)
 
     def done_with(self) -> None:
         """Release the pin so the batch becomes spillable again."""

@@ -35,8 +35,11 @@ _CODEC = {"none": 0, "lz4": 1, "zlib": 2, "zstd": 3}
 _CODEC_R = {v: k for k, v in _CODEC.items()}
 
 
-def _write_blob(out: io.BytesIO, raw: bytes,
+def _write_blob(out: io.BytesIO, raw,
                 codec: Optional[str] = None) -> None:
+    """``raw`` may be bytes or a contiguous byte memoryview into a shared
+    buffer (the packed-table fast path): every codec path consumes it
+    without an intermediate copy (np.frombuffer / zlib accept views)."""
     payload, codec = native.compress(raw, codec)
     if len(payload) >= len(raw):
         payload, codec = raw, "none"
@@ -134,13 +137,81 @@ def _col_from_arrays(dtype, key: str,
                         lengths, dtype, data2)
 
 
-def serialize_batch(batch: ColumnarBatch, schema: Schema,
-                    codec: Optional[str] = None) -> bytes:
-    """Device batch -> framed bytes (D2H then frame)."""
+def batch_to_arrays(batch: ColumnarBatch) -> Dict[str, np.ndarray]:
+    """D2H every lane of a device batch under its path-encoded keys."""
     arrays: Dict[str, np.ndarray] = {}
     for i, c in enumerate(batch.columns):
         _col_to_arrays(c, str(i), arrays)
-    return serialize_host(arrays, int(batch.num_rows), codec)
+    return arrays
+
+
+def pack_batch(batch: ColumnarBatch):
+    """One D2H staging pass: the device batch's lanes land in a single
+    contiguous host PackedTable (memory/packed.py — the pinned-staging
+    shape), which BOTH the spill host tier and `frame_packed` consume
+    without reparsing. This is the serialize-once carrier: a batch packed
+    here is never re-flattened, whether it goes to the wire, to disk, or
+    back to the device."""
+    from ..memory.packed import PackedTable
+    return PackedTable.pack(batch_to_arrays(batch), int(batch.num_rows))
+
+
+def frame_packed(packed, codec: Optional[str] = None) -> bytes:
+    """PackedTable -> RTPU frame, slicing each section's payload straight
+    out of the packed buffer (no per-array tobytes round-trip; the only
+    remaining copy is the codec's own output). Byte-compatible with
+    serialize_host — deserialize_host/deserialize_batch read both."""
+    mv = memoryview(packed.buffer).cast("B")
+    out = io.BytesIO()
+    out.write(MAGIC)
+    out.write(struct.pack("<IIq", VERSION, len(packed.meta.sections),
+                          packed.meta.num_rows))
+    for s in packed.meta.sections:
+        nb = s.key.encode()
+        dt = s.dtype.encode()
+        out.write(struct.pack("<I", len(nb)))
+        out.write(nb)
+        out.write(struct.pack("<B", len(dt)))
+        out.write(dt)
+        out.write(struct.pack("<B", len(s.shape)))
+        for dim in s.shape:
+            out.write(struct.pack("<q", dim))
+        _write_blob(out, mv[s.offset: s.offset + s.nbytes], codec)
+    return out.getvalue()
+
+
+def serialize_batch(batch: ColumnarBatch, schema: Schema,
+                    codec: Optional[str] = None) -> bytes:
+    """Device batch -> framed bytes: ONE D2H staging pass into a packed
+    table, then frame directly from it (reference: the serialize-once
+    contiguous-split + JCudfSerialization write path,
+    GpuPartitioning.scala:52)."""
+    return frame_packed(pack_batch(batch), codec)
+
+
+def iter_framed(batches, codec: Optional[str] = None,
+                depth: Optional[int] = None, metrics=None):
+    """Frame a stream of device batches with the D2H stage of batch N+1
+    overlapped with the framing/compression of batch N (the exchange-side
+    use of the bounded pipeline; depth=0 = synchronous). Yields
+    (item, frame_bytes) pairs where ``batches`` yields (item, batch)."""
+    from ..pipeline import close_iterator, prefetched
+
+    def staged():
+        for item, b in batches:
+            yield item, pack_batch(b)     # D2H on the producer thread
+
+    if depth is None:
+        from ..config import PREFETCH_DEPTH, PREFETCH_ENABLED, _REGISTRY
+        depth = int(_REGISTRY[PREFETCH_DEPTH.key].default) \
+            if _REGISTRY[PREFETCH_ENABLED.key].default else 0
+    it = prefetched(staged(), depth, metrics=metrics,
+                    name="exchange-serialize")
+    try:
+        for item, packed in it:
+            yield item, frame_packed(packed, codec)
+    finally:
+        close_iterator(it)
 
 
 def deserialize_batch(data: bytes, schema: Schema) -> ColumnarBatch:

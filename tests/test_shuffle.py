@@ -249,3 +249,62 @@ def test_serializer_round_trip_zstd():
         assert got.column("s").to_pylist() == t.column("s").to_pylist()
     finally:
         native.set_default_codec("lz4")
+
+
+def test_serialized_partitions_wire_export_round_trips():
+    """serialized_partitions frames each materialized piece exactly once
+    (pack -> frame; no Arrow anywhere) and covers every reader partition
+    in order, matching the normal device read path row for row."""
+    import numpy as np
+    from spark_rapids_tpu.batch import to_arrow
+    from spark_rapids_tpu.shuffle.serializer import deserialize_batch
+    t = pa.table({"a": np.arange(2000, dtype=np.int64),
+                  "v": np.arange(2000, dtype=np.float64)})
+    ex = ShuffleExchangeExec(HashPartitioning([col("a")], 4), scan(t))
+    schema = ex.output_schema
+    wire_rows = {}
+    for p, frames in ex.serialized_partitions(codec="lz4", depth=2):
+        rows = []
+        for f in frames:
+            rows.extend(rows_of(to_arrow(deserialize_batch(f, schema),
+                                         schema)))
+        wire_rows[p] = rows
+    assert sorted(wire_rows) == [0, 1, 2, 3]
+    for p in range(4):
+        expect = []
+        for b in ex.do_execute_partition(p):
+            expect.extend(rows_of(to_arrow(b, schema)))
+        assert_rows_equal(sorted(wire_rows[p]), sorted(expect))
+    assert ex.metrics["serializeTime"].total() > 0
+    ex.close()
+
+
+def test_serialized_partitions_frames_spilled_pieces_from_host():
+    """Pieces the catalog already spilled to the host tier frame straight
+    from their PackedTable — the export must NOT unspill them back to the
+    device (serialize-once; the D2H already happened at spill time)."""
+    import numpy as np
+    from spark_rapids_tpu.batch import to_arrow
+    from spark_rapids_tpu.memory.catalog import BufferCatalog, StorageTier
+    from spark_rapids_tpu.shuffle.serializer import deserialize_batch
+    t = pa.table({"a": np.arange(1000, dtype=np.int64)})
+    cat = BufferCatalog(device_limit=64 << 20, host_limit=64 << 20,
+                        spill_dir="/tmp/rtpu_test_wire_spill")
+    ex = ShuffleExchangeExec(HashPartitioning([col("a")], 2), scan(t),
+                             catalog=cat)
+    schema = ex.output_schema
+    ex.partition_row_counts()                   # materialize
+    cat.synchronous_spill(1 << 30)              # push every piece to host
+    tiers = {cat.tier_of(sb.hid)
+             for pieces in ex._materialize() for sb, _ in pieces}
+    assert tiers == {StorageTier.HOST}
+    total = 0
+    for p, frames in ex.serialized_partitions(codec="none", depth=0):
+        for f in frames:
+            total += int(deserialize_batch(f, schema).num_rows)
+    assert total == 1000
+    # still on the host tier: the wire export did not unspill
+    tiers = {cat.tier_of(sb.hid)
+             for pieces in ex._materialize() for sb, _ in pieces}
+    assert tiers == {StorageTier.HOST}
+    ex.close()
