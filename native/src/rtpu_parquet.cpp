@@ -1224,4 +1224,154 @@ int64_t rtpu_pq_decode_binary(const uint8_t* chunk, int64_t chunk_len,
     return decode_chunk(c, chunk, chunk_len);
 }
 
+// Decode one BYTE_ARRAY chunk KEEPING its RLE_DICTIONARY codes (the
+// compressed-execution scan hand-off: per-row bytes are never
+// materialized; the engine gets codes + the dictionary page's values).
+// Outputs: out_codes[expected_rows] (0 on null rows), out_validity
+// (one byte per row), dictionary as arrow-style
+// dict_offsets[dict_count+1] + dict_bytes. info[0] returns the dictionary
+// entry count and info[1] its byte size; if the provided caps are too
+// small the call returns ERR_SPACE with the needed sizes still in info,
+// and the caller reallocates and retries. A chunk containing any
+// non-dictionary data page (writer dictionary-overflow fallback) returns
+// ERR_UNSUPPORTED — the caller takes the materializing decode instead.
+int64_t rtpu_pq_decode_binary_codes(
+        const uint8_t* chunk, int64_t chunk_len, int32_t codec,
+        int32_t max_def, int64_t expected_rows,
+        int32_t* out_codes, uint8_t* out_validity,
+        int32_t* dict_offsets, int64_t dict_entries_cap,
+        uint8_t* dict_bytes, int64_t dict_bytes_cap,
+        int64_t* info) {
+    DecodeCtx c;
+    c.ptype = PT_BYTE_ARRAY;
+    c.codec = codec;
+    c.max_def = max_def;
+    c.expected_rows = expected_rows;
+    c.out_values = nullptr;
+    c.out_validity = out_validity;
+    c.bin = nullptr;
+    info[0] = 0;
+    info[1] = 0;
+    const uint8_t* p = chunk;
+    const uint8_t* end = chunk + chunk_len;
+    int64_t rows = 0;
+    std::vector<uint8_t> scratch;
+    auto emit_codes = [&](const uint8_t* vals, int64_t vals_len,
+                          const uint8_t* defs, int64_t defs_len,
+                          int64_t n_levels, int64_t row0) -> int64_t {
+        if (row0 + n_levels > c.expected_rows) return ERR_MALFORMED;
+        if (vals_len < 1) return ERR_MALFORMED;
+        int bw = vals[0];
+        if (bw > 32) return ERR_MALFORMED;
+        int64_t nnz = materialize_defs(c, defs, defs_len, n_levels, row0);
+        if (nnz < 0) return ERR_MALFORMED;
+        const uint8_t* valid = c.out_validity + row0;
+        std::vector<uint32_t> idx(nnz);
+        if (bw == 0) {
+            std::fill(idx.begin(), idx.end(), 0u);
+        } else {
+            RleReader idxr(vals + 1, vals_len - 1, bw);
+            if (!decode_indices(idxr, nnz, idx.data()))
+                return ERR_MALFORMED;
+        }
+        int64_t ipos = 0;
+        for (int64_t i = 0; i < n_levels; i++) {
+            if (valid[i]) {
+                uint32_t ix = idx[ipos++];
+                if ((int64_t)ix >= c.dict_count) return ERR_MALFORMED;
+                out_codes[row0 + i] = (int32_t)ix;
+            } else {
+                out_codes[row0 + i] = 0;
+            }
+        }
+        return n_levels;
+    };
+    while (p < end && rows < c.expected_rows) {
+        TReader r(p, end - p);
+        PageHeader h;
+        if (!parse_page_header(r, h)) return ERR_MALFORMED;
+        p = r.p;
+        if (end - p < h.compressed_size) return ERR_MALFORMED;
+        if (h.type == PAGE_DICT) {
+            scratch.resize(h.uncompressed_size);
+            int64_t un = decompress(c.codec, p, h.compressed_size,
+                                    scratch.data(), scratch.size());
+            if (un < 0) return un;
+            int64_t res = load_dict(c, scratch.data(), un, h.num_values);
+            if (res < 0) return res;
+        } else if (h.type == PAGE_DATA) {
+            if (h.encoding != ENC_PLAIN_DICT && h.encoding != ENC_RLE_DICT)
+                return ERR_UNSUPPORTED;
+            if (c.max_def > 0 && h.def_encoding != ENC_RLE)
+                return ERR_UNSUPPORTED;
+            scratch.resize(h.uncompressed_size);
+            int64_t un = decompress(c.codec, p, h.compressed_size,
+                                    scratch.data(), scratch.size());
+            if (un < 0) return un;
+            const uint8_t* defs = nullptr;
+            int64_t defs_len = 0;
+            const uint8_t* vals = scratch.data();
+            int64_t vals_len = un;
+            if (c.max_def > 0) {
+                if (un < 4) return ERR_MALFORMED;
+                uint32_t dl;
+                std::memcpy(&dl, scratch.data(), 4);
+                if (4 + (int64_t)dl > un) return ERR_MALFORMED;
+                defs = scratch.data() + 4;
+                defs_len = dl;
+                vals = scratch.data() + 4 + dl;
+                vals_len = un - 4 - dl;
+            }
+            int64_t res = emit_codes(vals, vals_len, defs, defs_len,
+                                     h.num_values, rows);
+            if (res < 0) return res;
+            rows += res;
+        } else if (h.type == PAGE_DATA_V2) {
+            if (h.encoding != ENC_PLAIN_DICT && h.encoding != ENC_RLE_DICT)
+                return ERR_UNSUPPORTED;
+            if (h.rep_len != 0) return ERR_UNSUPPORTED;   // flat only
+            int64_t lvl = h.def_len;
+            if (lvl > h.compressed_size) return ERR_MALFORMED;
+            const uint8_t* defs = p;
+            int64_t defs_len = lvl;
+            const uint8_t* comp_vals = p + lvl;
+            int64_t comp_len = h.compressed_size - lvl;
+            int64_t vals_cap = h.uncompressed_size - lvl;
+            scratch.resize(vals_cap > 0 ? vals_cap : 0);
+            int64_t un;
+            if (h.v2_compressed) {
+                un = decompress(c.codec, comp_vals, comp_len,
+                                scratch.data(), scratch.size());
+                if (un < 0) return un;
+            } else {
+                un = comp_len;
+                scratch.assign(comp_vals, comp_vals + comp_len);
+            }
+            int64_t res = emit_codes(scratch.data(), un, defs, defs_len,
+                                     h.num_values, rows);
+            if (res < 0) return res;
+            rows += res;
+        } else {
+            // index pages etc.: skip
+        }
+        p += h.compressed_size;
+    }
+    if (rows != c.expected_rows) return ERR_MALFORMED;
+    int64_t total = 0;
+    for (const std::string& s : c.dict_bin) total += (int64_t)s.size();
+    info[0] = c.dict_count;
+    info[1] = total;
+    if (c.dict_count > dict_entries_cap || total > dict_bytes_cap)
+        return ERR_SPACE;
+    int64_t off = 0;
+    dict_offsets[0] = 0;
+    for (int64_t i = 0; i < c.dict_count; i++) {
+        const std::string& s = c.dict_bin[i];
+        std::memcpy(dict_bytes + off, s.data(), s.size());
+        off += (int64_t)s.size();
+        dict_offsets[i + 1] = (int32_t)off;
+    }
+    return rows;
+}
+
 }  // extern "C"

@@ -108,6 +108,7 @@ class DeviceColumn:
     """
 
     data: jax.Array                 # [cap] | [cap, max_len] uint8 strings
+    #                               | int32[cap] codes (dict strings)
     #                               | tuple[DeviceColumn, ...] for structs
     validity: jax.Array             # bool[cap]; False beyond num_rows
     lengths: Optional[jax.Array] = None   # int32[cap], strings/arrays/maps
@@ -116,6 +117,12 @@ class DeviceColumn:
     # A map column is two zipped fixed-budget arrays sharing one lengths
     # vector — the TPU answer to cudf's LIST<STRUCT<K,V>> layout.
     data2: Optional[jax.Array] = None
+    # dictionary-encoded STRING columns only (dictenc.py): sorted-distinct
+    # padded entries + per-entry byte lengths; ``data`` holds the codes
+    # and ``lengths`` is None (rematerialized at decode). Invariants —
+    # including why code order == string order — live in dictenc.py.
+    dict_data: Optional[jax.Array] = None     # uint8[card, max_len]
+    dict_lengths: Optional[jax.Array] = None  # int32[card]
 
     @property
     def capacity(self) -> int:
@@ -126,6 +133,10 @@ class DeviceColumn:
     @property
     def is_struct(self) -> bool:
         return isinstance(self.data, tuple)
+
+    @property
+    def is_dict(self) -> bool:
+        return self.dict_data is not None
 
     @property
     def struct_fields(self) -> Tuple["DeviceColumn", ...]:
@@ -143,6 +154,8 @@ class DeviceColumn:
             n += self.lengths.size * 4
         if self.data2 is not None:
             n += self.data2.size * self.data2.dtype.itemsize
+        if self.dict_data is not None:
+            n += self.dict_data.size + self.dict_lengths.size * 4
         return n
 
 
@@ -330,8 +343,25 @@ def _scalar_storage(arr: pa.Array, dtype: SqlType,
 
 
 def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
-                      truncate_strings: bool = False) -> DeviceColumn:
+                      truncate_strings: bool = False,
+                      name: str = "",
+                      allow_dict: bool = True,
+                      dict_conf: Optional[tuple] = None) -> DeviceColumn:
     arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+
+    if pa.types.is_dictionary(arr.type):
+        # RLE_DICTIONARY scan hand-off: keep the codes, build the byte
+        # matrix once per DISTINCT value (dictenc.py). Nested positions
+        # and over-threshold cardinalities decode to the padded path.
+        if dtype.kind is TypeKind.STRING and allow_dict:
+            from .dictenc import column_from_arrow_dictionary
+            col = column_from_arrow_dictionary(arr, dtype, capacity,
+                                               truncate_strings, name,
+                                               dict_conf)
+            if col is not None:
+                return col
+        arr = arr.cast(arr.type.value_type)
+
     n = len(arr)
     if arr.null_count:
         validity = np.asarray(arr.is_valid())
@@ -346,8 +376,10 @@ def column_from_arrow(arr: pa.Array, dtype: SqlType, capacity: int,
         pval_dev = jnp.asarray(pval)
         kids = []
         for i, ct in enumerate(dtype.children):
+            # struct leaf lanes stay plain: generic struct recursion
+            # (gather/concat/serialize) does not carry dictionaries
             kid = column_from_arrow(arr.field(i), ct, capacity,
-                                    truncate_strings)
+                                    truncate_strings, allow_dict=False)
             kids.append(kid.with_validity(kid.validity & pval_dev))
         return DeviceColumn(tuple(kids), pval_dev, None, dtype)
 
@@ -447,7 +479,9 @@ def schema_from_arrow(schema: pa.Schema, string_max_len: int = 64) -> Schema:
 def from_arrow(table: pa.Table, capacity: Optional[int] = None,
                schema: Optional[Schema] = None,
                string_max_len: int = 64,
-               truncate_strings: bool = False) -> Tuple[ColumnarBatch, Schema]:
+               truncate_strings: bool = False,
+               dict_conf: Optional[tuple] = None
+               ) -> Tuple[ColumnarBatch, Schema]:
     """Build a device batch from an Arrow table (the scan H2D boundary).
 
     Nullability is tightened from the DATA (null_count metadata, free in
@@ -464,7 +498,8 @@ def from_arrow(table: pa.Table, capacity: Optional[int] = None,
         schema = Schema(tight)
     n = table.num_rows
     cap = capacity or bucket_capacity(n)
-    cols = [column_from_arrow(table.column(i), f.dtype, cap, truncate_strings)
+    cols = [column_from_arrow(table.column(i), f.dtype, cap, truncate_strings,
+                              name=f.name, dict_conf=dict_conf)
             for i, f in enumerate(schema)]
     return ColumnarBatch(tuple(cols), jnp.asarray(n, jnp.int32)), schema
 
@@ -529,8 +564,22 @@ def _col_to_arrow(col: DeviceColumn, dtype: SqlType, name: str,
             kids, names=list(names),
             mask=pa.array(~validity) if not validity.all() else None)
     if dtype.kind is TypeKind.STRING:
-        mat = np.asarray(col.data[:n])
-        lens = np.where(validity, np.asarray(col.lengths[:n]), 0)
+        if col.is_dict:
+            # lazy decode at the collect boundary: gather the dictionary
+            # on HOST (codes + small dict came down; bytes never lived
+            # per-row on device)
+            dmat = np.asarray(col.dict_data)
+            dlens = np.asarray(col.dict_lengths)
+            codes = np.clip(np.asarray(col.data[:n]), 0,
+                            max(dmat.shape[0] - 1, 0))
+            mat = dmat[codes] if dmat.shape[0] else \
+                np.zeros((n, dtype.max_len), np.uint8)
+            lens = np.where(validity,
+                            dlens[codes] if dlens.shape[0]
+                            else 0, 0).astype(np.int32)
+        else:
+            mat = np.asarray(col.data[:n])
+            lens = np.where(validity, np.asarray(col.lengths[:n]), 0)
         # vectorized: row-major masked bytes ARE the arrow data buffer
         mask = np.arange(mat.shape[1])[None, :] < lens[:, None]
         flat = np.ascontiguousarray(mat)[mask]

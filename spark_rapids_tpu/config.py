@@ -423,6 +423,35 @@ WINDOW_BATCH_ROWS = conf("spark.rapids.tpu.sql.window.batchRows").doc(
     "more than ~this many rows (reference: GpuKeyBatchingIterator)."
 ).integer(1 << 20)
 
+DICT_ENCODING_ENABLED = conf("spark.rapids.tpu.dictEncoding.enabled").doc(
+    "Compressed execution for string columns (dictenc.py): scans hand "
+    "dictionary codes straight to HBM, equality filters / hash partitioning "
+    "/ group-by keys operate on codes, and exchange/spill ship "
+    "dictionary+codes instead of padded byte matrices (reference: cudf "
+    "dictionary columns + nvcomp keeping data in wire form until the "
+    "device needs it). Operators that need bytes decode lazily at the "
+    "point of use — results are bit-for-bit identical either way."
+).boolean(True)
+
+DICT_MAX_CARDINALITY = conf(
+    "spark.rapids.tpu.dictEncoding.maxCardinality").doc(
+    "Distinct-value budget per dictionary-encoded string column; columns "
+    "above it fall back to the padded byte-matrix path with a recorded "
+    "reason tag (high-cardinality dictionaries stop paying for "
+    "themselves).").integer(1 << 16)
+
+DICT_MAX_CARD_FRACTION = conf(
+    "spark.rapids.tpu.dictEncoding.maxCardinalityFraction").doc(
+    "Dictionary cardinality must stay below this fraction of the batch's "
+    "rows for encoding to be kept at the scan boundary — near-unique "
+    "columns ship smaller as plain padded bytes.").floating(0.5)
+
+DICT_SCAN_ENABLED = conf("spark.rapids.tpu.dictEncoding.scan.enabled").doc(
+    "Ask the parquet readers (pyarrow read_dictionary and the native "
+    "RLE_DICTIONARY codes decode) to PRESERVE dictionary pages for string "
+    "columns instead of materializing bytes at decode time. Only "
+    "meaningful while dictEncoding.enabled is true.").boolean(True)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "
     "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."

@@ -1,0 +1,444 @@
+"""Dictionary-encoded string columns: the compressed data plane.
+
+The reference keeps string data in wire form until the device needs it —
+nvcomp-compressed buffers and cudf dictionary columns flow through shuffle
+and spill, and operators like join/group-by compare dictionary keys
+(reference: GpuColumnVector dictionary support + TableCompressionCodec;
+"GPU Acceleration of SQL Analytics on Compressed Data" in PAPERS.md shows
+the same win of operating directly on the encoded form). Here the encoded
+representation is::
+
+    data         int32[cap]        per-row code into the dictionary
+    dict_data    uint8[card, ml]   distinct padded UTF-8 strings
+    dict_lengths int32[card]       byte length per dictionary entry
+
+riding in the existing ``DeviceColumn`` (lengths lane unused — per-row
+lengths rematerialize as ``dict_lengths[codes]`` at decode).
+
+INVARIANTS (everything downstream relies on these):
+  1. Only STRING columns are ever dict-encoded.
+  2. Dictionary entries are DISTINCT ``(bytes, length)`` pairs in ascending
+     byte-lexicographic order with the length as tiebreak — exactly
+     ``sort_operands``' string order. Hence, within one column,
+     *code equality == string equality* and *code order == string order*,
+     so group-by keys sort/compare on one int32 lane instead of
+     ``max_len/8 + 1`` word lanes.
+  3. Null rows carry code 0 with validity False (payload-zeroing parity
+     with the plain path).
+  4. ``card`` is bucketed to a power of two (>= 8); padding entries are
+     all-zero, never referenced by a live code, and exist purely to bound
+     XLA recompiles (same policy as row-capacity bucketing).
+
+Cross-batch ops (exchange read coalesce) unify per-batch dictionaries with
+a device code-remap (``unify_dict_batches``); any site that cannot prove a
+shared dictionary decodes instead — decode is one gather, and bit-for-bit
+identical to the padded-matrix path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .batch import ColumnarBatch, DeviceColumn, Schema
+from .types import TypeKind
+
+MIN_DICT_CAPACITY = 8
+
+
+def bucket_card(card: int) -> int:
+    """Dictionary capacity bucket (power of two, >= MIN_DICT_CAPACITY)."""
+    if card <= MIN_DICT_CAPACITY:
+        return MIN_DICT_CAPACITY
+    return 1 << (card - 1).bit_length()
+
+
+# ---------------------------------------------------------------------------
+# fallback reason tags (the willNotWork-style record the window
+# over-capacity fallback established in PR 4 — overrides.py tags at plan
+# time; cardinality is runtime information, so the tag records here and
+# Session.fell_back surfaces it next to the plan-time reasons)
+# ---------------------------------------------------------------------------
+
+_FALLBACKS: dict = {}        # reason -> sequence number of its LAST record
+_FALLBACK_SEQ = 0
+_FALLBACK_CAP = 256          # reason strings embed per-batch numbers, so
+#                              distinct strings can keep arriving in a
+#                              long-lived process: evict oldest-recorded
+_FALLBACK_LOCK = threading.Lock()
+
+
+def record_fallback(reason: str) -> None:
+    global _FALLBACK_SEQ
+    with _FALLBACK_LOCK:
+        _FALLBACK_SEQ += 1
+        _FALLBACKS[reason] = _FALLBACK_SEQ
+        if len(_FALLBACKS) > _FALLBACK_CAP:
+            del _FALLBACKS[min(_FALLBACKS, key=_FALLBACKS.get)]
+
+
+def fallback_mark() -> int:
+    """Sequence watermark for per-session attribution: reasons recorded
+    AFTER the mark show up in fallback_reasons(since=mark). A repeat of
+    an already-seen reason bumps its sequence, so a session always sees
+    fallbacks that happened on its own watch (storage stays one entry
+    per distinct reason)."""
+    with _FALLBACK_LOCK:
+        return _FALLBACK_SEQ
+
+
+def fallback_reasons(since: int = 0) -> List[str]:
+    with _FALLBACK_LOCK:
+        return [r for r, s in _FALLBACKS.items() if s > since]
+
+
+def clear_fallbacks() -> None:
+    with _FALLBACK_LOCK:
+        _FALLBACKS.clear()
+
+
+def dict_conf(conf=None) -> Tuple[bool, int, float]:
+    """(enabled, max_cardinality, max_cardinality_fraction) — from the
+    given RapidsTpuConf or the registry defaults."""
+    from .config import (DICT_ENCODING_ENABLED, DICT_MAX_CARDINALITY,
+                         DICT_MAX_CARD_FRACTION, RapidsTpuConf)
+    c = conf or RapidsTpuConf()
+    return (bool(c.get(DICT_ENCODING_ENABLED.key)),
+            int(c.get(DICT_MAX_CARDINALITY.key)),
+            float(c.get(DICT_MAX_CARD_FRACTION.key)))
+
+
+# ---------------------------------------------------------------------------
+# host-side encode (np.unique gives the sorted-distinct invariant for free)
+# ---------------------------------------------------------------------------
+
+def _sort_keys(mat: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Void-typed memcmp keys whose order is (bytes, length) — the string
+    sort order of sort_operands (padding 0x00 sorts below content, and the
+    big-endian length word breaks ties for embedded-NUL strings)."""
+    ml = mat.shape[1]
+    be_len = np.ascontiguousarray(
+        lengths.astype(">i4")).view(np.uint8).reshape(-1, 4)
+    keyed = np.ascontiguousarray(
+        np.concatenate([mat, be_len], axis=1))
+    return keyed.view(np.dtype((np.void, ml + 4))).reshape(-1)
+
+
+def encode_strings_np(mat: np.ndarray, lengths: np.ndarray,
+                      validity: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(dict_mat[card, ml], dict_lens[card], codes[n]) from a padded byte
+    matrix. Dictionary is sorted-distinct over VALID rows; null rows get
+    code 0. ``card`` here is the true cardinality (bucket separately)."""
+    n, ml = mat.shape
+    lengths = np.where(validity, lengths, 0).astype(np.int32)
+    mat = np.where(validity[:, None], mat, 0).astype(np.uint8)
+    if not validity.any():
+        return (np.zeros((0, ml), np.uint8), np.zeros(0, np.int32),
+                np.zeros(n, np.int32))
+    keys = _sort_keys(mat, lengths)
+    vkeys = keys[validity]
+    uniq, inv = np.unique(vkeys, return_inverse=True)
+    # representative row per unique key (first occurrence)
+    first = np.full(len(uniq), -1, np.int64)
+    vidx = np.nonzero(validity)[0]
+    # reversed so the FIRST occurrence wins the final write
+    first[inv[::-1]] = vidx[::-1]
+    dict_mat = mat[first]
+    dict_lens = lengths[first]
+    codes = np.zeros(n, np.int32)
+    codes[validity] = inv.astype(np.int32)
+    return dict_mat, dict_lens, codes
+
+
+def _pad_dict(dict_mat: np.ndarray, dict_lens: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    card = dict_mat.shape[0]
+    cap = bucket_card(card)
+    if cap == card:
+        return dict_mat, dict_lens
+    pm = np.zeros((cap, dict_mat.shape[1]), np.uint8)
+    pm[:card] = dict_mat
+    pl = np.zeros(cap, np.int32)
+    pl[:card] = dict_lens
+    return pm, pl
+
+
+def encode_column(col: DeviceColumn,
+                  max_card: Optional[int] = None) -> Optional[DeviceColumn]:
+    """Host-round-trip encode of a PLAIN device string column (test/bench
+    utility — the scan boundary encodes straight from arrow instead).
+    Returns None when the TRUE cardinality (pre-bucketing) exceeds
+    ``max_card`` — the same threshold the scan boundary applies."""
+    assert col.dtype.kind is TypeKind.STRING and col.dict_data is None
+    mat = np.asarray(jax.device_get(col.data))
+    lengths = np.asarray(jax.device_get(col.lengths))
+    validity = np.asarray(jax.device_get(col.validity))
+    dm, dl, codes = encode_strings_np(mat, lengths, validity)
+    if max_card is not None and dm.shape[0] > max_card:
+        return None
+    dm, dl = _pad_dict(dm, dl)
+    return DeviceColumn(jnp.asarray(codes), jnp.asarray(validity), None,
+                        col.dtype, None, jnp.asarray(dm), jnp.asarray(dl))
+
+
+def encode_batch(batch: ColumnarBatch, schema: Schema,
+                 max_card: Optional[int] = None) -> ColumnarBatch:
+    """Encode every eligible string column (test/bench utility)."""
+    cols = []
+    for c, f in zip(batch.columns, schema):
+        if (f.dtype.kind is TypeKind.STRING and not c.is_struct
+                and c.dict_data is None):
+            enc = encode_column(c, max_card)
+            if enc is not None:
+                c = enc
+        cols.append(c)
+    return ColumnarBatch(tuple(cols), batch.num_rows)
+
+
+# ---------------------------------------------------------------------------
+# device-side decode (one gather; bit-for-bit the padded-matrix layout)
+# ---------------------------------------------------------------------------
+
+def decode_column(col: DeviceColumn) -> DeviceColumn:
+    """Dict column -> plain padded-matrix column. Traced-safe (pure jnp),
+    so lazy decode fuses into whatever kernel needed the bytes."""
+    if col.dict_data is None:
+        return col
+    card = col.dict_data.shape[0]
+    idx = jnp.clip(col.data, 0, card - 1)
+    data = jnp.take(col.dict_data, idx, axis=0)
+    lengths = jnp.take(col.dict_lengths, idx)
+    # payload-zero invalid rows: parity with make_column/_strings_to_matrix
+    data = jnp.where(col.validity[:, None], data, 0)
+    lengths = jnp.where(col.validity, lengths, 0)
+    return DeviceColumn(data, col.validity, lengths, col.dtype)
+
+
+def decode_batch(batch: ColumnarBatch) -> ColumnarBatch:
+    if not any(c.dict_data is not None for c in batch.columns
+               if not c.is_struct):
+        return batch
+    cols = tuple(decode_column(c) if not c.is_struct else c
+                 for c in batch.columns)
+    return ColumnarBatch(cols, batch.num_rows)
+
+
+def dict_entries_column(col: DeviceColumn) -> DeviceColumn:
+    """The dictionary itself as a (card-capacity) plain string column —
+    the evaluation domain for predicate pushdown: evaluate once per
+    DISTINCT value, then gather the [card] result through the codes."""
+    assert col.dict_data is not None
+    card = col.dict_data.shape[0]
+    return DeviceColumn(col.dict_data,
+                        jnp.ones(card, bool), col.dict_lengths, col.dtype)
+
+
+# ---------------------------------------------------------------------------
+# cross-batch dictionary unification (device code-remap)
+# ---------------------------------------------------------------------------
+
+def unify_dict_columns(cols: Sequence[DeviceColumn]
+                       ) -> Optional[List[DeviceColumn]]:
+    """Re-express dict columns (same logical column, different per-batch
+    dictionaries) over ONE merged sorted dictionary via a device
+    code-remap. Host-side merge over the small dictionaries, one
+    ``jnp.take`` per piece for the codes. Returns None when any piece is
+    not dict-encoded, or when the merged cardinality would exceed the
+    dictEncoding.maxCardinality registry default (caller decodes instead;
+    the session conf is not threaded to this eager boundary). EAGER only
+    — dictionary contents must be concrete, so never call under jit
+    tracing.
+
+    Bucket-padding rows (all-zero, length 0) are indistinguishable from a
+    real empty-string entry once padded, so the merged union may carry one
+    phantom "" entry no live code references — correctness-neutral, at
+    most one entry of wire overhead."""
+    if not cols or any(c.dict_data is None for c in cols):
+        return None
+    first = cols[0].dict_data
+    if all(c.dict_data is first for c in cols[1:]) or len(cols) == 1:
+        return list(cols)
+    mats = [np.asarray(jax.device_get(c.dict_data)) for c in cols]
+    lens = [np.asarray(jax.device_get(c.dict_lengths)) for c in cols]
+    if all(m.shape == mats[0].shape and np.array_equal(m, mats[0])
+           and np.array_equal(l, lens[0])
+           for m, l in zip(mats[1:], lens[1:])):
+        # byte-identical dictionaries (the common exchange-read case:
+        # every piece deserialized from one upstream batch carries its
+        # own copy): codes already agree — share ONE device object so
+        # concat_columns keeps the encoding, skip the merge+remap
+        return [c.replace(dict_data=cols[0].dict_data,
+                          dict_lengths=cols[0].dict_lengths) for c in cols]
+    ml = max(m.shape[1] for m in mats)
+    mats = [np.pad(m, ((0, 0), (0, ml - m.shape[1]))) if m.shape[1] < ml
+            else m for m in mats]
+    all_keys = np.concatenate([_sort_keys(m, l)
+                               for m, l in zip(mats, lens)])
+    merged_keys = np.unique(all_keys)          # sorted union
+    _, merge_max_card, _ = dict_conf()
+    if len(merged_keys) > merge_max_card:
+        record_fallback(
+            f"merged dictionary cardinality {len(merged_keys)} across "
+            f"{len(cols)} batches exceeds "
+            f"spark.rapids.tpu.dictEncoding.maxCardinality="
+            f"{merge_max_card}; decoding at the concat boundary instead")
+        return None
+    merged = merged_keys.view(np.uint8).reshape(len(merged_keys), ml + 4)
+    merged_mat = np.ascontiguousarray(merged[:, :ml])
+    merged_lens = np.ascontiguousarray(
+        merged[:, ml:]).view(">i4").astype(np.int32).reshape(-1)
+    pm, pl = _pad_dict(merged_mat, merged_lens)
+    dev_mat = jnp.asarray(pm)
+    dev_lens = jnp.asarray(pl)
+    out = []
+    for c, m, l in zip(cols, mats, lens):
+        remap = np.searchsorted(merged_keys, _sort_keys(m, l))
+        remap = np.clip(remap, 0, max(len(merged_keys) - 1, 0))
+        codes = jnp.take(jnp.asarray(remap.astype(np.int32)),
+                         jnp.clip(c.data, 0, m.shape[0] - 1))
+        out.append(DeviceColumn(codes, c.validity, None, c.dtype, None,
+                                dev_mat, dev_lens))
+    return out
+
+
+def unify_dict_batches(batches: Sequence[ColumnarBatch],
+                       ) -> List[ColumnarBatch]:
+    """Per column position: unify when every piece is dict-encoded, decode
+    when encodings are mixed, pass through otherwise. Called EAGERLY at
+    concat boundaries (exchange read coalesce, CoalesceBatchesExec) so
+    ``concat_columns`` sees one shared dictionary object and keeps the
+    encoded form across the concat."""
+    if len(batches) <= 1:
+        return list(batches)
+    ncols = batches[0].num_columns
+    new_cols: List[List[DeviceColumn]] = [list(b.columns) for b in batches]
+    for i in range(ncols):
+        cols = [b.columns[i] for b in batches]
+        if any(not c.is_struct and c.dict_data is not None for c in cols):
+            unified = unify_dict_columns(cols)
+            if unified is None:
+                unified = [decode_column(c) if not c.is_struct else c
+                           for c in cols]
+            for bi, c in enumerate(unified):
+                new_cols[bi][i] = c
+    return [ColumnarBatch(tuple(cs), b.num_rows)
+            for cs, b in zip(new_cols, batches)]
+
+
+# ---------------------------------------------------------------------------
+# arrow boundary (the scan hand-off: RLE_DICTIONARY page codes -> HBM)
+# ---------------------------------------------------------------------------
+
+def column_from_arrow_dictionary(arr, dtype, capacity: int,
+                                 truncate_strings: bool = False,
+                                 name: str = "",
+                                 conf3: Optional[tuple] = None
+                                 ) -> Optional[DeviceColumn]:
+    """Build a dict-encoded device column from a pa.DictionaryArray
+    WITHOUT materializing per-row bytes — the byte matrix is built once
+    per DISTINCT value (the scanner hands page codes straight to HBM).
+    Returns None when the column must take the padded-matrix fallback
+    (conf off / over the cardinality threshold / null dictionary entries),
+    recording the reason tag."""
+    import pyarrow as pa
+    from .batch import _strings_to_matrix
+    enabled, max_card, max_frac = conf3 or dict_conf()
+    n = len(arr)
+    card = len(arr.dictionary)
+    colname = f"column {name!r}: " if name else ""
+    if not enabled:
+        record_fallback(f"{colname}dictionary-encoded scan column decoded "
+                        f"to padded bytes: "
+                        f"spark.rapids.tpu.dictEncoding.enabled is false")
+        return None
+    if card > max_card:
+        record_fallback(
+            f"{colname}dictionary cardinality {card} exceeds "
+            f"spark.rapids.tpu.dictEncoding.maxCardinality={max_card}; "
+            f"falling back to the padded byte-matrix path")
+        return None
+    if n > 0 and card > max_frac * n:
+        record_fallback(
+            f"{colname}dictionary cardinality {card} exceeds "
+            f"{max_frac:g} of {n} rows "
+            f"(spark.rapids.tpu.dictEncoding.maxCardinalityFraction); "
+            f"encoding would not shrink the column")
+        return None
+    if arr.dictionary.null_count:
+        record_fallback(f"{colname}dictionary contains null entries; "
+                        f"falling back to the padded byte-matrix path")
+        return None
+    dmat, dlens = _strings_to_matrix(arr.dictionary.cast(pa.string()),
+                                     dtype.max_len, truncate_strings)
+    # canonicalize: SORTED-DISTINCT dictionary (invariant 2), codes
+    # remapped through the inverse. np.unique also DEDUPLICATES — arrow
+    # dictionaries may legally repeat values, and max_len truncation can
+    # collapse distinct entries; duplicate entries would silently break
+    # "code equality == string equality" downstream.
+    _, first_idx, inv = np.unique(_sort_keys(dmat, dlens),
+                                  return_index=True, return_inverse=True)
+    inv = inv.astype(np.int32)
+    dmat = dmat[first_idx]
+    dlens = dlens[first_idx]
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+    else:
+        validity = np.ones(n, dtype=bool)
+    idx_arr = arr.indices
+    if idx_arr.null_count:
+        idx_arr = idx_arr.fill_null(0)
+    raw_codes = np.asarray(idx_arr.to_numpy(zero_copy_only=False),
+                           dtype=np.int64)
+    codes = np.zeros(n, np.int32)
+    if card:
+        codes = inv[np.clip(raw_codes, 0, card - 1)]
+    codes = np.where(validity, codes, 0).astype(np.int32)
+    pm, pl = _pad_dict(dmat, dlens)
+    pad_codes = np.zeros(capacity, np.int32)
+    pad_codes[:n] = codes
+    pad_valid = np.zeros(capacity, bool)
+    pad_valid[:n] = validity
+    return DeviceColumn(jnp.asarray(pad_codes), jnp.asarray(pad_valid),
+                        None, dtype, None, jnp.asarray(pm),
+                        jnp.asarray(pl))
+
+
+def dictionary_encode_arrow(table):
+    """dictionary_encode every string column of an arrow table — the
+    form the RLE_DICTIONARY scan hand-off produces. Shared by
+    ``bench.py --wire``, the exchange microbench dict mode, and the
+    differential tests."""
+    import pyarrow as pa
+    return pa.table(
+        {c: (table[c].combine_chunks().dictionary_encode()
+             if pa.types.is_string(table[c].type)
+             or pa.types.is_large_string(table[c].type) else table[c])
+         for c in table.column_names})
+
+
+def dict_wire_bytes(batch: ColumnarBatch) -> Tuple[int, int]:
+    """(encoded_bytes, raw_bytes) the batch's string lanes occupy on the
+    wire, from the layout alone (no serialization): ``raw`` is what the
+    padded-matrix form would ship; ``encoded`` is what the current
+    representation ships (identical when nothing is dict-encoded). The
+    BENCH sidecar measures real serialized frames instead — this is the
+    cheap accounting twin, pinned against it by tests."""
+    enc = raw = 0
+    for c in batch.columns:
+        if c.is_struct or c.dtype.kind is not TypeKind.STRING:
+            continue
+        cap = c.capacity
+        ml = c.dtype.max_len
+        raw += cap * ml + 4 * cap            # byte matrix + lengths
+        if c.dict_data is not None:
+            card = c.dict_data.shape[0]
+            enc += 4 * cap + card * ml + 4 * card   # codes + dict
+        else:
+            enc += cap * ml + 4 * cap
+    return enc, raw

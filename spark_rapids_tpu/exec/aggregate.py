@@ -50,7 +50,9 @@ def _is_flat(t: T.SqlType) -> bool:
 
 
 def _pad_column(c: DeviceColumn, cap: int) -> DeviceColumn:
-    """Zero/False-pad a [L]-capacity column up to [cap] rows."""
+    """Zero/False-pad a [L]-capacity column up to [cap] rows. Dictionary
+    lanes are CARD-leading and ride along unpadded — every layout tier
+    must produce the same pytree structure for the lax.cond dispatch."""
     pad = cap - c.capacity
     if pad == 0:
         return c
@@ -61,7 +63,7 @@ def _pad_column(c: DeviceColumn, cap: int) -> DeviceColumn:
         return jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
 
     return DeviceColumn(pz(c.data), pz(c.validity), pz(c.lengths), c.dtype,
-                        pz(c.data2))
+                        pz(c.data2), c.dict_data, c.dict_lengths)
 
 
 class AggregateMode(enum.Enum):
@@ -270,17 +272,9 @@ class HashAggregateExec(UnaryExec):
         group g's key; TPU scatters are ~40x slower than gathers)."""
         iota = jnp.arange(cap, dtype=jnp.int32)
         slot_live = iota < count
-        out = []
-        for c in sorted_keys:
-            data = jnp.take(c.data, perm, axis=0)
-            lengths = jnp.take(c.lengths, perm, axis=0) \
-                if c.lengths is not None else None
-            data2 = jnp.take(c.data2, perm, axis=0) \
-                if c.data2 is not None else None
-            validity = jnp.take(c.validity, perm, axis=0) & slot_live
-            out.append(DeviceColumn(data, validity, lengths, c.dtype,
-                                    data2))
-        return out
+        # gather_column: dict-aware (codes gather, dictionary rides along)
+        # and struct-recursive, with slot_live folded into validity
+        return [gather_column(c, perm, slot_live) for c in sorted_keys]
 
     # ------------------------------------------------------------------
     # Round-3 fast kernel (docs/perf_r3.md): ONE key sort carrying every
@@ -308,7 +302,12 @@ class HashAggregateExec(UnaryExec):
             nullable = [f.nullable for f in self.key_fields]
             val_nullable = [f.nullable for f in self.buffer_fields]
         else:
-            key_cols = [e.eval(batch, self.ctx) for e in self.group_exprs]
+            # raw_eval: dict-encoded string keys group on CODES — one u32
+            # sort lane instead of max_len/8+1 word lanes, same order and
+            # same group boundaries (sorted-dictionary invariant)
+            from ..expressions.base import raw_eval
+            key_cols = [raw_eval(e, batch, self.ctx)
+                        for e in self.group_exprs]
             flat_vals = [e.eval(batch, self.ctx)
                          for e in self._upd_value_exprs]
             per_agg = self._upd_per_agg
@@ -434,7 +433,9 @@ class HashAggregateExec(UnaryExec):
         in_live = batch.row_mask()
         if mask is not None:
             in_live = in_live & mask
-        key_cols = [e.eval(batch, self.ctx) for e in self.group_exprs]
+        from ..expressions.base import raw_eval
+        key_cols = [raw_eval(e, batch, self.ctx)
+                    for e in self.group_exprs]
         input_cols = [[c.eval(batch, self.ctx) for c in agg.children]
                       for agg in self.aggs]
         value_sort = []

@@ -49,9 +49,15 @@ class InMemoryScanExec(LeafExec):
 
     def __init__(self, data, schema: Optional[Schema] = None,
                  batch_rows: Optional[int] = None, num_slices: int = 1,
-                 ctx: EvalContext = EvalContext()):
+                 ctx: EvalContext = EvalContext(),
+                 dict_conf: Optional[tuple] = None):
         super().__init__(ctx)
         self._num_slices = num_slices
+        # (enabled, maxCardinality, maxCardinalityFraction) for the H2D
+        # boundary; the planner threads the SESSION conf here so
+        # dictEncoding.enabled=false is honored off the file-scan path
+        # too. None = registry defaults (direct test construction).
+        self._dict_conf = dict_conf
         if isinstance(data, pa.Table):
             self._tables = [data]
             self._batches = None
@@ -82,7 +88,8 @@ class InMemoryScanExec(LeafExec):
             step = self._batch_rows or max(n, 1)
             for off in range(0, max(n, 1), step):
                 chunk = table.slice(off, step)
-                batch, _ = from_arrow(chunk, schema=self._schema)
+                batch, _ = from_arrow(chunk, schema=self._schema,
+                                      dict_conf=self._dict_conf)
                 yield batch
                 if n == 0:
                     break
@@ -108,7 +115,12 @@ class ProjectExec(UnaryExec):
             # a traced per-(partition, batch) scalar for stateless PRNG
             # expressions (Rand) — traced, so no per-batch retraces.
             ctx = EvalContext(self.ctx.ansi, {}, batch_seed=bseed)
-            cols = tuple(e.eval(batch, ctx) for e in self.exprs)
+            # raw_eval: a bare column reference passes the stored column
+            # through VERBATIM — dictionary-encoded strings keep their
+            # encoding across identity projections (select/reorder), the
+            # common case; computed expressions decode at the choke point
+            from ..expressions.base import raw_eval
+            cols = tuple(raw_eval(e, batch, ctx) for e in self.exprs)
             return ColumnarBatch(cols, batch.num_rows), _sum_errors(ctx)
 
         self._kernel = jax.jit(kernel)

@@ -56,7 +56,11 @@ class CoalesceBatchesExec(UnaryExec):
         if len(pending) == 1:
             return pending[0]
         cap = bucket_capacity(sum(b.capacity for b in pending))
-        return concat_batches(pending, cap)
+        # eager boundary: unify per-batch string dictionaries (device
+        # code-remap) so the coalesce keeps the encoded form instead of
+        # decoding to padded bytes at the first concat
+        from ..dictenc import unify_dict_batches
+        return concat_batches(unify_dict_batches(pending), cap)
 
     @property
     def produces_single_batch(self) -> bool:

@@ -56,7 +56,10 @@ def gather_column(col: DeviceColumn, indices: jax.Array,
     data2 = jnp.take(col.data2, idx, axis=0) if col.data2 is not None else None
     if row_valid is not None:
         validity = validity & row_valid
-    return DeviceColumn(data, validity, lengths, col.dtype, data2)
+    # dict strings: the CODES are the row lane; the dictionary rides along
+    # untouched (its leading dim is card, not cap)
+    return DeviceColumn(data, validity, lengths, col.dtype, data2,
+                        col.dict_data, col.dict_lengths)
 
 
 def _batched_takes(arrays: Sequence[jax.Array], idx: jax.Array
@@ -92,12 +95,23 @@ def gather_columns(cols: Sequence[DeviceColumn], indices: jax.Array,
         return []
     cap = cols[0].capacity
     idx = jnp.clip(indices, 0, cap - 1)
+    # dictionaries are NOT row lanes — strip them before the flatten so
+    # they are never row-gathered, reattach after (codes gather like any
+    # int32 lane)
+    dicts = [(c.dict_data, c.dict_lengths)
+             if not c.is_struct and c.dict_data is not None else None
+             for c in cols]
+    stripped = [c.replace(dict_data=None, dict_lengths=None)
+                if d is not None else c for c, d in zip(cols, dicts)]
     # every array lane (incl. struct leaf lanes — DeviceColumn is a
     # pytree and struct children are pytree nodes) flattens into one
     # batched-take set; unflatten restores the column structure
-    leaves, treedef = jax.tree_util.tree_flatten(list(cols))
+    leaves, treedef = jax.tree_util.tree_flatten(list(stripped))
     taken = _batched_takes(leaves, idx)
-    out = jax.tree_util.tree_unflatten(treedef, taken)
+    out = list(jax.tree_util.tree_unflatten(treedef, taken))
+    for i, d in enumerate(dicts):
+        if d is not None:
+            out[i] = out[i].replace(dict_data=d[0], dict_lengths=d[1])
     if row_valid is not None:
         out = [_and_validity_deep(c, row_valid) for c in out]
     return list(out)
@@ -139,6 +153,24 @@ def concat_columns(cols: Sequence[DeviceColumn], counts: Sequence[jax.Array],
     piece. Counts are traced, so offsets are traced too.
     """
     first = cols[0]
+    if any(not c.is_struct and c.dict_data is not None for c in cols):
+        shared = (not first.is_struct and first.dict_data is not None
+                  and all(c.dict_data is first.dict_data for c in cols))
+        if shared:
+            # all pieces share ONE dictionary object (sliced from one
+            # batch, or pre-unified by dictenc.unify_dict_batches): the
+            # codes concatenate like a plain int32 lane
+            plain = [c.replace(dict_data=None, dict_lengths=None)
+                     for c in cols]
+            out = concat_columns(plain, counts, capacity)
+            return out.replace(dict_data=first.dict_data,
+                               dict_lengths=first.dict_lengths)
+        # distinct per-piece dictionaries under tracing: decode (one
+        # gather each) and concatenate the padded form — callers that can
+        # run eagerly unify first and keep the encoding
+        from ..dictenc import decode_column
+        cols = [decode_column(c) if not c.is_struct else c for c in cols]
+        first = cols[0]
     if first.is_struct:
         kids = tuple(
             concat_columns([c.data[j] for c in cols], counts, capacity)
@@ -222,6 +254,13 @@ def orderable_words(col: DeviceColumn) -> List[jax.Array]:
     if k is TypeKind.STRUCT:
         raise TypeError("struct sort/partition keys have no device order "
                         "(planner tags them for CPU fallback)")
+    if k is TypeKind.STRING and col.dict_data is not None:
+        # dict-encoded strings: the dictionary is sorted by (bytes, length)
+        # — dictenc.py invariant 2 — so the CODE is a complete orderable
+        # word. One u32 lane through the sort instead of max_len/8 + 1.
+        # Only valid within one column (codes from different dictionaries
+        # are not comparable; cross-batch sites unify or decode first).
+        return [col.data.astype(jnp.uint32)]
     if k is TypeKind.STRING:
         # big-endian packed padded bytes: byte-wise lexicographic == uint64
         # word-wise lexicographic; zero padding sorts shorter strings first,

@@ -118,7 +118,11 @@ class FusedStage:
         if isinstance(node, ProjectExec):
             b = self._emit(node.children[0], by_scan, flags)
             ctx = EvalContext(node.ctx.ansi, {})
-            cols = tuple(e.eval(b, ctx) for e in node.exprs)
+            # raw_eval: identity projections keep dictionary-encoded
+            # strings encoded through the fused stage (same contract as
+            # the standalone ProjectExec kernel in basic.py)
+            from ..expressions.base import raw_eval
+            cols = tuple(raw_eval(e, b, ctx) for e in node.exprs)
             self._err_flags(ctx, flags)
             return ColumnarBatch(cols, b.num_rows)
 

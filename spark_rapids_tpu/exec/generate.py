@@ -76,6 +76,11 @@ class GenerateExec(UnaryExec):
 
     def _explode_kernel(self, batch: ColumnarBatch,
                         ctx: EvalContext) -> ColumnarBatch:
+        # flatten_repeat rebuilds carried columns lane by lane and has no
+        # dictionary slot — decode dict strings first (repeat-then-decode
+        # and decode-then-repeat commute)
+        from ..dictenc import decode_batch
+        batch = decode_batch(batch)
         arr = self.generator.eval(batch, ctx)
         cap, me = arr.data.shape[:2]     # array<string> data is 3D
         out_cap = cap * me

@@ -74,6 +74,12 @@ def _hash64(cols: Sequence[DeviceColumn], valid: jnp.ndarray) -> jnp.ndarray:
 def _keys_equal(a: List[DeviceColumn], b: List[DeviceColumn]) -> jnp.ndarray:
     eq = None
     for x, y in zip(a, b):
+        if x.dict_data is not None or y.dict_data is not None:
+            # the two sides carry DIFFERENT dictionaries (codes are not
+            # comparable across columns) — verify on decoded bytes; the
+            # decode gathers fuse into this kernel
+            from ..dictenc import decode_column
+            x, y = decode_column(x), decode_column(y)
         if x.lengths is not None:
             e = jnp.all(x.data == y.data, axis=1) & (x.lengths == y.lengths)
         elif x.data.ndim > 1:      # decimal128 limb matrices

@@ -215,6 +215,31 @@ def lit_if_needed(v: Any) -> Expression:
     return v if isinstance(v, Expression) else Literal.of(v)
 
 
+def resolve_stored_column(expr: "Expression",
+                          batch: ColumnarBatch) -> Optional[DeviceColumn]:
+    """The bare-reference probe shared by raw_eval and the dict predicate
+    pushdown: a (possibly aliased) BoundReference resolves to the STORED
+    column (dictionary encoding intact, no evaluation); anything computed
+    returns None — callers must not eval just to probe (a probe eval
+    would run the child twice and double ANSI error reports)."""
+    e = expr
+    while isinstance(e, Alias):
+        e = e.child
+    if isinstance(e, BoundReference):
+        return batch.columns[e.ordinal]
+    return None
+
+
+def raw_eval(expr: "Expression", batch: ColumnarBatch,
+             ctx: EvalContext = EvalContext()) -> DeviceColumn:
+    """Evaluate WITHOUT the dict-decode choke point: a (possibly aliased)
+    bare column reference returns the stored column verbatim — dictionary
+    codes included — so dict-aware consumers can operate on the encoded
+    form. Anything else evaluates normally (and therefore decoded)."""
+    col = resolve_stored_column(expr, batch)
+    return col if col is not None else expr.eval(batch, ctx)
+
+
 # ---------------------------------------------------------------------------
 # Leaves
 # ---------------------------------------------------------------------------
@@ -262,7 +287,15 @@ class BoundReference(Expression):
         return self._nullable
 
     def eval(self, batch, ctx=EvalContext()):
-        return batch.columns[self.ordinal]
+        col = batch.columns[self.ordinal]
+        if not col.is_struct and col.dict_data is not None:
+            # the decode choke point: expressions that consume string BYTES
+            # see the padded-matrix form (one gather, fused into the
+            # consumer's kernel); dict-AWARE consumers (hash partitioning,
+            # group-by keys, comparison pushdown) use raw_eval instead.
+            from ..dictenc import decode_column
+            return decode_column(col)
+        return col
 
     def __repr__(self):
         return f"input[{self.ordinal}, {self._dtype}]"

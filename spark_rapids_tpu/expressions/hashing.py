@@ -253,6 +253,12 @@ def hash_column(col: DeviceColumn, seed) -> jnp.ndarray:
     """Hash one column with the running per-row seed; nulls pass seed through."""
     k = col.dtype.kind
     seed = jnp.broadcast_to(seed, col.validity.shape).astype(jnp.uint32)
+    if k is TypeKind.STRING and col.dict_data is not None:
+        # the per-row running seed differs row to row, so the per-entry
+        # precompute below (murmur3_batch) does not apply — decode and
+        # mix the bytes (still bit-exact)
+        from ..dictenc import decode_column
+        col = decode_column(col)
     if k is TypeKind.STRING:
         h = _hash_string(col, seed)
     elif k in (TypeKind.INT64, TypeKind.TIMESTAMP):
@@ -280,11 +286,30 @@ def hash_column(col: DeviceColumn, seed) -> jnp.ndarray:
 
 def murmur3_batch(cols: Sequence[DeviceColumn],
                   seed: int = DEFAULT_SEED) -> jnp.ndarray:
-    """Row hash across columns (Spark Murmur3Hash expression), as int32."""
+    """Row hash across columns (Spark Murmur3Hash expression), as int32.
+
+    Dict-encoded string columns in the LEADING position hash on codes:
+    the seed is still the uniform constant there, so the byte mixing runs
+    once per DISTINCT value ([card] rows) and per-row hashes are a single
+    gather — bit-exact with Spark's hashUnsafeBytes over the decoded
+    bytes, at card/n of the mixing cost. Later positions carry a per-row
+    running seed and decode inside hash_column instead."""
     n = cols[0].validity.shape[0]
     h = jnp.full((n,), seed, jnp.uint32)
+    leading = True
     for c in cols:
-        h = hash_column(c, h)
+        if (leading and c.dtype.kind is TypeKind.STRING
+                and not c.is_struct and c.dict_data is not None):
+            from ..dictenc import dict_entries_column
+            ents = dict_entries_column(c)
+            card = c.dict_data.shape[0]
+            eseed = jnp.full((card,), seed, jnp.uint32)
+            eh = _hash_string(ents, eseed)
+            hv = jnp.take(eh, jnp.clip(c.data, 0, card - 1))
+            h = jnp.where(c.validity, hv, h)   # null keeps the seed
+        else:
+            h = hash_column(c, h)
+        leading = False
     return h.view(jnp.int32)
 
 

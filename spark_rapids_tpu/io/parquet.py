@@ -303,12 +303,36 @@ class ParquetSource(FileSource):
         if any(c not in schema.names for c in cols):
             return None      # partition/virtual columns: pyarrow path
         try:
-            return nf.read_row_group(rg, cols, schema)
+            # _dict_read_columns is empty on predicate-bearing or
+            # dict-disabled scans — it owns the fallback conditions
+            dict_cols = set(self._dict_read_columns(path)) or None
+            return nf.read_row_group(rg, cols, schema, dict_cols)
         except Exception:
             return None      # outside the native subset: pyarrow fallback
 
     def infer_arrow_schema(self) -> pa.Schema:
         return pq.read_schema(self.files[0])
+
+    def _dict_read_columns(self, path: str) -> List[str]:
+        """Top-level string columns to read as dictionary (codes kept
+        through decode — the pyarrow half of the RLE_DICTIONARY hand-off;
+        the native C++ half is read_row_group_dict). Empty when the scan
+        conf disables it OR a predicate is present: host predicate
+        evaluation (predicate_mask / acero filters) over dictionary
+        arrays is not guaranteed across pyarrow versions."""
+        if not getattr(self, "_dict_scan", None) or \
+                self.predicate is not None:
+            return []
+        schema = self._arrow_schemas.get(path)
+        if schema is None:
+            try:
+                schema = pq.read_schema(path)
+            except Exception:
+                return []
+            self._arrow_schemas[path] = schema
+        return [f.name for f in schema
+                if pa.types.is_string(f.type)
+                or pa.types.is_large_string(f.type)]
 
     def read_file(self, path: str) -> pa.Table:
         t = self._native_read_file(path)
@@ -318,10 +342,15 @@ class ParquetSource(FileSource):
             if self.predicate is not None else None
         if filt is not None:
             import pyarrow.dataset as ds
+            # no codes hand-off under a pushed-down filter: acero
+            # predicate evaluation over dictionary arrays is not
+            # guaranteed across pyarrow versions (same guard as the
+            # native path's predicate check in _native_read_row_group)
             dataset = ds.dataset(path, format="parquet")
             t = dataset.to_table(columns=self.columns, filter=filt)
         else:
-            t = pq.read_table(path, columns=self.columns)
+            t = pq.read_table(path, columns=self.columns,
+                              read_dictionary=self._dict_read_columns(path))
         return rebase_legacy_datetimes(t, self.rebase_mode, path)
 
     def _native_read_file(self, path: str) -> Optional[pa.Table]:
@@ -369,7 +398,12 @@ class ParquetSource(FileSource):
             t = pa.table({c: pa.array([], type=schema.field(c).type)
                           for c in keep})
         else:
-            t = pa.concat_tables(tables)
+            # per-row-group best effort can leave SOME row groups
+            # dictionary-encoded (codes hand-off) and others plain
+            # (writer fell back to PLAIN pages mid-file): normalize
+            # to plain before the concat
+            from .source import _concat_normalized
+            t = _concat_normalized(tables)
         if self.predicate is not None:
             mask = predicate_mask(self.predicate, t)
             if mask is not None:

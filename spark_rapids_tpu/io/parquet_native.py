@@ -69,6 +69,15 @@ def _lib():
             u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), u8p,
             ctypes.c_int64, u8p]
+        if hasattr(lib, "rtpu_pq_decode_binary_codes"):
+            # compressed-execution hand-off (older prebuilt .so files may
+            # lack the symbol; the materializing decode still works)
+            lib.rtpu_pq_decode_binary_codes.restype = ctypes.c_int64
+            lib.rtpu_pq_decode_binary_codes.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), u8p,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, u8p,
+                ctypes.c_int64, i64p]
         lib._pq_typed = True
     return lib
 
@@ -246,8 +255,67 @@ class NativeParquetFile:
             raise _Unsupported(f"fixed decode ({rc})")
         return _fixed_array(arrow_type, rows, ptype, values, validity)
 
+    def _decode_column_codes(self, rg: int, c: int,
+                             rows: int) -> Optional[pa.DictionaryArray]:
+        """RLE_DICTIONARY chunk decode that KEEPS the page codes: per-row
+        codes + the dictionary page's values become a pa.DictionaryArray
+        with zero per-row byte materialization (the compressed-execution
+        scan hand-off). None when the chunk is outside the codes subset
+        (PLAIN fallback pages, library without the symbol) — the caller
+        uses the materializing decode."""
+        lib = self._lib
+        if not hasattr(lib, "rtpu_pq_decode_binary_codes"):
+            return None
+        ptype, max_def, flat, _ = self._col_info[c]
+        if not flat or ptype != _PT_BYTE_ARRAY:
+            return None
+        info = (ctypes.c_int64 * 5)()
+        lib.rtpu_pq_chunk_info(self._h, rg, c, info)
+        codec, start, clen, _nvals, total_un = (int(x) for x in info)
+        if codec not in _SUPPORTED_CODECS:
+            return None
+        if start < 0 or start + clen > len(self._buf):
+            return None
+        chunk = self._buf[start:start + clen]
+        codes = np.empty(rows, np.int32)
+        validity = np.empty(rows, np.uint8)
+        # ents_cap sized to the cardinality budget up front (offsets are
+        # 4 bytes/entry): an undersized guess costs a FULL second chunk
+        # decode via ERR_SPACE on exactly the mid-cardinality columns
+        # this path targets
+        ents_cap = int(min(1 << 16, max(rows, 1)))
+        bytes_cap = max(total_un, 1)
+        dinfo = (ctypes.c_int64 * 2)()
+        for _ in range(2):
+            offsets = np.empty(ents_cap + 1, np.int32)
+            dbytes = np.empty(bytes_cap, np.uint8)
+            rc = lib.rtpu_pq_decode_binary_codes(
+                _u8(chunk), clen, codec, max_def, rows,
+                codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                _u8(validity),
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ents_cap, _u8(dbytes), bytes_cap, dinfo)
+            if rc == -4:          # ERR_SPACE: retry at the real sizes
+                ents_cap = max(int(dinfo[0]), 1)
+                bytes_cap = max(int(dinfo[1]), 1)
+                continue
+            break
+        if rc < 0:
+            return None
+        card = int(dinfo[0])
+        values = pa.StringArray.from_buffers(
+            card, pa.py_buffer(np.ascontiguousarray(
+                offsets[:card + 1]).tobytes()),
+            pa.py_buffer(np.ascontiguousarray(
+                dbytes[:int(dinfo[1])]).tobytes()))
+        indices = pa.Array.from_buffers(
+            pa.int32(), rows,
+            [_validity_buffer(validity), pa.py_buffer(codes)])
+        return pa.DictionaryArray.from_arrays(indices, values)
+
     def read_row_group(self, rg: int, columns: List[str],
-                       arrow_schema: pa.Schema) -> pa.Table:
+                       arrow_schema: pa.Schema,
+                       dict_columns: Optional[set] = None) -> pa.Table:
         rows = self.rg_rows(rg)
         arrays, names = [], []
         for name in columns:
@@ -257,7 +325,12 @@ class NativeParquetFile:
             at = arrow_schema.field(name).type
             if not _arrow_type_supported(at):
                 raise _Unsupported(f"arrow type {at}")
-            arrays.append(self._decode_column(rg, c, rows, at))
+            arr = None
+            if dict_columns and name in dict_columns:
+                arr = self._decode_column_codes(rg, c, rows)
+            if arr is None:
+                arr = self._decode_column(rg, c, rows, at)
+            arrays.append(arr)
             names.append(name)
         return pa.table(arrays, names=names)
 
@@ -342,12 +415,16 @@ def open_native(path: str) -> Optional[NativeParquetFile]:
 
 
 def read_row_group_native(path: str, rg: int, columns: List[str],
-                          arrow_schema: pa.Schema) -> Optional[pa.Table]:
-    """Native decode of one row group, or None (caller falls back)."""
+                          arrow_schema: pa.Schema,
+                          dict_columns: Optional[set] = None
+                          ) -> Optional[pa.Table]:
+    """Native decode of one row group, or None (caller falls back).
+    ``dict_columns`` names string columns whose RLE_DICTIONARY codes
+    should be preserved as pa.DictionaryArray (per-column best effort)."""
     f = open_native(path)
     if f is None:
         return None
     try:
-        return f.read_row_group(rg, columns, arrow_schema)
+        return f.read_row_group(rg, columns, arrow_schema, dict_columns)
     except _Unsupported:
         return None

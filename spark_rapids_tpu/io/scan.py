@@ -70,8 +70,14 @@ class FileSourceScanExec(LeafExec):
         it = self.source.read_split(self._files_for(p),
                                     metrics=self.metrics)
         try:
+            dict_conf = getattr(self.source, "_dict_conf", None)
             for host_table in it:
-                batch, _ = from_arrow(host_table, schema=self._schema)
+                # dictionary-typed columns (RLE_DICTIONARY scan hand-off)
+                # land as codes + dictionary; everything else pads as
+                # before. dict_conf carries the session's cardinality
+                # thresholds to the fallback decision.
+                batch, _ = from_arrow(host_table, schema=self._schema,
+                                      dict_conf=dict_conf)
                 self.metrics["numOutputRows"].add(host_table.num_rows)
                 yield batch
         finally:

@@ -63,6 +63,29 @@ def bounded_map(pool, items, fn, window: int, force_parallel: bool = False):
             yield item, fut.result()
 
 
+def undictionary_table(t: pa.Table) -> pa.Table:
+    """Cast dictionary-typed columns back to their value type (the
+    compressed-scan hand-off is per-file/per-row-group best effort, so
+    concat sites normalize when pieces disagree on dictionary-ness)."""
+    cols, changed = [], False
+    for i, f in enumerate(t.schema):
+        col = t.column(i)
+        if pa.types.is_dictionary(f.type):
+            col = col.cast(f.type.value_type)
+            changed = True
+        cols.append(col)
+    return pa.table(cols, names=t.column_names) if changed else t
+
+
+def _concat_normalized(tabs: List[pa.Table]) -> pa.Table:
+    """pa.concat_tables, decoding dictionary columns first when the
+    pieces' schemas disagree (file A kept RLE_DICTIONARY codes, file B's
+    writer fell back to PLAIN pages — otherwise concat raises)."""
+    if len(tabs) > 1 and any(t.schema != tabs[0].schema for t in tabs[1:]):
+        tabs = [undictionary_table(t) for t in tabs]
+    return pa.concat_tables(tabs)
+
+
 def reader_pool(num_threads: int = 8) -> cf.ThreadPoolExecutor:
     """Shared executor-wide decode pool; grows (never shrinks) when a
     session asks for more width — the old pool finishes its queue and is
@@ -184,6 +207,8 @@ class FileSource:
         self._mt_max_tasks: Optional[int] = None
         self._coalesce_par: Optional[int] = None
         self._prefetch_depth: Optional[int] = None
+        self._dict_conf: Optional[tuple] = None
+        self._dict_scan: Optional[bool] = None
         if hive_partitions:
             self._discover_hive_partitions()
             if self.columns and self.partition_schema:
@@ -235,6 +260,15 @@ class FileSource:
         self._coalesce_par = int(conf.get(COALESCING_PARALLEL_FILES.key))
         self._prefetch_depth = int(conf.get(PREFETCH_DEPTH.key)) \
             if conf.get(PREFETCH_ENABLED.key) else 0
+        from ..config import (DICT_ENCODING_ENABLED, DICT_MAX_CARDINALITY,
+                              DICT_MAX_CARD_FRACTION, DICT_SCAN_ENABLED)
+        # (enabled, maxCardinality, maxCardinalityFraction) threaded to the
+        # H2D boundary (batch.from_arrow) by the scan exec
+        self._dict_conf = (bool(conf.get(DICT_ENCODING_ENABLED.key)),
+                           int(conf.get(DICT_MAX_CARDINALITY.key)),
+                           float(conf.get(DICT_MAX_CARD_FRACTION.key)))
+        self._dict_scan = (self._dict_conf[0]
+                           and bool(conf.get(DICT_SCAN_ENABLED.key)))
 
     def partition_value(self, name: str, path: str):
         return self._pvalues[name][path]
@@ -316,7 +350,7 @@ class FileSource:
     def read_all(self) -> pa.Table:
         tables = [self._decorate(self.read_file(f), f)
                   for f in self.files]
-        return pa.concat_tables(tables) if tables else None
+        return _concat_normalized(tables) if tables else None
 
     def prefetch_depth(self) -> int:
         """Effective prefetch look-ahead: session conf via apply_conf,
@@ -374,7 +408,7 @@ class FileSource:
                                             par)]
             if not tabs:
                 return
-            t = pa.concat_tables(tabs)
+            t = _concat_normalized(tabs)
             for off in range(0, max(t.num_rows, 1), self.batch_rows):
                 yield t.slice(off, self.batch_rows)
                 if t.num_rows == 0:

@@ -132,6 +132,12 @@ class PackedTable:
         for lo, hi in zip(cuts, cuts[1:]):
             secs = []
             for s in self.meta.sections:
+                if s.key[:1] in ("D", "e"):
+                    # dictionary sections (dict strings) are CARD-leading,
+                    # not rows-leading: every row piece references the
+                    # whole dictionary, so replicate the section verbatim
+                    secs.append(s)
+                    continue
                 cap = s.shape[0] if s.shape else 1
                 stride = s.nbytes // max(cap, 1)
                 end = hi if hi is not None else cap

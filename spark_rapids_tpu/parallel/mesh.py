@@ -42,6 +42,10 @@ def stack_batches(batches: Sequence[ColumnarBatch],
     with a mesh, shard the leading axis over it (one partition per device)."""
     caps = {b.capacity for b in batches}
     assert len(caps) == 1, f"all partitions must share a capacity: {caps}"
+    # the device-axis stack has no per-shard dictionary slot (and cards
+    # differ per partition): decode dict strings at the mesh boundary
+    from ..dictenc import decode_batch
+    batches = [decode_batch(b) for b in batches]
     cols = []
     for i, c in enumerate(batches[0].columns):
         data = jnp.stack([b.columns[i].data for b in batches])

@@ -864,9 +864,11 @@ class Overrides:
                 if hasattr(n.source, "apply_conf"):
                     n.source.apply_conf(self.conf)
                 return FileSourceScanExec(n.source, n.num_slices)
+            from ..dictenc import dict_conf
             return InMemoryScanExec(n.data, schema=n._schema,
                                     num_slices=n.num_slices,
-                                    batch_rows=n.batch_rows)
+                                    batch_rows=n.batch_rows,
+                                    dict_conf=dict_conf(self.conf))
         if isinstance(n, L.LogicalRange):
             return RangeExec(n.start, n.end, n.step)
         if isinstance(n, L.LogicalProject):

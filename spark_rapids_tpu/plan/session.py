@@ -23,6 +23,10 @@ class Session:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = RapidsTpuConf(conf)
         self.last_plan = None          # captured physical plan (exec tree)
+        from ..dictenc import fallback_mark
+        # watermark: dict_fallbacks() reports only reasons recorded on
+        # THIS session's watch (the store itself is process-wide)
+        self._dict_fb_mark = fallback_mark()
 
     def with_conf(self, **kv) -> "Session":
         settings = dict(self.conf._settings)
@@ -194,3 +198,13 @@ class Session:
     def fell_back(self) -> List[str]:
         return [n for n in self.executed_exec_names()
                 if n.startswith("CpuFallback")]
+
+    def dict_fallbacks(self) -> List[str]:
+        """willNotWork-style reason tags recorded when a dictionary-encoded
+        scan column fell back to the padded byte-matrix path (cardinality
+        over threshold, conf off, null dictionary entries) SINCE this
+        session was created. Runtime companion to the plan-time
+        will_not_work reasons — same contract as the window over-capacity
+        tag: the fallback NEVER happens silently."""
+        from ..dictenc import fallback_reasons
+        return fallback_reasons(since=self._dict_fb_mark)

@@ -257,6 +257,12 @@ class ShuffleExchangeExec(UnaryExec):
                 for sb, _ in entries:
                     got.append(sb.get())
                     pinned.add(id(sb))
+                # per-batch dictionaries unify to ONE merged dictionary
+                # via a device code-remap (eager: we are between kernels
+                # here), so the shuffle-read coalesce keeps string
+                # columns encoded across the concat
+                from ..dictenc import unify_dict_batches
+                got = unify_dict_batches(got)
                 yield concat_batches(got, cap)
         finally:
             # free a piece after its LAST referencing read partition

@@ -46,7 +46,11 @@ class HashPartitioning(Partitioning):
                                 self.num_partitions)
 
     def partition_ids(self, batch, ctx=EvalContext()):
-        cols = [e.eval(batch, ctx) for e in self.exprs]
+        # raw_eval keeps dict-encoded string keys in code form:
+        # murmur3_batch hashes the dictionary entries once and gathers,
+        # still bit-exact with Spark's pmod(murmur3(row, 42), n) routing
+        from ..expressions.base import raw_eval
+        cols = [raw_eval(e, batch, ctx) for e in self.exprs]
         h = murmur3_batch(cols)
         m = h % jnp.int32(self.num_partitions)
         return jnp.where(m < 0, m + self.num_partitions, m).astype(jnp.int32)

@@ -120,6 +120,11 @@ def _col_to_arrays(c: DeviceColumn, key: str,
         arrays[f"l{key}"] = np.asarray(jax.device_get(c.lengths))
     if c.data2 is not None:     # map values / string-array lengths
         arrays[f"m{key}"] = np.asarray(jax.device_get(c.data2))
+    if c.dict_data is not None:
+        # dict strings ship dictionary + codes (d{key} above IS the code
+        # lane) instead of a padded byte matrix — the compressed wire form
+        arrays[f"D{key}"] = np.asarray(jax.device_get(c.dict_data))
+        arrays[f"e{key}"] = np.asarray(jax.device_get(c.dict_lengths))
 
 
 def _col_from_arrays(dtype, key: str,
@@ -133,8 +138,12 @@ def _col_from_arrays(dtype, key: str,
         return DeviceColumn(kids, validity, None, dtype)
     lengths = jnp.asarray(arrays[f"l{key}"]) if f"l{key}" in arrays else None
     data2 = jnp.asarray(arrays[f"m{key}"]) if f"m{key}" in arrays else None
+    dict_data = jnp.asarray(arrays[f"D{key}"]) \
+        if f"D{key}" in arrays else None
+    dict_lengths = jnp.asarray(arrays[f"e{key}"]) \
+        if f"e{key}" in arrays else None
     return DeviceColumn(jnp.asarray(arrays[f"d{key}"]), validity,
-                        lengths, dtype, data2)
+                        lengths, dtype, data2, dict_data, dict_lengths)
 
 
 def batch_to_arrays(batch: ColumnarBatch) -> Dict[str, np.ndarray]:

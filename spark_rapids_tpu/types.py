@@ -241,6 +241,10 @@ def from_arrow(arrow_type: Any, max_len: int = 64) -> SqlType:
         return decimal(arrow_type.precision, arrow_type.scale)
     if pa.types.is_string(arrow_type) or pa.types.is_large_string(arrow_type):
         return string(max_len)
+    if pa.types.is_dictionary(arrow_type):
+        # dictionary encoding is a COLUMN property (dictenc.py), not a
+        # type: dictionary<string> scans type as plain string
+        return from_arrow(arrow_type.value_type, max_len)
     if pa.types.is_date32(arrow_type):
         return DATE
     if pa.types.is_timestamp(arrow_type):

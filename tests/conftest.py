@@ -46,6 +46,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "smoke: fast core subset (<2 min) used as the commit "
                    "gate; full suite is the nightly tier")
+    config.addinivalue_line(
+        "markers", "slow: heavyweight tests excluded from the `-m 'not "
+                   "slow'` tier-1 gate (still part of the full nightly "
+                   "tier and its wall-clock budget)")
 
 
 def pytest_collection_modifyitems(config, items):

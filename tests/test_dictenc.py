@@ -1,0 +1,419 @@
+"""Dictionary-encoded (compressed) string execution: differential tests.
+
+The contract under test (dictenc.py): running a query over dictionary-
+encoded string columns is BIT-FOR-BIT identical to running it over the
+padded byte-matrix form — the encoding is a data-plane representation,
+never a semantics change. Every differential here collects the same query
+twice, once from plain string input and once from dictionary-encoded
+input, and compares exactly (approx_float=False: group-by on codes visits
+rows in the same string order as the plain path, so even float
+accumulation order matches).
+
+Shapes mirror the five BENCH configs with their TPC string columns
+restored (bench.py simplifies l_returnflag / ss_item_sk etc. to ints;
+the wire sidecar and these tests put the strings back).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.batch import from_arrow
+from spark_rapids_tpu.dictenc import (bucket_card, clear_fallbacks,
+                                      decode_batch, decode_column,
+                                      dict_wire_bytes, encode_batch,
+                                      encode_strings_np, fallback_reasons,
+                                      unify_dict_batches)
+from spark_rapids_tpu.expressions import col
+from spark_rapids_tpu.expressions.aggregates import Average, Count, Sum
+from spark_rapids_tpu.expressions.comparison import In
+from spark_rapids_tpu.io import read_parquet
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_tables_equal
+
+# ---------------------------------------------------------------------------
+# data: bench shapes with their TPC string columns restored
+# ---------------------------------------------------------------------------
+
+N = 4000
+
+
+def _rng(seed=3):
+    return np.random.default_rng(seed)
+
+
+def _lineitem(n=N, with_nulls=False):
+    rng = _rng(3)
+    flags = np.array(["A", "F", "N", "O", "R"])
+    t = pa.table({
+        "l_returnflag": pa.array(flags[rng.integers(0, 5, n)]),
+        "l_linestatus": pa.array(np.array(["O", "F"])[
+            rng.integers(0, 2, n)]),
+        "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+        "l_extendedprice": rng.uniform(1.0, 1e5, n),
+        "l_discount": rng.uniform(0.0, 0.1, n),
+        "l_shipdate": rng.integers(8000, 11000, n).astype(np.int32),
+    })
+    if with_nulls:
+        mask = rng.uniform(size=n) < 0.1
+        vals = t["l_returnflag"].to_pylist()
+        t = t.set_column(0, "l_returnflag", pa.array(
+            [None if m else v for v, m in zip(vals, mask)]))
+    return t
+
+
+def _store_sales(n=N, n_keys=256):
+    rng = _rng(5)
+    items = np.array([f"ITEM{i:07d}" for i in range(n_keys)])
+    return pa.table({
+        "ss_item_sk": pa.array(items[rng.integers(0, n_keys, n)]),
+        "ss_quantity": rng.integers(1, 100, n).astype(np.int64),
+        "ss_sales_price": rng.uniform(0.5, 500.0, n),
+        "ss_net_profit": rng.uniform(-100.0, 400.0, n),
+    })
+
+
+def _fact(n=N):
+    rng = _rng(11)
+    groups = np.array([f"G{i:02d}" for i in range(64)])
+    return pa.table({
+        "k": rng.integers(0, 1 << 10, n).astype(np.int32),
+        "g": pa.array(groups[rng.integers(0, 64, n)]),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+    })
+
+
+def _encode(t: pa.Table) -> pa.Table:
+    from spark_rapids_tpu.dictenc import dictionary_encode_arrow
+    return dictionary_encode_arrow(t)
+
+
+def _assert_differential(df_fn, t, conf=None, num_slices=1,
+                         ignore_order=True):
+    """Collect df_fn over plain vs dictionary-encoded input: bit-for-bit."""
+    ses = Session(conf)
+    plain = ses.collect(df_fn(table(t, num_slices=num_slices)))
+    enc = ses.collect(df_fn(table(_encode(t), num_slices=num_slices)))
+    assert_tables_equal(enc, plain, ignore_order=ignore_order,
+                        approx_float=False)
+    return enc
+
+
+# ---------------------------------------------------------------------------
+# smoke tier: the commit gate covers the encoded path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+def test_smoke_dict_roundtrip():
+    """Encode -> device decode -> collect is bit-for-bit the plain path
+    (nulls and empty strings included)."""
+    t = pa.table({"s": pa.array(["", "aa", None, "b", "aa", "", None, "c"]),
+                  "v": pa.array(np.arange(8, dtype=np.int64))})
+    plain, schema = from_arrow(t)
+    enc, _ = from_arrow(_encode(t), schema=schema)
+    assert enc.columns[0].is_dict
+    assert not plain.columns[0].is_dict
+    dec = decode_batch(enc)
+    np.testing.assert_array_equal(np.asarray(dec.columns[0].data),
+                                  np.asarray(plain.columns[0].data))
+    np.testing.assert_array_equal(np.asarray(dec.columns[0].lengths),
+                                  np.asarray(plain.columns[0].lengths))
+    np.testing.assert_array_equal(np.asarray(dec.columns[0].validity),
+                                  np.asarray(plain.columns[0].validity))
+
+
+@pytest.mark.smoke
+def test_smoke_dict_exchange_wire_roundtrip():
+    """Serializer round-trips the encoded form (dict + codes on the wire)
+    and the encoded frames are SMALLER than the padded byte-matrix form."""
+    from spark_rapids_tpu.shuffle.serializer import (deserialize_batch,
+                                                     serialize_batch)
+    t = _store_sales(2048)
+    schema_t, schema = from_arrow(t)
+    enc = encode_batch(schema_t, schema)
+    assert enc.columns[0].is_dict
+
+    # the serialize-once exchange path (pack_batch -> frame_packed)
+    enc_frame = serialize_batch(enc, schema, "none")
+    raw_frame = serialize_batch(decode_batch(enc), schema, "none")
+    assert len(enc_frame) < len(raw_frame), \
+        (len(enc_frame), len(raw_frame))
+
+    back = deserialize_batch(enc_frame, schema)
+    assert back.columns[0].is_dict
+    dec = decode_batch(back)
+    plain = decode_batch(enc)
+    for c_back, c_plain in zip(dec.columns, plain.columns):
+        np.testing.assert_array_equal(np.asarray(c_back.data),
+                                      np.asarray(c_plain.data))
+
+
+# ---------------------------------------------------------------------------
+# encode invariants
+# ---------------------------------------------------------------------------
+
+def test_encode_sorted_distinct_invariant():
+    rng = _rng(7)
+    words = np.array(["", "a", "ab", "abc", "b", "ba", "zz"])
+    vals = words[rng.integers(0, len(words), 500)]
+    t = pa.table({"s": pa.array(vals)})
+    b, schema = from_arrow(t)
+    mat = np.asarray(b.columns[0].data)
+    lens = np.asarray(b.columns[0].lengths)
+    valid = np.asarray(b.columns[0].validity)
+    dm, dl, codes = encode_strings_np(mat, lens, valid)
+    # distinct, sorted by (bytes, length) == string order
+    seen = [bytes(dm[i][:dl[i]]) for i in range(dm.shape[0])]
+    assert seen == sorted(set(seen))
+    assert len(seen) == len(set(seen))
+    # codes decode back to the exact rows
+    np.testing.assert_array_equal(dm[codes][valid], mat[valid])
+    np.testing.assert_array_equal(dl[codes][valid], lens[valid])
+    # code order == string order within the column
+    order_by_code = np.argsort(codes[valid], kind="stable")
+    strs = [bytes(r[:l]) for r, l in zip(mat[valid], lens[valid])]
+    assert [strs[i] for i in order_by_code] == sorted(strs)
+
+
+def test_bucket_card_powers_of_two():
+    assert bucket_card(0) == 8
+    assert bucket_card(8) == 8
+    assert bucket_card(9) == 16
+    assert bucket_card(1000) == 1024
+
+
+def test_unify_dict_batches_remap():
+    """Two batches with DIFFERENT per-batch dictionaries unify onto one
+    merged dictionary; decoded contents are unchanged."""
+    t1 = pa.table({"s": pa.array(["apple", "pear", "apple", None] * 4)})
+    t2 = pa.table({"s": pa.array(["pear", "quince", "fig", "fig"] * 4)})
+    b1, schema = from_arrow(_encode(t1))
+    b2, _ = from_arrow(_encode(t2), schema=schema)
+    u1, u2 = unify_dict_batches([b1, b2])
+    c1, c2 = u1.columns[0], u2.columns[0]
+    assert c1.is_dict and c2.is_dict
+    # ONE shared dictionary object after unification
+    assert c1.dict_data is c2.dict_data
+    for orig, uni in ((b1, u1), (b2, u2)):
+        d_orig = decode_batch(orig).columns[0]
+        d_uni = decode_batch(uni).columns[0]
+        np.testing.assert_array_equal(np.asarray(d_orig.data),
+                                      np.asarray(d_uni.data))
+        np.testing.assert_array_equal(np.asarray(d_orig.lengths),
+                                      np.asarray(d_uni.lengths))
+
+
+def test_dict_wire_bytes_accounting():
+    t = _store_sales(2048)
+    b, schema = from_arrow(t)
+    enc = encode_batch(b, schema)
+    enc_bytes, raw_bytes = dict_wire_bytes(enc)
+    assert enc_bytes < raw_bytes
+    plain_enc, plain_raw = dict_wire_bytes(b)
+    assert plain_enc == plain_raw
+
+
+# ---------------------------------------------------------------------------
+# encoded-vs-plain differential equivalence on the five bench shapes
+# ---------------------------------------------------------------------------
+
+def test_differential_q1_stage():
+    """filter + group-by on restored TPC-H string flags (q1_stage)."""
+    _assert_differential(
+        lambda df: df.where(col("l_shipdate") <= 10471)
+        .group_by("l_returnflag", "l_linestatus")
+        .agg(Sum(col("l_quantity")).alias("sq"),
+             Sum(col("l_extendedprice")).alias("sp"),
+             Count(col("l_quantity")).alias("n")),
+        _lineitem())
+
+
+def test_differential_hash_agg():
+    """high-cardinality string group-by keys (hash_agg shape)."""
+    _assert_differential(
+        lambda df: df.group_by("ss_item_sk")
+        .agg(Sum(col("ss_quantity")).alias("sq"),
+             Average(col("ss_sales_price")).alias("ap"),
+             Count(col("ss_net_profit")).alias("n")),
+        _store_sales())
+
+
+def test_differential_join_sort():
+    """hash join on a string key + sort + limit (join_sort shape)."""
+    rng = _rng(9)
+    items = np.array([f"ITEM{i:07d}" for i in range(64)])
+    dim = pa.table({"i_item_sk": pa.array(items),
+                    "i_class": pa.array(
+                        [f"CLASS{i % 7}" for i in range(64)])})
+    fact = _store_sales(N, 64)
+
+    ses = Session()
+
+    def q(f_df, d_df):
+        return (f_df.join(d_df, ["ss_item_sk"], ["i_item_sk"])
+                .group_by("i_class")
+                .agg(Sum(col("ss_quantity")).alias("sq"))
+                .order_by("i_class"))
+
+    plain = ses.collect(q(table(fact), table(dim)))
+    enc = ses.collect(q(table(_encode(fact)), table(_encode(dim))))
+    assert_tables_equal(enc, plain, approx_float=False)
+
+
+def test_differential_exchange():
+    """multi-slice group-by forces a shuffle exchange: per-batch
+    dictionaries cross the wire and unify at the read coalesce
+    (ici_exchange shape, host-mediated on this backend)."""
+    _assert_differential(
+        lambda df: df.group_by("g")
+        .agg(Sum(col("v")).alias("sv"), Count(col("k")).alias("n")),
+        _fact(), num_slices=4)
+
+
+def test_differential_filter_pushdown_ops():
+    """equality / IN / range filters evaluate per DISTINCT entry and
+    gather through the codes — same rows out."""
+    t = _lineitem()
+    for pred in (col("l_returnflag") == "A",
+                 col("l_returnflag") != "N",
+                 col("l_returnflag") < "N",
+                 In(col("l_returnflag"), ("A", "R")),
+                 col("l_linestatus") == "O"):
+        _assert_differential(
+            lambda df, p=pred: df.where(p).select(
+                "l_returnflag", "l_linestatus", "l_quantity"),
+            t)
+
+
+def test_differential_parquet_scan(tmp_path):
+    """The real scan boundary: RLE_DICTIONARY pages land as codes when
+    dictEncoding is on; collect equals the padded path bit-for-bit."""
+    t = _lineitem()
+    path = os.path.join(str(tmp_path), "lineitem.parquet")
+    pq.write_table(t, path, use_dictionary=True)
+    on = Session({"spark.rapids.tpu.dictEncoding.enabled": True})
+    off = Session({"spark.rapids.tpu.dictEncoding.enabled": False})
+
+    def q(ses):
+        return ses.collect(
+            read_parquet(path).where(col("l_shipdate") <= 10471)
+            .group_by("l_returnflag", "l_linestatus")
+            .agg(Sum(col("l_quantity")).alias("sq")))
+
+    assert_tables_equal(q(on), q(off), ignore_order=True,
+                        approx_float=False)
+
+
+# ---------------------------------------------------------------------------
+# null strings, empty strings
+# ---------------------------------------------------------------------------
+
+def test_differential_null_strings():
+    t = _lineitem(with_nulls=True)
+    _assert_differential(
+        lambda df: df.group_by("l_returnflag")
+        .agg(Count(col("l_quantity")).alias("n"),
+             Sum(col("l_quantity")).alias("sq")),
+        t)
+    _assert_differential(
+        lambda df: df.where(col("l_returnflag") == "F")
+        .select("l_returnflag", "l_quantity"), t)
+
+
+def test_differential_empty_strings():
+    vals = ["", "x", "", "xx", "x", "", None, "xyz"] * 64
+    t = pa.table({"s": pa.array(vals),
+                  "v": pa.array(np.arange(len(vals), dtype=np.int64))})
+    _assert_differential(
+        lambda df: df.group_by("s").agg(Sum(col("v")).alias("sv")), t)
+    _assert_differential(
+        lambda df: df.where(col("s") == "").select("s", "v"), t)
+
+
+def test_differential_distinct_via_groupby_order():
+    """order_by on a dict column: codes are a complete orderable word."""
+    _assert_differential(
+        lambda df: df.group_by("g").agg(Count(col("v")).alias("n"))
+        .order_by("g"), _fact(), ignore_order=False)
+
+
+# ---------------------------------------------------------------------------
+# cardinality-threshold fallback: never silent
+# ---------------------------------------------------------------------------
+
+def test_over_threshold_cardinality_fallback(tmp_path):
+    """Cardinality above maxCardinality takes the padded path, records a
+    willNotWork-style reason tag, and stays bit-for-bit correct."""
+    rng = _rng(13)
+    n = 2048
+    uniq = np.array([f"U{i:05d}" for i in range(512)])
+    t = pa.table({"s": pa.array(uniq[rng.integers(0, 512, n)]),
+                  "v": pa.array(np.arange(n, dtype=np.int64))})
+    path = os.path.join(str(tmp_path), "hicard.parquet")
+    pq.write_table(t, path, use_dictionary=True)
+    clear_fallbacks()
+    ses = Session({"spark.rapids.tpu.dictEncoding.maxCardinality": 64})
+    got = ses.collect(
+        read_parquet(path).group_by("s")
+        .agg(Sum(col("v")).alias("sv")))
+    reasons = ses.dict_fallbacks()
+    assert reasons, "over-threshold fallback must record a reason tag"
+    assert any("maxCardinality" in r for r in reasons), reasons
+    off = Session({"spark.rapids.tpu.dictEncoding.enabled": False})
+    expected = off.collect(
+        read_parquet(path).group_by("s")
+        .agg(Sum(col("v")).alias("sv")))
+    assert_tables_equal(got, expected, ignore_order=True,
+                        approx_float=False)
+
+
+def test_fraction_threshold_fallback_records_reason():
+    """Near-unique columns (cardinality > maxCardinalityFraction * rows)
+    fall back with a tag at the in-memory arrow boundary too."""
+    n = 64
+    vals = [f"V{i}" for i in range(n)]      # all-unique: card == rows
+    t = pa.table({"s": pa.array(vals)})
+    clear_fallbacks()
+    b, _ = from_arrow(_encode(t))
+    assert not b.columns[0].is_dict
+    reasons = fallback_reasons()
+    assert reasons and any("maxCardinalityFraction" in r
+                           for r in reasons), reasons
+
+
+def test_session_kill_switch_reaches_in_memory_scan():
+    """dictEncoding.enabled=false is threaded by the planner to the
+    IN-MEMORY H2D boundary too (not just file scans): encoded arrow
+    input takes the padded path, results match, reason recorded on the
+    session's watch."""
+    t = _fact(512)
+
+    def q():
+        return table(_encode(t)).group_by("g").agg(
+            Sum(col("v")).alias("sv"))
+
+    on = Session()
+    expected = on.collect(q())
+    off = Session({"spark.rapids.tpu.dictEncoding.enabled": False})
+    got = off.collect(q())
+    assert_tables_equal(got, expected, ignore_order=True,
+                        approx_float=False)
+    assert any("dictEncoding.enabled" in r
+               for r in off.dict_fallbacks()), off.dict_fallbacks()
+
+
+@pytest.mark.smoke
+def test_disabled_conf_fallback_records_reason():
+    """dictEncoding.enabled=false over dictionary arrow input: padded
+    path, reason recorded — the fallback is NEVER silent."""
+    t = pa.table({"s": pa.array(["a", "b", "a", "c"])})
+    clear_fallbacks()
+    b, _ = from_arrow(_encode(t), dict_conf=(False, 1 << 16, 0.5))
+    assert not b.columns[0].is_dict
+    reasons = fallback_reasons()
+    assert reasons and any("dictEncoding.enabled" in r
+                           for r in reasons), reasons

@@ -20,6 +20,12 @@ Four timed sections over the same hash-partitioned table:
                     packed path (pack -> frame straight from the packed
                     buffer), synchronous vs pipelined (D2H of partition
                     P+1 overlapped with framing/compression of P).
+  dict_partition    compressed execution (dictenc.py) on a STRING-HEAVY
+                    table: hash partitioning + exchange + wire framing
+                    with dictionary-encoded string columns (dict + codes)
+                    vs the padded byte-matrix form, over the host exchange
+                    path and the mesh all_to_all path (the mesh stack
+                    decodes at the boundary — measured as such).
 
 Run on any backend (`JAX_PLATFORMS=cpu python tools/exchange_microbench.py`
 uses the virtual multi-device CPU mesh); on the real chip the mesh section
@@ -193,6 +199,86 @@ def bench_wire_serialize(table):
     return legacy, packed_sync, packed_pipe
 
 
+def _string_table(n):
+    """String-heavy shape: one wide low-cardinality string (city names,
+    24 bytes) + one tiny flag string — the padded byte matrix dominates
+    the wire bytes, the dictionaries stay small."""
+    rng = np.random.default_rng(23)
+    import pyarrow as pa
+    cities = np.array([f"city_{i:04d}_{'x' * 14}" for i in range(512)])
+    status = np.array(["ACTIVE", "INACTIVE", "PENDING", "CLOSED"])
+    return pa.table({
+        "k": rng.integers(0, 1 << 20, n).astype(np.int64),
+        "city": pa.array(cities[rng.integers(0, 512, n)]),
+        "status": pa.array(status[rng.integers(0, 4, n)]),
+        "v": rng.uniform(-1e3, 1e3, n),
+    })
+
+
+def _dict_encode_table(table):
+    from spark_rapids_tpu.dictenc import dictionary_encode_arrow
+    return dictionary_encode_arrow(table)
+
+
+def bench_dict_partition():
+    """dict+codes vs padded bytes through the STRING-keyed exchange."""
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    n = min(N_ROWS, 1 << 18)          # strings are ~5x the bytes of ints
+    plain = _string_table(n)
+    enc = _dict_encode_table(plain)
+
+    def exchange(t):
+        ex = ShuffleExchangeExec(HashPartitioning([col("city")], N_PARTS),
+                                 InMemoryScanExec(t))
+
+        def run():
+            rows = 0
+            for p in range(ex.num_partitions):
+                for b in ex.do_execute_partition(p):
+                    rows += int(b.num_rows)
+            ex.do_close()
+            return rows
+        return run
+
+    def wire(t):
+        ex = ShuffleExchangeExec(HashPartitioning([col("city")], N_PARTS),
+                                 InMemoryScanExec(t))
+        ex.partition_row_counts()
+
+        def run():
+            total = 0
+            for _p, frames in ex.serialized_partitions(codec="none"):
+                total += sum(len(f) for f in frames)
+            return total
+        return run
+
+    (xp, _), (xe, _) = _time_group([exchange(plain), exchange(enc)])
+    (wp, nbp), (we, nbe) = _time_group([wire(plain), wire(enc)])
+    return n, (xp, xe), (wp, nbp, we, nbe)
+
+
+def bench_dict_mesh():
+    """Mesh all_to_all over the string-heavy shape, padded vs encoded
+    input. stack_batches decodes dict strings at the mesh boundary (the
+    device-axis stack has no per-shard dictionary slot), so the encoded
+    number measures decode-at-boundary + the same collective — the
+    honest cost of entering the ICI path from compressed form."""
+    from spark_rapids_tpu.batch import from_arrow
+    n = min(N_ROWS, 1 << 17)
+    plain = _string_table(n)
+    enc = _dict_encode_table(plain)
+    pb, schema = from_arrow(plain)
+    eb, _ = from_arrow(enc, schema=schema)
+    dtp, note = bench_mesh_all_to_all(pb, schema)
+    if dtp is None:
+        return None, note, None
+    dte, _ = bench_mesh_all_to_all(eb, schema)
+    return dtp, note, dte
+
+
 def bench_scan_prefetch(table):
     """Scan-side prefetch overlap (pipeline.py), measured honestly:
 
@@ -268,6 +354,32 @@ def main():
     rows.append(_emit("wire_serialize_packed_pipelined", dtp,
                       Mrows_per_s=round(N_ROWS / dtp / 1e6, 1),
                       note="D2H of P+1 overlaps framing of P"))
+
+    nd, (xp, xe), (wp, nbp, we, nbe) = bench_dict_partition()
+    rows.append(_emit("dict_exchange_padded", xp,
+                      Mrows_per_s=round(nd / xp / 1e6, 1),
+                      note=f"string-keyed exchange, {nd} rows"))
+    rows.append(_emit("dict_exchange_encoded", xe,
+                      Mrows_per_s=round(nd / xe / 1e6, 1),
+                      note="dict+codes: murmur3 per DISTINCT entry + "
+                           "gather; codes through the slice kernels"))
+    rows.append(_emit("dict_wire_padded", wp, MB=round(nbp / 1e6, 1),
+                      Mrows_per_s=round(nd / wp / 1e6, 1)))
+    rows.append(_emit("dict_wire_encoded", we, MB=round(nbe / 1e6, 1),
+                      Mrows_per_s=round(nd / we / 1e6, 1),
+                      note=f"dict+codes frames: {nbe / nbp:.2f}x the "
+                           f"padded bytes"))
+
+    try:
+        dtp, mnote, dte = bench_dict_mesh()
+        if dtp is None:
+            _emit("dict_mesh", 0.0, note=f"SKIPPED: {mnote}")
+        else:
+            rows.append(_emit("dict_mesh_padded", dtp, note=mnote))
+            rows.append(_emit("dict_mesh_encoded", dte, note=mnote +
+                              "; decode-at-boundary included"))
+    except Exception as e:
+        _emit("dict_mesh", 0.0, note=f"SKIPPED: {type(e).__name__}: {e}")
 
     mt, pf0, pf2 = bench_scan_prefetch(table)
     rows.append(_emit("scan_multithreaded", mt,
