@@ -452,6 +452,48 @@ DICT_SCAN_ENABLED = conf("spark.rapids.tpu.dictEncoding.scan.enabled").doc(
     "columns instead of materializing bytes at decode time. Only "
     "meaningful while dictEncoding.enabled is true.").boolean(True)
 
+RETRY_ENABLED = conf("spark.rapids.tpu.retry.enabled").doc(
+    "OOM retry state machine (memory/retry.py): an operator that hits a "
+    "retryable device OOM (buffer-catalog OutOfBudgetError or XLA "
+    "RESOURCE_EXHAUSTED) releases its pins, forces a synchronous spill, "
+    "backs off while other semaphore holders drain and re-runs — halving "
+    "its input down to retry.splitFloorRows on repeated OOM — instead of "
+    "failing the query (reference: RmmRapidsRetryIterator withRetry/"
+    "withRetryNoSplit). Disabled, OOMs propagate immediately.").boolean(True)
+
+RETRY_MAX_RETRIES = conf("spark.rapids.tpu.retry.maxRetries").doc(
+    "Same-size re-attempts per work item before the OOM is final (a "
+    "FinalOOMError that fails the query and, when memory.oomDumpDir is "
+    "set, writes a state dump). Splits reset the count — each half is a "
+    "fresh item.").integer(8)
+
+RETRY_SPLIT_FLOOR_ROWS = conf("spark.rapids.tpu.retry.splitFloorRows").doc(
+    "Split-and-retry halving floor: inputs at or below this many rows are "
+    "never split further (reference: the minimum batch size guard in "
+    "splitSpillableInHalfByRows).").integer(1 << 10)
+
+INJECT_OOM_MODE = conf("spark.rapids.tpu.test.injectOOM.mode").doc(
+    "Deterministic OOM fault injection at the instrumented allocation "
+    "sites (mirror of RmmSpark's forceRetryOOM): empty/off, 'every-N' "
+    "(every Nth allocation check throws a synthetic retryable OOM), or "
+    "'random' / 'random-P' (seeded probability P per check, default 0.2). "
+    "Test-only: makes every retry path executable on CPU.").text("")
+
+INJECT_OOM_SEED = conf("spark.rapids.tpu.test.injectOOM.seed").doc(
+    "RNG seed for injectOOM.mode=random — the same seed replays the same "
+    "injection schedule.").integer(0)
+
+INJECT_OOM_SKIP_COUNT = conf("spark.rapids.tpu.test.injectOOM.skipCount").doc(
+    "Exempt the first K allocation checks from injection, aiming the "
+    "fault at a deep site (e.g. pin k of n in the exchange read "
+    "loop).").integer(0)
+
+INJECT_OOM_OOM_COUNT = conf("spark.rapids.tpu.test.injectOOM.oomCount").doc(
+    "Consecutive synthetic OOMs thrown per trigger on the triggering "
+    "thread (RmmSpark numOOMs): 1 exercises plain retry, >1 forces "
+    "split-and-retry, > retry.maxRetries forces a final OOM + "
+    "oomDumpDir report.").integer(1)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "
     "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."

@@ -521,8 +521,12 @@ class HashAggregateExec(UnaryExec):
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         # accumulated partials ride the spill catalog (reference:
-        # LazySpillableColumnarBatch deque in GpuHashAggregateIterator)
-        from ..memory import SpillableBatch, device_budget
+        # LazySpillableColumnarBatch deque in GpuHashAggregateIterator);
+        # registrations and the merge passes run under the OOM retry loop
+        # (no split: re-ordering partial merges would change float
+        # accumulation order — spill-and-retry keeps results bit-for-bit)
+        from ..memory import (SpillableBatch, device_budget,
+                              register_with_retry)
         cat = device_budget()
         buf_schema = Schema(self.key_fields + self.buffer_fields)
         spillables: List[SpillableBatch] = []
@@ -551,7 +555,9 @@ class HashAggregateExec(UnaryExec):
             else:
                 part = batch
             # registered handles start unpinned (spillable)
-            spillables.append((SpillableBatch(cat, part, buf_schema),
+            spillables.append((register_with_retry(part, buf_schema,
+                                                   catalog=cat,
+                                                   name=self.name),
                                int(part.capacity)))
 
         finalize = self.mode in (AggregateMode.FINAL, AggregateMode.COMPLETE)
@@ -584,16 +590,35 @@ class HashAggregateExec(UnaryExec):
            chunked merge tree, then stream chunks in global key order,
            merging each and emitting every group except the boundary one
            (carried into the next chunk)."""
-        from ..memory import SpillableBatch
+        from ..memory import register_with_retry, with_retry_no_split
+
+        def _acquire_group(grp):
+            """Pin a group of partials transactionally: a mid-loop OOM
+            unpins what this attempt already pinned, so the retry loop
+            re-runs against a clean (fully spillable) state."""
+            got = []
+            try:
+                for sb, _ in grp:
+                    got.append(sb.get())  # retry-ok: _acquire_group runs only inside final_merge/window_merge bodies under with_retry_no_split
+            except BaseException:
+                for j in range(len(got)):
+                    grp[j][0].done_with()
+                raise
+            return got
+
         window = self.max_result_rows
         while True:
             total = sum(c for _, c in entries)
             if len(entries) == 1 or total <= window:
-                batches = [sb.get() for sb, _ in entries]
-                merged = batches[0] if len(batches) == 1 else concat_batches(
-                    batches, bucket_capacity(total))
-                for sb, _ in entries:
-                    sb.done_with()
+                def final_merge():
+                    batches = _acquire_group(entries)
+                    merged = batches[0] if len(batches) == 1 else \
+                        concat_batches(batches, bucket_capacity(total))
+                    for sb, _ in entries:
+                        sb.done_with()
+                    return merged
+                merged = with_retry_no_split(final_merge, catalog=cat,
+                                             name=self.name)
                 yield self._final_jit(merged) if finalize \
                     else self._merge_jit(merged)
                 return
@@ -610,17 +635,25 @@ class HashAggregateExec(UnaryExec):
                 if len(grp) == 1:
                     new_entries.append(grp[0])
                     continue
-                batches = [sb.get() for sb, _ in grp]
-                merged = self._merge_jit(
-                    concat_batches(batches, bucket_capacity(cap_sum)))
-                n = int(merged.num_rows)
-                out_cap = bucket_capacity(max(n, 1))
-                if out_cap < merged.capacity:
-                    merged = self._slice_compact(merged, out_cap)
+
+                def window_merge(grp=grp, cap_sum=cap_sum):
+                    batches = _acquire_group(grp)
+                    merged = self._merge_jit(
+                        concat_batches(batches, bucket_capacity(cap_sum)))
+                    n = int(merged.num_rows)
+                    out_cap = bucket_capacity(max(n, 1))
+                    if out_cap < merged.capacity:
+                        merged = self._slice_compact(merged, out_cap)
+                    for sb, _ in grp:
+                        sb.done_with()
+                    return merged
+
+                merged = with_retry_no_split(window_merge, catalog=cat,
+                                             name=self.name)
                 for sb, _ in grp:
-                    sb.done_with()
                     sb.close()
-                nsb = SpillableBatch(cat, merged, buf_schema)
+                nsb = register_with_retry(merged, buf_schema, catalog=cat,
+                                          name=self.name)
                 new_entries.append((nsb, int(merged.capacity)))
                 shrunk += cap_sum - int(merged.capacity)
             # mutate the caller's list so the finally-close sees live handles
@@ -656,8 +689,9 @@ class HashAggregateExec(UnaryExec):
         slice_jit = jax.jit(slice_batch, static_argnums=3)
 
         def batches():
+            from ..memory import acquire_with_retry
             for sb, _ in entries:
-                b = sb.get()
+                b = acquire_with_retry(sb, name=self.name)
                 sb.done_with()
                 yield b
 

@@ -83,14 +83,27 @@ class InMemoryScanExec(LeafExec):
         if self._batches is not None:
             yield from self._batches
             return
+        from ..memory.retry import maybe_inject, with_retry_no_split
+
+        def h2d(chunk):
+            maybe_inject("scan.h2d")
+            batch, _ = from_arrow(chunk, schema=self._schema,
+                                  dict_conf=self._dict_conf)
+            return batch
+
         for table in self._tables:
             n = table.num_rows
             step = self._batch_rows or max(n, 1)
             for off in range(0, max(n, 1), step):
                 chunk = table.slice(off, step)
-                batch, _ = from_arrow(chunk, schema=self._schema,
-                                      dict_conf=self._dict_conf)
-                yield batch
+                # H2D under the retry loop. NO split here: batch count
+                # feeds the partition round-robin below and the fusion
+                # planner's exactly-one-batch contract (fuse.py) — a
+                # split would reshuffle rows across partitions / drop
+                # the second half of a fused input. File scans split at
+                # their H2D instead (io/scan.py).
+                yield with_retry_no_split(lambda c=chunk: h2d(c),
+                                          name=self.name)
                 if n == 0:
                     break
 

@@ -648,37 +648,80 @@ class HashJoinExec(BinaryExec):
     def _probe(self, build_batches: List[ColumnarBatch],
                stream_iter: Iterator[ColumnarBatch]
                ) -> Iterator[ColumnarBatch]:
-        """Core probe loop against ONE in-memory build table."""
+        """Core probe loop against ONE in-memory build table.
+
+        Retry discipline: the build side is admitted to the spill catalog
+        (SpillableColumnarBatch shape — held across the retry boundary as
+        handles, not raw device arrays) and the concat+build runs under
+        with_retry_no_split; each probe batch runs under with_retry with
+        halving — a half-stream probes to the same pairs in the same
+        stream-row order, so concatenated outputs are bit-for-bit."""
         from ..batch import empty_batch
-        if not build_batches:
-            build = empty_batch(self.right.output_schema)
-        elif len(build_batches) == 1:
-            build = build_batches[0]
-        else:
-            cap = bucket_capacity(sum(b.capacity for b in build_batches))
-            build = concat_batches(build_batches, cap)
-        sorted_h, sbuild, _ = self._build_jit(build)
+        from ..memory import (SpillableInput, admit_all, device_budget,
+                              split_input_halves, with_retry,
+                              with_retry_no_split)
+        cat = device_budget()
+        build_schema = self.right.output_schema
+        build_inputs = admit_all(build_batches, build_schema, cat,
+                                 name=f"{self.name}.build")
+
+        def build_body():
+            got: List[ColumnarBatch] = []
+            try:
+                for binp in build_inputs:
+                    got.append(binp.acquire())
+                if not got:
+                    build = empty_batch(build_schema)
+                elif len(got) == 1:
+                    build = got[0]
+                else:
+                    cap = bucket_capacity(sum(b.capacity for b in got))
+                    build = concat_batches(got, cap)
+                return self._build_jit(build)
+            finally:
+                for j in range(len(got)):
+                    build_inputs[j].release()
+
+        try:
+            sorted_h, sbuild, _ = with_retry_no_split(
+                build_body, catalog=cat, name=f"{self.name}.build")
+        finally:
+            for binp in build_inputs:
+                binp.close()
         matched_build = jnp.zeros(sbuild.capacity, bool)
 
         semi = self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
                                   JoinType.EXISTENCE)
+        stream_schema = self.left.output_schema
+
+        def probe_body(item: SpillableInput):
+            b = item.acquire()
+            try:
+                lo, counts, offsets, total = self._count_jit(b, sorted_h)
+                total_i = int(total)
+                if total_i > (1 << 31) - 1:
+                    raise RuntimeError(
+                        f"join candidate explosion: {total_i} pairs in "
+                        f"one probe batch exceeds the int32 offset range; "
+                        f"reduce the batch size or pre-aggregate the "
+                        f"build side")
+                out_cap = bucket_capacity(max(total_i, 1))
+                if semi:
+                    return self._semi_jit(b, sbuild, (lo, counts, offsets),
+                                          matched_build, out_cap), None
+                return self._expand_jit(b, sbuild, (lo, counts, offsets),
+                                        matched_build, out_cap)
+            finally:
+                item.release()
+
         for stream in stream_iter:
-            lo, counts, offsets, total = self._count_jit(stream, sorted_h)
-            total_i = int(total)
-            if total_i > (1 << 31) - 1:
-                raise RuntimeError(
-                    f"join candidate explosion: {total_i} pairs in one "
-                    f"probe batch exceeds the int32 offset range; reduce "
-                    f"the batch size or pre-aggregate the build side")
-            out_cap = bucket_capacity(max(total_i, 1))
-            if semi:
-                yield self._semi_jit(stream, sbuild,
-                                     (lo, counts, offsets), matched_build,
-                                     out_cap)
-            else:
-                out, matched_build = self._expand_jit(
-                    stream, sbuild, (lo, counts, offsets),
-                    matched_build, out_cap)
+            inp = SpillableInput.admit(stream, stream_schema, cat,
+                                       name=self.name)
+            for out, mb in with_retry(inp, probe_body,
+                                      split=split_input_halves,
+                                      catalog=cat, name=self.name):
+                if mb is not None:
+                    matched_build = mb
                 yield out
 
         if self.join_type in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
@@ -710,7 +753,8 @@ class HashJoinExec(BinaryExec):
         pair independently with the normal probe loop. Stream buckets wait
         in the spill catalog, so peak device residency stays one bucket's
         build + one stream batch regardless of input size."""
-        from ..memory import SpillableBatch, device_budget
+        from ..memory import (SpillableBatch, acquire_with_retry,
+                              device_budget, register_with_retry)
         cat = device_budget()
         build_rows = sum(int(b.num_rows) for b in build_batches)
         n_buckets = -(-build_rows // self.max_build_rows)
@@ -738,13 +782,14 @@ class HashJoinExec(BinaryExec):
             for s in range(n_buckets):
                 piece = split_stream(batch, s)
                 if int(piece.num_rows) > 0:
-                    sub_stream[s].append(
-                        SpillableBatch(cat, piece, stream_schema))
+                    sub_stream[s].append(register_with_retry(
+                        piece, stream_schema, catalog=cat,
+                        name=f"{self.name}.grace"))
 
         for s in range(n_buckets):
             def pieces(bucket=s):
                 for sp in sub_stream[bucket]:
-                    out = sp.get()
+                    out = acquire_with_retry(sp, name=f"{self.name}.grace")
                     sp.done_with()
                     yield out
             try:

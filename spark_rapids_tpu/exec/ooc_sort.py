@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 
 from ..batch import ColumnarBatch, Schema, bucket_capacity
-from ..memory import BufferCatalog, SpillableBatch
+from ..memory import (BufferCatalog, SpillableBatch, acquire_with_retry,
+                      register_with_retry)
 from .common import compact, concat_batches, slice_batch, sort_operands
 from .sort import SortOrder, sort_batch
 
@@ -33,8 +34,10 @@ class _Run:
         self.chunks: List[SpillableBatch] = []
 
     def append(self, batch: ColumnarBatch) -> None:
-        # register() leaves the handle unpinned (spillable) already
-        self.chunks.append(SpillableBatch(self.catalog, batch, self.schema))
+        # register() leaves the handle unpinned (spillable) already; the
+        # registration reserve runs under the OOM retry loop
+        self.chunks.append(register_with_retry(
+            batch, self.schema, catalog=self.catalog, name="ooc_sort.run"))
 
     def close(self) -> None:
         for c in self.chunks:
@@ -124,13 +127,13 @@ class OutOfCoreSorter:
             pieces = [buf] if buf is not None else []
             bounds = []
             if ai < len(a.chunks):
-                ca = a.chunks[ai].get()
+                ca = acquire_with_retry(a.chunks[ai], name="ooc_sort.merge")
                 a.chunks[ai].done_with()
                 ai += 1
                 pieces.append(ca)
                 bounds.append((self._key_rank_last(ca), ai >= len(a.chunks)))
             if bi < len(b.chunks):
-                cb = b.chunks[bi].get()
+                cb = acquire_with_retry(b.chunks[bi], name="ooc_sort.merge")
                 b.chunks[bi].done_with()
                 bi += 1
                 pieces.append(cb)
@@ -185,7 +188,7 @@ class OutOfCoreSorter:
             runs = nxt
         final = runs[0]
         for sb in final.chunks:
-            yield sb.get()
+            yield acquire_with_retry(sb, name="ooc_sort.emit")
             sb.done_with()
         final.close()
 

@@ -106,31 +106,47 @@ class SortExec(UnaryExec):
         # the accumulation phase cannot blow the device budget (reference:
         # GpuOutOfCoreSortIterator spills pending batches; the final merge
         # still materializes the full result — OOC chunked merge is the
-        # planned refinement).
-        from ..memory import SpillableBatch, device_budget
+        # planned refinement). Registration AND the acquire-all merge run
+        # under the OOM retry loop: a failed attempt unpins, spills, and
+        # re-runs (the merge itself cannot split — the OOC path is the
+        # bounded-memory fallback for oversized inputs).
+        from ..memory import (acquire_with_retry, device_budget,
+                              register_with_retry, with_retry_no_split)
         cat = device_budget()
         spillables = []
         schema = self.output_schema
         for cp in range(self.child.num_partitions):
             for b in self.child.execute_partition(cp):
                 # registered handles start unpinned (spillable)
-                spillables.append(SpillableBatch(cat, b, schema))
+                spillables.append(register_with_retry(
+                    b, schema, catalog=cat, name=self.name))
         if not spillables:
             return
         try:
             if len(spillables) == 1:
-                yield self._sort_jit(spillables[0].get())
+                yield self._sort_jit(acquire_with_retry(
+                    spillables[0], name=self.name))
                 spillables[0].done_with()
                 return
-            caps = []
-            for sb in spillables:
-                b = sb.get()
-                caps.append(b)
-                sb.done_with()
+
+            def acquire_all():
+                got = []
+                try:
+                    for sb in spillables:
+                        got.append(sb.get())
+                except BaseException:
+                    for i in range(len(got)):
+                        spillables[i].done_with()
+                    raise
+                for sb in spillables:
+                    sb.done_with()
+                return got
+
+            caps = with_retry_no_split(acquire_all, catalog=cat,
+                                       name=self.name)
             total_cap = sum(b.capacity for b in caps)
             if total_cap > self.max_rows:
                 # out-of-core chunked merge (reference: GpuOutOfCoreSort)
-                from ..memory import device_budget
                 from .ooc_sort import OutOfCoreSorter
                 sorter = OutOfCoreSorter(self.orders, schema,
                                          device_budget())

@@ -506,11 +506,34 @@ class WindowExec(UnaryExec):
             for batch in self.child.execute_partition(p):
                 yield self._kernel(batch)
             return
-        batches = list(self.child.execute_partition(p))
-        if not batches:
+        # accumulated input batches ride the spill catalog across the
+        # retry boundary (SpillableColumnarBatch discipline); the concat +
+        # window kernel re-runs after an OOM with pins released and the
+        # store spilled (no split: a window partition must stay whole)
+        from ..memory import admit_all, device_budget, with_retry_no_split
+        cat = device_budget()
+        in_schema = self.child.output_schema
+        inputs = admit_all(self.child.execute_partition(p), in_schema, cat,
+                           name=f"{self.name}.admit")
+        if not inputs:
             return
-        if len(batches) == 1:
-            yield self._kernel(batches[0])
-            return
-        cap = bucket_capacity(sum(b.capacity for b in batches))
-        yield self._kernel(concat_batches(batches, cap))
+
+        def assemble_and_run():
+            got = []
+            try:
+                for item in inputs:
+                    got.append(item.acquire())
+                if len(got) == 1:
+                    return self._kernel(got[0])
+                cap = bucket_capacity(sum(b.capacity for b in got))
+                return self._kernel(concat_batches(got, cap))
+            finally:
+                for j in range(len(got)):
+                    inputs[j].release()
+
+        try:
+            yield with_retry_no_split(assemble_and_run, catalog=cat,
+                                      name=self.name)
+        finally:
+            for item in inputs:
+                item.close()

@@ -69,17 +69,29 @@ class FileSourceScanExec(LeafExec):
         from ..pipeline import close_iterator
         it = self.source.read_split(self._files_for(p),
                                     metrics=self.metrics)
+        from ..memory.retry import (maybe_inject, split_host_table,
+                                    with_retry)
         try:
             dict_conf = getattr(self.source, "_dict_conf", None)
-            for host_table in it:
+
+            def h2d(tbl):
                 # dictionary-typed columns (RLE_DICTIONARY scan hand-off)
                 # land as codes + dictionary; everything else pads as
                 # before. dict_conf carries the session's cardinality
                 # thresholds to the fallback decision.
-                batch, _ = from_arrow(host_table, schema=self._schema,
+                maybe_inject("scan.h2d")
+                batch, _ = from_arrow(tbl, schema=self._schema,
                                       dict_conf=dict_conf)
+                return batch
+
+            for host_table in it:
                 self.metrics["numOutputRows"].add(host_table.num_rows)
-                yield batch
+                # H2D under the retry loop: an OOM staging this table
+                # halves it (host-side slice) and device_puts the halves —
+                # downstream coalesce re-assembles them bit-for-bit
+                yield from with_retry(host_table, h2d,
+                                      split=split_host_table,
+                                      name=self.name)
         finally:
             # consumer abort (limit early-exit) must cancel the prefetch
             # producer promptly — no decode running past the query

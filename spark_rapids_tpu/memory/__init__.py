@@ -14,7 +14,11 @@ shape as the reference, pull- instead of push-triggered.
 """
 
 from .semaphore import TpuSemaphore
-from .catalog import (BufferCatalog, SpillableBatch, StorageTier,
-                      device_budget)
+from .catalog import (BufferCatalog, OutOfBudgetError, SpillableBatch,
+                      StorageTier, device_budget)
+from .retry import (FinalOOMError, InjectedOOMError, SpillableInput,
+                    acquire_with_retry, admit_all, is_retryable_oom,
+                    maybe_inject, oom_injection, register_with_retry,
+                    split_input_halves, with_retry, with_retry_no_split)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -30,6 +30,20 @@ import numpy as np
 from ..batch import ColumnarBatch, DeviceColumn, Schema
 from .. import types as T
 
+_maybe_inject = None
+
+
+def _inject(site: str) -> None:
+    """Late-bound hook into retry.maybe_inject (retry.py imports this
+    module, so the reference is resolved on first use, not at import) —
+    reserve/acquire are the hottest allocation paths and must not pay a
+    sys.modules lookup per call."""
+    global _maybe_inject
+    if _maybe_inject is None:
+        from .retry import maybe_inject
+        _maybe_inject = maybe_inject
+    _maybe_inject(site)
+
 
 class StorageTier(Enum):
     DEVICE = 0
@@ -77,6 +91,10 @@ class BufferCatalog:
         self._entries: Dict[int, _Entry] = {}
         self._next = 0
         self._lock = threading.RLock()
+        #: per-thread pin multiset {tid: {hid: count}} — the retry state
+        #: machine snapshots/restores a task's pins between attempts
+        #: (reference: RmmSpark per-thread state + SpillFramework pins)
+        self._thread_pins: Dict[int, Dict[int, int]] = {}
         self.device_used = 0
         self.host_used = 0
         self.spilled_to_host = 0
@@ -113,6 +131,10 @@ class BufferCatalog:
     def reserve(self, nbytes: int) -> None:
         """Ensure nbytes of device budget, spilling if necessary
         (reference: DeviceMemoryEventHandler.onAllocFailure, inverted)."""
+        # deterministic fault injection: every budget reservation is an
+        # instrumented allocation site (mirror of RmmSpark's injected OOM
+        # at the allocator). No-op unless a test enabled injection.
+        _inject("catalog.reserve")
         with self._lock:
             if self.device_used + nbytes <= self.device_limit:
                 self.device_used += nbytes
@@ -195,6 +217,10 @@ class BufferCatalog:
 
     def acquire(self, hid: int) -> ColumnarBatch:
         """Materialize a handle on device (unspilling as needed) and pin it."""
+        # every pin is an instrumented allocation site too: pinning an
+        # already-device buffer extends its residency (the exchange
+        # pack/pin loops), and the unspill path reserves fresh budget
+        _inject("catalog.acquire")
         with self._lock:
             e = self._entries[hid]
             if e.tier is not StorageTier.DEVICE:
@@ -214,6 +240,7 @@ class BufferCatalog:
                 e.host = None
                 e.tier = StorageTier.DEVICE
             e.pinned += 1
+            self._note_pin(hid, +1)
             return e.batch
 
     def _host_to_device(self, e: _Entry) -> ColumnarBatch:
@@ -233,12 +260,15 @@ class BufferCatalog:
                     f"handle #{hid} released while unpinned"
                     + (f" (registered at {e.origin})" if e.origin else ""))
             e.pinned -= 1
+            self._note_pin(hid, -1)
 
     def remove(self, hid: int) -> None:
         with self._lock:
             e = self._entries.pop(hid, None)
             if e is None:
                 return
+            for tp in self._thread_pins.values():
+                tp.pop(hid, None)
             if e.tier is StorageTier.DEVICE:
                 self.device_used = max(0, self.device_used - e.size)
             elif e.tier is StorageTier.HOST:
@@ -251,6 +281,73 @@ class BufferCatalog:
 
     def tier_of(self, hid: int) -> StorageTier:
         return self._entries[hid].tier
+
+    # ------------------------------------------------------------------
+    # per-thread pin accounting (retry-state-machine support; reference:
+    # the task-thread pin registry RmmSpark keeps so blocked/retrying
+    # tasks can release everything they hold)
+    # ------------------------------------------------------------------
+
+    def _note_pin(self, hid: int, delta: int) -> None:
+        """Record a pin/unpin against the calling thread (under _lock)."""
+        tid = threading.get_ident()
+        tp = self._thread_pins.setdefault(tid, {})
+        c = tp.get(hid, 0) + delta
+        if c <= 0:
+            tp.pop(hid, None)
+            if not tp:
+                self._thread_pins.pop(tid, None)
+        else:
+            tp[hid] = c
+
+    def pin_snapshot(self) -> Dict[int, int]:
+        """The calling thread's current pin multiset {hid: count}."""
+        with self._lock:
+            return dict(self._thread_pins.get(threading.get_ident(), {}))
+
+    def restore_pins(self, snapshot: Dict[int, int]) -> None:
+        """Release every pin the calling thread took SINCE ``snapshot``
+        (a failed retry attempt's pins) so held batches become spillable
+        again. Pins a body already released itself are not re-released;
+        handles the body removed are skipped."""
+        with self._lock:
+            current = dict(self._thread_pins.get(threading.get_ident(), {}))
+            for hid, cnt in current.items():
+                excess = cnt - snapshot.get(hid, 0)
+                for _ in range(excess):
+                    e = self._entries.get(hid)
+                    if e is None or e.pinned <= 0:
+                        break
+                    e.pinned -= 1
+                    self._note_pin(hid, -1)
+
+    def total_pinned(self) -> int:
+        """Sum of pin counts over all handles (0 = everything spillable;
+        the invariant tests assert at session close)."""
+        with self._lock:
+            return sum(e.pinned for e in self._entries.values())
+
+    def tier_summary(self) -> str:
+        """One line per tier: entry count + registered bytes, plus the
+        budget headroom (the oomDumpDir occupancy section)."""
+        with self._lock:
+            per = {t: [0, 0] for t in StorageTier}
+            pinned = 0
+            for e in self._entries.values():
+                per[e.tier][0] += 1
+                per[e.tier][1] += e.size
+                if e.pinned:
+                    pinned += 1
+            lines = [f"device_used={self.device_used}b of "
+                     f"{self.device_limit}b; host_used={self.host_used}b "
+                     f"of {self.host_limit}b; pinned_handles={pinned}; "
+                     f"total_pins={self.total_pinned()}"]
+            for t in StorageTier:
+                lines.append(f"  {t.name}: {per[t][0]} entries, "
+                             f"{per[t][1]}b")
+            lines.append(f"  spilled_to_host={self.spilled_to_host}b "
+                         f"spilled_to_disk={self.spilled_to_disk}b")
+            return "\n".join(lines)
 
     def host_view(self, hid: int):
         """The handle's PackedTable when it lives on the HOST tier, else

@@ -50,6 +50,18 @@ class TpuSemaphore:
             del self._holders[tid]
         self._sem.release()
 
+    def held_depth(self) -> int:
+        """The calling thread's re-entrant hold depth (0 = not a holder).
+        The retry state machine releases this many times before backing
+        off so other holders can drain, then re-acquires."""
+        with self._lock:
+            return self._holders.get(threading.get_ident(), 0)
+
+    def holders(self) -> Dict[int, int]:
+        """{thread id: depth} of current holders (oomDumpDir report)."""
+        with self._lock:
+            return dict(self._holders)
+
     @contextmanager
     def task(self):
         self.acquire_if_necessary()

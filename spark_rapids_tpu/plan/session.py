@@ -85,6 +85,13 @@ class Session:
             return plan.interpret()
         from ..exec.base import collect as collect_exec
         from ..exec.python_exec import _python_semaphore
+        from ..memory.retry import apply_session_conf
+        from ..memory.retry import metrics as _retry_metrics
+        # install this session's retry/OOM-injection/oomDumpDir settings
+        # (process-wide, like the reference's per-executor RmmSpark state)
+        # and watermark the retry counters so metrics() reports deltas
+        apply_session_conf(self.conf)
+        self._retry0 = _retry_metrics().snapshot()
         self._sem_wait0 = _python_semaphore.wait_time_ns
         try:
             return collect_exec(plan)
@@ -178,6 +185,17 @@ class Session:
             getattr(self, "_sem_wait0", _python_semaphore.wait_time_ns)
         if wait > 0:
             out["python.semaphoreWaitTime"] = wait
+        # retry state machine counters since this session's last collect
+        # (retryCount / splitAndRetryCount / retryBlockTime / spill bytes
+        # the recovery forced) — the GpuTaskMetrics roll-up twin
+        from ..memory.retry import metrics as _retry_metrics
+        snap = _retry_metrics().snapshot()
+        base = getattr(self, "_retry0", None)
+        if base is not None:
+            for k, v in snap.items():
+                delta = v - base.get(k, 0)
+                if delta > 0:
+                    out[f"retry.{k}"] = delta
         return out
 
     def executed_exec_names(self) -> List[str]:

@@ -128,12 +128,31 @@ class ExecutorRuntime:
     # ------------------------------------------------------------------
 
     FATAL_MARKERS = ("DEADLINE_EXCEEDED", "device is in an invalid state",
-                     "HBM OOM", "halted", "RESOURCE_EXHAUSTED: XLA")
+                     "halted")
 
     def classify_failure(self, exc: BaseException) -> bool:
-        """True if fatal for the device (executor must be replaced)."""
+        """True if fatal for the device (executor must be replaced).
+
+        The device-OOM family (RESOURCE_EXHAUSTED / HBM OOM — memory/
+        retry.py RETRYABLE_OOM_MARKERS, one list so classification and
+        retry can never disagree) belongs to the retry state machine:
+        release pins, spill, re-run, split — only a post-retry
+        FinalOOMError fails the query, and even that leaves the executor
+        healthy (the reference's task-level GpuOOM vs executor-fatal
+        CUDA errors). An explicit fatal marker wins over an OOM marker
+        in the same message: a halted device is gone no matter what
+        exhausted it."""
+        from .memory.retry import FinalOOMError
+        if isinstance(exc, FinalOOMError):
+            # the retry framework already released pins and spilled the
+            # store; the query died but the device is in a clean state
+            return False
         msg = str(exc)
-        return any(m in msg for m in self.FATAL_MARKERS)
+        if any(m in msg for m in self.FATAL_MARKERS):
+            return True
+        # everything else — including the retryable OOM family
+        # (is_retryable_oom) — leaves the device usable
+        return False
 
     def on_task_failed(self, exc: BaseException) -> None:
         if not self.classify_failure(exc):

@@ -41,8 +41,9 @@ class DeviceShuffleCache:
     # ---- writer side (RapidsCachingWriter.write) ----
     def add_batch(self, shuffle_id: int, map_id: int, reduce_id: int,
                   batch: ColumnarBatch, schema: Schema) -> None:
-        from ..memory import SpillableBatch
-        sb = SpillableBatch(self.catalog, batch, schema)
+        from ..memory import register_with_retry
+        sb = register_with_retry(batch, schema, catalog=self.catalog,
+                                 name="device_cache")
         with self._lock:
             self._blocks[(shuffle_id, map_id, reduce_id)] = (sb, schema)
         self.transport.publish_lazy(shuffle_id, map_id, reduce_id)
@@ -55,7 +56,8 @@ class DeviceShuffleCache:
         if ent is None:
             return None
         sb, _ = ent
-        out = sb.get()
+        from ..memory import acquire_with_retry
+        out = acquire_with_retry(sb, name="device_cache")
         sb.done_with()
         return out
 
@@ -67,7 +69,8 @@ class DeviceShuffleCache:
         if ent is None:
             return None
         sb, schema = ent
-        batch = sb.get()
+        from ..memory import acquire_with_retry
+        batch = acquire_with_retry(sb, name="device_cache")
         try:
             return serialize_batch(batch, schema, self.codec)
         finally:

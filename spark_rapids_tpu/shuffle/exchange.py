@@ -186,10 +186,12 @@ class ShuffleExchangeExec(UnaryExec):
         bound_cols = jax.jit(bounds_kernel)(allk)
         part.set_bounds(bound_cols, n - 1)
 
-    def _register(self, out, p: int, piece: ColumnarBatch) -> None:
+    def _register(self, staged, p: int, piece: ColumnarBatch) -> None:
         """Shrink a partition piece to its row-count bucket and hand it to
         the spill catalog (padding at full input capacity would multiply
-        device residency by the partition count)."""
+        device residency by the partition count). Appends to ``staged``
+        so a failed write attempt can free its partial pieces before the
+        retry loop re-runs it."""
         rows = int(piece.num_rows)
         if rows == 0:
             return
@@ -197,8 +199,9 @@ class ShuffleExchangeExec(UnaryExec):
         if cap < piece.capacity:
             piece = self._shrink_jit(piece, cap)
         # registration leaves the entry unpinned → spillable under pressure
-        sb = SpillableBatch(self._cat(), piece, self.output_schema)
-        out[p].append((sb, rows))
+        sb = SpillableBatch(self._cat(), piece,
+                            self.output_schema)  # retry-ok: only write_body (runs under with_retry) calls _register
+        staged.append((p, sb, rows))
 
     def _materialize(self) -> List[List[Tuple[SpillableBatch, int]]]:
         if self._materialized is not None:
@@ -220,16 +223,58 @@ class ShuffleExchangeExec(UnaryExec):
                       for b in self.child.execute_partition(cp))
         cat = self._cat()
         spill0 = cat.spilled_to_host + cat.spilled_to_disk
+        from ..memory.retry import (SpillableInput, split_input_halves,
+                                    with_retry)
         from ..utils import tracing
-        for batch in stream:
-            with tracing.op_range(f"{self.name}.write"):
+        in_schema = self.child.output_schema
+
+        def write_body(item: SpillableInput):
+            """One write attempt over one (possibly split) input: slice
+            per target partition and register the pieces. Transactional —
+            an OOM mid-loop frees this attempt's pieces so the retry (or
+            the half-inputs after a split) starts clean."""
+            b = item.acquire()
+            staged: List[Tuple[int, SpillableBatch, int]] = []
+            try:
                 if n == 1:
-                    self._register(out, 0, batch)
-                    continue
-                pids = self._pids_jit(batch)
-                for p in range(n):
-                    self._register(out, p,
-                                   self._slice_jit(batch, pids, p))
+                    self._register(staged, 0, b)
+                else:
+                    pids = self._pids_jit(b)
+                    for p in range(n):
+                        self._register(staged, p,
+                                       self._slice_jit(b, pids, p))
+            except BaseException:
+                for _p, sb, _r in staged:
+                    sb.close()
+                raise
+            finally:
+                item.release()
+            return staged
+
+        try:
+            for batch in stream:
+                with tracing.op_range(f"{self.name}.write"):
+                    # the input batch rides the catalog across retry
+                    # boundaries (SpillableColumnarBatch discipline); a
+                    # repeated OOM halves it — half-inputs slice to the
+                    # same pieces in the same order, so reads stay
+                    # bit-for-bit
+                    inp = SpillableInput.admit(batch, in_schema, cat,
+                                               name=f"{self.name}.admit")
+                    for staged in with_retry(inp, write_body,
+                                             split=split_input_halves,
+                                             catalog=cat, name=self.name):
+                        for p, sb, rows in staged:
+                            out[p].append((sb, rows))
+        except BaseException:
+            # a mid-stream failure (final OOM on a later batch, child
+            # error) must free the pieces earlier batches already staged:
+            # self._materialized is still None here, so do_close would
+            # never see them
+            for part in out:
+                for sb, _rows in part:
+                    sb.close()
+            raise
         from ..exec.base import DEBUG, Metric
         self.metrics.setdefault(
             "spillBytes", Metric("spillBytes", DEBUG)).add(
@@ -246,33 +291,45 @@ class ShuffleExchangeExec(UnaryExec):
             return
         # shuffle-read coalesce (reference: GpuShuffleCoalesceExec)
         cap = bucket_capacity(max(sum(rows for _, rows in entries), 1))
-        pinned = set()
-        try:
-            if len(entries) == 1:
-                batch = entries[0][0].get()
-                pinned.add(id(entries[0][0]))
-                yield batch
-            else:
+        from ..memory.retry import with_retry_no_split
+
+        def assemble():
+            """The pin loop, transactional: a mid-loop OOM from get()
+            unpins the ALREADY-PINNED entries before propagating — the
+            retry loop (or a coordinated re-read) finds every piece
+            unpinned and spillable, and `use` refcounts are only
+            committed after a successful read below."""
+            pinned: List[SpillableBatch] = []
+            try:
                 got = []
                 for sb, _ in entries:
                     got.append(sb.get())
-                    pinned.add(id(sb))
+                    pinned.append(sb)
+                if len(got) == 1:
+                    return pinned, got[0]
                 # per-batch dictionaries unify to ONE merged dictionary
                 # via a device code-remap (eager: we are between kernels
                 # here), so the shuffle-read coalesce keeps string
                 # columns encoded across the concat
                 from ..dictenc import unify_dict_batches
                 got = unify_dict_batches(got)
-                yield concat_batches(got, cap)
+                return pinned, concat_batches(got, cap)
+            except BaseException:
+                for sb in pinned:
+                    sb.done_with()
+                raise
+
+        pinned, batch = with_retry_no_split(assemble, catalog=self._cat(),
+                                            name=f"{self.name}.read")
+        pinned_ids = {id(sb) for sb in pinned}
+        try:
+            yield batch
         finally:
             # free a piece after its LAST referencing read partition
-            # (skew-split replicates build pieces across readers). Two
-            # error-path subtleties: an abandoned generator (limit
-            # early-exit) may be finalized AFTER do_close() already reset
-            # the refcounts (use is None -> idempotent close), and a
-            # mid-loop OOM from get() leaves later entries UNPINNED —
-            # only actually-pinned handles get done_with, so the original
-            # error propagates instead of a DoubleReleaseError.
+            # (skew-split replicates build pieces across readers). An
+            # abandoned generator (limit early-exit) may be finalized
+            # AFTER do_close() already reset the refcounts (use is None
+            # -> idempotent close).
             use = self._use_left
             for op_, lo, hi in spec:
                 for i in range(lo, hi):
@@ -283,7 +340,7 @@ class ShuffleExchangeExec(UnaryExec):
                     use[(op_, i)] -= 1
                     if use[(op_, i)] <= 0:
                         sb.close()
-                    elif id(sb) in pinned:
+                    elif id(sb) in pinned_ids:
                         sb.done_with()
 
     def serialized_partitions(self, codec: Optional[str] = None,
@@ -309,20 +366,32 @@ class ShuffleExchangeExec(UnaryExec):
         specs = self._reader_specs()
         parts = self._materialize()
 
+        from ..memory.retry import with_retry_no_split
+
         def staged():
-            # producer stage: D2H (or host-tier view) per piece
+            # producer stage: D2H (or host-tier view) per piece. The
+            # pack/pin of each piece runs under the retry loop — an OOM
+            # on the producer thread (pin of a spilled piece reserving
+            # budget) spills/retries there; an unretryable one is
+            # re-raised at the consumer by the pipeline.
             for p, spec in enumerate(specs):
                 for op_, lo, hi in spec:
                     for i in range(lo, hi):
                         sb = parts[op_][i][0]
-                        pt = sb.host_view()
-                        if pt is None:
-                            batch = sb.get()
-                            try:
-                                pt = pack_batch(batch)
-                            finally:
-                                sb.done_with()
-                        yield p, pt
+
+                        def pack_one(sb=sb):
+                            pt = sb.host_view()
+                            if pt is None:
+                                batch = sb.get()
+                                try:
+                                    pt = pack_batch(batch)
+                                finally:
+                                    sb.done_with()
+                            return pt
+
+                        yield p, with_retry_no_split(
+                            pack_one, catalog=self._cat(),
+                            name=f"{self.name}.wire")
 
         if depth is None:
             from ..config import PREFETCH_DEPTH, PREFETCH_ENABLED, _REGISTRY
@@ -446,28 +515,37 @@ class BroadcastExchangeExec(UnaryExec):
         return 1
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        from ..memory.retry import acquire_with_retry, with_retry_no_split
         if self._sb is None:
             batches = [b for cp in range(self.child.num_partitions)
                        for b in self.child.execute_partition(cp)]
-            if not batches:
-                from ..batch import empty_batch
-                cached = empty_batch(self.output_schema)
-            elif len(batches) == 1:
-                cached = batches[0]
-            else:
-                cap = bucket_capacity(sum(b.capacity for b in batches))
-                cached = concat_batches(batches, cap)
-            if cached.size_bytes() > self.max_bytes:
-                raise BroadcastTooLargeError(
-                    f"broadcast relation is {cached.size_bytes()}b > "
-                    f"spark.rapids.tpu.broadcast.maxBytes={self.max_bytes}; "
-                    f"use a shuffled join for this build side")
             if self._catalog is None:
                 from ..memory.catalog import device_budget
                 self._catalog = device_budget()
-            self._sb = SpillableBatch(self._catalog, cached,
+
+            def build():
+                if not batches:
+                    from ..batch import empty_batch
+                    cached = empty_batch(self.output_schema)
+                elif len(batches) == 1:
+                    cached = batches[0]
+                else:
+                    cap = bucket_capacity(sum(b.capacity for b in batches))
+                    cached = concat_batches(batches, cap)
+                if cached.size_bytes() > self.max_bytes:
+                    # NOT retryable: a planner-contract violation, no
+                    # amount of spilling shrinks the relation
+                    raise BroadcastTooLargeError(
+                        f"broadcast relation is {cached.size_bytes()}b > "
+                        f"spark.rapids.tpu.broadcast.maxBytes="
+                        f"{self.max_bytes}; use a shuffled join for this "
+                        f"build side")
+                return SpillableBatch(self._catalog, cached,
                                       self.output_schema)
-        batch = self._sb.get()
+
+            self._sb = with_retry_no_split(build, catalog=self._catalog,
+                                           name=self.name)
+        batch = acquire_with_retry(self._sb, name=self.name)
         try:
             yield batch
         finally:

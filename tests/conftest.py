@@ -50,6 +50,10 @@ def pytest_configure(config):
         "markers", "slow: heavyweight tests excluded from the `-m 'not "
                    "slow'` tier-1 gate (still part of the full nightly "
                    "tier and its wall-clock budget)")
+    config.addinivalue_line(
+        "markers", "oom_inject: OOM retry framework + deterministic "
+                   "fault-injection coverage; `pytest -m oom_inject` is "
+                   "the smoke-tier robustness job in the tier-1 flow")
 
 
 def pytest_collection_modifyitems(config, items):

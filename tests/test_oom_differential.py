@@ -1,0 +1,215 @@
+"""Fault-injection differential suite (ISSUE 7 satellite).
+
+The acceptance contract for the OOM retry framework: with synthetic OOM
+injected at EVERY instrumented allocation site (every-Nth mode, N in
+{1, 3}) and with a seeded random schedule, the five bench shapes
+(bench.py: q1_stage, hash_agg, join_sort, parquet_scan, exchange) must
+
+  1. complete — retries/splits recover every injected failure,
+  2. produce results bit-for-bit identical to the clean run,
+  3. report nonzero retry metrics (the recovery actually ran), and
+  4. leak nothing: catalog pin count zero and no new handles at
+     session close.
+
+Each shape collects once clean and once per injection mode on the SAME
+input; injection is configured through the session conf
+(spark.rapids.tpu.test.injectOOM.*), the production surface, not the
+test-only oom_injection() helper — this also covers apply_session_conf.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Average, Count, Sum
+from spark_rapids_tpu.memory.catalog import device_budget
+from spark_rapids_tpu.plan import Session, table
+
+from harness.asserts import assert_tables_equal
+
+N = 3000
+
+#: the injection schedules of the acceptance criteria: every allocation
+#: check fails once, every 3rd fails, and a seeded random 20%
+MODES = [
+    pytest.param({"spark.rapids.tpu.test.injectOOM.mode": "every-1"},
+                 id="every-1"),
+    pytest.param({"spark.rapids.tpu.test.injectOOM.mode": "every-3"},
+                 id="every-3"),
+    pytest.param({"spark.rapids.tpu.test.injectOOM.mode": "random",
+                  "spark.rapids.tpu.test.injectOOM.seed": 42},
+                 id="random"),
+]
+
+
+def _rng(seed=3):
+    return np.random.default_rng(seed)
+
+
+@pytest.fixture(autouse=True)
+def _injection_off_after():
+    """apply_session_conf state is process-wide (the executor-singleton
+    shape): force injection OFF after every test so a failure here can
+    never cascade synthetic OOMs into unrelated suites."""
+    from spark_rapids_tpu.memory.retry import injector
+    yield
+    injector().configure("")
+    assert not injector().enabled
+
+
+#: float64 aggregation is conf-gated (emulated-f64 backends); on this
+#: CPU test platform f64 is native and BOTH runs share the backend, so
+#: enabling it keeps the comparison bit-for-bit
+_F64_OK = {"spark.rapids.tpu.sql.incompatibleOps.enabled": True}
+
+
+def _assert_differential(df_fn, conf_extra=None, base=None):
+    """Collect df_fn clean, then under the injection conf: bit-for-bit
+    equal, retry metrics nonzero, zero pins and no new catalog handles."""
+    cat = device_budget()
+    clean_ses = Session(dict(base or {}))
+    clean = clean_ses.collect(df_fn())
+    assert cat.total_pinned() == 0, cat.dump_state()
+
+    entries0 = len(cat._entries)
+    conf = dict(base or {})
+    conf.update(conf_extra or {})
+    inj_ses = Session(conf)
+    injected = inj_ses.collect(df_fn())
+    # the device plan must not have fallen back to the CPU interpreter —
+    # a fallback would "pass" the differential without touching a single
+    # instrumented allocation site
+    from spark_rapids_tpu.plan.overrides import CpuFallbackExec
+    assert inj_ses.last_plan is not None
+    assert not isinstance(inj_ses.last_plan, CpuFallbackExec), \
+        inj_ses.last_plan
+    assert_tables_equal(injected, clean, ignore_order=True,
+                        approx_float=False)
+    m = inj_ses.metrics()
+    assert m.get("retry.retryCount", 0) > 0, \
+        f"no retries recorded under injection: {m}"
+    assert cat.total_pinned() == 0, cat.dump_state()
+    assert len(cat._entries) == entries0, cat.dump_state()
+    return injected
+
+
+# ---------------------------------------------------------------------------
+# shape 1: q1_stage — filter + group-by aggregate (TPC-H lineitem)
+# ---------------------------------------------------------------------------
+
+def _lineitem(n=N):
+    rng = _rng(3)
+    return pa.table({
+        "l_returnflag": rng.integers(0, 3, n).astype(np.int32),
+        "l_linestatus": rng.integers(0, 2, n).astype(np.int32),
+        "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+        "l_extendedprice": rng.uniform(1.0, 1e5, n),
+        "l_shipdate": rng.integers(8000, 11000, n).astype(np.int32),
+    })
+
+
+@pytest.mark.smoke
+@pytest.mark.oom_inject
+@pytest.mark.parametrize("conf", MODES)
+def test_oom_differential_q1_stage(conf):
+    # num_slices=2: multi-batch input keeps the stage on the iterator
+    # path (whole-stage fusion runs ONE XLA program with no catalog
+    # allocation sites — nothing to inject there)
+    t = _lineitem()
+    _assert_differential(
+        lambda: table(t, num_slices=2)
+        .where(col("l_shipdate") <= lit(10471))
+        .group_by("l_returnflag", "l_linestatus")
+        .agg(Sum(col("l_quantity")).alias("sq"),
+             Sum(col("l_extendedprice")).alias("sp"),
+             Count(col("l_quantity")).alias("n")),
+        conf, base=_F64_OK)
+
+
+# ---------------------------------------------------------------------------
+# shape 2: hash_agg — high-cardinality group-by (TPC-DS store_sales)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.oom_inject
+@pytest.mark.parametrize("conf", MODES)
+def test_oom_differential_hash_agg(conf):
+    rng = _rng(5)
+    t = pa.table({
+        "ss_item_sk": rng.integers(0, 256, N).astype(np.int64),
+        "ss_quantity": rng.integers(1, 100, N).astype(np.int64),
+        "ss_sales_price": rng.uniform(0.5, 500.0, N),
+    })
+    _assert_differential(
+        lambda: table(t, num_slices=2).group_by("ss_item_sk")
+        .agg(Sum(col("ss_quantity")).alias("sq"),
+             Average(col("ss_sales_price")).alias("ap"),
+             Count(col("ss_quantity")).alias("n")),
+        conf, base=_F64_OK)
+
+
+# ---------------------------------------------------------------------------
+# shape 3: join_sort — hash join + group-by + sort (TPC-H q3/q10)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.oom_inject
+@pytest.mark.parametrize("conf", MODES)
+def test_oom_differential_join_sort(conf):
+    from spark_rapids_tpu.exec.join import JoinType
+    rng = _rng(9)
+    fact = pa.table({
+        "k": rng.integers(0, 64, N).astype(np.int64),
+        "v": rng.integers(-1000, 1000, N).astype(np.int64),
+    })
+    dim = pa.table({"dk": np.arange(64, dtype=np.int64),
+                    "cls": (np.arange(64, dtype=np.int64) % 7)})
+    _assert_differential(
+        lambda: table(fact, num_slices=2)
+        .join(table(dim), ["k"], ["dk"], JoinType.INNER)
+        .group_by("cls").agg(Sum(col("v")).alias("sv"))
+        .order_by("cls"),
+        conf)
+
+
+# ---------------------------------------------------------------------------
+# shape 4: parquet_scan — multi-file scan + predicate + projection
+# (exercises the io/scan.py H2D retry with host-table halving)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.oom_inject
+@pytest.mark.parametrize("conf", MODES)
+def test_oom_differential_parquet_scan(conf, tmp_path):
+    from spark_rapids_tpu.io import read_parquet
+    rng = _rng(13)
+    for i in range(3):
+        pq.write_table(pa.table({
+            "k": rng.integers(0, 1000, N // 3).astype(np.int64),
+            "v": rng.uniform(-10.0, 10.0, N // 3),
+        }), str(tmp_path / f"part-{i}.parquet"))
+    _assert_differential(
+        lambda: read_parquet(str(tmp_path))
+        .where(col("k") > lit(100))
+        .select(col("k"), col("v")),
+        conf)
+
+
+# ---------------------------------------------------------------------------
+# shape 5: exchange — multi-slice group-by forces a shuffle exchange
+# (exercises the pack/pin write loop + read-coalesce pin loop + split)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.oom_inject
+@pytest.mark.parametrize("conf", MODES)
+def test_oom_differential_exchange(conf):
+    rng = _rng(11)
+    t = pa.table({
+        "g": rng.integers(0, 64, N).astype(np.int32),
+        "v": rng.integers(-1000, 1000, N).astype(np.int64),
+    })
+    extra = {"spark.rapids.tpu.shuffle.partitions": 4}
+    extra.update(conf)
+    _assert_differential(
+        lambda: table(t, num_slices=4).group_by("g")
+        .agg(Sum(col("v")).alias("sv"), Count(col("g")).alias("n")),
+        extra)

@@ -1,0 +1,637 @@
+"""OOM retry state machine unit tests (memory/retry.py).
+
+Reference contract under test: RmmRapidsRetryIterator's withRetry /
+withRetryNoSplit — release held pins, spill, back off, re-run; split the
+input in half on repeated OOM; only a post-retry OOM is final (and dumps
+state to oomDumpDir). Plus the deterministic fault-injection layer that
+makes every path run on CPU, and the exchange pin-loop regression the
+retry boundary exposed.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.batch import from_arrow, to_arrow
+from spark_rapids_tpu.memory.catalog import (BufferCatalog,
+                                             OutOfBudgetError)
+from spark_rapids_tpu.memory.retry import (FinalOOMError, InjectedOOMError,
+                                           SpillableInput,
+                                           is_retryable_oom, metrics,
+                                           oom_injection, retry_policy,
+                                           split_host_table,
+                                           split_input_halves, with_retry,
+                                           with_retry_no_split,
+                                           write_oom_dump)
+
+from harness.asserts import assert_tables_equal
+
+
+def _table(n=1000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": rng.integers(0, 50, n).astype(np.int64),
+                     "v": rng.integers(-100, 100, n).astype(np.int64)})
+
+
+def _batch(n=1000, seed=7):
+    t = _table(n, seed)
+    b, schema = from_arrow(t)
+    return t, b, schema
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+@pytest.mark.oom_inject
+def test_retryable_classification():
+    assert is_retryable_oom(OutOfBudgetError("cannot reserve"))
+    assert is_retryable_oom(InjectedOOMError("injected OOM at x"))
+    # the XLA HBM OOM family (plugin.py matcher)
+    assert is_retryable_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: XLA:TPU ran out of memory"))
+    # both phrasings of a device OOM are ONE family (plugin.py and the
+    # retry loop share RETRYABLE_OOM_MARKERS — they can never disagree)
+    assert is_retryable_oom(RuntimeError("HBM OOM allocating 2GiB"))
+    assert not is_retryable_oom(ValueError("boom"))
+    assert not is_retryable_oom(MemoryError("host oom"))
+    assert not is_retryable_oom(FinalOOMError("gave up"))
+
+
+@pytest.mark.oom_inject
+def test_plugin_classifies_retryable_oom_not_fatal():
+    from spark_rapids_tpu.plugin import ExecutorRuntime
+    rt = ExecutorRuntime.get()
+    assert not rt.classify_failure(RuntimeError(
+        "RESOURCE_EXHAUSTED: XLA:TPU ran out of memory"))
+    assert not rt.classify_failure(FinalOOMError("post-retry"))
+    assert not rt.classify_failure(RuntimeError("HBM OOM allocating 2GiB"))
+    assert rt.classify_failure(RuntimeError("device is in an invalid state"))
+    # an explicit fatal marker wins over an OOM marker in the same
+    # message: a halted device is gone no matter what exhausted it
+    assert rt.classify_failure(RuntimeError(
+        "RESOURCE_EXHAUSTED then the device halted"))
+
+
+# ---------------------------------------------------------------------------
+# retry loop
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+@pytest.mark.oom_inject
+def test_no_split_retries_then_succeeds(tmp_path):
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    calls = [0]
+
+    def body():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise OutOfBudgetError("synthetic")
+        return "ok"
+
+    m0 = metrics().snapshot()
+    assert with_retry_no_split(body, catalog=cat, name="t") == "ok"
+    assert calls[0] == 3
+    delta = metrics().snapshot()["retryCount"] - m0["retryCount"]
+    assert delta == 2
+
+
+@pytest.mark.oom_inject
+def test_non_retryable_propagates_immediately(tmp_path):
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    calls = [0]
+
+    def body():
+        calls[0] += 1
+        raise ValueError("not an oom")
+
+    with pytest.raises(ValueError):
+        with_retry_no_split(body, catalog=cat, name="t")
+    assert calls[0] == 1
+
+
+@pytest.mark.oom_inject
+def test_retry_disabled_propagates(tmp_path):
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    with retry_policy(enabled=False):
+        with pytest.raises(OutOfBudgetError):
+            with_retry_no_split(lambda: (_ for _ in ()).throw(
+                OutOfBudgetError("x")), catalog=cat, name="t")
+
+
+@pytest.mark.oom_inject
+def test_final_oom_after_max_retries_writes_dump(tmp_path):
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    dump_dir = str(tmp_path / "dumps")
+
+    def body():
+        raise OutOfBudgetError("always")
+
+    with retry_policy(dump_dir=dump_dir, max_retries=2):
+        with pytest.raises(FinalOOMError) as ei:
+            with_retry_no_split(body, catalog=cat, name="always-oom")
+    assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+    text = open(ei.value.dump_path).read()
+    assert "catalog tier occupancy" in text
+    assert "always-oom" in text
+    assert "retry/split counts per operator" in text
+    assert "semaphore holders" in text
+
+
+@pytest.mark.oom_inject
+def test_retry_releases_pins_and_spills(tmp_path):
+    """A body that pins a catalog handle and OOMs must find it unpinned
+    (and spilled) on the retry — the withRetry release-what-you-hold
+    contract."""
+    t, b, schema = _batch()
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    inp = SpillableInput.from_batch(b, schema, cat)
+    attempts = [0]
+
+    def body():
+        got = inp.acquire()          # pin WITHOUT releasing
+        attempts[0] += 1
+        if attempts[0] == 1:
+            assert cat.total_pinned() == 1
+            raise OutOfBudgetError("mid-use")
+        return got
+
+    spill0 = cat.spilled_to_host
+    got = with_retry_no_split(body, catalog=cat, name="t")
+    # the framework restored the failed attempt's pin; only the
+    # successful attempt's pin remains
+    assert cat.total_pinned() == 1
+    assert cat.spilled_to_host > spill0, "recovery never forced a spill"
+    assert_tables_equal(to_arrow(got, schema), t)
+    inp.release()
+    inp.close()
+    assert cat.total_pinned() == 0
+
+
+@pytest.mark.smoke
+@pytest.mark.oom_inject
+def test_split_and_retry_bit_for_bit(tmp_path):
+    """Two OOMs on the same input halve it; results concatenate to the
+    no-OOM output, in order."""
+    t, b, schema = _batch(2000)
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    inp = SpillableInput.from_batch(b, schema, cat)
+    oomed = [0]
+
+    def body(item):
+        got = item.acquire()
+        try:
+            if item.rows > 1000 and oomed[0] < 2:
+                oomed[0] += 1
+                raise OutOfBudgetError("too big")
+            return to_arrow(got, schema)
+        finally:
+            item.release()
+
+    m0 = metrics().snapshot()
+    with retry_policy(split_floor_rows=64):
+        outs = list(with_retry(inp, body, split=split_input_halves,
+                               catalog=cat, name="t"))
+    assert len(outs) == 2, "input never split"
+    assert metrics().snapshot()["splitAndRetryCount"] \
+        > m0["splitAndRetryCount"]
+    assert_tables_equal(pa.concat_tables(outs), t,
+                        ignore_order=False, approx_float=False)
+    assert cat.total_pinned() == 0
+    # split closed the original input; halves were closed after use
+    assert not cat._entries, cat.dump_state()
+
+
+@pytest.mark.oom_inject
+def test_split_floor_blocks_split_then_final_oom(tmp_path):
+    t, b, schema = _batch(500)
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    inp = SpillableInput.from_batch(b, schema, cat)
+
+    def body(item):
+        raise OutOfBudgetError("never fits")
+
+    with retry_policy(split_floor_rows=1 << 10, max_retries=2):
+        with pytest.raises(FinalOOMError):
+            list(with_retry(inp, body, split=split_input_halves,
+                            catalog=cat, name="t"))
+    # the framework closed the input on the way out
+    assert not cat._entries, cat.dump_state()
+
+
+@pytest.mark.oom_inject
+def test_split_oom_is_one_more_attempt(tmp_path):
+    """An OOM raised inside split() itself (it re-acquires the batch and
+    registers halves — allocations at peak pressure) re-enters recovery
+    instead of escaping the state machine, and leaks nothing."""
+    t, b, schema = _batch(2000)
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    inp = SpillableInput.from_batch(b, schema, cat)
+    oomed = [0]
+    split_calls = [0]
+
+    def body(item):
+        got = item.acquire()
+        try:
+            if item.rows > 1000 and oomed[0] < 3:
+                oomed[0] += 1
+                raise OutOfBudgetError("too big")
+            return to_arrow(got, schema)
+        finally:
+            item.release()
+
+    def flaky_split(item):
+        split_calls[0] += 1
+        if split_calls[0] == 1:
+            raise OutOfBudgetError("split itself OOMs")
+        return split_input_halves(item)
+
+    with retry_policy(split_floor_rows=64):
+        outs = list(with_retry(inp, body, split=flaky_split,
+                               catalog=cat, name="t"))
+    assert split_calls[0] == 2, "failed split never re-attempted"
+    assert len(outs) == 2
+    assert_tables_equal(pa.concat_tables(outs), t,
+                        ignore_order=False, approx_float=False)
+    assert cat.total_pinned() == 0
+    assert not cat._entries, cat.dump_state()
+
+
+@pytest.mark.oom_inject
+def test_split_closes_left_half_on_right_registration_oom(
+        tmp_path, monkeypatch):
+    """Registering the halves is transactional: an OOM registering the
+    RIGHT half closes the already-registered left half (split runs at
+    peak pressure — a leak here compounds every retry)."""
+    t, b, schema = _batch(2000)
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    inp = SpillableInput.from_batch(b, schema, cat)
+    orig = SpillableInput.from_batch.__func__
+    calls = [0]
+
+    def flaky(cls, batch, schema, catalog=None):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise OutOfBudgetError("right half registration")
+        return orig(cls, batch, schema, catalog)
+
+    monkeypatch.setattr(SpillableInput, "from_batch", classmethod(flaky))
+    with retry_policy(split_floor_rows=64):
+        with pytest.raises(OutOfBudgetError):
+            inp.split(64)
+    assert calls[0] == 2
+    # the original input survives (split failed), no leaked halves
+    assert cat.total_pinned() == 0
+    inp.close()
+    assert not cat._entries, cat.dump_state()
+
+
+@pytest.mark.oom_inject
+def test_exchange_write_midstream_failure_frees_staged_pieces(tmp_path):
+    """A mid-stream failure during the exchange write loop (a later
+    batch dies after earlier batches staged their pieces) must free the
+    already-staged pieces — self._materialized is not yet assigned, so
+    do_close would never see them."""
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.shuffle import HashPartitioning, \
+        ShuffleExchangeExec
+
+    class Boom(InMemoryScanExec):
+        def do_execute_partition(self, p):
+            it = super().do_execute_partition(p)
+            yield next(it)
+            raise ValueError("downstream failure")
+
+    cat = BufferCatalog(device_limit=64 << 20, spill_dir=str(tmp_path))
+    t = _table(4000, seed=13)
+    ex = ShuffleExchangeExec(
+        HashPartitioning([col("k")], 4),
+        Boom(t, num_slices=1, batch_rows=1000), catalog=cat)
+    with pytest.raises(ValueError):
+        for _ in ex.execute_partition(0):
+            pass
+    assert cat.total_pinned() == 0
+    assert not cat._entries, cat.dump_state()
+
+
+@pytest.mark.oom_inject
+def test_admit_all_closes_on_midway_failure(tmp_path):
+    """admit_all is transactional: a failed admit k of n closes the
+    already-admitted handles (no ownerless catalog entries)."""
+    from spark_rapids_tpu.memory.retry import admit_all
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    _, b1, schema = _batch(100, seed=1)
+    _, b2, _ = _batch(100, seed=2)
+    with retry_policy(enabled=False):
+        with oom_injection("every-1", skip_count=1):
+            with pytest.raises(InjectedOOMError):
+                admit_all([b1, b2], schema, cat, name="t")
+    assert not cat._entries, cat.dump_state()
+    assert cat.total_pinned() == 0
+
+
+@pytest.mark.oom_inject
+def test_retry_backoff_uses_global_semaphore(tmp_path):
+    """with_retry defaults to the process admission semaphore: a holder
+    that retries still holds exactly its slot after recovery (released
+    across the backoff, re-acquired after)."""
+    from spark_rapids_tpu.memory.semaphore import global_semaphore
+    sem = global_semaphore()
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    calls = [0]
+
+    def body():
+        calls[0] += 1
+        if calls[0] == 1:
+            raise OutOfBudgetError("x")
+        assert sem.held_depth() == 1, "semaphore not re-acquired"
+        return "ok"
+
+    with sem.task():
+        assert sem.held_depth() == 1
+        assert with_retry_no_split(body, catalog=cat, name="t") == "ok"
+        assert sem.held_depth() == 1
+    assert sem.held_depth() == 0
+
+
+@pytest.mark.oom_inject
+def test_split_host_table_order_preserving():
+    t = _table(100)
+    with retry_policy(split_floor_rows=16):
+        halves = split_host_table(t)
+    assert halves and len(halves) == 2
+    assert_tables_equal(pa.concat_tables(halves), t, ignore_order=False)
+    tiny = _table(10)
+    with retry_policy(split_floor_rows=1 << 10):
+        assert split_host_table(tiny) is None
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+@pytest.mark.oom_inject
+def test_injector_every_n_deterministic():
+    with oom_injection("every-3") as inj:
+        fired = []
+        for i in range(9):
+            try:
+                inj.check("site")
+                fired.append(False)
+            except InjectedOOMError:
+                fired.append(True)
+        assert fired == [False, False, True,
+                         False, False, False,   # post-trigger free pass
+                         True, False, False]
+
+
+@pytest.mark.oom_inject
+def test_injector_random_seed_replays():
+    def run(seed):
+        with oom_injection(f"random-0.5", seed=seed) as inj:
+            out = []
+            for _ in range(50):
+                try:
+                    inj.check("s")
+                    out.append(0)
+                except InjectedOOMError:
+                    out.append(1)
+            return out
+    assert run(11) == run(11)
+    assert run(11) != run(12)
+    assert sum(run(11)) > 0
+
+
+@pytest.mark.oom_inject
+def test_injector_skip_count_targets_deep_site():
+    with oom_injection("every-1", skip_count=3) as inj:
+        for i in range(3):
+            inj.check("s")          # exempt
+        with pytest.raises(InjectedOOMError):
+            inj.check("s")
+
+
+@pytest.mark.oom_inject
+def test_injector_oom_count_consecutive():
+    with oom_injection("every-1", oom_count=2) as inj:
+        with pytest.raises(InjectedOOMError):
+            inj.check("s")
+        with pytest.raises(InjectedOOMError):
+            inj.check("s")          # pending consecutive throw
+        inj.check("s")              # free pass after the sequence
+        with pytest.raises(InjectedOOMError):
+            inj.check("s")          # counting resumed
+
+
+@pytest.mark.oom_inject
+def test_injection_through_catalog_reserve_retried(tmp_path):
+    """every-1 injection at catalog.reserve: every registration OOMs once
+    and the retry loop recovers each time."""
+    cat = BufferCatalog(device_limit=1 << 24, spill_dir=str(tmp_path))
+    t, b, schema = _batch()
+    m0 = metrics().snapshot()
+    with oom_injection("every-1"):
+        inp = SpillableInput.admit(b, schema, cat, name="t")
+    assert metrics().snapshot()["retryCount"] > m0["retryCount"]
+    got = with_retry_no_split(inp.acquire, catalog=cat, name="t")
+    assert_tables_equal(to_arrow(got, schema), t)
+    inp.release()
+    inp.close()
+
+
+# ---------------------------------------------------------------------------
+# exchange read pin loop regression (ISSUE 7 satellite: a failed get()
+# at pin k of n must unpin the already-pinned entries before propagating)
+# ---------------------------------------------------------------------------
+
+def _exchange(tmp_path, n=4000, parts=4, cat=None):
+    from spark_rapids_tpu.exec import InMemoryScanExec
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.shuffle import HashPartitioning, \
+        ShuffleExchangeExec
+    cat = cat or BufferCatalog(device_limit=64 << 20,
+                               spill_dir=str(tmp_path))
+    t = _table(n, seed=13)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], parts),
+                             InMemoryScanExec(t, num_slices=2,
+                                              batch_rows=n // 4),
+                             catalog=cat)
+    return t, ex, cat
+
+
+@pytest.mark.oom_inject
+def test_exchange_mid_pin_oom_unpins_before_propagating(tmp_path):
+    """Inject OOM at pin k of n in the read loop with retry DISABLED:
+    the error propagates (no DoubleReleaseError masking it), every
+    already-pinned entry is unpinned, and the pieces survive for a
+    later clean read."""
+    t, ex, cat = _exchange(tmp_path)
+    ex._materialize()
+    assert cat.total_pinned() == 0
+    # find a reader partition with >= 2 pieces so pin k of n is mid-loop
+    specs = ex._reader_specs()
+    parts = ex._materialize()
+    target = next(p for p, spec in enumerate(specs)
+                  if sum(hi - lo for _, lo, hi in spec) >= 2)
+    with retry_policy(enabled=False):
+        # skip the first pin, fail the second (pin k=2 of n)
+        with oom_injection("every-1", skip_count=1):
+            with pytest.raises(InjectedOOMError):
+                for _ in ex.execute_partition(target):
+                    pass
+    assert cat.total_pinned() == 0, cat.dump_state()
+    # `use` refcounts were not corrupted by the failed read: a clean
+    # re-read of every partition still returns exactly the input rows
+    seen = []
+    for p in range(ex.num_partitions):
+        for b in ex.execute_partition(p):
+            tb = to_arrow(b, ex.output_schema)
+            seen.extend(zip(tb.column("k").to_pylist(),
+                            tb.column("v").to_pylist()))
+    expect = list(zip(t.column("k").to_pylist(), t.column("v").to_pylist()))
+    assert sorted(seen) == sorted(expect)
+    ex.close()
+    assert cat.total_pinned() == 0
+    assert not cat._entries, cat.dump_state()
+
+
+@pytest.mark.oom_inject
+def test_exchange_read_retries_injected_pin_oom(tmp_path):
+    """Same fault with retry ENABLED: the read succeeds."""
+    t, ex, cat = _exchange(tmp_path)
+    with oom_injection("every-1", skip_count=5):
+        seen = []
+        for p in range(ex.num_partitions):
+            for b in ex.execute_partition(p):
+                tb = to_arrow(b, ex.output_schema)
+                seen.extend(zip(tb.column("k").to_pylist(),
+                                tb.column("v").to_pylist()))
+    expect = list(zip(t.column("k").to_pylist(), t.column("v").to_pylist()))
+    assert sorted(seen) == sorted(expect)
+    ex.close()
+    assert cat.total_pinned() == 0
+
+
+# ---------------------------------------------------------------------------
+# pipeline prefetch producer (ISSUE 7 satellite: injected OOM in the
+# producer surfaces at the consumer as a retryable classified error —
+# not a hang — and prompt cancel still works)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.oom_inject
+def test_prefetch_producer_oom_surfaces_retryable_at_consumer():
+    from spark_rapids_tpu.pipeline import PrefetchIterator
+
+    def producer():
+        yield 1
+        yield 2
+        raise InjectedOOMError("injected OOM at producer")
+
+    it = PrefetchIterator(producer(), depth=2)
+    got = []
+    with pytest.raises(InjectedOOMError) as ei:
+        for x in it:
+            got.append(x)
+    assert got == [1, 2]
+    assert is_retryable_oom(ei.value)
+    # producer thread is joined — nothing left running
+    assert it._producer_done()
+
+
+@pytest.mark.oom_inject
+def test_prefetch_prompt_cancel_with_injection_active():
+    from spark_rapids_tpu.pipeline import PrefetchIterator
+    started = threading.Event()
+
+    def producer():
+        started.set()
+        for i in range(10_000):
+            yield i
+
+    with oom_injection("every-1000"):
+        it = PrefetchIterator(producer(), depth=2)
+        assert next(it) == 0
+        started.wait(5)
+        it.close()                  # prompt cancel mid-stream
+        assert it._producer_done()
+
+
+# ---------------------------------------------------------------------------
+# repo lint (ISSUE 7 satellite): operators must not allocate from the
+# catalog outside a with_retry scope or swallow the OOM family bare
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+@pytest.mark.oom_inject
+def test_lint_retry_clean():
+    """The tree itself passes the lint — this IS the tier-1 lint job."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import lint_retry
+    finally:
+        sys.path.pop(0)
+    assert lint_retry.lint() == []
+
+
+@pytest.mark.oom_inject
+def test_lint_retry_catches_violations(tmp_path):
+    """The lint actually fires on an unprotected allocation, a swallowed
+    OOM, and honors the retry-ok pragma."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import lint_retry
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "pkg"
+    (pkg / "exec").mkdir(parents=True)
+    (pkg / "exec" / "bad.py").write_text(
+        "def run(cat, batch, schema):\n"
+        "    sb = SpillableBatch(cat, batch, schema)\n"
+        "    try:\n"
+        "        return sb.get()\n"
+        "    except MemoryError:\n"
+        "        return None\n"
+        "\n"
+        "def ok(cat, batch, schema):\n"
+        "    return SpillableBatch(cat, batch, schema)  # retry-ok: test\n"
+        "\n"
+        "def protected(sb):\n"
+        "    def body():\n"
+        "        return sb.get()\n"
+        "    return with_retry_no_split(body)\n")
+    problems = lint_retry.lint(str(pkg))
+    assert len(problems) == 3, problems       # ctor + bare get + swallow
+    assert any("SpillableBatch" in p for p in problems)
+    assert any(".get()" in p for p in problems)
+    assert any("swallows" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# wire path: exchange serialized_partitions under injection
+# ---------------------------------------------------------------------------
+
+@pytest.mark.oom_inject
+def test_exchange_wire_retries_under_injection(tmp_path):
+    from spark_rapids_tpu.shuffle.serializer import deserialize_host
+    t, ex, cat = _exchange(tmp_path, n=2000, parts=2)
+    clean = [(p, [deserialize_host(f)[1] for f in frames])
+             for p, frames in ex.serialized_partitions()]
+    ex.close()
+    t2, ex2, cat2 = _exchange(tmp_path, n=2000, parts=2)
+    with oom_injection("every-2"):
+        inj = [(p, [deserialize_host(f)[1] for f in frames])
+               for p, frames in ex2.serialized_partitions()]
+    ex2.close()
+    assert [(p, sum(ns)) for p, ns in clean] == \
+        [(p, sum(ns)) for p, ns in inj]
+    assert cat2.total_pinned() == 0

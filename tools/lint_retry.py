@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Repo lint: catalog allocations must run under the OOM retry loop.
+
+Two rules, enforced over the AST (no imports of the checked code):
+
+1. **Unprotected allocation** — in the operator layers
+   (``spark_rapids_tpu/{exec,shuffle,io}/``) a catalog allocation site
+   (``SpillableBatch(...)`` construction, ``<catalog>.reserve(...)``, or
+   a zero-argument ``.get()`` / ``.acquire()`` — the spillable-handle
+   pin calls) must be reachable only through the retry state machine:
+   the enclosing function is passed to ``with_retry`` /
+   ``with_retry_no_split`` (or is a lambda argument of one), or the
+   call IS one of the retry-owning wrappers (``register_with_retry``,
+   ``acquire_with_retry``, ``SpillableInput.admit``). An OOM at an
+   unprotected site kills the query instead of retrying — exactly the
+   regression this lint exists to catch.
+
+2. **Swallowed OOM** — anywhere in ``spark_rapids_tpu/``, an
+   ``except`` handler that catches the OOM family (``MemoryError``,
+   ``OutOfBudgetError``, ``InjectedOOMError``, ``FinalOOMError``) or a
+   bare ``except:`` must re-raise something. Silently eating an OOM
+   hides the pressure signal from the retry framework AND corrupts the
+   injection suite (a swallowed synthetic OOM looks like success).
+
+Escape hatch: a ``# retry-ok: <reason>`` comment on the flagged line
+(or on the enclosing ``def`` line) suppresses rule 1 for sites whose
+retry scope is established by a caller the AST cannot see — the reason
+is mandatory and should name that caller.
+
+Exit status 0 = clean, 1 = violations (printed one per line). Runs in
+the tier-1 flow via tests/test_retry.py::test_lint_retry_clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Set, Tuple
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "spark_rapids_tpu")
+
+#: layers whose allocations must be retry-scoped (rule 1); memory/ owns
+#: the machinery itself and plan/ never touches the catalog directly
+OPERATOR_DIRS = ("exec", "shuffle", "io")
+
+RETRY_WRAPPERS = {"with_retry", "with_retry_no_split", "acquire_with_retry",
+                  "register_with_retry", "admit"}
+
+OOM_NAMES = {"MemoryError", "OutOfBudgetError", "InjectedOOMError",
+             "FinalOOMError"}
+
+PRAGMA = "# retry-ok:"
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_alloc_site(node: ast.Call) -> Optional[str]:
+    """Name the allocation kind, or None."""
+    name = _call_name(node)
+    if name == "SpillableBatch":
+        return "SpillableBatch(...)"
+    if name == "reserve" and isinstance(node.func, ast.Attribute):
+        return ".reserve(...)"
+    if name in ("get", "acquire") and isinstance(node.func, ast.Attribute) \
+            and not node.args and not node.keywords:
+        # zero-arg .get()/.acquire(): the spillable-handle pin calls
+        # (argful forms are dict.get, queue.get(timeout=...), ...)
+        return f".{name}()"
+    return None
+
+
+#: keyword arguments of the retry wrappers that never carry a callable —
+#: counting them as protected would silently disable rule 1 for any
+#: same-named module function
+_NONCALLABLE_KWS = {"catalog", "name", "max_retries", "semaphore",
+                    "close_input", "priority", "schema"}
+
+
+def _protected_names(tree: ast.AST) -> Set[str]:
+    """Function names passed (as bare names) into a retry wrapper's
+    CALLABLE positions — their bodies run under the retry loop. The
+    with_retry input argument, catalog=/name=-style keywords, and
+    admit's batch/schema arguments are data, not bodies."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        wrapper = _call_name(node) if isinstance(node, ast.Call) else None
+        if wrapper not in RETRY_WRAPPERS or wrapper == "admit":
+            continue
+        if wrapper == "with_retry":
+            args = list(node.args)[1:]  # args[0] is the input item
+        elif wrapper == "with_retry_no_split":
+            args = list(node.args)      # the body
+        else:
+            args = []                   # acquire/register take data only
+        args += [kw.value for kw in node.keywords
+                 if kw.arg not in _NONCALLABLE_KWS]
+        for arg in args:
+            if isinstance(arg, ast.Name):
+                out.add(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                out.add(arg.attr)
+    return out
+
+
+def _retry_lambda_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+    """Line spans of lambdas passed directly to a retry wrapper."""
+    spans = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) in RETRY_WRAPPERS):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                spans.append((arg.lineno, arg.end_lineno or arg.lineno))
+    return spans
+
+
+def _has_pragma(lines: List[str], *linenos: int) -> bool:
+    return any(0 < n <= len(lines) and PRAGMA in lines[n - 1]
+               for n in linenos)
+
+
+def _lint_allocations(path: str, tree: ast.AST,
+                      lines: List[str]) -> List[str]:
+    protected = _protected_names(tree)
+    lam_spans = _retry_lambda_spans(tree)
+
+    # map every node to its enclosing function chain (innermost last)
+    problems = []
+
+    def visit(node: ast.AST, chain: List[ast.AST]) -> None:
+        here = chain + [node] if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+            else chain
+        if isinstance(node, ast.Call):
+            kind = _is_alloc_site(node)
+            if kind and not _ok(node, here):
+                problems.append(
+                    f"{path}:{node.lineno}: {kind} outside a with_retry "
+                    f"scope (wrap the enclosing function in with_retry/"
+                    f"with_retry_no_split, use register_with_retry/"
+                    f"acquire_with_retry, or annotate the line with "
+                    f"'{PRAGMA} <reason>')")
+        for child in ast.iter_child_nodes(node):
+            visit(child, here)
+
+    def _ok(node: ast.Call, chain: List[ast.AST]) -> bool:
+        # the call is itself a retry wrapper invocation target, e.g.
+        # SpillableInput.admit(...)
+        if _call_name(node) in RETRY_WRAPPERS:
+            return True
+        def_lines = list(range(node.lineno,
+                               (node.end_lineno or node.lineno) + 1))
+        for fn in chain:
+            if isinstance(fn, ast.Lambda):
+                if any(lo <= fn.lineno <= hi for lo, hi in lam_spans):
+                    return True
+            else:
+                if fn.name in protected:
+                    return True
+                def_lines.append(fn.lineno)
+        return _has_pragma(lines, *def_lines)
+
+    visit(tree, [])
+    return problems
+
+
+def _lint_swallowed_oom(path: str, tree: ast.AST,
+                        lines: List[str]) -> List[str]:
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names: Set[str] = set()
+        t = node.type
+        if t is None:
+            names.add("<bare except>")
+        else:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                n = e.id if isinstance(e, ast.Name) else \
+                    e.attr if isinstance(e, ast.Attribute) else None
+                if n in OOM_NAMES:
+                    names.add(n)
+        if not names:
+            continue
+        if any(isinstance(sub, ast.Raise) for sub in ast.walk(node)):
+            continue
+        if _has_pragma(lines, node.lineno):
+            continue
+        problems.append(
+            f"{path}:{node.lineno}: except {'/'.join(sorted(names))} "
+            f"swallows the OOM without re-raising — the retry framework "
+            f"(and the injection suite) never sees it")
+    return problems
+
+
+def lint(pkg_dir: str = PKG) -> List[str]:
+    problems: List[str] = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            src = open(path).read()
+            lines = src.splitlines()
+            tree = ast.parse(src, filename=path)
+            sub = os.path.relpath(root, pkg_dir).split(os.sep)[0]
+            if sub in OPERATOR_DIRS:
+                problems += _lint_allocations(rel, tree, lines)
+            problems += _lint_swallowed_oom(rel, tree, lines)
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\nlint_retry: {len(problems)} violation(s)")
+        return 1
+    print("lint_retry: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
