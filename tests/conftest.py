@@ -52,8 +52,9 @@ def pytest_configure(config):
                    "tier and its wall-clock budget)")
     config.addinivalue_line(
         "markers", "oom_inject: OOM retry framework + deterministic "
-                   "fault-injection coverage; `pytest -m oom_inject` is "
-                   "the smoke-tier robustness job in the tier-1 flow")
+                   "fault-injection coverage; `pytest -m 'oom_inject "
+                   "and not slow'` is the smoke-tier robustness job in "
+                   "the tier-1 flow (the full mode matrix is nightly)")
 
 
 def pytest_collection_modifyitems(config, items):

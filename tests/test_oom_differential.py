@@ -32,16 +32,27 @@ from harness.asserts import assert_tables_equal
 N = 3000
 
 #: the injection schedules of the acceptance criteria: every allocation
-#: check fails once, every 3rd fails, and a seeded random 20%
+#: check fails once, every 3rd fails, and a seeded random 20%. every-1
+#: (the strongest schedule — every site fails) gates every tier; the
+#: every-3/random variants ride the nightly tier (each differential
+#: collects the query 2-3x, and 15 in-tier runs would eat ~3.5 min of
+#: the tier-1 window)
+_EVERY1 = {"spark.rapids.tpu.test.injectOOM.mode": "every-1"}
+_EVERY3 = {"spark.rapids.tpu.test.injectOOM.mode": "every-3"}
+_RANDOM = {"spark.rapids.tpu.test.injectOOM.mode": "random",
+           "spark.rapids.tpu.test.injectOOM.seed": 42}
+
 MODES = [
-    pytest.param({"spark.rapids.tpu.test.injectOOM.mode": "every-1"},
-                 id="every-1"),
-    pytest.param({"spark.rapids.tpu.test.injectOOM.mode": "every-3"},
-                 id="every-3"),
-    pytest.param({"spark.rapids.tpu.test.injectOOM.mode": "random",
-                  "spark.rapids.tpu.test.injectOOM.seed": 42},
-                 id="random"),
+    pytest.param(_EVERY1, id="every-1"),
+    pytest.param(_EVERY3, id="every-3", marks=pytest.mark.slow),
+    pytest.param(_RANDOM, id="random", marks=pytest.mark.slow),
 ]
+
+#: the q1 shape doubles as the smoke-gate representative — but only the
+#: in-tier every-1 variant (a function-level smoke mark would drag the
+#: slow variants into the <2-min `-m smoke` gate)
+Q1_MODES = [pytest.param(_EVERY1, id="every-1",
+                         marks=pytest.mark.smoke)] + MODES[1:]
 
 
 def _rng(seed=3):
@@ -110,9 +121,8 @@ def _lineitem(n=N):
     })
 
 
-@pytest.mark.smoke
 @pytest.mark.oom_inject
-@pytest.mark.parametrize("conf", MODES)
+@pytest.mark.parametrize("conf", Q1_MODES)
 def test_oom_differential_q1_stage(conf):
     # num_slices=2: multi-batch input keeps the stage on the iterator
     # path (whole-stage fusion runs ONE XLA program with no catalog
