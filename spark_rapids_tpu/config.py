@@ -202,6 +202,32 @@ TRANSPORT_MAX_IN_FLIGHT = conf(
     "spark.rapids.shuffle.ucx.activeMessages / maxBytesInFlight "
     "pipelining).").integer(4)
 
+TRANSPORT_CONNECT_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.shuffle.transport.connectTimeoutMs").doc(
+    "Deadline for establishing (and handshaking) a peer connection; an "
+    "unreachable peer surfaces as PeerUnreachableError after the retry "
+    "budget instead of blocking a fetching thread (reference: the UCX "
+    "transport's endpoint setup timeout).").integer(30000)
+
+TRANSPORT_IO_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.shuffle.transport.ioTimeoutMs").doc(
+    "Post-connect socket deadline on every transport send/recv: a peer "
+    "that accepts then goes silent times out instead of deadlocking the "
+    "per-peer connection lock forever (reference: the transaction "
+    "timeouts on RapidsShuffleClient requests).").integer(30000)
+
+TRANSPORT_BACKOFF_MS = conf(
+    "spark.rapids.tpu.shuffle.transport.retryBackoffMs").doc(
+    "Base delay of the jittered exponential backoff between transport "
+    "retry attempts (delay ~ base * 2^attempt * jitter, capped by "
+    "retryBackoffMaxMs); 0 disables backoff (reference: the shuffle "
+    "fetch retry wait in RapidsShuffleIterator).").integer(10)
+
+TRANSPORT_BACKOFF_MAX_MS = conf(
+    "spark.rapids.tpu.shuffle.transport.retryBackoffMaxMs").doc(
+    "Upper bound on one transport retry backoff sleep."
+).integer(1000)
+
 PARQUET_NATIVE_DECODE = conf(
     "spark.rapids.tpu.sql.format.parquet.nativeDecode.enabled").doc(
     "Decode parquet column chunks with the native C++ decoder "
@@ -493,6 +519,56 @@ INJECT_OOM_OOM_COUNT = conf("spark.rapids.tpu.test.injectOOM.oomCount").doc(
     "thread (RmmSpark numOOMs): 1 exercises plain retry, >1 forces "
     "split-and-retry, > retry.maxRetries forces a final OOM + "
     "oomDumpDir report.").integer(1)
+
+INJECT_NET_MODE = conf("spark.rapids.tpu.test.injectNet.mode").doc(
+    "Deterministic network fault injection at the transport frame seam "
+    "(_send_frame/_recv_frame — the NetInjector twin of injectOOM.mode): "
+    "empty/off, 'every-N' (every Nth eligible frame op faults), or "
+    "'random' / 'random-P' (seeded probability P per frame, default "
+    "0.2). Test-only: makes every transport retry/failover path "
+    "executable without real network faults.").text("")
+
+INJECT_NET_SEED = conf("spark.rapids.tpu.test.injectNet.seed").doc(
+    "RNG seed for injectNet.mode=random — the same seed replays the "
+    "same fault schedule.").integer(0)
+
+INJECT_NET_SKIP_COUNT = conf("spark.rapids.tpu.test.injectNet.skipCount").doc(
+    "Exempt the first K frame checks from injection, aiming the fault "
+    "at a deep site (e.g. window k of a streamed block).").integer(0)
+
+INJECT_NET_FAULT_KIND = conf("spark.rapids.tpu.test.injectNet.faultKind").doc(
+    "Fault thrown per trigger: 'drop' (connection closed mid-"
+    "transaction), 'delay' (frame stalls injectNet.delayMs), 'truncate' "
+    "(frame cut short then connection closed), 'corrupt' (payload bit-"
+    "flip AFTER checksumming — the receiver's CRC must catch it), or "
+    "'mix' (cycles through all four per trigger).").text("drop")
+
+INJECT_NET_DELAY_MS = conf("spark.rapids.tpu.test.injectNet.delayMs").doc(
+    "Stall duration of an injected 'delay' fault.").integer(20)
+
+SERVER_MAX_SESSIONS = conf("spark.rapids.tpu.server.maxSessions").doc(
+    "Bound on concurrently connected plan-server sessions; connections "
+    "over the bound get a structured 'unavailable' reply with a "
+    "retry-after hint instead of an unbounded handler-thread pile-up "
+    "(reference: the concurrentGpuTasks admission story applied at the "
+    "serving tier).").integer(32)
+
+SERVER_QUERY_TIMEOUT_MS = conf("spark.rapids.tpu.server.queryTimeoutMs").doc(
+    "Default per-query deadline enforced by the plan-server watchdog "
+    "(a 'plan' header timeout_ms overrides per query; 0 = unbounded). "
+    "A query over its deadline gets a structured retryable error and "
+    "the connection closes instead of tying the handler thread forever."
+).integer(0)
+
+SERVER_RETRY_AFTER_MS = conf("spark.rapids.tpu.server.retryAfterMs").doc(
+    "retry_after_ms hint carried on plan-server 'unavailable' replies "
+    "(circuit breaker open, maxSessions exceeded).").integer(1000)
+
+SERVER_TEST_COLLECT_DELAY_MS = conf(
+    "spark.rapids.tpu.server.test.collectDelayMs").doc(
+    "Test-only: stall each plan collect this long (in cancellable "
+    "slices) so watchdog/cancellation paths are deterministic."
+).internal().integer(0)
 
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "

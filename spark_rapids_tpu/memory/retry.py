@@ -314,6 +314,10 @@ def apply_session_conf(conf) -> None:
                         int(conf.get(INJECT_OOM_SEED.key)),
                         int(conf.get(INJECT_OOM_SKIP_COUNT.key)),
                         int(conf.get(INJECT_OOM_OOM_COUNT.key)))
+    # the network injector rides the same entry point (one conf-apply
+    # per collect configures BOTH process-wide fault layers)
+    from ..shuffle import netfault
+    netfault.apply_session_conf(conf)
 
 
 def set_dump_dir(path: str) -> None:

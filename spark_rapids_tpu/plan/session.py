@@ -77,8 +77,13 @@ class Session:
                 self.last_plan = plan
         return "exec", plan
 
-    def collect(self, df: DataFrame) -> pa.Table:
-        kind, plan = self.prepare(df)
+    def collect(self, df: DataFrame, _prepared=None) -> pa.Table:
+        """``_prepared`` lets a caller that already ran ``prepare(df)``
+        (the plan server separates the bind phase from execution for
+        its failure classification) hand the result in, so the planning
+        pipeline runs once per query."""
+        kind, plan = _prepared if _prepared is not None \
+            else self.prepare(df)
         if kind == "interpret":
             return Interpreter(ansi=self.conf.ansi).execute(df.plan)
         if kind == "fallback":
@@ -92,6 +97,8 @@ class Session:
         # and watermark the retry counters so metrics() reports deltas
         apply_session_conf(self.conf)
         self._retry0 = _retry_metrics().snapshot()
+        from ..shuffle.transport import transport_metrics
+        self._net0 = transport_metrics().snapshot()
         self._sem_wait0 = _python_semaphore.wait_time_ns
         try:
             return collect_exec(plan)
@@ -188,14 +195,25 @@ class Session:
         # retry state machine counters since this session's last collect
         # (retryCount / splitAndRetryCount / retryBlockTime / spill bytes
         # the recovery forced) — the GpuTaskMetrics roll-up twin
-        from ..memory.retry import metrics as _retry_metrics
-        snap = _retry_metrics().snapshot()
-        base = getattr(self, "_retry0", None)
-        if base is not None:
+        def emit_deltas(prefix: str, snap: dict, base) -> None:
+            # process-wide counters report as deltas since this
+            # session's last collect watermark (None = never collected)
+            if base is None:
+                return
             for k, v in snap.items():
                 delta = v - base.get(k, 0)
                 if delta > 0:
-                    out[f"retry.{k}"] = delta
+                    out[f"{prefix}.{k}"] = delta
+
+        from ..memory.retry import metrics as _retry_metrics
+        emit_deltas("retry", _retry_metrics().snapshot(),
+                    getattr(self, "_retry0", None))
+        # transport fetch-retry counters (fetchRetryCount /
+        # fetchBackoffTime / corruptFrameCount / peerFailoverCount) ride
+        # the same delta-since-last-collect shape
+        from ..shuffle.transport import transport_metrics
+        emit_deltas("net", transport_metrics().snapshot(),
+                    getattr(self, "_net0", None))
         return out
 
     def executed_exec_names(self) -> List[str]:

@@ -185,8 +185,11 @@ class ExecutorRuntime:
     # registry of executor heartbeats for shuffle peer discovery)
     # ------------------------------------------------------------------
 
-    def heartbeat(self, executor_id: str) -> None:
-        self._heartbeats[executor_id] = time.time()
+    def heartbeat(self, executor_id) -> None:
+        # keys normalize to str: the CACHED-shuffle registry path hands
+        # the transport INT executor ids (spark.rapids.tpu.executorId)
+        # while in-process callers use strings — one table serves both
+        self._heartbeats[str(executor_id)] = time.time()
 
     def start_heartbeat(self, executor_id: str,
                         interval_s: Optional[float] = None
@@ -212,6 +215,15 @@ class ExecutorRuntime:
             self._hb_senders.append((t, stop))
         t.start()
         return stop
+
+    def mark_unreachable(self, executor_id) -> None:
+        """Transport-report hook (TcpTransport.on_unreachable): a peer
+        that exhausted its fetch retry budget stops counting as live
+        immediately instead of coasting until its heartbeat ages out —
+        subsequent list_blocks calls skip it without paying a socket
+        timeout (reference: transport errors feeding the
+        RapidsShuffleHeartbeatManager's executor-death bookkeeping)."""
+        self._heartbeats.pop(str(executor_id), None)
 
     def live_executors(self, timeout_s: Optional[float] = None
                        ) -> List[str]:

@@ -18,9 +18,21 @@ from . import plandoc, protocol
 
 
 class PlanServerError(RuntimeError):
-    def __init__(self, message: str, remote_traceback: str = ""):
+    """Structured server-side failure. ``retryable`` marks transient
+    conditions (deadline overrun, admission pressure) a client scheduler
+    should resubmit; ``unavailable`` + ``retry_after_ms`` carry the
+    circuit-breaker / maxSessions backpressure signal."""
+
+    def __init__(self, message: str, remote_traceback: str = "",
+                 retryable: bool = False, unavailable: bool = False,
+                 timeout: bool = False,
+                 retry_after_ms: Optional[int] = None):
         super().__init__(message)
         self.remote_traceback = remote_traceback
+        self.retryable = retryable
+        self.unavailable = unavailable
+        self.timeout = timeout
+        self.retry_after_ms = retry_after_ms
 
 
 class PlanClient:
@@ -34,19 +46,26 @@ class PlanClient:
         #: operator metrics of the last collect (server-side
         #: Session.metrics(), the reference's SQLMetrics roll-up)
         self.last_metrics: dict = {}
-        protocol.send_preamble(self._sock)
-        version = protocol.recv_preamble(self._sock)
-        if version != protocol.PROTOCOL_VERSION:
-            raise PlanServerError(
-                f"protocol version mismatch: server {version}, "
-                f"client {protocol.PROTOCOL_VERSION}")
-        self._request({"msg": "hello", "conf": conf or {}})
+        try:
+            protocol.send_preamble(self._sock)
+            version = protocol.recv_preamble(self._sock)
+            if version != protocol.PROTOCOL_VERSION:
+                raise PlanServerError(
+                    f"protocol version mismatch: server {version}, "
+                    f"client {protocol.PROTOCOL_VERSION}")
+            self._request({"msg": "hello", "conf": conf or {}})
+        except BaseException:
+            # a rejected handshake (version mismatch, maxSessions
+            # unavailable reply) must not leak the connection — callers
+            # retrying on retry_after_ms would accumulate open fds
+            self.close()
+            raise
 
     # ---- lifecycle ----
     def close(self) -> None:
         try:
             self._sock.close()
-        except OSError:
+        except OSError:  # net-ok: teardown, socket may already be dead
             pass
 
     def __enter__(self):
@@ -60,8 +79,13 @@ class PlanClient:
         protocol.send_msg(self._sock, header, body)
         reply, reply_body = protocol.recv_msg(self._sock)
         if reply.get("msg") == "error":
-            raise PlanServerError(reply.get("error", "server error"),
-                                  reply.get("traceback", ""))
+            raise PlanServerError(
+                reply.get("error", "server error"),
+                reply.get("traceback", ""),
+                retryable=bool(reply.get("retryable")),
+                unavailable=bool(reply.get("unavailable")),
+                timeout=bool(reply.get("timeout")),
+                retry_after_ms=reply.get("retry_after_ms"))
         return reply, reply_body
 
     def _ship_tables(self, tables: Dict[str, pa.Table]) -> None:
@@ -81,12 +105,18 @@ class PlanClient:
         return doc
 
     # ---- public surface ----
-    def collect(self, df: DataFrame, conf: Optional[dict] = None
-                ) -> pa.Table:
+    def collect(self, df: DataFrame, conf: Optional[dict] = None,
+                timeout_ms: Optional[int] = None) -> pa.Table:
+        """``timeout_ms`` sets the server-side per-query deadline (the
+        watchdog cancels and answers a retryable error past it); 0 means
+        explicitly unbounded; None defers to
+        spark.rapids.tpu.server.queryTimeoutMs."""
         doc = self._serialize(df)
-        reply, body = self._request(
-            {"msg": "plan", "mode": "collect", "plan": doc,
-             "conf": conf or {}})
+        header = {"msg": "plan", "mode": "collect", "plan": doc,
+                  "conf": conf or {}}
+        if timeout_ms is not None:
+            header["timeout_ms"] = int(timeout_ms)
+        reply, body = self._request(header)
         self.last_execs = reply.get("execs", [])
         self.last_fell_back = reply.get("fell_back", [])
         self.last_metrics = reply.get("metrics", {})

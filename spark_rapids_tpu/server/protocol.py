@@ -35,6 +35,9 @@ class ProtocolError(ConnectionError):
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    # net-ok: callers own the socket deadline — the server handler sets
+    # settimeout(idle_timeout) before the first recv; the client's
+    # create_connection(timeout=...) persists on its socket
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(n - len(buf), 1 << 20))

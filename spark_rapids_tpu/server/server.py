@@ -9,6 +9,24 @@ response carries the executed exec names + fallback list the way the
 reference's plan-capture listener exposes them to its test harness
 (ExecutionPlanCaptureCallback.scala:31).
 
+Serving-tier fault policy (reference: the executor fatal-error exit
+policy, Plugin.scala:215-393, applied at a query frontend the way
+"Accelerating Presto with GPUs" degrades gracefully when the
+accelerator is unhealthy):
+
+- **admission** — at most ``spark.rapids.tpu.server.maxSessions``
+  concurrent connections; over the bound, a structured ``unavailable``
+  reply with ``retry_after_ms`` instead of an unbounded thread pile-up;
+- **circuit breaker** — every ``plan`` consults the executor's health
+  (``ExecutorRuntime.ensure_healthy``); once a fatal device error
+  poisons the runtime, plans get ``unavailable`` + retry-after, never a
+  dead connection;
+- **watchdog** — a per-query deadline (``plan`` header ``timeout_ms``,
+  default ``spark.rapids.tpu.server.queryTimeoutMs``) returns a
+  structured RETRYABLE error when the collect overruns instead of tying
+  the handler thread forever; ``stop()`` cancels in-flight queries and
+  unblocks their handlers.
+
 Run standalone:  python -m spark_rapids_tpu.server --port 9099
 """
 
@@ -18,8 +36,9 @@ import socket
 import socketserver
 import sys
 import threading
+import time
 import traceback
-from typing import Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 import pyarrow as pa
 
@@ -28,43 +47,243 @@ from ..plan.session import Session
 from . import plandoc, protocol
 
 
+class QueryCancelledError(RuntimeError):
+    """The server cancelled this query (deadline overrun or stop())."""
+
+
+def _runtime_health() -> None:
+    """Default breaker probe: the process ExecutorRuntime, when one
+    exists (a device-less test server has nothing to poison)."""
+    from ..plugin import ExecutorRuntime
+    runtime = ExecutorRuntime._instance
+    if runtime is not None:
+        runtime.ensure_healthy()
+
+
+class CircuitBreaker:
+    """CLOSED while the executor is healthy, OPEN once a fatal device
+    error poisons it: plans are answered ``unavailable`` (with a
+    retry-after hint for the client's scheduler) instead of queueing
+    onto a dead device. The breaker re-probes health on every admit, so
+    it closes again the moment the runtime is replaced/healthy (the
+    half-open probe is free here — ``ensure_healthy`` is a field
+    check)."""
+
+    def __init__(self, health_check: Optional[Callable[[], None]] = None,
+                 retry_after_ms: int = 1000):
+        self.health_check = health_check or _runtime_health
+        self.retry_after_ms = retry_after_ms
+        self.rejected_count = 0
+
+    def admit(self) -> Optional[str]:
+        """None = admit; otherwise the reason the executor is
+        unavailable."""
+        try:
+            self.health_check()
+            return None
+        except Exception as e:
+            self.rejected_count += 1
+            return f"{type(e).__name__}: {e}"
+
+    def record_failure(self, exc: BaseException) -> None:
+        """Classify a query failure against the runtime's fatal-marker
+        policy; a fatal one poisons the runtime, opening the breaker for
+        every subsequent plan (reference: onTaskFailed →
+        executor-unusable). ONLY execution-phase failures (tagged where
+        the collect actually ran) are classified: the fatal markers are
+        message substrings, and letting request-validation errors — whose
+        text echoes client-controlled input — reach them would let one
+        crafted message poison the executor for every session."""
+        if not getattr(exc, "_rtpu_exec_phase", False):
+            return
+        from ..plugin import ExecutorRuntime
+        runtime = ExecutorRuntime._instance
+        if runtime is not None and runtime.classify_failure(exc):
+            runtime.on_task_failed(exc)
+
+
+class _ActiveQuery:
+    def __init__(self, thread: threading.Thread, cancel: threading.Event):
+        self.thread = thread
+        self.cancel = cancel
+        #: set under track_lock when the handler abandons this query on
+        #: deadline overrun: the WORKER now owns the maxSessions slot
+        #: and releases it when the collect actually ends, so abandoned
+        #: workers still count against the admission bound
+        self.owns_admission = False
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         sock: socket.socket = self.request
-        sock.settimeout(self.server.idle_timeout)   # type: ignore[attr-defined]
+        srv = self.server
+        sock.settimeout(srv.idle_timeout)   # type: ignore[attr-defined]
         try:
             version = protocol.recv_preamble(sock)
             protocol.send_preamble(sock)
+        except (protocol.ProtocolError, OSError, socket.timeout):
+            # net-ok: malformed/temporized preamble — drop the
+            # connection; nothing is registered yet
+            return
+        # the admission slot is taken only AFTER the preamble completes:
+        # a connection that never speaks (slowloris) must not hold a
+        # maxSessions slot for the whole idle timeout
+        admitted = srv.admission.acquire(blocking=False)
+        try:
+            if not admitted:
+                self._try_send(sock, {
+                    "msg": "error", "fatal": True, "unavailable": True,
+                    "retryable": True,
+                    "retry_after_ms": srv.retry_after_ms,
+                    "error": f"server at maxSessions="
+                             f"{srv.max_sessions}; retry later"})
+                return
             if version != protocol.PROTOCOL_VERSION:
-                protocol.send_msg(sock, {
+                self._try_send(sock, {
                     "msg": "error", "fatal": True,
                     "error": f"protocol version mismatch: client {version}, "
                              f"server {protocol.PROTOCOL_VERSION}"})
                 return
-        except (protocol.ProtocolError, OSError, socket.timeout):
-            return
+            with srv.track_lock:
+                srv.active_conns.add(sock)
+                srv.session_count += 1
+            try:
+                self._session_loop(sock)
+            finally:
+                with srv.track_lock:
+                    srv.active_conns.discard(sock)
+                    srv.session_count -= 1
+        finally:
+            if admitted and not getattr(self, "_admission_transferred",
+                                        False):
+                srv.admission.release()
+
+    @staticmethod
+    def _try_send(sock, reply: dict, body: bytes = b"") -> bool:
+        try:
+            protocol.send_msg(sock, reply, body)
+            return True
+        except OSError:  # net-ok: client gone; reply is best-effort
+            return False
+
+    def _session_loop(self, sock) -> None:
+        srv = self.server
         tables: Dict[str, pa.Table] = {}
-        conf = dict(self.server.base_conf)          # type: ignore[attr-defined]
-        while True:
+        conf = dict(srv.base_conf)          # type: ignore[attr-defined]
+        while not srv.shutting_down.is_set():
             try:
                 header, body = protocol.recv_msg(sock)
             except (protocol.ProtocolError, OSError, socket.timeout):
+                # net-ok: oversized/truncated frame or idle timeout —
+                # per-connection isolation; the server stays up
                 return
-            try:
-                reply, reply_body = self._dispatch(
-                    header, body, tables, conf)
-            except Exception as e:   # per-request isolation: report, keep conn
-                reply = {"msg": "error", "error": f"{type(e).__name__}: {e}",
-                         "traceback": traceback.format_exc()}
-                reply_body = b""
-            try:
-                protocol.send_msg(sock, reply, reply_body)
-            except OSError:
+            reply, reply_body = self._serve_one(header, body, tables, conf)
+            if not self._try_send(sock, reply, reply_body):
                 return
             if reply.get("fatal"):
                 return
 
-    def _dispatch(self, header, body, tables, conf):
+    def _serve_one(self, header, body, tables, conf):
+        srv = self.server
+        if header.get("msg") == "plan":
+            reason = srv.breaker.admit()
+            if reason is not None:
+                return {"msg": "error", "unavailable": True,
+                        "retryable": True,
+                        "retry_after_ms": srv.retry_after_ms,
+                        "error": f"executor unavailable: {reason}"}, b""
+            try:
+                # an EXPLICIT timeout_ms wins, including 0 (= unbounded,
+                # matching the queryTimeoutMs conf's documented meaning)
+                timeout_ms = int(header.get("timeout_ms",
+                                            srv.default_timeout_ms) or 0)
+            except (TypeError, ValueError):
+                return {"msg": "error",
+                        "error": f"invalid timeout_ms "
+                                 f"{header.get('timeout_ms')!r}"}, b""
+            if timeout_ms > 0:
+                return self._serve_with_watchdog(header, body, tables,
+                                                 conf, timeout_ms)
+        try:
+            return self._dispatch(header, body, tables, conf,
+                                  srv.shutting_down.is_set)
+        except Exception as e:   # per-request isolation: report, keep conn
+            srv.breaker.record_failure(e)
+            return {"msg": "error", "error": f"{type(e).__name__}: {e}",
+                    "traceback": traceback.format_exc()}, b""
+
+    def _serve_with_watchdog(self, header, body, tables, conf,
+                             timeout_ms: int):
+        """Run the plan on a watchdog-supervised worker. On deadline
+        overrun the handler replies a structured RETRYABLE error and
+        closes the session (fatal=True): the worker may still be inside
+        an uninterruptible collect, so the connection must not accept
+        further queries that would interleave with it. The worker checks
+        its cancel flag at the cancellation points (pre-execution and
+        the test delay loop) and is joined — bounded — by stop()."""
+        srv = self.server
+        cancel = threading.Event()
+        done = threading.Event()
+        box: dict = {}
+
+        def cancelled() -> bool:
+            return cancel.is_set() or srv.shutting_down.is_set()
+
+        query = _ActiveQuery(None, cancel)
+
+        def work():
+            try:
+                box["reply"] = self._dispatch(header, body, tables, conf,
+                                              cancelled)
+            except Exception as e:
+                # classify HERE, not on receipt: a query that overran its
+                # deadline still fails later on this thread, and a fatal
+                # device error must open the breaker even though the
+                # handler already replied timeout and moved on
+                srv.breaker.record_failure(e)
+                box["exc"] = e
+            finally:
+                done.set()
+                with srv.track_lock:
+                    srv.active_queries[:] = [
+                        q for q in srv.active_queries if q is not query]
+                    owned = query.owns_admission
+                if owned:
+                    srv.admission.release()
+
+        worker = threading.Thread(target=work, daemon=True,
+                                  name="plan-query")
+        query.thread = worker
+        with srv.track_lock:
+            srv.active_queries.append(query)
+        worker.start()
+        if not done.wait(timeout_ms / 1000.0):
+            cancel.set()
+            with srv.track_lock:
+                if any(q is query for q in srv.active_queries):
+                    # the worker is still collecting: hand it the
+                    # admission slot so abandoned queries keep counting
+                    # against maxSessions until they actually end (the
+                    # handler's finally skips the release)
+                    query.owns_admission = True
+                    self._admission_transferred = True
+            return {"msg": "error", "fatal": True, "retryable": True,
+                    "timeout": True,
+                    "error": f"query exceeded its {timeout_ms}ms deadline; "
+                             f"cancelled — resubmit (possibly with a "
+                             f"larger timeout_ms)"}, b""
+        if "exc" in box:
+            e = box["exc"]      # already breaker-classified by the worker
+            # the exception was caught on the WORKER thread — format its
+            # own traceback, not this handler thread's (empty) one
+            return {"msg": "error", "error": f"{type(e).__name__}: {e}",
+                    "retryable": isinstance(e, QueryCancelledError),
+                    "traceback": "".join(traceback.format_exception(
+                        type(e), e, e.__traceback__))}, b""
+        return box["reply"]
+
+    def _dispatch(self, header, body, tables, conf,
+                  cancelled: Callable[[], bool]):
         msg = header.get("msg")
         if msg == "hello":
             conf.update(header.get("conf") or {})
@@ -88,7 +307,21 @@ class _Handler(socketserver.BaseRequestHandler):
                 return {"msg": "explained"}, ses.explain(df).encode("utf-8")
             if mode != "collect":
                 raise ValueError(f"unknown plan mode {mode!r}")
-            result = ses.collect(df)
+            self._check_cancel(cancelled, ses)
+            # plan/bind FIRST, untagged: binding errors echo client-
+            # chosen names (a column literally called "...halted...")
+            # and must never reach the breaker's substring classifier
+            prepared = ses.prepare(df)
+            try:
+                result = ses.collect(df, _prepared=prepared)
+            except Exception as e:
+                if prepared[0] == "exec":
+                    # planning succeeded and the plan ran on DEVICE —
+                    # only these failures may reach the breaker's
+                    # fatal-marker classification (interpreter/fallback
+                    # paths never touch the device)
+                    e._rtpu_exec_phase = True
+                raise
             return ({"msg": "result",
                      "rows": result.num_rows,
                      "execs": ses.executed_exec_names(),
@@ -99,6 +332,25 @@ class _Handler(socketserver.BaseRequestHandler):
                                  for k, v in ses.metrics().items()}},
                     protocol.table_to_ipc(result))
         raise ValueError(f"unknown message {msg!r}")
+
+    @staticmethod
+    def _check_cancel(cancelled: Callable[[], bool], ses: Session) -> None:
+        """Pre-execution cancellation point. The test-only collect delay
+        (server.test.collectDelayMs) sleeps here in cancellable slices so
+        watchdog/stop() paths are deterministic to test; the collect
+        itself is not interruptible mid-flight — cancellation closes the
+        session and discards the result instead."""
+        from ..config import SERVER_TEST_COLLECT_DELAY_MS
+        delay_s = int(ses.conf.get(SERVER_TEST_COLLECT_DELAY_MS.key)) \
+            / 1000.0
+        deadline = time.monotonic() + delay_s
+        while True:
+            if cancelled():
+                raise QueryCancelledError("query cancelled by the server")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            time.sleep(min(remaining, 0.01))
 
 
 class _ThreadingServer(socketserver.ThreadingTCPServer):
@@ -111,10 +363,26 @@ class PlanServer:
     module entry point as its own process)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 conf: Optional[dict] = None, idle_timeout: float = 600.0):
-        self._server = _ThreadingServer((host, port), _Handler)
-        self._server.base_conf = dict(conf or {})     # type: ignore[attr-defined]
-        self._server.idle_timeout = idle_timeout      # type: ignore[attr-defined]
+                 conf: Optional[dict] = None, idle_timeout: float = 600.0,
+                 health_check: Optional[Callable[[], None]] = None):
+        from ..config import (RapidsTpuConf, SERVER_MAX_SESSIONS,
+                              SERVER_QUERY_TIMEOUT_MS,
+                              SERVER_RETRY_AFTER_MS)
+        tconf = RapidsTpuConf(dict(conf or {}))
+        srv = _ThreadingServer((host, port), _Handler)
+        srv.base_conf = dict(conf or {})              # type: ignore
+        srv.idle_timeout = idle_timeout               # type: ignore
+        srv.max_sessions = int(tconf.get(SERVER_MAX_SESSIONS.key))
+        srv.retry_after_ms = int(tconf.get(SERVER_RETRY_AFTER_MS.key))
+        srv.default_timeout_ms = int(tconf.get(SERVER_QUERY_TIMEOUT_MS.key))
+        srv.admission = threading.Semaphore(srv.max_sessions)
+        srv.breaker = CircuitBreaker(health_check, srv.retry_after_ms)
+        srv.shutting_down = threading.Event()
+        srv.track_lock = threading.Lock()
+        srv.active_conns = set()
+        srv.active_queries: List[_ActiveQuery] = []
+        srv.session_count = 0
+        self._server = srv
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -124,6 +392,17 @@ class PlanServer:
     @property
     def port(self) -> int:
         return self._server.server_address[1]
+
+    @property
+    def active_sessions(self) -> int:
+        """Admitted, preamble-complete sessions currently connected."""
+        with self._server.track_lock:
+            return self._server.session_count
+
+    @property
+    def active_query_count(self) -> int:
+        with self._server.track_lock:
+            return len(self._server.active_queries)
 
     def start(self) -> "PlanServer":
         self._thread = threading.Thread(
@@ -135,11 +414,41 @@ class PlanServer:
     def serve_forever(self) -> None:
         self._server.serve_forever()
 
-    def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+    def stop(self, grace_s: float = 10.0) -> None:
+        """Stop accepting, CANCEL in-flight queries (cooperative cancel
+        flag + closing their connections, so no handler blocks in recv
+        past shutdown), and join the workers up to ``grace_s``."""
+        srv = self._server
+        srv.shutting_down.set()
+        with srv.track_lock:
+            queries = list(srv.active_queries)
+            conns = list(srv.active_conns)
+        for q in queries:
+            q.cancel.set()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:  # net-ok: peer already hung up
+                pass
+            try:
+                sock.close()
+            except OSError:  # net-ok: teardown
+                pass
+        srv.shutdown()
+        srv.server_close()
+        deadline = time.monotonic() + grace_s
+        for q in queries:
+            q.thread.join(timeout=max(deadline - time.monotonic(), 0.1))
         if self._thread is not None:
             self._thread.join(timeout=10)
+
+
+def readiness_line(server: PlanServer) -> str:
+    """The stdout readiness signal wrapping process managers (and the
+    test harness) parse: ``listening on <host>:<port>`` with the BOUND
+    port, so ``--port 0`` deployments learn the real one."""
+    return (f"spark-rapids-tpu plan server listening on "
+            f"{server.address[0]}:{server.port}")
 
 
 def main(argv=None) -> int:
@@ -165,8 +474,7 @@ def main(argv=None) -> int:
         conf[k] = v
     server = PlanServer(args.host, args.port, conf)
     # the port line is the readiness signal for wrapping process managers
-    print(f"spark-rapids-tpu plan server listening on "
-          f"{server.address[0]}:{server.port}", flush=True)
+    print(readiness_line(server), flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -11,5 +11,7 @@ from .partitioning import (HashPartitioning, Partitioning,
                            SinglePartitioning)
 from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
 from .multithreaded import MultithreadedShuffleExchangeExec
+from .transport import (BlockCorruptError, BlockMissingError,
+                        PeerUnreachableError, TransportError)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

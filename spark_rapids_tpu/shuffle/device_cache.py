@@ -126,16 +126,43 @@ def shared_device_cache(conf=None) -> DeviceShuffleCache:
             # bind wide when discovery is configured, loopback otherwise
             window = None
             retries = 3
+            connect_ms, io_ms = 30000, 30000
+            backoff_ms, backoff_max_ms = 10, 1000
             if conf is not None:
-                from ..config import (TRANSPORT_RETRIES,
+                from ..config import (TRANSPORT_BACKOFF_MAX_MS,
+                                      TRANSPORT_BACKOFF_MS,
+                                      TRANSPORT_CONNECT_TIMEOUT_MS,
+                                      TRANSPORT_IO_TIMEOUT_MS,
+                                      TRANSPORT_RETRIES,
                                       TRANSPORT_WINDOW_BYTES)
                 window = int(conf.get(TRANSPORT_WINDOW_BYTES.key))
                 retries = int(conf.get(TRANSPORT_RETRIES.key))
+                connect_ms = int(conf.get(TRANSPORT_CONNECT_TIMEOUT_MS.key))
+                io_ms = int(conf.get(TRANSPORT_IO_TIMEOUT_MS.key))
+                backoff_ms = int(conf.get(TRANSPORT_BACKOFF_MS.key))
+                backoff_max_ms = int(conf.get(TRANSPORT_BACKOFF_MAX_MS.key))
             from .transport import DEFAULT_WINDOW_BYTES
             transport = TcpTransport(
                 host="0.0.0.0" if registry_conf else "127.0.0.1",
                 retries=retries,
-                window_bytes=window or DEFAULT_WINDOW_BYTES)
+                window_bytes=window or DEFAULT_WINDOW_BYTES,
+                connect_timeout_s=connect_ms / 1000.0,
+                io_timeout_s=io_ms / 1000.0 if io_ms else None,
+                backoff_base_ms=backoff_ms,
+                backoff_max_ms=backoff_max_ms)
+            # report unreachable peers to the heartbeat registry so their
+            # blocks stop being listed as live (reference: transport
+            # errors feeding RapidsShuffleHeartbeatManager). liveness is
+            # deliberately NOT wired here: in registry mode the
+            # RegistryClient's live_table is the peer-liveness authority
+            # (remote executors heartbeat the DRIVER registry, not this
+            # process), and the local heartbeat table would veto every
+            # remote peer; in-transport suspect ordering covers fetch
+            # failover either way.
+            from ..plugin import ExecutorRuntime
+            runtime = ExecutorRuntime._instance
+            if runtime is not None:
+                transport.on_unreachable = runtime.mark_unreachable
             if conf is not None:
                 from ..config import (CACHED_HEARTBEAT_INTERVAL_MS,
                                       EXECUTOR_ID)
@@ -161,5 +188,5 @@ def socket_host() -> str:
     import socket as _s
     try:
         return _s.gethostbyname(_s.gethostname())
-    except OSError:
+    except OSError:  # net-ok: no resolvable hostname — loopback fallback
         return "127.0.0.1"

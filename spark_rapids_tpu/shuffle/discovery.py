@@ -41,6 +41,8 @@ class _RegistryHandler(socketserver.StreamRequestHandler):
             else:
                 self.wfile.write(b'{"error": "bad op"}\n')
         except (OSError, ValueError, KeyError):
+            # net-ok: registry handler; a malformed/broken control-plane
+            # request tears down its own short-lived connection
             pass
 
 
@@ -116,14 +118,16 @@ class RegistryClient:
         while not self._stop.wait(interval_s):
             try:
                 self._rpc({"op": "heartbeat", "id": self.exec_id})
-            except OSError:
-                pass    # registry unreachable: peers see us expire
+            except OSError:  # net-ok: registry down — peers see us expire
+                pass
 
     def peers(self) -> Dict[int, Tuple[str, int]]:
         """Live peer table EXCLUDING self — TcpTransport peer_source."""
         try:
             table = self._rpc({"op": "list"})
         except OSError:
+            # net-ok: registry unreachable — an empty peer table falls
+            # back to the static table (transport merges over it)
             return {}
         return {int(i): (h, p) for i, (h, p) in table.items()
                 if int(i) != self.exec_id}

@@ -59,6 +59,7 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
                  max_bytes_in_flight: int = 512 << 20,
                  ctx: Optional[EvalContext] = None,
                  transport=None,
+                 read_transport=None,
                  codec: Optional[str] = None):
         super().__init__(child, ctx)
         self.partitioning = partitioning.bind(child.output_schema)
@@ -83,6 +84,12 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         else:
             self._owns_transport = False
         self.transport = transport
+        # cross-process shape: the map side publishes into ``transport``
+        # (this executor's block server) while reducers pull through
+        # ``read_transport`` — a fetching client whose peer table sees
+        # the map side over the wire. Defaults to the same transport
+        # (single-process: local fast path).
+        self.read_transport = read_transport or transport
         # random 63-bit id: per-process counters COLLIDE when two
         # processes share one transport root (cross-process mode)
         self.shuffle_id = uuid.uuid4().int & ((1 << 63) - 1)
@@ -148,14 +155,14 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         self._write_all()
-        blocks = self.transport.list_blocks(self.shuffle_id, p)
+        blocks = self.read_transport.list_blocks(self.shuffle_id, p)
         if not blocks:
             return
         schema = self.output_schema
         # pipelined fetch: decode each block the moment its bytes land
         # while later fetches keep streaming (transport.fetch_many)
         batches = [deserialize_batch(data, schema)
-                   for _, data in self.transport.fetch_many(
+                   for _, data in self.read_transport.fetch_many(
                        blocks,
                        max_in_flight=self.max_in_flight_fetches)]
         total = sum(int(b.num_rows) for b in batches)

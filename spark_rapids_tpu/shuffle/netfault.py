@@ -1,0 +1,229 @@
+"""Deterministic network fault injection at the transport frame seam.
+
+Reference: RapidsShuffleClientSuite / RapidsShuffleTestHelper — the UCX
+shuffle's retry/transaction story is tested against mocked transports
+that drop, corrupt, and stall transactions on a schedule. The TPU twin
+is modeled on the OOM injector (memory/retry.py `OomInjector`,
+RmmSpark's forceRetryOOM shape): a process-wide injector configured by
+``spark.rapids.tpu.test.injectNet.{mode,seed,skipCount,faultKind,
+delayMs}`` whose hooks sit inside `transport._send_frame` /
+`transport._recv_frame`, so every fault lands exactly where a real
+network would deliver it — AFTER checksums are computed on the send
+side, BEFORE they are verified on the receive side.
+
+Fault kinds (``faultKind``):
+
+- ``drop``      — the connection closes mid-transaction (peer crash /
+                  RST); the client's retry loop must reconnect.
+- ``delay``     — the frame stalls ``delayMs`` (congestion); nothing
+                  fails, deadlines and pipelining absorb it.
+- ``truncate``  — the frame is cut short and the connection closes
+                  (peer died mid-send); the receiver sees a mid-frame
+                  EOF.
+- ``corrupt``   — one payload bit flips after the CRC was computed;
+                  the RECEIVER's checksum verification must catch it
+                  and classify the fetch BlockCorruptError.
+- ``mix``       — cycles drop → delay → truncate → corrupt per trigger.
+
+Scheduling mirrors the OOM injector exactly: ``every-N`` fires on every
+Nth eligible frame, ``random[-P]`` with seeded probability; re-attempts
+inside a transport retry scope are ``suppressed()`` (no NEW triggers, so
+recovery terminates), and the first check after a trigger is an
+uncounted free pass so even ``every-1`` converges.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+FAULT_KINDS = ("drop", "delay", "truncate", "corrupt")
+
+
+class InjectedNetError(ConnectionError):
+    """Synthetic transport fault from the injection layer (test-only).
+    A ConnectionError so production classification (retry + reconnect)
+    is exercised end to end, like InjectedOOMError rides
+    OutOfBudgetError."""
+
+
+class NetInjector:
+    """Decides, per transport frame, whether (and how) it faults."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._gen = 0
+        self.configure("")
+
+    def configure(self, mode: str, seed: int = 0, skip_count: int = 0,
+                  fault_kind: str = "drop", delay_ms: int = 20) -> None:
+        with self._lock:
+            mode = (mode or "").strip().lower()
+            self._mode = mode
+            self._every = 0
+            self._p = 0.0
+            if mode.startswith("every-"):
+                self._every = max(int(mode.split("-", 1)[1]), 1)
+            elif mode.startswith("random"):
+                self._p = float(mode.split("-", 1)[1]) \
+                    if "-" in mode else 0.2
+            elif mode not in ("", "off"):
+                raise ValueError(f"unknown injectNet.mode {mode!r}")
+            fault_kind = (fault_kind or "drop").strip().lower()
+            if fault_kind not in FAULT_KINDS + ("mix",):
+                raise ValueError(f"unknown injectNet.faultKind "
+                                 f"{fault_kind!r}")
+            self._kind = fault_kind
+            self._delay_s = max(int(delay_ms), 0) / 1000.0
+            self._rng = random.Random(seed)
+            self._skip_left = max(int(skip_count), 0)
+            self._checks = 0
+            self.injected = 0
+            # invalidate thread-local free-pass state WITHOUT replacing
+            # self._tls — another thread may be inside suppressed() right
+            # now (same hazard the OOM injector documents)
+            self._gen += 1
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._every or self._p)
+
+    @contextmanager
+    def suppressed(self):
+        """Scope for transport retry re-attempts: no NEW faults fire
+        inside, so recovery terminates under every-1 schedules."""
+        self._tls.suppress = getattr(self._tls, "suppress", 0) + 1
+        try:
+            yield
+        finally:
+            self._tls.suppress = max(
+                getattr(self._tls, "suppress", 1) - 1, 0)
+
+    def decide(self, site: str) -> Optional[str]:
+        """Returns the fault kind this frame suffers, or None. The
+        transport seam applies the kind's mechanics (close/sleep/flip)."""
+        if not self.enabled:
+            return None
+        if getattr(self._tls, "gen", -1) != self._gen:
+            self._tls.gen = self._gen
+            self._tls.free = False
+        if getattr(self._tls, "free", False):
+            # post-trigger free pass: the retry that follows a fault must
+            # be able to make progress even outside a suppressed() scope
+            self._tls.free = False
+            return None
+        if getattr(self._tls, "suppress", 0) > 0:
+            return None
+        with self._lock:
+            if self._skip_left > 0:
+                self._skip_left -= 1
+                return None
+            self._checks += 1
+            n = self._checks
+            fire = (self._every and n % self._every == 0) or \
+                (self._p and self._rng.random() < self._p)
+            if not fire:
+                return None
+            self.injected += 1
+            kind = self._kind
+            if kind == "mix":
+                kind = FAULT_KINDS[(self.injected - 1) % len(FAULT_KINDS)]
+        self._tls.free = True
+        return kind
+
+    @property
+    def delay_s(self) -> float:
+        return self._delay_s
+
+
+_INJECTOR = NetInjector()
+
+
+def net_injector() -> NetInjector:
+    return _INJECTOR
+
+
+def apply_session_conf(conf) -> None:
+    """Install a session's injectNet settings process-wide (the same
+    executor-singleton shape as the OOM injector: the last session to
+    run configures it)."""
+    from ..config import (INJECT_NET_DELAY_MS, INJECT_NET_FAULT_KIND,
+                          INJECT_NET_MODE, INJECT_NET_SEED,
+                          INJECT_NET_SKIP_COUNT)
+    _INJECTOR.configure(str(conf.get(INJECT_NET_MODE.key)),
+                        int(conf.get(INJECT_NET_SEED.key)),
+                        int(conf.get(INJECT_NET_SKIP_COUNT.key)),
+                        str(conf.get(INJECT_NET_FAULT_KIND.key)),
+                        int(conf.get(INJECT_NET_DELAY_MS.key)))
+
+
+@contextmanager
+def net_injection(mode: str, seed: int = 0, skip_count: int = 0,
+                  fault_kind: str = "drop", delay_ms: int = 20):
+    """Test helper: enable injection inside the block, restore off after."""
+    _INJECTOR.configure(mode, seed, skip_count, fault_kind, delay_ms)
+    try:
+        yield _INJECTOR
+    finally:
+        _INJECTOR.configure("")
+
+
+def _flip_bit(payload: bytes) -> bytes:
+    """Deterministic single-bit corruption of a frame payload."""
+    if not payload:
+        return payload
+    buf = bytearray(payload)
+    buf[len(buf) // 2] ^= 0x40
+    return bytes(buf)
+
+
+def fault_send(sock, frame: bytes, site: str) -> bytes:
+    """Send-side seam: returns the (possibly corrupted) frame to put on
+    the wire, or raises/closes per the scheduled fault. ``frame`` is the
+    complete encoded frame INCLUDING its checksum."""
+    kind = _INJECTOR.decide(site)
+    if kind is None:
+        return frame
+    if kind == "delay":
+        time.sleep(_INJECTOR.delay_s)
+        return frame
+    if kind == "corrupt":
+        # flip a payload bit past the frame header: the header's CRC was
+        # computed over the clean payload, so the receiver must reject it
+        head = min(13, len(frame) - 1)
+        return frame[:head] + _flip_bit(frame[head:])
+    if kind == "truncate":
+        try:
+            sock.sendall(frame[: max(len(frame) // 2, 1)])
+        except OSError:  # net-ok: the injected close is the fault itself
+            pass
+        _close(sock)
+        raise InjectedNetError(f"injected truncate at {site}")
+    _close(sock)                                   # kind == "drop"
+    raise InjectedNetError(f"injected connection drop at {site}")
+
+
+def fault_recv(sock, payload: bytes, site: str) -> bytes:
+    """Receive-side seam: returns the (possibly corrupted) payload, or
+    raises per the scheduled fault — BEFORE checksum verification."""
+    kind = _INJECTOR.decide(site)
+    if kind is None:
+        return payload
+    if kind == "delay":
+        time.sleep(_INJECTOR.delay_s)
+        return payload
+    if kind == "corrupt":
+        return _flip_bit(payload)
+    _close(sock)                  # truncate/drop: mid-frame peer death
+    raise InjectedNetError(f"injected {kind} at {site}")
+
+
+def _close(sock) -> None:
+    try:
+        sock.close()
+    except OSError:  # net-ok: injected teardown, best-effort close
+        pass

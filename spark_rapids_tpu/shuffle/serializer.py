@@ -7,7 +7,7 @@ payloads run through the native LZ4 (utils/native.py, C++) — used by the
 disk spill tier and the multithreaded shuffle, and as the DCN wire format.
 
 Frame layout (little-endian):
-  magic 'RTPU' | u32 version | u32 ncols | i64 nrows
+  magic 'RTPU' | u32 version | u32 crc32(body) | u32 ncols | i64 nrows
   per column:
     u8 has_lengths | u8 codec(0=none,1=lz4,2=zlib,3=zstd) padding x2
     u32 name_len | name bytes
@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import io
 import struct
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -30,9 +31,40 @@ from ..types import TypeKind
 from ..utils import native
 
 MAGIC = b"RTPU"
-VERSION = 1
+#: v2 added the envelope CRC32 (integrity of wire frames + spill files)
+VERSION = 2
 _CODEC = {"none": 0, "lz4": 1, "zlib": 2, "zstd": 3}
 _CODEC_R = {v: k for k, v in _CODEC.items()}
+
+#: magic(4) + version(4) + crc(4): the body the CRC covers starts here
+_HEADER_LEN = 12
+
+
+class FrameChecksumError(RuntimeError):
+    """The frame body does not match the CRC32 its envelope carries —
+    the bytes were damaged between serialize (exchange wire export,
+    disk-tier spill write) and deserialize (fetch decode, spill read).
+    Failing loudly here is the contract: a corrupt frame must never
+    decode into silently-wrong rows."""
+
+
+def _start_frame() -> io.BytesIO:
+    """Open a frame with a zero CRC placeholder; _seal_frame patches it."""
+    out = io.BytesIO()
+    out.write(MAGIC)
+    out.write(struct.pack("<II", VERSION, 0))
+    return out
+
+
+def _seal_frame(out: io.BytesIO) -> bytes:
+    """Patch the envelope CRC32 in place — no extra full-frame copy on
+    the spill/wire hot path (a multi-hundred-MB frame must not
+    transiently double while the process is spilling under pressure)."""
+    buf = out.getbuffer()
+    crc = zlib.crc32(buf[_HEADER_LEN:]) & 0xFFFFFFFF
+    struct.pack_into("<I", buf, 8, crc)
+    del buf          # release the memoryview before getvalue()
+    return out.getvalue()
 
 
 def _write_blob(out: io.BytesIO, raw,
@@ -59,9 +91,8 @@ def serialize_host(arrays: Dict[str, np.ndarray], num_rows: int,
                    codec: Optional[str] = None) -> bytes:
     """Serialize named host arrays (the spill-store / shuffle-write side).
     ``codec`` overrides the process default (per-session shuffle codec)."""
-    out = io.BytesIO()
-    out.write(MAGIC)
-    out.write(struct.pack("<IIq", VERSION, len(arrays), num_rows))
+    out = _start_frame()
+    out.write(struct.pack("<Iq", len(arrays), num_rows))
     for name, arr in arrays.items():
         arr = np.asarray(arr)   # NOT ascontiguousarray: it promotes 0-d to 1-d
         nb = name.encode()
@@ -74,15 +105,23 @@ def serialize_host(arrays: Dict[str, np.ndarray], num_rows: int,
         for s in arr.shape:
             out.write(struct.pack("<q", s))
         _write_blob(out, arr.tobytes(), codec)
-    return out.getvalue()
+    return _seal_frame(out)
 
 
 def deserialize_host(data: bytes) -> Tuple[Dict[str, np.ndarray], int]:
     buf = memoryview(data)
     assert bytes(buf[:4]) == MAGIC, "bad frame magic"
-    version, ncols, num_rows = struct.unpack_from("<IIq", buf, 4)
-    assert version == VERSION
-    pos = 4 + 16
+    version, crc = struct.unpack_from("<II", buf, 4)
+    assert version == VERSION, f"frame version {version} != {VERSION}"
+    # verified on EVERY deserialize — shuffle fetch decode and disk-tier
+    # spill read alike (reference: the per-buffer checksums the UCX
+    # shuffle validates on receive)
+    if zlib.crc32(buf[_HEADER_LEN:]) & 0xFFFFFFFF != crc:
+        raise FrameChecksumError(
+            f"frame body fails its envelope CRC32 "
+            f"({len(data) - _HEADER_LEN} bytes)")
+    ncols, num_rows = struct.unpack_from("<Iq", buf, _HEADER_LEN)
+    pos = _HEADER_LEN + 12
     arrays: Dict[str, np.ndarray] = {}
     for _ in range(ncols):
         (nlen,) = struct.unpack_from("<I", buf, pos)
@@ -171,9 +210,8 @@ def frame_packed(packed, codec: Optional[str] = None) -> bytes:
     remaining copy is the codec's own output). Byte-compatible with
     serialize_host — deserialize_host/deserialize_batch read both."""
     mv = memoryview(packed.buffer).cast("B")
-    out = io.BytesIO()
-    out.write(MAGIC)
-    out.write(struct.pack("<IIq", VERSION, len(packed.meta.sections),
+    out = _start_frame()
+    out.write(struct.pack("<Iq", len(packed.meta.sections),
                           packed.meta.num_rows))
     for s in packed.meta.sections:
         nb = s.key.encode()
@@ -186,7 +224,7 @@ def frame_packed(packed, codec: Optional[str] = None) -> bytes:
         for dim in s.shape:
             out.write(struct.pack("<q", dim))
         _write_blob(out, mv[s.offset: s.offset + s.nbytes], codec)
-    return out.getvalue()
+    return _seal_frame(out)
 
 
 def serialize_batch(batch: ColumnarBatch, schema: Schema,

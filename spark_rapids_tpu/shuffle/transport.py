@@ -16,7 +16,23 @@ elastic shuffles). Two implementations of one interface:
   mode's storage, behind the trait so it is swappable),
 - TcpTransport — a length-prefixed binary protocol over sockets:
   HELLO version handshake, FETCH(shuffle, map, reduce) → OK payload /
-  MISSING / ERROR, connection-per-request clients with retry.
+  MISSING / ERROR, persistent per-peer connections with retry.
+
+Fault model (reference: RapidsShuffleIterator's retry/transaction story):
+
+- every frame carries a CRC32 of its payload, verified on receive — a
+  corrupt frame is a typed ``BlockCorruptError`` retried against the
+  SAME peer (the bytes exist there; the wire lied);
+- a block a peer answers MISSING for is a ``BlockMissingError`` that
+  fails over to the next peer immediately (no same-peer retry);
+- connect and post-connect I/O both carry conf-driven deadlines
+  (`spark.rapids.tpu.shuffle.transport.{connectTimeoutMs,ioTimeoutMs}`)
+  so a peer that accepts then goes silent times out instead of
+  deadlocking the per-peer connection lock; retries back off with
+  jittered exponential delay; a peer that exhausts its retry budget is
+  a ``PeerUnreachableError``, reported through ``on_unreachable`` (the
+  heartbeat-registry hook) and deprioritized for subsequent fetches so
+  one dead peer degrades one block's latency, not the whole read.
 
 Every payload is the framed serializer format (serializer.py), so blocks
 are compressed once on publish and device-decoded once on fetch.
@@ -25,14 +41,21 @@ are compressed once on publish and device-decoded once on fetch.
 from __future__ import annotations
 
 import os
+import random
+import re
 import socket
 import socketserver
 import struct
 import threading
+import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
+from .netfault import fault_recv, fault_send, net_injector
+
 _MAGIC = b"RTPU"
-_VERSION = 1
+#: v2 added the per-frame payload CRC32 to the header
+_VERSION = 2
 
 # ops
 _HELLO, _FETCH, _OK, _MISSING, _ERROR, _LIST = 1, 2, 3, 4, 5, 6
@@ -48,8 +71,74 @@ class TransportError(RuntimeError):
     pass
 
 
+class BlockMissingError(TransportError):
+    """The asked peer does not hold the block — fail over to other
+    peers; retrying the same peer cannot help (reference: the
+    BlockNotFound transaction status)."""
+
+
+class BlockCorruptError(TransportError):
+    """A frame failed its checksum — the peer holds the bytes but the
+    wire (or a spill tier) damaged them; retry against the SAME peer."""
+
+
+class PeerUnreachableError(TransportError):
+    """Connect/transact with a peer kept failing past the retry budget —
+    report to the heartbeat registry and fail over (reference: the
+    executor-death story behind RapidsShuffleHeartbeatManager)."""
+
+
 class BlockId(Tuple):
     """(shuffle_id, map_id, reduce_id)"""
+
+
+# ---------------------------------------------------------------------------
+# transport metrics (reference: the shuffle fetch/retry SQLMetrics the
+# RapidsShuffleIterator posts; rolled into Session.metrics() like the
+# retry framework's counters)
+# ---------------------------------------------------------------------------
+
+class TransportMetrics:
+    """Process-wide fetch-retry counters; sessions report deltas."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.fetch_retry_count = 0
+        self.fetch_backoff_time_ns = 0
+        self.corrupt_frame_count = 0
+        self.peer_failover_count = 0
+
+    def note_retry(self) -> None:
+        with self._lock:
+            self.fetch_retry_count += 1
+
+    def note_backoff(self, ns: int) -> None:
+        with self._lock:
+            self.fetch_backoff_time_ns += int(ns)
+
+    def note_corrupt(self) -> None:
+        with self._lock:
+            self.corrupt_frame_count += 1
+
+    def note_failover(self) -> None:
+        with self._lock:
+            self.peer_failover_count += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "fetchRetryCount": self.fetch_retry_count,
+                "fetchBackoffTime": self.fetch_backoff_time_ns,
+                "corruptFrameCount": self.corrupt_frame_count,
+                "peerFailoverCount": self.peer_failover_count,
+            }
+
+
+_METRICS = TransportMetrics()
+
+
+def transport_metrics() -> TransportMetrics:
+    return _METRICS
 
 
 class ShuffleTransport:
@@ -83,6 +172,11 @@ class ShuffleTransport:
         pass
 
 
+#: strict block filename shape; anything else in the root is a bug or
+#: corruption, never silently skipped
+_BLOCK_FILE_RE = re.compile(r"s(\d+)-m(\d+)-r(\d+)\.rtpu\Z")
+
+
 class LocalFsTransport(ShuffleTransport):
     """Shared-directory blocks (works across processes on one host or any
     shared filesystem — the reference's fallback shuffle storage)."""
@@ -92,6 +186,10 @@ class LocalFsTransport(ShuffleTransport):
         os.makedirs(root, exist_ok=True)
 
     def _path(self, s: int, m: int, r: int) -> str:
+        if s < 0 or m < 0 or r < 0:
+            # a negative id would embed an extra '-' in the filename and
+            # make it unparseable on the list side
+            raise TransportError(f"invalid block id s{s}-m{m}-r{r}")
         return os.path.join(self.root, f"s{s}-m{m}-r{r}.rtpu")
 
     def publish(self, s: int, m: int, r: int, payload: bytes) -> None:
@@ -105,14 +203,25 @@ class LocalFsTransport(ShuffleTransport):
             with open(self._path(s, m, r), "rb") as f:
                 return f.read()
         except FileNotFoundError:
-            raise TransportError(f"missing block s{s}-m{m}-r{r}")
+            raise BlockMissingError(f"missing block s{s}-m{m}-r{r}")
 
     def list_blocks(self, s: int, r: int):
+        """Strictly parsed directory listing: every ``*.rtpu`` file must
+        match the block filename shape exactly — a malformed name (e.g.
+        an id that itself contained ``-``) raises instead of being
+        silently skipped, which would silently drop its rows.
+        ``*.tmp`` staging files from in-flight publishes are ignored."""
         out = []
         for name in os.listdir(self.root):
-            if name.startswith(f"s{s}-") and name.endswith(f"-r{r}.rtpu"):
-                m = int(name.split("-")[1][1:])
-                out.append((s, m, r))
+            if not name.endswith(".rtpu"):
+                continue
+            match = _BLOCK_FILE_RE.fullmatch(name)
+            if match is None:
+                raise TransportError(
+                    f"malformed block file {name!r} in {self.root}")
+            fs, fm, fr = (int(g) for g in match.groups())
+            if fs == s and fr == r:
+                out.append((fs, fm, fr))
         return sorted(out)
 
     def remove_shuffle(self, s: int) -> None:
@@ -120,7 +229,7 @@ class LocalFsTransport(ShuffleTransport):
             if name.startswith(f"s{s}-"):
                 try:
                     os.remove(os.path.join(self.root, name))
-                except OSError:
+                except OSError:  # net-ok: concurrent cleanup, best effort
                     pass
 
     def close(self) -> None:
@@ -132,8 +241,22 @@ class LocalFsTransport(ShuffleTransport):
 # TCP transport
 # ---------------------------------------------------------------------------
 
-def _send_frame(sock: socket.socket, op: int, payload: bytes) -> None:
-    sock.sendall(_MAGIC + struct.pack("<BI", op, len(payload)) + payload)
+def _crc(payload) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def _send_frame(sock: socket.socket, op: int, payload: bytes,
+                site: Optional[str] = None) -> None:
+    """Encode ``magic | op u8 | len u32 | crc32 u32 | payload`` and send.
+    ``site`` names a CLIENT-side call for the fault injector (server
+    replies pass None: the client seam already observes every way a
+    server can die, and injecting on both sides of one transaction would
+    make every-1 schedules non-convergent)."""
+    frame = _MAGIC + struct.pack("<BII", op, len(payload),
+                                 _crc(payload)) + payload
+    if site is not None and net_injector().enabled:
+        frame = fault_send(sock, frame, site)
+    sock.sendall(frame)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -146,12 +269,20 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
-    head = _recv_exact(sock, 9)
+def _recv_frame(sock: socket.socket,
+                site: Optional[str] = None) -> Tuple[int, bytes]:
+    head = _recv_exact(sock, 13)
     if head[:4] != _MAGIC:
         raise TransportError("bad magic")
-    op, ln = struct.unpack("<BI", head[4:])
-    return op, _recv_exact(sock, ln)
+    op, ln, crc = struct.unpack("<BII", head[4:])
+    payload = _recv_exact(sock, ln)
+    if site is not None and net_injector().enabled:
+        payload = fault_recv(sock, payload, site)
+    if _crc(payload) != crc:
+        _METRICS.note_corrupt()
+        raise BlockCorruptError(
+            f"frame checksum mismatch (op {op}, {ln} bytes)")
+    return op, payload
 
 
 class _BlockServer(socketserver.ThreadingTCPServer):
@@ -204,6 +335,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 else:
                     _send_frame(self.request, _OK, blk)
         except (TransportError, ConnectionError, OSError):
+            # net-ok: server side of a broken/corrupt connection — the
+            # teardown IS the reply; the client's retry loop reconnects
             return
 
 
@@ -219,7 +352,13 @@ class TcpTransport(ShuffleTransport):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
                  retries: int = 3, liveness=None, peer_source=None,
-                 window_bytes: int = DEFAULT_WINDOW_BYTES):
+                 window_bytes: int = DEFAULT_WINDOW_BYTES,
+                 connect_timeout_s: float = 30.0,
+                 io_timeout_s: Optional[float] = 30.0,
+                 backoff_base_ms: float = 10.0,
+                 backoff_max_ms: float = 1000.0,
+                 on_unreachable=None,
+                 suspect_ttl_s: float = 30.0):
         self._local: Dict[Tuple[int, int, int], bytes] = {}
         #: staging window for large-block fetches (the bounce-buffer
         #: size); blocks above it stream as _FETCH_AT range reads
@@ -240,7 +379,22 @@ class TcpTransport(ShuffleTransport):
         #: payload lives elsewhere (the device-resident shuffle cache)
         self.resolver = None
         self.peers = dict(peers or {})
-        self.retries = retries
+        self.retries = max(int(retries), 1)
+        #: conf-driven deadlines (transport.{connectTimeoutMs,ioTimeoutMs})
+        self.connect_timeout_s = connect_timeout_s
+        self.io_timeout_s = io_timeout_s if io_timeout_s else None
+        #: jittered exponential backoff between retry attempts
+        self.backoff_base_s = max(backoff_base_ms, 0.0) / 1000.0
+        self.backoff_max_s = max(backoff_max_ms, 0.0) / 1000.0
+        #: peer-id hook fired when a peer exhausts its retry budget —
+        #: normally ExecutorRuntime.mark_unreachable, so the heartbeat
+        #: registry stops listing the peer as live
+        self.on_unreachable = on_unreachable
+        #: peers that recently proved unreachable are tried LAST for the
+        #: ttl, so one dead peer taxes only the blocks it exclusively
+        #: owns instead of every fetch in the read
+        self.suspect_ttl_s = suspect_ttl_s
+        self._suspects: Dict[Tuple[str, int], float] = {}
         # liveness: () -> iterable of live peer ids, normally the driver
         # heartbeat registry's live_executors (reference:
         # RapidsShuffleHeartbeatManager feeding UCX endpoint setup).
@@ -306,8 +460,31 @@ class TcpTransport(ShuffleTransport):
             peers.update(self.peer_source())
         if self.liveness is None:
             return peers
-        live = set(self.liveness())
-        return {pid: a for pid, a in peers.items() if pid in live}
+        # ids compare as strings: the heartbeat registry normalizes its
+        # keys, while peer tables may key on int executor ids
+        live = {str(x) for x in self.liveness()}
+        return {pid: a for pid, a in peers.items() if str(pid) in live}
+
+    def _ordered_peers(self) -> List[Tuple[object, Tuple[str, int]]]:
+        """Live peers, recently-unreachable suspects LAST (stable order
+        otherwise) — healthy peers answer first, so a dead peer's
+        timeout is only paid for blocks no healthy peer holds."""
+        peers = list(self._live_peers().items())
+        now = time.time()
+        with self._conns_guard:
+            suspects = {a for a, t in self._suspects.items()
+                        if now - t < self.suspect_ttl_s}
+        peers.sort(key=lambda kv: kv[1] in suspects)
+        return peers
+
+    def _note_unreachable(self, peer_id, addr) -> None:
+        with self._conns_guard:
+            self._suspects[addr] = time.time()
+        if self.on_unreachable is not None:
+            try:
+                self.on_unreachable(peer_id)
+            except Exception:
+                pass    # reporting must never mask the fetch error
 
     def list_blocks(self, s: int, r: int):
         """Local blocks UNION every LIVE peer's blocks (the shuffle
@@ -318,7 +495,11 @@ class TcpTransport(ShuffleTransport):
         executor-death story)."""
         out = set(self.local_blocks(s, r))
         for peer_id, addr in self._live_peers().items():
-            maps = self._retrying(addr, self._list_from, s, r)
+            try:
+                maps = self._retrying(addr, self._list_from, s, r)
+            except PeerUnreachableError:
+                self._note_unreachable(peer_id, addr)
+                raise
             out.update((s, m, r) for m in maps)
         return sorted(out)
 
@@ -329,49 +510,118 @@ class TcpTransport(ShuffleTransport):
             for key in [k for k in self._index if k[0] == s]:
                 del self._index[key]
 
+    # ---- retry policy -------------------------------------------------
+
+    def _backoff(self, attempt: int) -> None:
+        """Jittered exponential backoff (reference: the shuffle fetch
+        retry wait) — full jitter in [base/2, base] * 2^(attempt-1)."""
+        if self.backoff_base_s <= 0:
+            return
+        delay = min(self.backoff_base_s * (1 << min(attempt - 1, 10)),
+                    self.backoff_max_s)
+        delay *= 0.5 + random.random() * 0.5
+        t0 = time.perf_counter_ns()
+        time.sleep(delay)
+        _METRICS.note_backoff(time.perf_counter_ns() - t0)
+
     def _retrying(self, addr, fn, *args):
+        """Typed retry loop for one peer transaction:
+
+        - BlockMissingError propagates immediately — the caller fails
+          over to the next peer; retrying the same peer cannot help;
+        - BlockCorruptError retries the SAME peer (the bytes are there,
+          the wire lied) and stays typed when retries run out;
+        - everything else (reset, timeout, mid-frame close) retries with
+          jittered backoff and becomes PeerUnreachableError when the
+          budget is exhausted."""
         last: Optional[Exception] = None
-        for _ in range(self.retries):
+        corrupt_last = False
+        for attempt in range(self.retries):
+            if attempt:
+                self._backoff(attempt)
             try:
-                return fn(addr, *args)
+                if attempt == 0:
+                    return fn(addr, *args)
+                # re-attempts never start NEW injected faults — recovery
+                # must converge (mirror of the OOM injector's contract)
+                with net_injector().suppressed():
+                    return fn(addr, *args)
+            except BlockMissingError:
+                raise
+            except BlockCorruptError as ex:
+                last, corrupt_last = ex, True
+                _METRICS.note_retry()
             except (TransportError, ConnectionError, OSError) as ex:
-                last = ex
-                if isinstance(ex, TransportError) and \
-                        "missing" in str(ex):
-                    raise
-        raise TransportError(f"peer {addr} unreachable: {last}")
+                # net-ok: counted + retried; budget exhaustion re-raises
+                # typed (PeerUnreachableError) below the loop
+                last, corrupt_last = ex, False
+                _METRICS.note_retry()
+        if corrupt_last:
+            raise BlockCorruptError(
+                f"peer {addr}: corrupt frames through "
+                f"{self.retries} attempts: {last}")
+        raise PeerUnreachableError(
+            f"peer {addr} unreachable after {self.retries} "
+            f"attempts: {last}")
 
     # ---- fetch (local fast path, else ask each peer) ----
     def fetch(self, s: int, m: int, r: int) -> bytes:
         blk = self._local.get((s, m, r))
         if blk is not None:
             return blk
-        last: Optional[Exception] = None
-        for peer_id, addr in self._live_peers().items():
+        missing: List[Exception] = []
+        failed: List[Exception] = []
+        for peer_id, addr in self._ordered_peers():
             try:
                 return self._retrying(addr, self._fetch_from, s, m, r)
-            except TransportError as ex:
-                # missing on this peer or peer dead: try the next peer
-                last = ex
-        raise TransportError(f"block s{s}-m{m}-r{r} not found on any peer"
-                             + (f" (last: {last})" if last else ""))
+            except BlockMissingError as ex:
+                missing.append(ex)
+            except PeerUnreachableError as ex:
+                self._note_unreachable(peer_id, addr)
+                _METRICS.note_failover()
+                failed.append(ex)
+            except TransportError as ex:    # corrupt past the budget
+                _METRICS.note_failover()
+                failed.append(ex)
+        if failed:
+            if all(isinstance(ex, BlockCorruptError) for ex in failed):
+                # every serving peer is reachable but the bytes keep
+                # failing their CRC: that is a data-integrity problem,
+                # not a reachability one — keep the taxonomy honest
+                raise BlockCorruptError(
+                    f"block s{s}-m{m}-r{r} corrupt on every serving "
+                    f"peer (last: {failed[-1]})")
+            # the block may live on a peer we could not reach — surface
+            # the reachability failure, not a bogus "missing"
+            raise PeerUnreachableError(
+                f"block s{s}-m{m}-r{r} unresolved: {len(failed)} peer "
+                f"fetch(es) failed (last: {failed[-1]}), missing on "
+                f"{len(missing)} peer(s)")
+        raise BlockMissingError(
+            f"block s{s}-m{m}-r{r} not found on any peer"
+            + (f" (last: {missing[-1]})" if missing else ""))
 
     # ---- persistent per-peer connections --------------------------------
     def _conn_of(self, addr):
         """(socket, lock) for ``addr``; connects + handshakes once and
         keeps the connection for the transport's lifetime (the reference
-        keeps UCX endpoints alive the same way)."""
+        keeps UCX endpoints alive the same way). The connect deadline
+        covers the handshake; after it the socket switches to the I/O
+        deadline so no later recv can block forever."""
         with self._conns_guard:
             sock = self._conns.get(addr)
             lock = self._conn_locks.setdefault(addr, threading.Lock())
         if sock is not None:
             return sock, lock
-        sock = socket.create_connection(addr, timeout=30)
+        sock = socket.create_connection(addr,
+                                        timeout=self.connect_timeout_s)
         try:
-            _send_frame(sock, _HELLO, struct.pack("<I", _VERSION))
-            op, payload = _recv_frame(sock)
+            _send_frame(sock, _HELLO, struct.pack("<I", _VERSION),
+                        site="hello.send")
+            op, payload = _recv_frame(sock, site="hello.recv")
             if op != _HELLO:
                 raise TransportError(f"handshake failed: {payload!r}")
+            sock.settimeout(self.io_timeout_s)
         except BaseException:
             sock.close()
             raise
@@ -390,18 +640,23 @@ class TcpTransport(ShuffleTransport):
                 del self._conns[addr]
         try:
             sock.close()
-        except OSError:
+        except OSError:  # net-ok: already-dead socket, teardown path
             pass
 
     def _transact(self, addr, op: int, payload: bytes):
         """One request/response on the persistent connection; a transport
-        failure drops the connection so retries reconnect."""
+        failure drops the connection so retries reconnect. The per-peer
+        lock is held across one bounded (io-deadline) round trip — a
+        hung peer times out instead of deadlocking every fetching
+        thread behind the lock."""
         sock, lock = self._conn_of(addr)
         try:
             with lock:
-                _send_frame(sock, op, payload)
-                return _recv_frame(sock)
+                _send_frame(sock, op, payload, site="transact.send")
+                return _recv_frame(sock, site="transact.recv")
         except (TransportError, ConnectionError, OSError):
+            # includes BlockCorruptError: after a corrupt frame the
+            # stream may be desynced — reconnect before the retry
             self._drop_conn(addr, sock)
             raise
 
@@ -418,7 +673,7 @@ class TcpTransport(ShuffleTransport):
         op, payload = self._transact(addr, _SIZE,
                                      struct.pack("<qqq", s, m, r))
         if op == _MISSING:
-            raise TransportError("missing block")
+            raise BlockMissingError("missing block")
         if op != _OK:
             raise TransportError(f"peer error: {payload!r}")
         (total,) = struct.unpack("<q", payload)
@@ -428,7 +683,7 @@ class TcpTransport(ShuffleTransport):
             if op == _OK:
                 return payload
             if op == _MISSING:
-                raise TransportError("missing block")
+                raise BlockMissingError("missing block")
             raise TransportError(f"peer error: {payload!r}")
         # windowed streaming: fixed-size range reads into one buffer
         # (WindowedBlockIterator over bounce-buffer-sized steps)
@@ -448,7 +703,11 @@ class TcpTransport(ShuffleTransport):
         order while later fetches proceed in the background, so device
         decode overlaps the wire (the reference's windowed pending-fetch
         pipeline). Different peers progress in parallel; one peer's
-        frames serialize on its connection."""
+        frames serialize on its connection. Failover is PER BLOCK
+        (each fetch() retries/fails over independently, and the first
+        unreachable verdict deprioritizes that peer for the rest of the
+        read) — one dead peer degrades the latency of the blocks only
+        it held, instead of aborting the whole exchange read."""
         from ..io.source import bounded_map, reader_pool
         pool = reader_pool(max(2, max_in_flight))
         yield from bounded_map(pool, list(ids),
@@ -462,7 +721,7 @@ class TcpTransport(ShuffleTransport):
         for sock in conns:
             try:
                 sock.close()
-            except OSError:
+            except OSError:  # net-ok: teardown, socket may already be dead
                 pass
         self._server.shutdown()
         self._server.server_close()

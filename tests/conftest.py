@@ -55,6 +55,12 @@ def pytest_configure(config):
                    "fault-injection coverage; `pytest -m 'oom_inject "
                    "and not slow'` is the smoke-tier robustness job in "
                    "the tier-1 flow (the full mode matrix is nightly)")
+    config.addinivalue_line(
+        "markers", "net_inject: transport fault-tolerance + deterministic "
+                   "network fault-injection coverage; `pytest -m "
+                   "'net_inject and not slow'` is the tier-1 network "
+                   "robustness job alongside oom_inject (the full "
+                   "kind/schedule matrix is nightly)")
 
 
 def pytest_collection_modifyitems(config, items):

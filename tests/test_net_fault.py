@@ -1,0 +1,548 @@
+"""Transport fault-tolerance unit coverage (ISSUE 9 tentpole):
+
+- typed error taxonomy (BlockMissingError / BlockCorruptError /
+  PeerUnreachableError) replacing string matching,
+- per-frame CRC32 + the serializer envelope CRC (wire AND spill-read
+  integrity),
+- conf-driven connect/IO deadlines killing the hung-peer deadlock,
+- NetInjector determinism and the net lint.
+
+`pytest -m "net_inject and not slow"` is the tier-1 network robustness
+job; see test_net_differential.py for the bench-shape differentials.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.shuffle.netfault import (NetInjector, net_injection,
+                                               net_injector)
+from spark_rapids_tpu.shuffle.transport import (BlockCorruptError,
+                                                BlockMissingError,
+                                                LocalFsTransport,
+                                                PeerUnreachableError,
+                                                TcpTransport,
+                                                TransportError,
+                                                transport_metrics)
+
+pytestmark = pytest.mark.net_inject
+
+
+@pytest.fixture(autouse=True)
+def _net_injection_off_after():
+    """Injector state is process-wide: force it OFF after every test so
+    a failure here cannot cascade synthetic faults into other suites."""
+    yield
+    net_injector().configure("")
+    assert not net_injector().enabled
+
+
+def _client(server, **kw):
+    kw.setdefault("retries", 3)
+    kw.setdefault("connect_timeout_s", 5.0)
+    kw.setdefault("io_timeout_s", 5.0)
+    kw.setdefault("backoff_base_ms", 1.0)
+    return TcpTransport(peers={1: server.address}, **kw)
+
+
+# ---------------------------------------------------------------------------
+# typed taxonomy
+# ---------------------------------------------------------------------------
+
+def test_missing_block_is_typed_and_does_not_retry():
+    server = TcpTransport()
+    server.publish(1, 0, 0, b"present")
+    client = _client(server)
+    m0 = transport_metrics().snapshot()
+    try:
+        with pytest.raises(BlockMissingError, match="not found"):
+            client.fetch(1, 9, 9)
+        # a MISSING verdict fails over immediately: no same-peer retries
+        assert transport_metrics().snapshot()["fetchRetryCount"] == \
+            m0["fetchRetryCount"]
+        assert client.fetch(1, 0, 0) == b"present"
+    finally:
+        client.close()
+        server.close()
+
+
+def test_unreachable_peer_is_typed():
+    dead = TcpTransport()
+    dead_addr = dead.address
+    dead.close()
+    client = TcpTransport(peers={1: dead_addr}, retries=2,
+                          connect_timeout_s=2.0, io_timeout_s=2.0,
+                          backoff_base_ms=1.0)
+    try:
+        with pytest.raises(PeerUnreachableError):
+            client.fetch(3, 0, 0)
+    finally:
+        client.close()
+
+
+def test_taxonomy_is_transport_error():
+    # callers catching the base class keep working across the taxonomy
+    for cls in (BlockMissingError, BlockCorruptError,
+                PeerUnreachableError):
+        assert issubclass(cls, TransportError)
+
+
+# ---------------------------------------------------------------------------
+# frame CRC (wire integrity)
+# ---------------------------------------------------------------------------
+
+def test_frame_crc_detects_wire_corruption():
+    from spark_rapids_tpu.shuffle.transport import (_recv_frame,
+                                                    _send_frame)
+    a, b = socket.socketpair()
+    try:
+        _send_frame(a, 3, b"payload-bytes")
+        op, payload = _recv_frame(b)
+        assert (op, payload) == (3, b"payload-bytes")
+        # corrupt one payload byte on the wire: receiver must reject
+        frame = bytearray()
+        import zlib
+        body = b"payload-bytes"
+        frame += b"RTPU" + struct.pack("<BII", 3, len(body),
+                                       zlib.crc32(body) & 0xFFFFFFFF)
+        frame += body
+        frame[-3] ^= 0x10
+        a.sendall(bytes(frame))
+        c0 = transport_metrics().snapshot()["corruptFrameCount"]
+        with pytest.raises(BlockCorruptError, match="checksum"):
+            _recv_frame(b)
+        assert transport_metrics().snapshot()["corruptFrameCount"] == c0 + 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_injected_corruption_retries_same_peer_and_recovers():
+    server = TcpTransport()
+    server.publish(2, 0, 0, b"x" * 4096)
+    client = _client(server, retries=4)
+    m0 = transport_metrics().snapshot()
+    try:
+        with net_injection("every-1", fault_kind="corrupt"):
+            assert client.fetch(2, 0, 0) == b"x" * 4096
+        m1 = transport_metrics().snapshot()
+        assert m1["corruptFrameCount"] > m0["corruptFrameCount"]
+        assert m1["fetchRetryCount"] > m0["fetchRetryCount"]
+    finally:
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# serializer envelope CRC (spill-read integrity)
+# ---------------------------------------------------------------------------
+
+def test_envelope_checksum_roundtrip_and_corruption():
+    from spark_rapids_tpu.shuffle.serializer import (FrameChecksumError,
+                                                     deserialize_host,
+                                                     serialize_host)
+    arrays = {"a": np.arange(100, dtype=np.int64),
+              "b": np.linspace(0, 1, 100)}
+    frame = serialize_host(arrays, 100)
+    back, n = deserialize_host(frame)
+    assert n == 100 and np.array_equal(back["a"], arrays["a"])
+    bad = bytearray(frame)
+    bad[len(bad) // 2] ^= 0x01      # body bit-flip
+    with pytest.raises(FrameChecksumError):
+        deserialize_host(bytes(bad))
+
+
+def test_packed_frame_checksum_covers_spill_files(tmp_path):
+    from spark_rapids_tpu.memory.packed import PackedTable
+    from spark_rapids_tpu.shuffle.serializer import (FrameChecksumError,
+                                                     deserialize_host,
+                                                     frame_packed)
+    pt = PackedTable.pack({"d0": np.arange(64, dtype=np.int32)}, 64)
+    path = tmp_path / "buf-1.rtpu"
+    path.write_bytes(frame_packed(pt))
+    arrays, n = deserialize_host(path.read_bytes())   # clean spill read
+    assert n == 64
+    data = bytearray(path.read_bytes())
+    data[-5] ^= 0x80                                  # disk corruption
+    path.write_bytes(bytes(data))
+    with pytest.raises(FrameChecksumError):
+        deserialize_host(path.read_bytes())
+
+
+# ---------------------------------------------------------------------------
+# deadlines: the hung-peer deadlock (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+def _silent_server():
+    """A peer that accepts connections then never speaks again."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    stop = threading.Event()
+    held = []
+
+    def loop():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+                held.append(conn)     # accept, keep open, stay silent
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def close():
+        stop.set()
+        srv.close()
+        for c in held:
+            c.close()
+        t.join(timeout=5)
+
+    return srv.getsockname(), close
+
+
+def test_hung_peer_times_out_instead_of_hanging():
+    addr, close = _silent_server()
+    client = TcpTransport(peers={1: addr}, retries=1,
+                          connect_timeout_s=2.0, io_timeout_s=0.3,
+                          backoff_base_ms=1.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(PeerUnreachableError):
+            client.fetch(1, 0, 0)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        client.close()
+        close()
+
+
+def test_hung_peer_does_not_deadlock_concurrent_fetchers():
+    """The regression this PR fixes: _transact used to hold the per-peer
+    lock through an unbounded recv, so ONE hung peer wedged every
+    fetching thread forever. With the I/O deadline both threads resolve
+    within a bound."""
+    addr, close = _silent_server()
+    client = TcpTransport(peers={1: addr}, retries=1,
+                          connect_timeout_s=2.0, io_timeout_s=0.3,
+                          backoff_base_ms=1.0)
+    errs = []
+
+    def work():
+        try:
+            client.fetch(1, 0, 0)
+        except TransportError as ex:
+            errs.append(ex)
+
+    threads = [threading.Thread(target=work) for _ in range(2)]
+    try:
+        t0 = time.monotonic()
+        [t.start() for t in threads]
+        [t.join(timeout=10) for t in threads]
+        assert not any(t.is_alive() for t in threads), "fetcher deadlocked"
+        assert time.monotonic() - t0 < 10.0
+        assert len(errs) == 2
+        assert all(isinstance(e, PeerUnreachableError) for e in errs)
+    finally:
+        client.close()
+        close()
+
+
+def test_io_timeout_is_conf_driven():
+    from spark_rapids_tpu.config import (TRANSPORT_CONNECT_TIMEOUT_MS,
+                                         TRANSPORT_IO_TIMEOUT_MS,
+                                         RapidsTpuConf)
+    conf = RapidsTpuConf({
+        TRANSPORT_CONNECT_TIMEOUT_MS.key: "1500",
+        TRANSPORT_IO_TIMEOUT_MS.key: "250"})
+    assert conf.get(TRANSPORT_CONNECT_TIMEOUT_MS.key) == 1500
+    assert conf.get(TRANSPORT_IO_TIMEOUT_MS.key) == 250
+
+
+# ---------------------------------------------------------------------------
+# suspects + heartbeat reporting
+# ---------------------------------------------------------------------------
+
+def test_unreachable_peer_is_deprioritized_for_later_fetches():
+    dead = TcpTransport()
+    dead_addr = dead.address
+    dead.close()
+    live = TcpTransport()
+    live.publish(7, 0, 0, b"a")
+    live.publish(7, 1, 0, b"b")
+    client = TcpTransport(peers={1: dead_addr, 2: live.address},
+                          retries=1, connect_timeout_s=2.0,
+                          io_timeout_s=2.0, backoff_base_ms=1.0)
+    try:
+        t_first0 = time.monotonic()
+        assert client.fetch(7, 0, 0) == b"a"    # pays the dead peer once
+        first = time.monotonic() - t_first0
+        # the dead peer is now a suspect: later fetches try the live
+        # peer FIRST and never touch the dead one
+        assert client._ordered_peers()[0][0] == 2
+        t0 = time.monotonic()
+        assert client.fetch(7, 1, 0) == b"b"
+        assert time.monotonic() - t0 <= max(first, 0.5)
+    finally:
+        client.close()
+        live.close()
+
+
+def test_unreachable_reported_to_heartbeat_registry():
+    from spark_rapids_tpu.plugin import init
+
+    runtime = init()
+    runtime.heartbeat("exec-gone")
+    assert "exec-gone" in runtime.live_executors(timeout_s=60.0)
+    dead = TcpTransport()
+    dead_addr = dead.address
+    dead.close()
+    client = TcpTransport(peers={"exec-gone": dead_addr}, retries=1,
+                          connect_timeout_s=2.0, io_timeout_s=2.0,
+                          backoff_base_ms=1.0,
+                          on_unreachable=runtime.mark_unreachable)
+    try:
+        with pytest.raises(PeerUnreachableError):
+            client.fetch(9, 0, 0)
+        # the fetch failure reported the peer: no longer listed live
+        assert "exec-gone" not in runtime.live_executors(timeout_s=60.0)
+    finally:
+        client.close()
+
+
+def test_persistently_corrupt_peer_stays_typed_corrupt():
+    """A reachable peer that keeps serving CRC-failing bytes must
+    surface as BlockCorruptError, not PeerUnreachableError — corruption
+    on a live peer is a data-integrity problem (review finding)."""
+    import zlib
+    from spark_rapids_tpu.shuffle.transport import (_MAGIC, _VERSION,
+                                                    _recv_frame)
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    stop = threading.Event()
+
+    def rogue():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                _recv_frame(conn)                       # client HELLO
+                payload = struct.pack("<I", _VERSION)
+                conn.sendall(_MAGIC + struct.pack(     # valid handshake
+                    "<BII", 1, len(payload),
+                    zlib.crc32(payload) & 0xFFFFFFFF) + payload)
+                while True:
+                    _recv_frame(conn)                   # any request
+                    bad = b"\x00" * 8
+                    conn.sendall(_MAGIC + struct.pack(  # WRONG crc
+                        "<BII", 3, len(bad), 0xDEADBEEF) + bad)
+            except (TransportError, OSError):
+                conn.close()
+
+    t = threading.Thread(target=rogue, daemon=True)
+    t.start()
+    client = TcpTransport(peers={1: srv.getsockname()}, retries=2,
+                          connect_timeout_s=2.0, io_timeout_s=2.0,
+                          backoff_base_ms=1.0)
+    try:
+        with pytest.raises(BlockCorruptError, match="corrupt"):
+            client.fetch(1, 0, 0)
+    finally:
+        client.close()
+        stop.set()
+        srv.close()
+        t.join(timeout=5)
+
+
+def test_heartbeat_ids_are_type_agnostic():
+    """The CACHED-registry path keys peers by INT executor id while
+    in-process callers use strings — heartbeat/mark_unreachable/liveness
+    must agree across both (review finding)."""
+    from spark_rapids_tpu.plugin import init
+
+    runtime = init()
+    runtime.heartbeat(41)
+    assert "41" in runtime.live_executors(timeout_s=60.0)
+    runtime.mark_unreachable(41)
+    assert "41" not in runtime.live_executors(timeout_s=60.0)
+    # transport-side comparison normalizes too: an int-keyed peer table
+    # filters against the string-keyed registry
+    runtime.heartbeat(42)
+    t = TcpTransport(peers={42: ("127.0.0.1", 1), 43: ("127.0.0.1", 2)},
+                     liveness=runtime.live_executors)
+    try:
+        assert set(t._live_peers()) == {42}
+    finally:
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# LocalFsTransport strict filename parsing (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+def test_localfs_malformed_block_file_raises(tmp_path):
+    t = LocalFsTransport(str(tmp_path / "s"))
+    t.publish(1, 2, 0, b"ok")
+    (tmp_path / "s" / "s1-mbogus-r0.rtpu").write_bytes(b"junk")
+    with pytest.raises(TransportError, match="malformed"):
+        t.list_blocks(1, 0)
+
+
+def test_localfs_ignores_tmp_staging_files(tmp_path):
+    t = LocalFsTransport(str(tmp_path / "s"))
+    t.publish(1, 2, 0, b"ok")
+    # an in-flight publish from another process
+    (tmp_path / "s" / "s1-m3-r0.rtpu.tmp").write_bytes(b"partial")
+    assert t.list_blocks(1, 0) == [(1, 2, 0)]
+
+
+def test_localfs_rejects_negative_ids(tmp_path):
+    """A negative map id would embed an extra '-' and mis-parse (the old
+    int(name.split('-')[1][1:]) bug class) — publish refuses it."""
+    t = LocalFsTransport(str(tmp_path / "s"))
+    with pytest.raises(TransportError, match="invalid block id"):
+        t.publish(1, -3, 0, b"x")
+
+
+# ---------------------------------------------------------------------------
+# NetInjector semantics
+# ---------------------------------------------------------------------------
+
+def test_injector_every_n_schedule():
+    inj = NetInjector()
+    inj.configure("every-3", fault_kind="drop")
+    hits = [inj.decide(f"s{i}") for i in range(9)]
+    # fires on checks 3, 6, 9 — but each trigger grants the next check a
+    # free pass, consuming one slot
+    assert hits[2] == "drop"
+    assert hits.count("drop") >= 2
+    assert hits[0] is None and hits[1] is None
+
+
+def test_injector_random_is_seed_deterministic():
+    a, b = NetInjector(), NetInjector()
+    a.configure("random-0.5", seed=7)
+    b.configure("random-0.5", seed=7)
+    seq_a = [a.decide("s") for _ in range(32)]
+    seq_b = [b.decide("s") for _ in range(32)]
+    assert seq_a == seq_b
+    assert any(k is not None for k in seq_a)
+
+
+def test_injector_suppressed_scope_blocks_new_triggers():
+    inj = NetInjector()
+    inj.configure("every-1", fault_kind="drop")
+    assert inj.decide("s") == "drop"
+    with inj.suppressed():
+        assert all(inj.decide("s") is None for _ in range(8))
+
+
+def test_injector_skip_count_aims_deep():
+    inj = NetInjector()
+    inj.configure("every-1", skip_count=4, fault_kind="delay")
+    hits = [inj.decide("s") for i in range(6)]
+    assert hits[:4] == [None] * 4
+    assert hits[4] == "delay"
+
+
+def test_injector_mix_cycles_kinds():
+    inj = NetInjector()
+    inj.configure("every-1", fault_kind="mix")
+    kinds = []
+    for _ in range(8):
+        k = inj.decide("s")
+        if k is not None:
+            kinds.append(k)
+    assert kinds[:4] == ["drop", "delay", "truncate", "corrupt"]
+
+
+def test_injector_conf_plumbing():
+    """The production surface: session conf → apply_session_conf →
+    process-wide injector (same shape as injectOOM)."""
+    from spark_rapids_tpu.config import RapidsTpuConf
+    from spark_rapids_tpu.memory.retry import apply_session_conf
+    conf = RapidsTpuConf({
+        "spark.rapids.tpu.test.injectNet.mode": "every-2",
+        "spark.rapids.tpu.test.injectNet.faultKind": "corrupt"})
+    apply_session_conf(conf)
+    try:
+        assert net_injector().enabled
+        assert net_injector().decide("s") is None
+        assert net_injector().decide("s") == "corrupt"
+    finally:
+        apply_session_conf(RapidsTpuConf())
+    assert not net_injector().enabled
+
+
+# ---------------------------------------------------------------------------
+# repo lint (ISSUE 9 satellite): sockets carry deadlines, faults are
+# never silently swallowed — run in tier-1 like lint_retry
+# ---------------------------------------------------------------------------
+
+def _load_lint():
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import lint_net
+        importlib.reload(lint_net)
+        return lint_net
+    finally:
+        sys.path.pop(0)
+
+
+def test_lint_net_clean():
+    """The tree itself passes the lint — this IS the tier-1 lint job."""
+    assert _load_lint().lint() == []
+
+
+def test_lint_net_catches_violations(tmp_path):
+    lint_net = _load_lint()
+    pkg = tmp_path / "pkg"
+    (pkg / "shuffle").mkdir(parents=True)
+    (pkg / "shuffle" / "bad.py").write_text(
+        "import socket\n"
+        "def connect(addr):\n"
+        "    return socket.create_connection(addr)\n"     # no timeout
+        "def pull(sock):\n"
+        "    return sock.recv(1024)\n"                    # no settimeout
+        "def swallow(sock):\n"
+        "    try:\n"
+        "        sock.sendall(b'x')\n"
+        "    except OSError:\n"                           # swallowed
+        "        pass\n")
+    (pkg / "shuffle" / "good.py").write_text(
+        "import socket\n"
+        "def connect(addr, t):\n"
+        "    s = socket.create_connection(addr, timeout=t)\n"
+        "    s.settimeout(t)\n"
+        "    return s\n"
+        "def pull(sock):\n"
+        "    return sock.recv(1024)\n"
+        "def teardown(sock):\n"
+        "    try:\n"
+        "        sock.close()\n"
+        "    except OSError:  # net-ok: teardown\n"
+        "        pass\n")
+    problems = lint_net.lint(str(pkg))
+    assert len(problems) == 3
+    assert any("create_connection" in p for p in problems)
+    assert any(".recv()" in p for p in problems)
+    assert any("swallows" in p for p in problems)
+    assert all("bad.py" in p for p in problems)

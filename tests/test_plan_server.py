@@ -228,6 +228,430 @@ def test_external_process_server_bit_identical():
         proc.wait(timeout=10)
 
 
+# ---------------------------------------------------------------------------
+# serving-tier robustness (ISSUE 9): malformed input, deadlines, circuit
+# breaker, bounded admission, stop() cancellation
+# ---------------------------------------------------------------------------
+
+import socket
+import struct
+import threading
+
+
+def _poll(cond, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def _tiny_df():
+    t = pa.table({"x": [1, 2, 3]})
+    from spark_rapids_tpu.expressions import col, lit
+    return table(t).select((col("x") + lit(1)).alias("y"))
+
+
+def _assert_server_alive(server):
+    """The server must keep serving fresh connections and leak no
+    session slots."""
+    with PlanClient("127.0.0.1", server.port) as client:
+        assert client.collect(_tiny_df()).column("y").to_pylist() == \
+            [2, 3, 4]
+    assert _poll(lambda: server.active_sessions == 0), \
+        f"leaked sessions: {server.active_sessions}"
+
+
+def test_malformed_truncated_preamble_keeps_server_alive():
+    from spark_rapids_tpu.server import protocol
+    server = PlanServer().start()
+    try:
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=5) as s:
+            s.sendall(b"RT")              # truncated preamble, then EOF
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=5) as s:
+            s.sendall(b"XXXX" + struct.pack("<H", 1))   # bad magic
+        _assert_server_alive(server)
+    finally:
+        server.stop()
+
+
+def test_malformed_oversized_header_disconnects_cleanly():
+    from spark_rapids_tpu.server import protocol
+    server = PlanServer().start()
+    try:
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=5) as s:
+            protocol.send_preamble(s)
+            assert protocol.recv_preamble(s) == protocol.PROTOCOL_VERSION
+            # claim a header bigger than _MAX_HEADER: the server must
+            # refuse to buffer it and drop the connection
+            s.sendall(struct.pack("<I", protocol._MAX_HEADER + 1))
+            s.sendall(b"j" * 64)
+            s.settimeout(5)
+            assert s.recv(1) == b""       # clean disconnect, no reply
+        _assert_server_alive(server)
+    finally:
+        server.stop()
+
+
+def test_malformed_oversized_body_disconnects_cleanly():
+    import json
+    from spark_rapids_tpu.server import protocol
+    server = PlanServer().start()
+    try:
+        with socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=5) as s:
+            protocol.send_preamble(s)
+            protocol.recv_preamble(s)
+            h = json.dumps({"msg": "table", "name": "t"}).encode()
+            s.sendall(struct.pack("<I", len(h)) + h
+                      + struct.pack("<Q", protocol._MAX_BODY + 1))
+            s.settimeout(5)
+            assert s.recv(1) == b""       # refused before buffering 16G
+        _assert_server_alive(server)
+    finally:
+        server.stop()
+
+
+def test_invalid_plandoc_returns_error_and_keeps_session():
+    server = PlanServer().start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            with pytest.raises(PlanServerError):
+                client._request({"msg": "plan", "mode": "collect",
+                                 "plan": {"node": "no-such-node"}})
+            # same connection still serves queries
+            out = client.collect(_tiny_df())
+            assert out.column("y").to_pylist() == [2, 3, 4]
+        _assert_server_alive(server)
+    finally:
+        server.stop()
+
+
+def test_query_deadline_watchdog_returns_retryable_error():
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.test.collectDelayMs": 2000}).start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            t0 = time.monotonic()
+            with pytest.raises(PlanServerError) as ei:
+                client.collect(_tiny_df(), timeout_ms=150)
+            assert time.monotonic() - t0 < 1.5     # watchdog, not delay
+            assert ei.value.retryable and ei.value.timeout
+            assert "deadline" in str(ei.value)
+        # the cancelled worker drains (cooperative cancel at the delay
+        # loop) and fresh sessions work
+        assert _poll(lambda: server.active_query_count == 0)
+        _assert_server_alive(server)
+    finally:
+        server.stop()
+
+
+def test_watchdog_supervised_error_carries_worker_traceback():
+    """The failure happens on the watchdog WORKER thread — the reply
+    must carry that thread's traceback, not the handler's empty one
+    (review finding: 'NoneType: None')."""
+    server = PlanServer().start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            from spark_rapids_tpu.expressions import col
+            t = pa.table({"x": [1, 2, 3]})
+            bad = table(t).select(col("nope"))
+            with pytest.raises(PlanServerError) as ei:
+                client.collect(bad, timeout_ms=30000)   # watchdog path
+            assert "nope" in str(ei.value)
+            assert "Traceback" in ei.value.remote_traceback
+            assert "NoneType: None" not in ei.value.remote_traceback
+    finally:
+        server.stop()
+
+
+def test_default_query_timeout_conf():
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.test.collectDelayMs": 2000,
+        "spark.rapids.tpu.server.queryTimeoutMs": 150}).start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            with pytest.raises(PlanServerError) as ei:
+                client.collect(_tiny_df())      # no per-plan timeout
+            assert ei.value.retryable and ei.value.timeout
+    finally:
+        server.stop()
+
+
+def test_circuit_breaker_answers_unavailable():
+    def sick():
+        raise RuntimeError("executor poisoned by earlier fatal error")
+
+    server = PlanServer(health_check=sick).start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            with pytest.raises(PlanServerError) as ei:
+                client.collect(_tiny_df())
+            assert ei.value.unavailable and ei.value.retryable
+            assert ei.value.retry_after_ms == 1000    # conf default
+            assert "unavailable" in str(ei.value)
+            # non-plan traffic (table upload) still flows: the breaker
+            # guards the device, not the control plane
+            from spark_rapids_tpu.server import protocol
+            client._request({"msg": "table", "name": "t"},
+                            protocol.table_to_ipc(pa.table({"x": [1]})))
+    finally:
+        server.stop()
+
+
+def test_fatal_device_error_opens_breaker_via_runtime():
+    """A plan submitted AFTER an injected fatal device error gets a
+    structured unavailable reply, not a dead connection (ISSUE 9
+    acceptance)."""
+    from spark_rapids_tpu.plugin import init
+
+    runtime = init()
+    server = PlanServer().start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            assert client.collect(_tiny_df()).num_rows == 3   # healthy
+            runtime.on_task_failed(
+                RuntimeError("device is in an invalid state"))
+            with pytest.raises(PlanServerError) as ei:
+                client.collect(_tiny_df())
+            assert ei.value.unavailable
+            assert ei.value.retry_after_ms is not None
+            # recovery: a replaced/healthy runtime closes the breaker
+            runtime.fatal_error = None
+            assert client.collect(_tiny_df()).num_rows == 3
+    finally:
+        runtime.fatal_error = None
+        server.stop()
+
+
+def test_validation_error_with_fatal_marker_text_cannot_poison_runtime():
+    """Fatal-marker classification is substring-based; a request whose
+    ECHOED text contains a marker (e.g. an unknown mode named 'halted')
+    must stay a per-request error — only execution-phase failures may
+    open the breaker (review finding: one crafted message must not DoS
+    every session)."""
+    from spark_rapids_tpu.plugin import init
+
+    runtime = init()
+    assert runtime.fatal_error is None
+    server = PlanServer().start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            doc = client._serialize(_tiny_df())
+            with pytest.raises(PlanServerError, match="halted"):
+                client._request({"msg": "plan", "mode": "halted",
+                                 "plan": doc})
+            assert runtime.fatal_error is None, \
+                "validation error poisoned the executor"
+            assert client.collect(_tiny_df()).num_rows == 3
+    finally:
+        runtime.fatal_error = None
+        server.stop()
+
+
+def test_binding_error_echoing_fatal_marker_cannot_poison_runtime():
+    """Bind-phase failures echo client-chosen COLUMN NAMES; a column
+    literally named after a fatal marker must stay a per-request error
+    (review finding: binding happens inside collect, so the exec-phase
+    tag needs planning to succeed first)."""
+    from spark_rapids_tpu.plugin import init
+
+    runtime = init()
+    assert runtime.fatal_error is None
+    server = PlanServer().start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            from spark_rapids_tpu.expressions import col
+            t = pa.table({"x": [1, 2, 3]})
+            bad = table(t).select(
+                col("zz device is in an invalid state zz"))
+            with pytest.raises(PlanServerError):
+                client.collect(bad)
+            assert runtime.fatal_error is None, \
+                "binding error poisoned the executor"
+            assert client.collect(_tiny_df()).num_rows == 3
+    finally:
+        runtime.fatal_error = None
+        server.stop()
+
+
+def test_abandoned_worker_still_counts_against_max_sessions(monkeypatch):
+    """On deadline overrun the admission slot transfers to the worker:
+    an abandoned, still-collecting query keeps counting against
+    maxSessions until it actually ends (review finding: otherwise a
+    timeout loop runs unboundedly many concurrent collects)."""
+    from spark_rapids_tpu.server import server as server_mod
+
+    release = threading.Event()
+    real_dispatch = server_mod._Handler._dispatch
+
+    def stuck_dispatch(self, header, body, tables, conf, cancelled):
+        if header.get("msg") == "plan":
+            release.wait(20)        # uncancellable in-flight collect
+        return real_dispatch(self, header, body, tables, conf, cancelled)
+
+    monkeypatch.setattr(server_mod._Handler, "_dispatch", stuck_dispatch)
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.maxSessions": 1}).start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            with pytest.raises(PlanServerError) as ei:
+                client.collect(_tiny_df(), timeout_ms=150)
+            assert ei.value.timeout
+        # the session closed, but its abandoned worker holds the slot
+        with pytest.raises(PlanServerError) as ei2:
+            PlanClient("127.0.0.1", server.port)
+        assert ei2.value.unavailable
+        release.set()               # the collect finally ends
+
+        def admitted():
+            try:
+                with PlanClient("127.0.0.1", server.port):
+                    return True
+            except PlanServerError:
+                return False
+
+        assert _poll(admitted, timeout_s=10), \
+            "slot never released after the worker finished"
+    finally:
+        release.set()
+        server.stop()
+
+
+def test_invalid_timeout_ms_gets_structured_error():
+    server = PlanServer().start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            doc, tables = plandoc.plan_to_doc(_tiny_df().plan)
+            with pytest.raises(PlanServerError, match="timeout_ms"):
+                client._request({"msg": "plan", "mode": "collect",
+                                 "plan": doc, "timeout_ms": "soon"})
+            # per-request isolation: the session survives
+            assert client.collect(_tiny_df()).num_rows == 3
+    finally:
+        server.stop()
+
+
+def test_explicit_timeout_ms_zero_means_unbounded():
+    """timeout_ms=0 must override the server default (the conf documents
+    0 = unbounded), not silently coalesce into it."""
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.test.collectDelayMs": 400,
+        "spark.rapids.tpu.server.queryTimeoutMs": 150}).start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as client:
+            out = client.collect(_tiny_df(), timeout_ms=0)   # no watchdog
+            assert out.column("y").to_pylist() == [2, 3, 4]
+    finally:
+        server.stop()
+
+
+def test_silent_connection_does_not_hold_admission_slot():
+    """A connect that never sends its preamble (slowloris) must not pin
+    a maxSessions slot for the idle timeout (review finding)."""
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.maxSessions": 1}).start()
+    silent = socket.create_connection(("127.0.0.1", server.port),
+                                      timeout=5)
+    try:
+        time.sleep(0.1)               # handler is parked in recv_preamble
+        _assert_server_alive(server)  # the one slot is still free
+    finally:
+        silent.close()
+        server.stop()
+
+
+def test_max_sessions_admission_bound():
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.maxSessions": 1}).start()
+    try:
+        with PlanClient("127.0.0.1", server.port) as c1:
+            assert _poll(lambda: server.active_sessions == 1)
+            with pytest.raises(PlanServerError) as ei:
+                PlanClient("127.0.0.1", server.port)
+            assert ei.value.unavailable and ei.value.retryable
+            assert "maxSessions" in str(ei.value)
+            assert c1.collect(_tiny_df()).num_rows == 3   # c1 unaffected
+        # slot released: a new session is admitted
+        assert _poll(lambda: server.active_sessions == 0)
+        _assert_server_alive(server)
+    finally:
+        server.stop()
+
+
+def test_rejected_handshake_closes_client_socket(monkeypatch):
+    """The maxSessions retry dance must not leak a socket per rejected
+    PlanClient construction (review finding)."""
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.maxSessions": 1}).start()
+    created = []
+    real_create = socket.create_connection
+
+    def spy(*a, **kw):
+        s = real_create(*a, **kw)
+        created.append(s)
+        return s
+
+    monkeypatch.setattr(socket, "create_connection", spy)
+    try:
+        with PlanClient("127.0.0.1", server.port):
+            with pytest.raises(PlanServerError):
+                PlanClient("127.0.0.1", server.port)   # over the bound
+        assert all(s.fileno() == -1 for s in created), \
+            "rejected handshake leaked an open socket"
+    finally:
+        server.stop()
+
+
+def test_stop_cancels_in_flight_query():
+    """An in-flight query must not hold its thread past stop(): the
+    cancel flag + connection close unblock the handler and the worker
+    joins within the grace period (ISSUE 9 satellite)."""
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.test.collectDelayMs": 30000}).start()
+    errs = []
+
+    def submit():
+        try:
+            with PlanClient("127.0.0.1", server.port) as client:
+                client.collect(_tiny_df(), timeout_ms=60000)
+        except Exception as e:    # noqa: BLE001 — recorded for assert
+            errs.append(e)
+
+    t = threading.Thread(target=submit, daemon=True)
+    t.start()
+    try:
+        assert _poll(lambda: server.active_query_count == 1,
+                     timeout_s=10.0), "query never started"
+        t0 = time.monotonic()
+        server.stop(grace_s=5.0)
+        assert time.monotonic() - t0 < 8.0, "stop() blocked on the query"
+        assert server.active_query_count == 0, "query thread leaked"
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert errs, "client should observe the cancelled session"
+    finally:
+        if t.is_alive():
+            t.join(timeout=1)
+
+
+def test_readiness_line_reports_bound_port():
+    from spark_rapids_tpu.server.server import readiness_line
+    server = PlanServer().start()
+    try:
+        line = readiness_line(server)
+        m = re.search(r"listening on ([\d.]+):(\d+)$", line)
+        assert m, line
+        assert m.group(1) == "127.0.0.1"
+        assert int(m.group(2)) == server.port != 0
+    finally:
+        server.stop()
+
+
 def test_plandoc_window_expression():
     """Window specs (plain dataclasses riding the expression tree) must
     cross the wire; VERDICT's front-end must cover the full dialect."""

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Repo lint: sockets must carry deadlines; transport faults must not
+be silently swallowed.
+
+The network twin of tools/lint_retry.py, enforced over the AST:
+
+1. **Connect without a deadline** — anywhere in ``spark_rapids_tpu/``,
+   a ``create_connection(...)`` call must pass an explicit ``timeout=``
+   keyword. A connect with no deadline blocks a fetching thread for
+   the kernel default (minutes) when a peer dies between accept and
+   SYN-ACK — exactly the hang the transport deadlines exist to kill.
+
+2. **Recv without a deadline discipline** — in the transport planes
+   (``spark_rapids_tpu/{shuffle,server}/``), a ``.recv(...)`` call is
+   only allowed in a module that also calls ``settimeout(...)``
+   somewhere (the socket's deadline is set at connect/accept time), or
+   under a ``# net-ok: <reason>`` pragma naming who owns the deadline.
+
+3. **Swallowed transport fault** — in ``spark_rapids_tpu/{shuffle,
+   server}/``, an ``except`` handler that catches the OS fault family
+   (``OSError``, ``ConnectionError``, ``TimeoutError``,
+   ``socket.timeout``, ``BrokenPipeError``, ``ConnectionResetError``)
+   must re-raise something or carry the pragma. Silently eating a
+   transport fault hides it from the retry/failover taxonomy AND
+   corrupts the injection suite (a swallowed injected fault looks like
+   success).
+
+Escape hatch: a ``# net-ok: <reason>`` comment on the flagged line, in
+the enclosing function's span (rules 1-2), or in the handler's span
+(rule 3). The reason is mandatory and should name the deadline owner /
+why the swallow is the correct reply (e.g. server-side teardown).
+
+Exit status 0 = clean, 1 = violations (printed one per line). Runs in
+the tier-1 flow via tests/test_net_fault.py::test_lint_net_clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "spark_rapids_tpu")
+
+#: the transport planes rules 2-3 police; file-I/O OSError handling in
+#: io//plan//utils/ is a different (non-socket) concern
+NET_DIRS = ("shuffle", "server")
+
+FAULT_NAMES = {"OSError", "ConnectionError", "TimeoutError", "timeout",
+               "BrokenPipeError", "ConnectionResetError",
+               "ConnectionRefusedError", "ConnectionAbortedError"}
+
+PRAGMA = "# net-ok:"
+
+
+def _span_has_pragma(lines: List[str], lo: int, hi: int) -> bool:
+    return any(PRAGMA in lines[i - 1]
+               for i in range(max(lo, 1), min(hi, len(lines)) + 1))
+
+
+def _enclosing_spans(tree: ast.AST):
+    """(node, [enclosing function nodes]) for every node."""
+    out = []
+
+    def visit(node, chain):
+        here = chain + [node] if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+            else chain
+        out.append((node, chain))
+        for child in ast.iter_child_nodes(node):
+            visit(child, here)
+
+    visit(tree, [])
+    return out
+
+
+def _pragma_ok(lines: List[str], node: ast.AST,
+               chain: List[ast.AST]) -> bool:
+    lo, hi = node.lineno, node.end_lineno or node.lineno
+    if _span_has_pragma(lines, lo, hi):
+        return True
+    if chain:
+        fn = chain[-1]
+        return _span_has_pragma(lines, fn.lineno,
+                                fn.end_lineno or fn.lineno)
+    return False
+
+
+def _call_attr(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def lint_file(path: str, rel: str, net_plane: bool) -> List[str]:
+    src = open(path).read()
+    lines = src.splitlines()
+    tree = ast.parse(src, filename=path)
+    problems: List[str] = []
+    module_sets_timeout = any(
+        isinstance(n, ast.Call) and _call_attr(n) == "settimeout"
+        for n in ast.walk(tree))
+
+    for node, chain in _enclosing_spans(tree):
+        if isinstance(node, ast.Call):
+            name = _call_attr(node)
+            if name == "create_connection":
+                if not any(kw.arg == "timeout" for kw in node.keywords) \
+                        and not _pragma_ok(lines, node, chain):
+                    problems.append(
+                        f"{rel}:{node.lineno}: create_connection without "
+                        f"timeout= — an unreachable peer blocks the "
+                        f"caller for the kernel default (pass the conf "
+                        f"deadline, or annotate '{PRAGMA} <reason>')")
+            elif name == "recv" and net_plane and \
+                    isinstance(node.func, ast.Attribute):
+                if not module_sets_timeout \
+                        and not _pragma_ok(lines, node, chain):
+                    problems.append(
+                        f"{rel}:{node.lineno}: .recv() in a module that "
+                        f"never calls settimeout — a silent peer hangs "
+                        f"this thread forever (set the socket deadline, "
+                        f"or annotate '{PRAGMA} <who owns the "
+                        f"deadline>')")
+        elif isinstance(node, ast.ExceptHandler) and net_plane:
+            t = node.type
+            caught = set()
+            if t is not None:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    n = e.id if isinstance(e, ast.Name) else \
+                        e.attr if isinstance(e, ast.Attribute) else None
+                    if n in FAULT_NAMES:
+                        caught.add(n)
+            if t is None:
+                caught.add("<bare except>")
+            if not caught:
+                continue
+            if any(isinstance(sub, ast.Raise) for sub in ast.walk(node)):
+                continue
+            if _span_has_pragma(lines, node.lineno,
+                                node.end_lineno or node.lineno):
+                continue
+            problems.append(
+                f"{rel}:{node.lineno}: except "
+                f"{'/'.join(sorted(caught))} swallows a transport fault "
+                f"without re-raising — the retry/failover taxonomy (and "
+                f"the net-injection suite) never sees it (re-raise, or "
+                f"annotate '{PRAGMA} <reason>')")
+    return problems
+
+
+def lint(pkg_dir: str = PKG) -> List[str]:
+    problems: List[str] = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        sub = os.path.relpath(root, pkg_dir).split(os.sep)[0]
+        net_plane = sub in NET_DIRS
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            problems += lint_file(path, rel, net_plane)
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\nlint_net: {len(problems)} violation(s)")
+        return 1
+    print("lint_net: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
