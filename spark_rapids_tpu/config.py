@@ -564,6 +564,50 @@ SERVER_RETRY_AFTER_MS = conf("spark.rapids.tpu.server.retryAfterMs").doc(
     "retry_after_ms hint carried on plan-server 'unavailable' replies "
     "(circuit breaker open, maxSessions exceeded).").integer(1000)
 
+SERVER_PLAN_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.server.planCache.enabled").doc(
+    "Memoize planning (tag/CBO outcomes + fusion/mesh eligibility) per "
+    "plan-shape fingerprint, so a repeated query shape skips the planner "
+    "walks; literals are parameterized out of the fingerprint under "
+    "value-insensitive parents, and capacity buckets keep the rebuilt "
+    "plan's jitted kernels hitting XLA's compile cache across sessions "
+    "(docs/serving.md).").boolean(True)
+
+SERVER_PLAN_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.server.planCache.maxEntries").doc(
+    "LRU entry bound of the planning cache.").integer(256)
+
+SERVER_RESULT_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.server.resultCache.enabled").doc(
+    "Serve bit-for-bit repeated queries from an LRU over serialized "
+    "results, keyed on (literal-inclusive plan fingerprint, per-table "
+    "content digests, conf); invalidated on drop_table/re-upload. Only "
+    "plans whose scans are in-memory tables are eligible "
+    "(docs/serving.md).").boolean(False)
+
+SERVER_RESULT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.server.resultCache.maxBytes").doc(
+    "Byte budget of the result-set cache; least-recently-used entries "
+    "evict past it, and a single result larger than the budget is never "
+    "stored.").bytes_(256 << 20)
+
+SERVER_CONCURRENT_COLLECTS = conf(
+    "spark.rapids.tpu.server.concurrentCollects").doc(
+    "In-flight collect bound at the plan server: per-query admission "
+    "(semaphore + a per-query device-memory reservation against the "
+    "buffer catalog) replaces the coarse maxSessions slot as the "
+    "execution throttle, so independent tenants overlap H2D/compute/D2H "
+    "instead of queueing head-of-line (reference: concurrentGpuTasks "
+    "applied at the serving tier).").integer(4)
+
+SERVER_QUERY_RESERVE_BYTES = conf(
+    "spark.rapids.tpu.server.queryReserveBytes").doc(
+    "Device-memory reservation each admitted query takes against the "
+    "buffer catalog before executing (0 = auto: the plan's logical size "
+    "estimate, capped at 1/concurrentCollects of the device budget). "
+    "The reservation triggers spill like any allocation and is released "
+    "when the collect ends.").bytes_(0)
+
 SERVER_TEST_COLLECT_DELAY_MS = conf(
     "spark.rapids.tpu.server.test.collectDelayMs").doc(
     "Test-only: stall each plan collect this long (in cancellable "

@@ -20,6 +20,7 @@ stddev/variance→double (Welford/Chan parallel merge).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -45,25 +46,32 @@ from .base import EvalContext, Expression
 # was rejected earlier because its unrolled HLO stalls the remote
 # compiler at 4M rows; the fori_loop body is traced once.)
 
-_SEG_BOUNDS = None
+#: THREAD-LOCAL: the bounds are traced arrays published mid-trace, and
+#: the serving tier runs N concurrent collects over one process
+#: (server.concurrentCollects) — a module global here let one thread's
+#: tracer leak into another's trace (UnexpectedTracerError under the
+#: concurrent-client load test)
+_SEG_TL = threading.local()
+
+
+def _seg_bounds():
+    return getattr(_SEG_TL, "bounds", None)
 
 
 class segment_bounds:
     """Trace-time context: group-slot (start_row, end_row) bounds over the
     key-sorted batch, published by HashAggregateExec for the duration of
-    the agg.update/merge calls."""
+    the agg.update/merge calls (per thread; see _SEG_TL)."""
 
     def __init__(self, starts, ends):
         self._b = (starts, ends)
 
     def __enter__(self):
-        global _SEG_BOUNDS
-        self._prev = _SEG_BOUNDS
-        _SEG_BOUNDS = self._b
+        self._prev = _seg_bounds()
+        _SEG_TL.bounds = self._b
 
     def __exit__(self, *a):
-        global _SEG_BOUNDS
-        _SEG_BOUNDS = self._prev
+        _SEG_TL.bounds = self._prev
 
 
 def _seg_scan_reduce(x, seg, identity, op):
@@ -346,7 +354,7 @@ _MINMAX_F64_KINDS = frozenset({
 
 
 def _at_group_starts(vals, default):
-    starts, ends = _SEG_BOUNDS
+    starts, ends = _seg_bounds()
     out = jnp.take(vals, jnp.clip(starts, 0, vals.shape[0] - 1))
     return jnp.where(ends >= starts, out, default)
 
@@ -356,7 +364,7 @@ def _at_group_starts(vals, default):
 # (keyless aggregation under a fused filter mask interleaves the dead
 # sentinel between live ids).
 def _seg_sum(x, seg, cap):
-    if _SEG_BOUNDS is not None:
+    if _seg_bounds() is not None:
         # Round-3 rework (docs/perf_r3.md): segmented sum over key-sorted
         # rows = ONE cumsum + a window difference at the published group
         # bounds. cumsum is 3–19 ms per 4M f64 rows where the emulated-
@@ -367,7 +375,7 @@ def _seg_sum(x, seg, cap):
         # the (start=1, end=0) convention: c[0]-c[1]+x[1] == 0.
         if x.dtype == jnp.bool_:
             x = x.astype(jnp.int32)
-        starts, ends = _SEG_BOUNDS
+        starts, ends = _seg_bounds()
         n = x.shape[0]
         s = jnp.clip(starts, 0, n - 1)
         if jnp.issubdtype(x.dtype, jnp.floating):
@@ -398,7 +406,7 @@ def _minmax_identity(dtype, is_min: bool):
 
 
 def _seg_min(x, seg, cap):
-    if _SEG_BOUNDS is not None:
+    if _seg_bounds() is not None:
         ident = _minmax_identity(x.dtype, True)
         suf = _seg_scan_reduce(x, seg, ident, jnp.minimum)
         return _at_group_starts(suf, ident)
@@ -406,7 +414,7 @@ def _seg_min(x, seg, cap):
 
 
 def _seg_max(x, seg, cap):
-    if _SEG_BOUNDS is not None:
+    if _seg_bounds() is not None:
         ident = _minmax_identity(x.dtype, False)
         suf = _seg_scan_reduce(x, seg, ident, jnp.maximum)
         return _at_group_starts(suf, ident)

@@ -71,6 +71,100 @@ class TpuSemaphore:
             self.release_if_held()
 
 
+class AdmissionCancelledError(RuntimeError):
+    """The caller's cancel flag fired while waiting for admission."""
+
+
+class QueryAdmission:
+    """Serving-tier per-query admission (plan server): a collect-slot
+    semaphore (``spark.rapids.tpu.server.concurrentCollects``) plus a
+    per-query device-memory reservation against the buffer catalog.
+
+    The slot bounds how many collects are in flight over one device so
+    independent tenants overlap H2D/compute/D2H; the reservation makes a
+    query's footprint visible to the catalog BEFORE it allocates, so
+    admission — not the middle of a kernel — is where spill pressure is
+    applied. Inside the collect the PR 7 retry machinery still owns the
+    fine-grained story: on OOM it drains the process TpuSemaphore across
+    its backoff and re-runs, with this query's reservation already
+    counted in the budget it retries against."""
+
+    def __init__(self, max_concurrent: int, catalog=None):
+        self.max_concurrent = max(1, int(max_concurrent))
+        self._sem = threading.BoundedSemaphore(self.max_concurrent)
+        self._catalog = catalog
+        self._lock = threading.Lock()
+        self.wait_time_ns = 0          # slot + reservation wait, summed
+        self.admitted_count = 0
+        self.in_flight = 0
+
+    def _cat(self):
+        if self._catalog is None:
+            from .catalog import device_budget
+            self._catalog = device_budget()
+        return self._catalog
+
+    @contextmanager
+    def admit(self, reserve_bytes: int = 0,
+              cancelled: Optional[callable] = None,
+              poll_s: float = 0.01):
+        """Block until a slot AND the reservation are both held; a true
+        ``cancelled()`` while waiting raises AdmissionCancelledError.
+        Reservation failures (OutOfBudgetError after spilling) back off
+        and retry — admission pressure queues, it does not fail the
+        query."""
+        import time
+        from .catalog import OutOfBudgetError
+        # an explicit reservation larger than the whole device budget
+        # could never be satisfied — the wait loop would spin forever
+        # holding a collect slot; cap it (the reservation is admission
+        # accounting, not a guarantee of exclusive HBM)
+        reserve_bytes = min(int(reserve_bytes), self._cat().device_limit)
+        t0 = time.perf_counter_ns()
+        while not self._sem.acquire(timeout=poll_s):
+            if cancelled is not None and cancelled():
+                self._note_wait(t0)
+                raise AdmissionCancelledError(
+                    "cancelled while waiting for a collect slot")
+        reserved = 0
+        try:
+            while reserve_bytes > 0:
+                if cancelled is not None and cancelled():
+                    # count the aborted wait too: long waits are exactly
+                    # the ones the admission-pressure metric must see
+                    self._note_wait(t0)
+                    raise AdmissionCancelledError(
+                        "cancelled while waiting for the memory "
+                        "reservation")
+                try:
+                    self._cat().reserve(reserve_bytes)
+                    reserved = reserve_bytes
+                    break
+                except OutOfBudgetError:  # retry-ok: admission QUEUES on
+                    # budget pressure by design — the query has not
+                    # started, there are no pins to restore, and the
+                    # retry framework takes over once it executes
+                    time.sleep(poll_s)
+            self._note_wait(t0)
+            with self._lock:
+                self.admitted_count += 1
+                self.in_flight += 1
+            try:
+                yield
+            finally:
+                with self._lock:
+                    self.in_flight -= 1
+        finally:
+            if reserved:
+                self._cat().unreserve(reserved)
+            self._sem.release()
+
+    def _note_wait(self, t0: int) -> None:
+        import time
+        with self._lock:
+            self.wait_time_ns += time.perf_counter_ns() - t0
+
+
 _GLOBAL: Optional[TpuSemaphore] = None
 _GLOBAL_LOCK = threading.Lock()
 

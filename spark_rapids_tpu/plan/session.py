@@ -23,6 +23,19 @@ class Session:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = RapidsTpuConf(conf)
         self.last_plan = None          # captured physical plan (exec tree)
+        #: how the serving caches treated the last query:
+        #: {"plan": hit|miss|uncacheable: ..., "result": hit|miss|off|...}
+        self.last_cache: Dict[str, str] = {}
+        #: (df, (key, digests) | None) kept between try_cached_result and
+        #: the collect that consumes it (the server splits those calls)
+        self._rc_state = None
+        #: (execs, fell_back) of the run a cached result was stored from
+        self._cached_serve = None
+        #: raw Arrow IPC bytes of the last cached serve (b"" otherwise)
+        self.last_result_ipc: bytes = b""
+        #: (df, encode_plan result | Uncacheable) — ONE plandoc walk per
+        #: query feeds both the result key and the shape fingerprint
+        self._doc_memo = None
         from ..dictenc import fallback_mark
         # watermark: dict_fallbacks() reports only reasons recorded on
         # THIS session's watch (the store itself is process-wide)
@@ -47,61 +60,247 @@ class Session:
             # plan as if a TPU were present, execute on CPU
             self.last_plan = Overrides(self.conf).plan(df.plan)
             return "interpret", None
-        plan = Overrides(self.conf).plan(df.plan)
+        from ..config import SERVER_PLAN_CACHE_ENABLED
+        fp = None
+        if self.conf.get(SERVER_PLAN_CACHE_ENABLED.key):
+            from . import plancache
+            try:
+                fp = plancache.shape_fingerprint(
+                    df.plan, self.conf, encoded=self._encoded_plan(df))
+            except plancache.Uncacheable as e:
+                # never silent: the reason rides the cache-info surface
+                self.last_cache["plan"] = f"uncacheable: {e.reason}"
+            if fp is not None:
+                decisions = plancache.planning_cache().get(fp)
+                if decisions is not None:
+                    prepared = self._plan_from_decisions(df, decisions)
+                    if prepared is not None:
+                        plancache.metrics().note("plan_hits")
+                        self.last_cache["plan"] = "hit"
+                        return prepared
+        return self._plan_fresh(df, fp)
+
+    def _plan_fresh(self, df: DataFrame, fp: Optional[str]):
+        """The uncached planning pipeline; when ``fp`` is set, the
+        tag/CBO outcome and the fusion/mesh eligibility land in the
+        process planning cache for the next same-shape query."""
+        ov = Overrides(self.conf)
+        plan = ov.plan(df.plan)
         self.last_plan = plan
         from .overrides import CpuFallbackExec as _CFE
+        kind = "exec"
+        mesh_eligible = fuse_eligible = False
         if isinstance(plan, _CFE):
             # CPU-topped plan: stay on the host (no device round-trip for
             # the final island — required for device-unsupported types)
+            kind = "fallback"
+        else:
+            from ..shuffle.manager import get_shuffle_manager
+            lowered_done = False
+            if get_shuffle_manager(self.conf).wants_mesh_lowering:
+                # ICI shuffle mode: fuse the planned query onto ONE SPMD
+                # mesh program (exchanges → XLA collectives); unsupported
+                # plan shapes keep the host-mediated exchanges
+                from ..parallel.lowering import try_lower_to_mesh
+                lowered = try_lower_to_mesh(plan, self._mesh())
+                if lowered is not None:
+                    plan = lowered
+                    self.last_plan = plan
+                    mesh_eligible = lowered_done = True
+            if not lowered_done:
+                from ..config import FUSION_ENABLED
+                if self.conf.get(FUSION_ENABLED.key):
+                    # whole-stage fusion: an eligible linear single-batch
+                    # stage runs as ONE XLA program (overflow-flag retries
+                    # inside FusedStage.run); ineligible shapes keep the
+                    # iterator path
+                    from ..exec.fuse import try_fuse_exec
+                    fused = try_fuse_exec(plan)
+                    if fused is not None:
+                        plan = fused
+                        self.last_plan = plan
+                        fuse_eligible = True
+        if fp is not None:
+            from ..config import SERVER_PLAN_CACHE_MAX_ENTRIES
+            from . import plancache
+            plancache.metrics().note("plan_misses")
+            self.last_cache["plan"] = "miss"
+            plancache.planning_cache().put(
+                fp,
+                plancache.PlanDecisions(
+                    plancache.collect_reasons(ov.last_meta),
+                    fuse_eligible=fuse_eligible,
+                    mesh_eligible=mesh_eligible),
+                max_entries=int(
+                    self.conf.get(SERVER_PLAN_CACHE_MAX_ENTRIES.key)))
+        return kind, plan
+
+    def _plan_from_decisions(self, df: DataFrame, decisions):
+        """Planning-cache hit: replay the cached tag/CBO outcome onto a
+        fresh meta tree and REBUILD the physical execs (exec trees are
+        stateful and never shared between collects). Fusion/mesh lowering
+        run only when the cached shape proved eligible — and both
+        re-validate, so a same-bucket input that no longer qualifies
+        degrades to the iterator path instead of misexecuting. Returns
+        None on a replay mismatch (fingerprint collision guard)."""
+        from . import plancache
+        from .overrides import CpuFallbackExec as _CFE
+        from .overrides import PlanMeta, insert_coalesce_transitions
+        ov = Overrides(self.conf)
+        meta = PlanMeta(df.plan, self.conf)
+        if not plancache.apply_reasons(meta, decisions.reasons):
+            return None
+        ov.last_meta = meta
+        from ..config import COALESCE_MAX_ROWS
+        plan = insert_coalesce_transitions(
+            ov._convert(meta), self.conf.batch_size_bytes,
+            max_rows=int(self.conf.get(COALESCE_MAX_ROWS.key)))
+        self.last_plan = plan
+        if isinstance(plan, _CFE):
             return "fallback", plan
-        from ..shuffle.manager import get_shuffle_manager
-        if get_shuffle_manager(self.conf).wants_mesh_lowering:
-            # ICI shuffle mode: fuse the planned query onto ONE SPMD mesh
-            # program (exchanges → XLA collectives); unsupported plan
-            # shapes keep the host-mediated exchanges
-            from ..parallel.lowering import try_lower_to_mesh
-            lowered = try_lower_to_mesh(plan, self._mesh())
-            if lowered is not None:
-                plan = lowered
-                self.last_plan = plan
-                return "exec", plan
-        from ..config import FUSION_ENABLED
-        if self.conf.get(FUSION_ENABLED.key):
-            # whole-stage fusion: an eligible linear single-batch stage
-            # runs as ONE XLA program (overflow-flag retries inside
-            # FusedStage.run); ineligible shapes keep the iterator path
+        if decisions.mesh_eligible:
+            from ..shuffle.manager import get_shuffle_manager
+            if get_shuffle_manager(self.conf).wants_mesh_lowering:
+                from ..parallel.lowering import try_lower_to_mesh
+                lowered = try_lower_to_mesh(plan, self._mesh())
+                if lowered is not None:
+                    self.last_plan = lowered
+                    return "exec", lowered
+        if decisions.fuse_eligible:
             from ..exec.fuse import try_fuse_exec
             fused = try_fuse_exec(plan)
             if fused is not None:
-                plan = fused
-                self.last_plan = plan
+                self.last_plan = fused
+                return "exec", fused
         return "exec", plan
+
+    def _watermark(self) -> None:
+        """Snapshot every process-wide counter group ONCE per collect,
+        regardless of which execution path runs (exec / interpret /
+        fallback / cached serve) — an interpret collect after an exec one
+        must report deltas against ITS OWN start, not the older exec
+        watermark."""
+        from ..exec.python_exec import _python_semaphore
+        from ..memory.retry import metrics as _retry_metrics
+        from ..shuffle.transport import transport_metrics
+        from . import plancache
+        self._retry0 = _retry_metrics().snapshot()
+        self._net0 = transport_metrics().snapshot()
+        self._sem_wait0 = _python_semaphore.wait_time_ns
+        self._cache0 = plancache.metrics().snapshot()
+
+    def try_cached_result(self, df: DataFrame) -> Optional[pa.Table]:
+        """Serving-tier fast path: consult the result cache WITHOUT
+        planning. Returns the cached table (bit-for-bit: the stored
+        Arrow IPC bytes of the original run) or None; the computed key
+        is kept so the collect() that follows stores under it."""
+        from . import plancache
+        self.last_cache = {}
+        self._cached_serve = None
+        self.last_result_ipc = b""
+        self._watermark()
+        kd = self._result_cache_key(df)
+        self._rc_state = (df, kd)
+        if kd is None:
+            return None
+        entry = plancache.result_cache().get(kd[0])
+        if entry is None:
+            plancache.metrics().note("result_misses")
+            self.last_cache["result"] = "miss"
+            return None
+        plancache.metrics().note("result_hits")
+        self.last_cache["result"] = "hit"
+        self.last_plan = None
+        self._cached_serve = (list(entry.execs), list(entry.fell_back))
+        #: the stored bytes, so the server can forward them verbatim
+        #: (bit-for-bit serving without a decode/re-encode round trip)
+        self.last_result_ipc = entry.ipc
+        self._rc_state = None
+        from ..server import protocol
+        return protocol.ipc_to_table(entry.ipc)
+
+    def _encoded_plan(self, df: DataFrame):
+        """Memoized plancache.encode_plan for the current query: one
+        plandoc walk feeds both cache keys. Raises (and re-raises the
+        memoized) Uncacheable."""
+        from . import plancache
+        memo = self._doc_memo
+        if memo is not None and memo[0] is df:
+            if isinstance(memo[1], plancache.Uncacheable):
+                raise memo[1]
+            return memo[1]
+        try:
+            enc = plancache.encode_plan(df.plan)
+        except plancache.Uncacheable as e:
+            self._doc_memo = (df, e)
+            raise
+        self._doc_memo = (df, enc)
+        return enc
+
+    def _result_cache_key(self, df: DataFrame):
+        from ..config import SERVER_RESULT_CACHE_ENABLED
+        if not self.conf.get(SERVER_RESULT_CACHE_ENABLED.key):
+            self.last_cache.setdefault("result", "off")
+            return None
+        from . import plancache
+        try:
+            return plancache.result_key(df.plan, self.conf,
+                                        encoded=self._encoded_plan(df))
+        except plancache.Uncacheable as e:
+            self.last_cache["result"] = f"uncacheable: {e.reason}"
+            return None
+
+    def _store_result(self, kd, result: pa.Table) -> pa.Table:
+        if kd is not None:
+            from ..config import SERVER_RESULT_CACHE_MAX_BYTES
+            from ..server import protocol
+            from . import plancache
+            key, digests = kd
+            ipc = protocol.table_to_ipc(result)
+            # the server's reply body IS these bytes: publish them so a
+            # cacheable miss serializes once, not once to store and once
+            # to reply
+            self.last_result_ipc = ipc
+            plancache.result_cache().put(
+                plancache.ResultEntry(
+                    key=key, ipc=ipc, digests=digests,
+                    execs=tuple(self.executed_exec_names()),
+                    fell_back=tuple(self.fell_back()),
+                    rows=result.num_rows),
+                max_bytes=int(
+                    self.conf.get(SERVER_RESULT_CACHE_MAX_BYTES.key)))
+        return result
 
     def collect(self, df: DataFrame, _prepared=None) -> pa.Table:
         """``_prepared`` lets a caller that already ran ``prepare(df)``
         (the plan server separates the bind phase from execution for
         its failure classification) hand the result in, so the planning
         pipeline runs once per query."""
+        state = self._rc_state
+        if state is None or state[0] is not df:
+            # in-process path: this collect opens the query (the server
+            # calls try_cached_result itself, before prepare)
+            hit = self.try_cached_result(df)
+            if hit is not None:
+                return hit
+            state = self._rc_state
+        self._rc_state = None
+        kd = state[1]
         kind, plan = _prepared if _prepared is not None \
             else self.prepare(df)
         if kind == "interpret":
-            return Interpreter(ansi=self.conf.ansi).execute(df.plan)
+            return self._store_result(
+                kd, Interpreter(ansi=self.conf.ansi).execute(df.plan))
         if kind == "fallback":
-            return plan.interpret()
+            return self._store_result(kd, plan.interpret())
         from ..exec.base import collect as collect_exec
-        from ..exec.python_exec import _python_semaphore
         from ..memory.retry import apply_session_conf
-        from ..memory.retry import metrics as _retry_metrics
         # install this session's retry/OOM-injection/oomDumpDir settings
-        # (process-wide, like the reference's per-executor RmmSpark state)
-        # and watermark the retry counters so metrics() reports deltas
+        # (process-wide, like the reference's per-executor RmmSpark state);
+        # the metric watermarks were taken at query open in _watermark()
         apply_session_conf(self.conf)
-        self._retry0 = _retry_metrics().snapshot()
-        from ..shuffle.transport import transport_metrics
-        self._net0 = transport_metrics().snapshot()
-        self._sem_wait0 = _python_semaphore.wait_time_ns
         try:
-            return collect_exec(plan)
+            return self._store_result(kd, collect_exec(plan))
         finally:
             plan.close()    # free catalog-registered exchange/broadcast state
 
@@ -177,14 +376,16 @@ class Session:
         """Aggregated operator metrics of the last executed plan, filtered
         by spark.rapids.tpu.sql.metrics.level (reference: the SQLMetrics
         the plugin posts to the Spark UI)."""
-        if self.last_plan is None:
+        if self.last_plan is None and self._cached_serve is None:
             return {}
-        from ..config import METRICS_LEVEL
-        from ..exec.base import DEBUG, ESSENTIAL, MODERATE
-        level = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE,
-                 "DEBUG": DEBUG}.get(
-            str(self.conf.get(METRICS_LEVEL.key)).upper(), MODERATE)
-        out = self.last_plan.collect_metrics(level)
+        out = {}
+        if self.last_plan is not None:
+            from ..config import METRICS_LEVEL
+            from ..exec.base import DEBUG, ESSENTIAL, MODERATE
+            level = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE,
+                     "DEBUG": DEBUG}.get(
+                str(self.conf.get(METRICS_LEVEL.key)).upper(), MODERATE)
+            out = self.last_plan.collect_metrics(level)
         from ..exec.python_exec import _python_semaphore
         # delta since this session's last collect — the semaphore counter
         # is process-global
@@ -214,9 +415,18 @@ class Session:
         from ..shuffle.transport import transport_metrics
         emit_deltas("net", transport_metrics().snapshot(),
                     getattr(self, "_net0", None))
+        # serving-cache counters (plan/result hit/miss/eviction/
+        # invalidation) since this session's last collect opened
+        from . import plancache
+        emit_deltas("cache", plancache.metrics().snapshot(),
+                    getattr(self, "_cache0", None))
         return out
 
     def executed_exec_names(self) -> List[str]:
+        if self._cached_serve is not None:
+            # cached serve: nothing executed; report the plan-capture
+            # surface of the run the entry was stored from
+            return list(self._cached_serve[0])
         names = []
 
         def walk(e):
@@ -232,6 +442,8 @@ class Session:
         return names
 
     def fell_back(self) -> List[str]:
+        if self._cached_serve is not None:
+            return list(self._cached_serve[1])
         return [n for n in self.executed_exec_names()
                 if n.startswith("CpuFallback")]
 

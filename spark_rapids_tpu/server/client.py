@@ -46,6 +46,10 @@ class PlanClient:
         #: operator metrics of the last collect (server-side
         #: Session.metrics(), the reference's SQLMetrics roll-up)
         self.last_metrics: dict = {}
+        #: serving-cache treatment of the last collect ({"plan": ...,
+        #: "result": ...}) and whether it was served from the result cache
+        self.last_cache: dict = {}
+        self.last_cached: bool = False
         try:
             protocol.send_preamble(self._sock)
             version = protocol.recv_preamble(self._sock)
@@ -120,7 +124,25 @@ class PlanClient:
         self.last_execs = reply.get("execs", [])
         self.last_fell_back = reply.get("fell_back", [])
         self.last_metrics = reply.get("metrics", {})
+        self.last_cache = reply.get("cache", {})
+        self.last_cached = bool(reply.get("cached"))
         return protocol.ipc_to_table(body)
+
+    def register_table(self, name: str, table: pa.Table) -> dict:
+        """Upload (or REPLACE) a named server-side table. The ack
+        reports the content digest and how many cached results the
+        replacement invalidated."""
+        reply, _ = self._request({"msg": "table", "name": name},
+                                 protocol.table_to_ipc(table))
+        self._known[name] = table
+        return reply
+
+    def drop_table(self, name: str) -> dict:
+        """Drop a server-side table; the ack's ``invalidated`` counts
+        the cached results that depended on it."""
+        reply, _ = self._request({"msg": "drop_table", "name": name})
+        self._known.pop(name, None)
+        return reply
 
     def explain(self, df: DataFrame, conf: Optional[dict] = None) -> str:
         doc = self._serialize(df)

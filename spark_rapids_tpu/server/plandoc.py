@@ -268,7 +268,14 @@ def plan_to_doc(plan: L.LogicalPlan,
             if node.data is not None:
                 name = by_id.get(id(node.data))
                 if name is None:
-                    name = f"t{len(tables)}"
+                    # collision-safe: the registry may be pre-seeded with
+                    # client-chosen names (PlanClient.register_table) —
+                    # an auto name must never rebind an existing entry
+                    i = len(tables)
+                    name = f"t{i}"
+                    while name in tables:
+                        i += 1
+                        name = f"t{i}"
                     tables[name] = node.data
                     by_id[id(node.data)] = name
                 doc["table"] = name

@@ -102,6 +102,16 @@ class CircuitBreaker:
             runtime.on_task_failed(exc)
 
 
+class _TableRegistry(dict):
+    """Per-connection table registry (name -> pa.Table) plus the content
+    digest of each upload — the dependency key the result cache is
+    invalidated on when a client drops or replaces a table."""
+
+    def __init__(self):
+        super().__init__()
+        self.digests: Dict[str, str] = {}
+
+
 class _ActiveQuery:
     def __init__(self, thread: threading.Thread, cancel: threading.Event):
         self.thread = thread
@@ -168,7 +178,7 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _session_loop(self, sock) -> None:
         srv = self.server
-        tables: Dict[str, pa.Table] = {}
+        tables = _TableRegistry()
         conf = dict(srv.base_conf)          # type: ignore[attr-defined]
         while not srv.shutting_down.is_set():
             try:
@@ -284,6 +294,7 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _dispatch(self, header, body, tables, conf,
                   cancelled: Callable[[], bool]):
+        srv = self.server
         msg = header.get("msg")
         if msg == "hello":
             conf.update(header.get("conf") or {})
@@ -291,13 +302,33 @@ class _Handler(socketserver.BaseRequestHandler):
                     "server": "spark-rapids-tpu",
                     "version": protocol.PROTOCOL_VERSION}, b""
         if msg == "table":
+            from ..plan import plancache
             name = header["name"]
+            digest = plancache.digest_ipc(body)
+            invalidated = 0
+            old = tables.digests.get(name)
+            if old is not None and old != digest:
+                # re-upload with NEW content: results derived from the
+                # replaced table must never be served again
+                invalidated = plancache.result_cache() \
+                    .invalidate_digest(old)
             tables[name] = protocol.ipc_to_table(body)
+            # prime the digest memo from the wire bytes we already hold,
+            # so result keys never re-hash the table
+            plancache.register_digest(tables[name], digest)
+            tables.digests[name] = digest
             return {"msg": "table_ack", "name": name,
-                    "rows": tables[name].num_rows}, b""
+                    "rows": tables[name].num_rows,
+                    "digest": digest, "invalidated": invalidated}, b""
         if msg == "drop_table":
-            tables.pop(header["name"], None)
-            return {"msg": "table_ack", "name": header["name"]}, b""
+            from ..plan import plancache
+            name = header["name"]
+            tables.pop(name, None)
+            digest = tables.digests.pop(name, None)
+            invalidated = plancache.result_cache() \
+                .invalidate_digest(digest) if digest else 0
+            return {"msg": "table_ack", "name": name,
+                    "invalidated": invalidated}, b""
         if msg == "plan":
             plan = plandoc.doc_to_plan(header["plan"], tables)
             df = DataFrame(plan)
@@ -307,30 +338,65 @@ class _Handler(socketserver.BaseRequestHandler):
                 return {"msg": "explained"}, ses.explain(df).encode("utf-8")
             if mode != "collect":
                 raise ValueError(f"unknown plan mode {mode!r}")
-            self._check_cancel(cancelled, ses)
-            # plan/bind FIRST, untagged: binding errors echo client-
-            # chosen names (a column literally called "...halted...")
-            # and must never reach the breaker's substring classifier
-            prepared = ses.prepare(df)
-            try:
-                result = ses.collect(df, _prepared=prepared)
-            except Exception as e:
-                if prepared[0] == "exec":
-                    # planning succeeded and the plan ran on DEVICE —
-                    # only these failures may reach the breaker's
-                    # fatal-marker classification (interpreter/fallback
-                    # paths never touch the device)
-                    e._rtpu_exec_phase = True
-                raise
+            if cancelled():
+                raise QueryCancelledError("query cancelled by the server")
+            # result-set cache first: a hit serves the stored IPC bytes
+            # verbatim — no planning, no admission, no device work
+            result = ses.try_cached_result(df)
+            cached = result is not None
+            if not cached:
+                # plan/bind, untagged: binding errors echo client-chosen
+                # names (a column literally called "...halted...") and
+                # must never reach the breaker's substring classifier
+                prepared = ses.prepare(df)
+                from ..memory.semaphore import AdmissionCancelledError
+                # interpret/fallback queries never touch the device:
+                # admit them through the slot (they still consume CPU)
+                # but reserve no HBM — a CPU-query stream must not spill
+                # device-resident state of concurrent device tenants
+                reserve = srv.query_reserve_for(df) \
+                    if prepared[0] == "exec" else 0
+                try:
+                    with srv.query_admission.admit(
+                            reserve, cancelled=cancelled):
+                        # the test-only collect delay runs INSIDE the
+                        # admitted region so collectDelayMs holds a real
+                        # collect slot — deterministic admission
+                        # contention for the watchdog/serialization
+                        # tests (cancellation semantics are unchanged:
+                        # the delay loop polls the same cancel flag)
+                        self._check_cancel(cancelled, ses)
+                        try:
+                            result = ses.collect(df, _prepared=prepared)
+                        except Exception as e:
+                            if prepared[0] == "exec":
+                                # planning succeeded and the plan ran on
+                                # DEVICE — only these failures may reach
+                                # the breaker's fatal-marker
+                                # classification (interpreter/fallback
+                                # paths never touch the device)
+                                e._rtpu_exec_phase = True
+                            raise
+                except AdmissionCancelledError:
+                    raise QueryCancelledError(
+                        "query cancelled while waiting for admission")
+            # cached serves AND cacheable misses publish their IPC bytes
+            # on the session (one serialization per result, verbatim)
+            body_out = ses.last_result_ipc or protocol.table_to_ipc(result)
             return ({"msg": "result",
                      "rows": result.num_rows,
                      "execs": ses.executed_exec_names(),
                      "fell_back": ses.fell_back(),
+                     "cached": cached,
+                     # how each cache layer treated this query, plus the
+                     # admission the execution paid — the loadbench and
+                     # the acceptance counters read these
+                     "cache": dict(ses.last_cache),
                      # operator metrics ride back to the driver the way
                      # the reference posts SQLMetrics to the Spark UI
                      "metrics": {k: int(v)
                                  for k, v in ses.metrics().items()}},
-                    protocol.table_to_ipc(result))
+                    body_out)
         raise ValueError(f"unknown message {msg!r}")
 
     @staticmethod
@@ -357,6 +423,23 @@ class _ThreadingServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
 
+    def query_reserve_for(self, df) -> int:
+        """Per-query device reservation taken at admission: an explicit
+        ``server.queryReserveBytes`` wins; auto (0) reserves the plan's
+        logical size estimate (unknown → 64 MiB), capped at
+        1/concurrentCollects of the device budget so a full house of
+        admitted queries can never over-commit HBM at admission time."""
+        if self.query_reserve_bytes > 0:
+            return self.query_reserve_bytes
+        from ..memory.catalog import device_budget
+        from ..plan.overrides import estimate_bytes
+        cap = device_budget().device_limit \
+            // max(1, self.concurrent_collects)
+        est = estimate_bytes(df.plan)
+        if est is None:
+            est = 64 << 20
+        return max(0, min(int(est), cap))
+
 
 class PlanServer:
     """Embeddable server handle (tests embed it; production runs the
@@ -365,7 +448,9 @@ class PlanServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  conf: Optional[dict] = None, idle_timeout: float = 600.0,
                  health_check: Optional[Callable[[], None]] = None):
-        from ..config import (RapidsTpuConf, SERVER_MAX_SESSIONS,
+        from ..config import (RapidsTpuConf, SERVER_CONCURRENT_COLLECTS,
+                              SERVER_MAX_SESSIONS,
+                              SERVER_QUERY_RESERVE_BYTES,
                               SERVER_QUERY_TIMEOUT_MS,
                               SERVER_RETRY_AFTER_MS)
         tconf = RapidsTpuConf(dict(conf or {}))
@@ -376,6 +461,16 @@ class PlanServer:
         srv.retry_after_ms = int(tconf.get(SERVER_RETRY_AFTER_MS.key))
         srv.default_timeout_ms = int(tconf.get(SERVER_QUERY_TIMEOUT_MS.key))
         srv.admission = threading.Semaphore(srv.max_sessions)
+        # per-QUERY admission: maxSessions bounds connections, this
+        # bounds in-flight collects over the one device (+ a per-query
+        # memory reservation against the buffer catalog) so independent
+        # tenants overlap H2D/compute/D2H instead of queueing
+        srv.concurrent_collects = int(
+            tconf.get(SERVER_CONCURRENT_COLLECTS.key))
+        srv.query_reserve_bytes = int(
+            tconf.get(SERVER_QUERY_RESERVE_BYTES.key))
+        from ..memory.semaphore import QueryAdmission
+        srv.query_admission = QueryAdmission(srv.concurrent_collects)
         srv.breaker = CircuitBreaker(health_check, srv.retry_after_ms)
         srv.shutting_down = threading.Event()
         srv.track_lock = threading.Lock()
@@ -403,6 +498,22 @@ class PlanServer:
     def active_query_count(self) -> int:
         with self._server.track_lock:
             return len(self._server.active_queries)
+
+    def serving_stats(self) -> dict:
+        """Cache + admission snapshot (the loadbench/ops surface)."""
+        from ..plan import plancache
+        adm = self._server.query_admission
+        return {
+            "planCacheEntries": len(plancache.planning_cache()),
+            "resultCache": plancache.result_cache().stats(),
+            "counters": plancache.metrics().snapshot(),
+            "admission": {
+                "concurrentCollects": adm.max_concurrent,
+                "admitted": adm.admitted_count,
+                "inFlight": adm.in_flight,
+                "waitTimeNs": adm.wait_time_ns,
+            },
+        }
 
     def start(self) -> "PlanServer":
         self._thread = threading.Thread(

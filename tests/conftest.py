@@ -56,6 +56,11 @@ def pytest_configure(config):
                    "and not slow'` is the smoke-tier robustness job in "
                    "the tier-1 flow (the full mode matrix is nightly)")
     config.addinivalue_line(
+        "markers", "serving: multi-tenant serving tier (plan/result "
+                   "caches, fingerprints, concurrent sessions); `pytest "
+                   "-m 'serving and smoke'` is the <2-min mini load "
+                   "smoke job (docs/serving.md)")
+    config.addinivalue_line(
         "markers", "net_inject: transport fault-tolerance + deterministic "
                    "network fault-injection coverage; `pytest -m "
                    "'net_inject and not slow'` is the tier-1 network "

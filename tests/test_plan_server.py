@@ -669,3 +669,157 @@ def test_plandoc_window_expression():
     doc, tables = plandoc.plan_to_doc(df.plan)
     plan2 = plandoc.doc_to_plan(json.loads(json.dumps(doc)), tables)
     assert Session().collect(DataFrame(plan2)).equals(Session().collect(df))
+
+
+# ---------------------------------------------------------------------------
+# serving tier (ISSUE 10): result-cache serving, invalidation acks,
+# per-query admission
+# ---------------------------------------------------------------------------
+
+_SERVING_CONF = {
+    "spark.rapids.tpu.server.planCache.enabled": "true",
+    "spark.rapids.tpu.server.resultCache.enabled": "true",
+}
+
+
+@pytest.mark.serving
+def test_server_result_cache_serves_repeat_bit_for_bit():
+    server = PlanServer(conf=_SERVING_CONF).start()
+    try:
+        orders, cust = _orders_table(), _cust_table()
+        df = _query(table(orders), table(cust))
+        with PlanClient("127.0.0.1", server.port) as c:
+            first = c.collect(df)
+            assert not c.last_cached
+            execs1, fell1 = c.last_execs, c.last_fell_back
+            again = c.collect(df)
+            assert c.last_cached
+            assert c.last_cache.get("result") == "hit"
+            assert again.equals(first)
+            # the cached serve reports the stored run's plan capture
+            assert c.last_execs == execs1
+            assert c.last_fell_back == fell1
+            # cache counters ride the metrics roll-up
+            assert c.last_metrics.get("cache.resultCacheHitCount") == 1
+        stats = server.serving_stats()
+        assert stats["resultCache"]["entries"] >= 1
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_server_drop_table_invalidates_and_acks_count():
+    server = PlanServer(conf=_SERVING_CONF).start()
+    try:
+        t = pa.table({"x": np.arange(100, dtype=np.int64)})
+        with PlanClient("127.0.0.1", server.port) as c:
+            ack = c.register_table("t", t)
+            assert ack["rows"] == 100 and ack["digest"]
+            df = table(t).select((col("x") * lit(2)).alias("y"))
+            c.collect(df)
+            c.collect(df)
+            assert c.last_cached
+            dropped = c.drop_table("t")
+            assert dropped["invalidated"] == 1
+            # re-registering + re-querying recomputes (miss, not stale)
+            c.register_table("t", t)
+            c.collect(df)
+            assert not c.last_cached
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_server_table_replacement_never_serves_stale():
+    """Re-uploading a name with NEW content must invalidate dependents
+    (acked) and queries against the new table must see the new rows."""
+    server = PlanServer(conf=_SERVING_CONF).start()
+    try:
+        v1 = pa.table({"x": np.arange(50, dtype=np.int64)})
+        v2 = pa.table({"x": np.arange(50, 150, dtype=np.int64)})
+        with PlanClient("127.0.0.1", server.port) as c:
+            ack1 = c.register_table("t", v1)
+            r1 = c.collect(table(v1).agg(Sum(col("x")).alias("s")))
+            assert r1.column("s").to_pylist() == [sum(range(50))]
+            ack2 = c.register_table("t", v2)      # REPLACE with new bytes
+            assert ack2["invalidated"] == 1
+            assert ack2["digest"] != ack1["digest"]
+            r2 = c.collect(table(v2).agg(Sum(col("x")).alias("s")))
+            assert r2.column("s").to_pylist() == [sum(range(50, 150))]
+            # same-content re-upload invalidates nothing
+            ack3 = c.register_table("t", v2)
+            assert ack3["invalidated"] == 0
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_server_cache_off_reports_off():
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.planCache.enabled": "false"}).start()
+    try:
+        t = pa.table({"x": [1, 2, 3]})
+        with PlanClient("127.0.0.1", server.port) as c:
+            c.collect(table(t).select((col("x") + lit(1)).alias("y")))
+            assert not c.last_cached
+            assert c.last_cache.get("result") == "off"
+            assert "plan" not in c.last_cache    # fingerprinting skipped
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_server_admission_watchdog_cancels_queued_query():
+    """A query that cannot admit before its deadline gets the structured
+    retryable timeout, and the abandoned worker releases its slot."""
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.concurrentCollects": "1",
+        "spark.rapids.tpu.server.test.collectDelayMs": "700",
+    }).start()
+    try:
+        t = pa.table({"x": np.arange(10, dtype=np.int64)})
+        df = table(t).select((col("x") + lit(1)).alias("y"))
+        import threading as _th
+        done = []
+
+        def slow():
+            with PlanClient("127.0.0.1", server.port) as c1:
+                done.append(c1.collect(df))
+
+        holder = _th.Thread(target=slow)
+        holder.start()
+        time.sleep(0.15)        # the slot is now held by the delay query
+        with PlanClient("127.0.0.1", server.port) as c2:
+            with pytest.raises(PlanServerError) as ei:
+                c2.collect(df, timeout_ms=300)
+            assert ei.value.timeout and ei.value.retryable
+        holder.join(timeout=10)
+        assert len(done) == 1
+        deadline = time.monotonic() + 5
+        while server.active_query_count and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert server.active_query_count == 0
+        # the freed slot admits new queries normally
+        with PlanClient("127.0.0.1", server.port) as c3:
+            assert c3.collect(df).num_rows == 10
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_register_table_name_never_collides_with_auto_names():
+    """A client-chosen registry name (register_table) must never capture
+    a plan's auto-named scan: the query below would silently bind to the
+    registered table if plan_to_doc reused its name."""
+    server = PlanServer(conf=_SERVING_CONF).start()
+    try:
+        registered = pa.table({"x": np.arange(1000, dtype=np.int64)})
+        local = pa.table({"x": np.arange(5, dtype=np.int64)})
+        with PlanClient("127.0.0.1", server.port) as c:
+            # occupy the exact name plan_to_doc would generate next
+            c.register_table("t1", registered)
+            out = c.collect(table(local).agg(Sum(col("x")).alias("s")))
+            assert out.column("s").to_pylist() == [10], \
+                "query bound to the registered table, not its own scan"
+    finally:
+        server.stop()

@@ -1,0 +1,328 @@
+"""Serving-tier cache suite (ISSUE 10): plan-shape fingerprinting,
+planning-cache replay, the result-set cache, and the once-per-collect
+metrics watermark fix.
+
+Fingerprint contract (docs/serving.md): literal-parameterized under
+value-insensitive parents, sensitive to conf / schema / capacity
+buckets / plan structure, and value-preserving where planning reads the
+value (regex patterns)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import RapidsTpuConf
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Count, Sum
+from spark_rapids_tpu.plan import Session, table
+from spark_rapids_tpu.plan import plancache
+from spark_rapids_tpu.plan.plancache import (PlanningCache, ResultCache,
+                                             ResultEntry, Uncacheable)
+
+pytestmark = pytest.mark.serving
+
+
+def _t(n=100, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "x": np.arange(n, dtype=np.int64),
+        "g": rng.integers(0, 5, n).astype(np.int64),
+        "s": [f"r{i % 13}" for i in range(n)],
+    })
+
+
+def _fp(df, conf=None):
+    return plancache.shape_fingerprint(df.plan, RapidsTpuConf(conf))
+
+
+# ---------------------------------------------------------------------------
+# fingerprint unit suite
+# ---------------------------------------------------------------------------
+
+@pytest.mark.smoke
+def test_fingerprint_parameterizes_comparison_literals():
+    t = _t()
+    a = table(t).where(col("x") > lit(5))
+    b = table(t).where(col("x") > lit(999))
+    assert _fp(a) == _fp(b)
+
+
+def test_fingerprint_parameterizes_arithmetic_literals():
+    t = _t()
+    a = table(t).select((col("x") * lit(3) + lit(1)).alias("y"))
+    b = table(t).select((col("x") * lit(7) + lit(4)).alias("y"))
+    assert _fp(a) == _fp(b)
+
+
+def test_fingerprint_literal_dtype_stays():
+    t = _t()
+    a = table(t).where(col("x") > lit(5))
+    b = table(t).where(col("x") > lit(5.0))   # int64 vs float64 literal
+    assert _fp(a) != _fp(b)
+
+
+def test_fingerprint_value_sensitive_literal_not_parameterized():
+    # regex support is decided per PATTERN at plan time: the value must
+    # stay in the fingerprint or a cached "runs on device" for a simple
+    # pattern would replay onto an unsupported one (the pattern is a
+    # static expression field in this dialect, so it rides the
+    # positional encoding — never the literal parameterization)
+    from spark_rapids_tpu.expressions.regex import RLike
+    t = _t()
+    a = table(t).where(RLike(col("s"), "r1"))
+    b = table(t).where(RLike(col("s"), "r[0-9]+"))
+    assert _fp(a) != _fp(b)
+
+
+def test_fingerprint_conf_sensitivity():
+    t = _t()
+    df = table(t).where(col("x") > lit(5))
+    base = _fp(df)
+    flipped = _fp(df, {
+        "spark.rapids.tpu.sql.incompatibleOps.enabled": "true"})
+    assert base != flipped
+    # serving-tier knobs (including the cache confs themselves) never
+    # change a plan, so they stay out of the fingerprint
+    same = _fp(df, {
+        "spark.rapids.tpu.server.resultCache.enabled": "true",
+        "spark.rapids.tpu.server.concurrentCollects": "8"})
+    assert base == same
+
+
+def test_fingerprint_bucket_sensitivity():
+    # 100 vs 120 rows share the 128 capacity bucket -> one fingerprint
+    # (the cached plan's kernels hit XLA's compile cache); 100 vs 300 do
+    # not (128 vs 512)
+    a = table(_t(100))
+    b = table(_t(120))
+    c = table(_t(300))
+    assert _fp(a) == _fp(b)
+    assert _fp(a) != _fp(c)
+
+
+def test_fingerprint_structure_and_schema():
+    t = _t()
+    plain = table(t)
+    filtered = table(t).where(col("x") > lit(5))
+    assert _fp(plain) != _fp(filtered)
+    renamed = pa.table({"y": t.column("x"), "g": t.column("g"),
+                        "s": t.column("s")})
+    assert _fp(table(t)) != _fp(table(renamed))
+
+
+def test_fingerprint_window_overcap_bit():
+    # unpartitioned windows gate on an EXACT row estimate vs
+    # batchRowCapacity; two inputs in the same capacity bucket that
+    # straddle the gate must not share a fingerprint
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.expressions.window import RowNumber, over
+    conf = {"spark.rapids.tpu.sql.batchRowCapacity": 64}
+    w = over(RowNumber(), [], [asc(col("x"))])
+    small = table(_t(30)).window(w.alias("rn"))
+    big = table(_t(100)).window(w.alias("rn"))
+    # same bucket (both <=128), opposite sides of cap=64
+    assert _fp(small, conf) != _fp(big, conf)
+
+
+def test_fingerprint_uncacheable_plans_raise():
+    # a server-side-object scan has no wire encoding: uncacheable, loud
+    from spark_rapids_tpu.plan.logical import DataFrame, LogicalScan
+    df = DataFrame(LogicalScan((), source=object(),
+                               _schema=table(_t()).schema()))
+    with pytest.raises(Uncacheable):
+        plancache.shape_fingerprint(df.plan, RapidsTpuConf())
+
+
+def test_result_key_keeps_literal_values_and_digests():
+    t = _t()
+    a_key, a_dig = plancache.result_key(
+        table(t).where(col("x") > lit(5)).plan, RapidsTpuConf())
+    b_key, _ = plancache.result_key(
+        table(t).where(col("x") > lit(6)).plan, RapidsTpuConf())
+    assert a_key != b_key          # literal values stay in the key
+    # same CONTENT in a distinct object -> same digests, same key
+    t2 = pa.table({"x": t.column("x"), "g": t.column("g"),
+                   "s": t.column("s")})
+    c_key, c_dig = plancache.result_key(
+        table(t2).where(col("x") > lit(5)).plan, RapidsTpuConf())
+    assert c_key == a_key and c_dig == a_dig
+
+
+def test_result_key_file_source_uncacheable(tmp_path):
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.plan.logical import DataFrame, LogicalScan
+    p = tmp_path / "f.parquet"
+    pq.write_table(_t(), str(p))
+    src = ParquetSource([str(p)])
+    df = DataFrame(LogicalScan((), source=src, _schema=src.schema()))
+    # plan-cacheable (with file stats in the fingerprint)...
+    fp1 = plancache.shape_fingerprint(df.plan, RapidsTpuConf())
+    assert fp1
+    # ...but never result-cacheable: no content digest for files
+    with pytest.raises(Uncacheable):
+        plancache.result_key(df.plan, RapidsTpuConf())
+    # touching the file changes the planning fingerprint
+    import os
+    os.utime(str(p), ns=(1, 1))
+    assert plancache.shape_fingerprint(df.plan, RapidsTpuConf()) != fp1
+
+
+# ---------------------------------------------------------------------------
+# cache mechanics
+# ---------------------------------------------------------------------------
+
+def test_planning_cache_lru_eviction():
+    c = PlanningCache(max_entries=2)
+    d = plancache.PlanDecisions(reasons=((),))
+    c.put("a", d)
+    c.put("b", d)
+    assert c.get("a") is d        # refresh a
+    c.put("c", d)                 # evicts b (LRU)
+    assert c.get("b") is None
+    assert c.get("a") is d and c.get("c") is d
+
+
+def test_result_cache_byte_budget_and_eviction():
+    c = ResultCache(max_bytes=100)
+    c.put(ResultEntry(key="a", ipc=b"x" * 40, digests=("d1",)))
+    c.put(ResultEntry(key="b", ipc=b"y" * 40, digests=("d2",)))
+    assert len(c) == 2 and c.used_bytes == 80
+    c.put(ResultEntry(key="c", ipc=b"z" * 40, digests=("d3",)))
+    assert c.get("a") is None      # LRU evicted
+    assert c.used_bytes <= 100
+    # an entry alone over the budget is never stored
+    assert not c.put(ResultEntry(key="big", ipc=b"q" * 200,
+                                 digests=()))
+    assert c.get("big") is None
+
+
+def test_result_cache_invalidate_digest():
+    c = ResultCache(max_bytes=1 << 20)
+    c.put(ResultEntry(key="a", ipc=b"1", digests=("d1", "d2")))
+    c.put(ResultEntry(key="b", ipc=b"2", digests=("d2",)))
+    c.put(ResultEntry(key="c", ipc=b"3", digests=("d3",)))
+    assert c.invalidate_digest("d2") == 2
+    assert c.get("a") is None and c.get("b") is None
+    assert c.get("c") is not None
+    assert c.invalidate_digest("d2") == 0
+
+
+def test_result_cache_put_same_key_replaces():
+    c = ResultCache(max_bytes=100)
+    c.put(ResultEntry(key="a", ipc=b"x" * 30, digests=()))
+    c.put(ResultEntry(key="a", ipc=b"y" * 50, digests=()))
+    assert len(c) == 1 and c.used_bytes == 50
+    assert c.get("a").ipc == b"y" * 50
+
+
+# ---------------------------------------------------------------------------
+# session integration
+# ---------------------------------------------------------------------------
+
+def test_session_plan_cache_hit_differential():
+    t = _t(400)
+    q = lambda v: (table(t).where(col("x") > lit(v))        # noqa: E731
+                   .group_by("g").agg(Sum(col("x")).alias("s"),
+                                      Count().alias("n")))
+    fresh = Session({"spark.rapids.tpu.server.planCache.enabled":
+                     "false"}).collect(q(50))
+    ses = Session()
+    first = ses.collect(q(50))
+    assert ses.last_cache.get("plan") in ("miss", "hit")
+    again = ses.collect(q(50))
+    assert ses.last_cache.get("plan") == "hit"
+    other_literal = ses.collect(q(200))
+    assert ses.last_cache.get("plan") == "hit"   # parameterized shape
+    assert first.equals(fresh)
+    assert again.equals(fresh)
+    expected = Session({"spark.rapids.tpu.server.planCache.enabled":
+                        "false"}).collect(q(200))
+    assert other_literal.equals(expected)
+    m = ses.metrics()
+    assert m.get("cache.planCacheHitCount", 0) >= 1
+
+
+def test_session_plan_cache_replays_fallback_decision():
+    # a conf-disabled exec tags a CPU fallback; the cached decision must
+    # replay to the same fallback plan (and results), not a device plan
+    t = _t(200)
+    conf = {"spark.rapids.tpu.sql.exec.Filter": "false"}
+    df = table(t).where(col("x") > lit(10))
+    s1 = Session(conf)
+    r1 = s1.collect(df)
+    assert s1.fell_back()
+    s2 = Session(conf)
+    r2 = s2.collect(df)
+    assert s2.last_cache.get("plan") == "hit"
+    assert s2.fell_back()
+    assert r1.equals(r2)
+
+
+def test_session_result_cache_bit_for_bit_and_counters():
+    t = _t(300)
+    conf = {"spark.rapids.tpu.server.resultCache.enabled": "true"}
+    df = (table(t).where(col("x") > lit(20))
+          .group_by("g").agg(Sum(col("x")).alias("s")))
+    ses = Session(conf)
+    first = ses.collect(df)
+    assert ses.last_cache.get("result") == "miss"
+    second = ses.collect(df)
+    assert ses.last_cache.get("result") == "hit"
+    assert second.equals(first)
+    # the cached serve reports the stored run's plan capture
+    assert ses.executed_exec_names()
+    m = ses.metrics()
+    assert m.get("cache.resultCacheHitCount", 0) == 1
+    # uncached oracle
+    oracle = Session().collect(df)
+    assert first.equals(oracle)
+
+
+def test_session_result_cache_distinguishes_literals_and_data():
+    conf = {"spark.rapids.tpu.server.resultCache.enabled": "true"}
+    t1, t2 = _t(100, seed=1), _t(100, seed=2)
+    ses = Session(conf)
+    a = ses.collect(table(t1).where(col("x") > lit(10)))
+    b = ses.collect(table(t1).where(col("x") > lit(90)))
+    assert ses.last_cache.get("result") == "miss"   # literal in the key
+    c = ses.collect(table(t2).where(col("x") > lit(10)))
+    assert ses.last_cache.get("result") == "miss"   # digest in the key
+    assert not a.equals(b)
+    assert a.num_rows != c.num_rows or not a.equals(c)
+
+
+# ---------------------------------------------------------------------------
+# satellite: metrics watermark once per collect, regardless of path
+# ---------------------------------------------------------------------------
+
+def test_metrics_watermark_reset_on_every_path():
+    from spark_rapids_tpu.memory.retry import metrics as retry_metrics
+    t = _t(200)
+    conf = {"spark.rapids.tpu.sql.exec.Filter": "false"}
+    ses = Session(conf)
+    # 1) exec-path collect (no filter -> stays on device) watermarks
+    ses.collect(table(t).group_by("g").agg(Count().alias("n")))
+    # 2) ANOTHER task's retry activity moves the process-wide counter
+    retry_metrics().note_retry("synthetic-other-session")
+    # 3) a FALLBACK-path collect on the same session: before the fix it
+    #    skipped the watermark and reported the other task's delta
+    ses.collect(table(t).where(col("x") > lit(10)))
+    assert ses.fell_back()
+    m = ses.metrics()
+    assert "retry.retryCount" not in m, \
+        "fallback collect reported a stale retry watermark delta"
+
+
+def test_metrics_watermark_covers_cached_serves():
+    conf = {"spark.rapids.tpu.server.resultCache.enabled": "true"}
+    t = _t(150)
+    df = table(t).group_by("g").agg(Sum(col("x")).alias("s"))
+    ses = Session(conf)
+    ses.collect(df)
+    from spark_rapids_tpu.memory.retry import metrics as retry_metrics
+    retry_metrics().note_retry("synthetic-other-session-2")
+    ses.collect(df)
+    m = ses.metrics()
+    assert m.get("cache.resultCacheHitCount") == 1
+    assert "retry.retryCount" not in m

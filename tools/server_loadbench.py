@@ -1,0 +1,255 @@
+"""Serving-tier load bench: many concurrent framed-TCP clients against
+one embedded PlanServer, mixed repeated/unique query shapes.
+
+The acceptance instrument for ISSUE 10: it reports QPS + p50/p99 latency
+split by repeated vs unique shapes, the plan/result cache hit counters,
+and admission stats — and with ``--compare`` it re-runs the identical
+workload with the planning cache disabled so the repeated-shape p50
+improvement is measured on the same machine in the same process.
+
+    python tools/server_loadbench.py --clients 100 --rounds 5 --compare \
+        --json-out BENCH_loadbench.json
+
+Results land in docs/profiling.md; the <2-min smoke-tier mini run is
+``pytest -m "serving and smoke"`` (tests/test_serving_concurrent.py),
+which drives this module with small parameters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _tables(rows: int):
+    import numpy as np
+    import pyarrow as pa
+    rng = np.random.default_rng(17)
+    lineitem = pa.table({
+        "k": rng.integers(0, 3, rows).astype(np.int32),
+        "l_quantity": rng.integers(1, 51, rows).astype(np.int64),
+        "l_extendedprice": rng.uniform(1.0, 1e5, rows),
+    })
+    sales = pa.table({
+        "k": rng.integers(0, 256, rows).astype(np.int64),
+        "ss_quantity": rng.integers(1, 100, rows).astype(np.int64),
+    })
+    facts = pa.table({
+        "k": rng.integers(0, 64, rows).astype(np.int64),
+        "v": rng.integers(-1000, 1000, rows).astype(np.int64),
+    })
+    dims = pa.table({
+        "k": np.arange(64, dtype=np.int64),
+        "w": rng.integers(0, 10, 64).astype(np.int64),
+    })
+    return {"lineitem": lineitem, "sales": sales, "facts": facts,
+            "dims": dims}
+
+
+def _shapes(tabs):
+    """The bench shapes as (name, df_builder(literal)) pairs — each
+    builder varies ONE comparison literal, so every variant shares a
+    plan-shape fingerprint (repeat = same literal, unique = fresh)."""
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.aggregates import Count, Sum
+    from spark_rapids_tpu.plan import table
+
+    def q1(v):
+        return (table(tabs["lineitem"])
+                .where(col("l_quantity") > lit(int(v)))
+                .group_by("k")
+                .agg(Sum(col("l_extendedprice")).alias("rev"),
+                     Count().alias("n")))
+
+    def hash_agg(v):
+        return (table(tabs["sales"])
+                .where(col("ss_quantity") > lit(int(v)))
+                .group_by("k").agg(Sum(col("ss_quantity")).alias("q")))
+
+    def join_sort(v):
+        from spark_rapids_tpu.exec.sort import asc
+        return (table(tabs["facts"])
+                .where(col("v") > lit(int(v)))
+                .join(table(tabs["dims"]), ["k"], ["k"])
+                .group_by("w").agg(Sum(col("v")).alias("s"))
+                .order_by(asc(col("w"))))
+
+    def exchange(v):
+        return (table(tabs["facts"], num_slices=4)
+                .where(col("v") > lit(int(v)))
+                .group_by("k").agg(Sum(col("v")).alias("s")))
+
+    return [("q1_stage", q1), ("hash_agg", hash_agg),
+            ("join_sort", join_sort), ("exchange", exchange)]
+
+
+def _pct(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))
+    return xs[i]
+
+
+def run_load(clients: int, rounds: int, rows: int,
+             plan_cache: bool, result_cache: bool,
+             concurrent_collects: int = 4,
+             unique_fraction: float = 0.25,
+             host: str = "127.0.0.1",
+             client_timeout: float = 900.0) -> dict:
+    """Drive ``clients`` threads x ``rounds`` x shapes; round 0 plants
+    each shape, later rounds repeat it (same literal) except a
+    ``unique_fraction`` of queries that draw a fresh literal."""
+    from spark_rapids_tpu.server import PlanClient, PlanServer
+    conf = {
+        "spark.rapids.tpu.server.planCache.enabled": str(plan_cache),
+        "spark.rapids.tpu.server.resultCache.enabled": str(result_cache),
+        "spark.rapids.tpu.server.concurrentCollects":
+            str(concurrent_collects),
+        "spark.rapids.tpu.server.maxSessions": str(max(64, clients + 8)),
+    }
+    tabs = _tables(rows)
+    shapes = _shapes(tabs)
+    from spark_rapids_tpu.plan import plancache
+    counters0 = plancache.metrics().snapshot()
+    server = PlanServer(host=host, conf=conf).start()
+    samples = []          # (shape, kind, ms, cached, plan_info)
+    lock = threading.Lock()
+    errors = []
+
+    def worker(ci: int):
+        try:
+            with PlanClient(host, server.port,
+                            timeout=client_timeout) as c:
+                for r in range(rounds):
+                    for si, (name, build) in enumerate(shapes):
+                        unique = r > 0 and \
+                            ((ci * 31 + r * 7 + si) % 100) < \
+                            unique_fraction * 100
+                        lit_v = 25 if not unique else \
+                            1 + (ci * 131 + r * 17 + si * 7) % 900
+                        kind = "unique" if unique else \
+                            ("first" if r == 0 else "repeat")
+                        t0 = time.perf_counter()
+                        c.collect(build(lit_v))
+                        ms = (time.perf_counter() - t0) * 1e3
+                        with lock:
+                            samples.append(
+                                (name, kind, ms, c.last_cached,
+                                 c.last_cache.get("plan", "")))
+        except Exception as e:    # pragma: no cover - surfaced below
+            with lock:
+                errors.append(f"client {ci}: {type(e).__name__}: {e}")
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    # closed clients unwind their handler threads on the next recv;
+    # give the server a moment to drain before counting leaks
+    deadline = time.monotonic() + 5.0
+    while server.active_sessions and time.monotonic() < deadline:
+        time.sleep(0.02)
+    stats = server.serving_stats()
+    # the process-wide counters outlive a run (the --compare leg shares
+    # the process): report THIS run's deltas
+    stats["counters"] = {k: v - counters0.get(k, 0)
+                         for k, v in stats["counters"].items()}
+    leaked_sessions = server.active_sessions
+    server.stop()
+    if errors:
+        raise RuntimeError("loadbench clients failed:\n" +
+                           "\n".join(errors[:5]))
+
+    def agg(pred):
+        xs = [ms for (_, kind, ms, _, _) in samples if pred(kind)]
+        return {"n": len(xs), "p50_ms": round(_pct(xs, 50), 3),
+                "p99_ms": round(_pct(xs, 99), 3)}
+
+    total = len(samples)
+    out = {
+        "clients": clients, "rounds": rounds, "rows": rows,
+        "plan_cache": plan_cache, "result_cache": result_cache,
+        "concurrent_collects": concurrent_collects,
+        "wall_s": round(wall, 3),
+        "qps": round(total / wall, 1) if wall else 0.0,
+        "queries": total,
+        "all": agg(lambda k: True),
+        "repeat": agg(lambda k: k == "repeat"),
+        "unique": agg(lambda k: k == "unique"),
+        "first": agg(lambda k: k == "first"),
+        "result_cache_served": sum(1 for s in samples if s[3]),
+        "plan_cache_hits_client": sum(1 for s in samples
+                                      if s[4] == "hit"),
+        "server": stats,
+        "leaked_sessions": leaked_sessions,
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--clients", type=int, default=100)
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--rows", type=int, default=20000)
+    p.add_argument("--unique-fraction", type=float, default=0.25)
+    p.add_argument("--concurrent-collects", type=int, default=4)
+    p.add_argument("--no-plan-cache", action="store_true")
+    p.add_argument("--no-result-cache", action="store_true")
+    p.add_argument("--compare", action="store_true",
+                   help="re-run the same workload with both caches off "
+                        "and report the repeated-shape p50 ratio")
+    p.add_argument("--json-out", default=None,
+                   help="append the report into a BENCH-style sidecar")
+    p.add_argument("--client-timeout", type=float, default=900.0,
+                   help="per-client socket timeout, seconds; uncached "
+                        "high-fan-in runs queue long on a CPU host")
+    args = p.parse_args(argv)
+
+    report = {"loadbench": run_load(
+        args.clients, args.rounds, args.rows,
+        plan_cache=not args.no_plan_cache,
+        result_cache=not args.no_result_cache,
+        concurrent_collects=args.concurrent_collects,
+        unique_fraction=args.unique_fraction,
+        client_timeout=args.client_timeout)}
+    if args.compare:
+        report["loadbench_uncached"] = run_load(
+            args.clients, args.rounds, args.rows,
+            plan_cache=False, result_cache=False,
+            concurrent_collects=args.concurrent_collects,
+            unique_fraction=args.unique_fraction,
+            client_timeout=args.client_timeout)
+        a = report["loadbench"]["repeat"]["p50_ms"]
+        b = report["loadbench_uncached"]["repeat"]["p50_ms"]
+        report["repeat_p50_speedup"] = round(b / a, 3) if a else None
+    print(json.dumps(report, indent=2))
+    if args.json_out:
+        existing = {}
+        if os.path.exists(args.json_out):
+            try:
+                with open(args.json_out) as f:
+                    existing = json.load(f)
+            except (OSError, ValueError):
+                existing = {}
+        existing.update(report)
+        with open(args.json_out, "w") as f:
+            json.dump(existing, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
