@@ -228,6 +228,30 @@ TRANSPORT_BACKOFF_MAX_MS = conf(
     "Upper bound on one transport retry backoff sleep."
 ).integer(1000)
 
+SHUFFLE_REPLICAS = conf("spark.rapids.tpu.shuffle.replicas").doc(
+    "Replication factor for published map outputs: each serialized piece "
+    "is additionally written to this many live peers at publish time, so "
+    "a dead executor's exclusively-held blocks are served from a replica "
+    "(plain failover) instead of recomputed. 0 (default) = no "
+    "replication — lineage recompute is the only recovery for blocks the "
+    "dead peer alone held. Surviving the dead peer's FAILED LISTING "
+    "additionally requires lineage.enabled (the default): replica writes "
+    "are best-effort, so only the lineage registry can certify a "
+    "partial listing lost no rows (reference: external shuffle "
+    "services' block replication story).").integer(0)
+
+SHUFFLE_LINEAGE_ENABLED = conf(
+    "spark.rapids.tpu.shuffle.lineage.enabled").doc(
+    "Record shuffle lineage — producing plan fragment + input digest per "
+    "published map output — so a reduce-side fetch whose failover is "
+    "exhausted (BlockMissingError with no serving peer, "
+    "PeerUnreachableError on a dead executor) deterministically "
+    "RECOMPUTES exactly the lost map partitions, verifies them against "
+    "the publish-time content digest, and resumes bit-for-bit instead of "
+    "failing the query (reference: Spark's MapOutputTracker + "
+    "stage-resubmission recovery, compressed to the fragment level)."
+).boolean(True)
+
 PARQUET_NATIVE_DECODE = conf(
     "spark.rapids.tpu.sql.format.parquet.nativeDecode.enabled").doc(
     "Decode parquet column chunks with the native C++ decoder "

@@ -71,6 +71,14 @@ class FinalOOMError(MemoryError):
         self.dump_path = dump_path
 
 
+class RetryCancelledError(RuntimeError):
+    """The caller's ``cancelled`` hook fired between retry attempts —
+    the body is not re-run. Cleanup already happened (the failed
+    attempt's pins were restored, queued inputs closed), so the caller
+    can unwind immediately; lineage recompute maps this onto the plan
+    server's query-cancellation error."""
+
+
 def is_retryable_oom(exc: BaseException) -> bool:
     """True when the retry state machine should handle ``exc``: a buffer
     catalog OutOfBudgetError (including injected OOM) or an XLA
@@ -511,7 +519,8 @@ def _final_oom(exc: BaseException, cat: BufferCatalog, name: str,
 def with_retry(inp, body: Callable, split: Optional[Callable] = None,
                *, catalog: Optional[BufferCatalog] = None, name: str = "op",
                max_retries: Optional[int] = None, semaphore=None,
-               close_input: bool = True):
+               close_input: bool = True,
+               cancelled: Optional[Callable[[], bool]] = None):
     """Generator: run ``body`` over ``inp`` and whatever ``split`` makes
     of it under OOM, yielding each result in input-row order.
 
@@ -523,7 +532,13 @@ def with_retry(inp, body: Callable, split: Optional[Callable] = None,
     effects (e.g. close staged catalog handles) before letting a
     retryable OOM propagate — the framework restores pins, not arbitrary
     state. Items are closed after use when ``close_input`` (and on any
-    raise), matching withRetry's ownership of its spillable input."""
+    raise), matching withRetry's ownership of its spillable input.
+
+    ``cancelled`` (optional) is polled before every attempt: a retry
+    storm must not ride out its whole backoff budget after the server
+    already cancelled the query (stop()/watchdog during a lineage
+    recompute) — the loop raises RetryCancelledError instead of
+    re-running the body."""
     cat = catalog
     if cat is None:
         from .catalog import device_budget
@@ -542,6 +557,12 @@ def with_retry(inp, body: Callable, split: Optional[Callable] = None,
             item = work.popleft()
             attempt = 0
             while True:
+                if cancelled is not None and cancelled():
+                    _close_item(item)
+                    raise RetryCancelledError(
+                        f"{name}: cancelled before attempt "
+                        f"{attempt + 1} — the query was stopped while "
+                        f"its retry loop was recovering")
                 snap = cat.pin_snapshot()
                 try:
                     if attempt == 0 or not _POLICY.enabled:
@@ -611,14 +632,15 @@ _NO_INPUT = _NoInput()
 
 def with_retry_no_split(body: Callable, *, catalog: Optional[BufferCatalog]
                         = None, name: str = "op",
-                        max_retries: Optional[int] = None, semaphore=None):
+                        max_retries: Optional[int] = None, semaphore=None,
+                        cancelled: Optional[Callable[[], bool]] = None):
     """Run a no-argument ``body`` under the retry loop (no split policy:
     final merges, broadcast builds, single acquires). Returns the body's
     result (reference: withRetryNoSplit)."""
     return next(with_retry(_NO_INPUT, lambda _i: body(), split=None,
                            catalog=catalog, name=name,
                            max_retries=max_retries, semaphore=semaphore,
-                           close_input=False))
+                           close_input=False, cancelled=cancelled))
 
 
 def acquire_with_retry(sb: SpillableBatch, *, catalog: Optional[BufferCatalog]

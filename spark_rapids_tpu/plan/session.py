@@ -182,10 +182,12 @@ class Session:
         watermark."""
         from ..exec.python_exec import _python_semaphore
         from ..memory.retry import metrics as _retry_metrics
+        from ..shuffle.lineage import metrics as _lineage_metrics
         from ..shuffle.transport import transport_metrics
         from . import plancache
         self._retry0 = _retry_metrics().snapshot()
         self._net0 = transport_metrics().snapshot()
+        self._lineage0 = _lineage_metrics().snapshot()
         self._sem_wait0 = _python_semaphore.wait_time_ns
         self._cache0 = plancache.metrics().snapshot()
 
@@ -415,6 +417,12 @@ class Session:
         from ..shuffle.transport import transport_metrics
         emit_deltas("net", transport_metrics().snapshot(),
                     getattr(self, "_net0", None))
+        # query-recovery counters (recomputeCount / recomputedPartitions
+        # / replicaBytes / lineageMissCount): the lineage plane's answer
+        # to "did this query survive a lost executor, and how"
+        from ..shuffle.lineage import metrics as _lineage_metrics
+        emit_deltas("lineage", _lineage_metrics().snapshot(),
+                    getattr(self, "_lineage0", None))
         # serving-cache counters (plan/result hit/miss/eviction/
         # invalidation) since this session's last collect opened
         from . import plancache

@@ -56,6 +56,14 @@ class ExecutorRuntime:
         self.fatal_error: Optional[BaseException] = None
         self.started_at = time.time()
         self._heartbeats: Dict[str, float] = {}
+        #: executors a transport PROVED unreachable: they need a fresh
+        #: register() handshake to count as live again — a stray late
+        #: heartbeat must not resurrect a dead block server
+        self._dead_executors: set = set()
+        #: guards _heartbeats + _dead_executors together: the dead check
+        #: and the stamp must be one atomic step, or a concurrent
+        #: mark_unreachable between them gets silently undone
+        self._hb_lock = threading.Lock()
         self._hb_senders: List[tuple] = []      # (thread, stop event)
 
         self._version_handshake()
@@ -185,11 +193,35 @@ class ExecutorRuntime:
     # registry of executor heartbeats for shuffle peer discovery)
     # ------------------------------------------------------------------
 
-    def heartbeat(self, executor_id) -> None:
+    def register(self, executor_id) -> None:
+        """The explicit liveness handshake: clears a dead promotion and
+        stamps the executor live. mark_unreachable + register is the
+        full suspect→dead→rehabilitated cycle; a bare heartbeat only
+        covers the live legs."""
+        eid = str(executor_id)
+        with self._hb_lock:
+            self._dead_executors.discard(eid)
+            self._heartbeats[eid] = time.time()
+
+    def heartbeat(self, executor_id) -> bool:
+        """Stamp liveness unless the executor was promoted dead; returns
+        False (refused) for a dead one — it must register() afresh. The
+        dead check and the stamp are ONE atomic step under the lock, so
+        a concurrent mark_unreachable cannot be silently undone by a
+        heartbeat that already passed the check."""
         # keys normalize to str: the CACHED-shuffle registry path hands
         # the transport INT executor ids (spark.rapids.tpu.executorId)
         # while in-process callers use strings — one table serves both
-        self._heartbeats[str(executor_id)] = time.time()
+        eid = str(executor_id)
+        with self._hb_lock:
+            if eid in self._dead_executors:
+                # a transport PROVED this executor's block server dead;
+                # a stray late heartbeat must not silently resurrect it
+                # into every reader's fetch ordering — rehabilitation
+                # requires the explicit register() handshake
+                return False
+            self._heartbeats[eid] = time.time()
+        return True
 
     def start_heartbeat(self, executor_id: str,
                         interval_s: Optional[float] = None
@@ -205,8 +237,37 @@ class ExecutorRuntime:
                 CACHED_HEARTBEAT_INTERVAL_MS.key) / 1000.0
 
         def loop():
+            # a FRESH sender is the registration handshake (the executor
+            # restating itself); subsequent stamps are plain heartbeats.
+            # A refused beat means this executor was promoted dead while
+            # its sender is demonstrably alive (transient partition) —
+            # perform the explicit re-register handshake, the same
+            # rehabilitation RegistryClient._beat does on the wire. A
+            # truly dead executor has no sender, so stray late beats
+            # from other callers still cannot resurrect it. Re-registers
+            # BACK OFF exponentially while refusals keep recurring: a
+            # HALF-dead executor (heartbeat thread alive, block server
+            # wedged) would otherwise undo its promotion every interval
+            # and re-tax every reader's fetch with the very timeouts the
+            # promotion exists to remove; the backoff resets only after
+            # a sustained healthy stretch.
+            self.register(executor_id)
+            rereg_backoff = interval_s
+            last_rereg = time.time()
+            healthy = 0
             while not stop.is_set():
-                self.heartbeat(executor_id)
+                if self.heartbeat(executor_id):
+                    healthy += 1
+                    if healthy >= 10:
+                        rereg_backoff = interval_s
+                else:
+                    healthy = 0
+                    now = time.time()
+                    if now - last_rereg >= rereg_backoff:
+                        self.register(executor_id)
+                        last_rereg = now
+                        rereg_backoff = min(rereg_backoff * 2,
+                                            max(60.0, interval_s))
                 stop.wait(interval_s)
 
         t = threading.Thread(target=loop, daemon=True,
@@ -222,8 +283,13 @@ class ExecutorRuntime:
         immediately instead of coasting until its heartbeat ages out —
         subsequent list_blocks calls skip it without paying a socket
         timeout (reference: transport errors feeding the
-        RapidsShuffleHeartbeatManager's executor-death bookkeeping)."""
-        self._heartbeats.pop(str(executor_id), None)
+        RapidsShuffleHeartbeatManager's executor-death bookkeeping).
+        The removal is a PROMOTION to dead, not mere staleness: only an
+        explicit register() brings the executor back."""
+        eid = str(executor_id)
+        with self._hb_lock:
+            self._dead_executors.add(eid)
+            self._heartbeats.pop(eid, None)
 
     def live_executors(self, timeout_s: Optional[float] = None
                        ) -> List[str]:
@@ -232,8 +298,12 @@ class ExecutorRuntime:
             timeout_s = self.conf.get(
                 CACHED_HEARTBEAT_TIMEOUT_MS.key) / 1000.0
         now = time.time()
-        return [e for e, t in self._heartbeats.items()
-                if now - t <= timeout_s]
+        with self._hb_lock:
+            # snapshot under the same lock the sender threads stamp
+            # under — iterating a dict a register() is inserting into
+            # raises "dictionary changed size during iteration"
+            return [e for e, t in self._heartbeats.items()
+                    if now - t <= timeout_s]
 
     def shutdown(self) -> None:
         # deterministic teardown: stop AND join the senders so no stamp

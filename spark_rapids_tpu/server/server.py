@@ -356,15 +356,25 @@ class _Handler(socketserver.BaseRequestHandler):
                 # device-resident state of concurrent device tenants
                 reserve = srv.query_reserve_for(df) \
                     if prepared[0] == "exec" else 0
+                from ..shuffle import lineage
                 try:
                     with srv.query_admission.admit(
-                            reserve, cancelled=cancelled):
+                            reserve, cancelled=cancelled), \
+                            lineage.cancel_scope(
+                                cancelled, exc=QueryCancelledError):
                         # the test-only collect delay runs INSIDE the
                         # admitted region so collectDelayMs holds a real
                         # collect slot — deterministic admission
                         # contention for the watchdog/serialization
                         # tests (cancellation semantics are unchanged:
-                        # the delay loop polls the same cancel flag)
+                        # the delay loop polls the same cancel flag).
+                        # The lineage cancel scope makes stop()/watchdog
+                        # cancellation observable INSIDE a collect whose
+                        # exchange read is recomputing lost partitions:
+                        # the recompute loop polls the flag between
+                        # recoveries (and between retry attempts),
+                        # raises QueryCancelledError, and this admit
+                        # context releases the slot on unwind.
                         self._check_cancel(cancelled, ses)
                         try:
                             result = ses.collect(df, _prepared=prepared)
@@ -500,8 +510,10 @@ class PlanServer:
             return len(self._server.active_queries)
 
     def serving_stats(self) -> dict:
-        """Cache + admission snapshot (the loadbench/ops surface)."""
+        """Cache + admission + recovery snapshot (the loadbench/ops
+        surface)."""
         from ..plan import plancache
+        from ..shuffle.lineage import metrics as lineage_metrics
         adm = self._server.query_admission
         return {
             "planCacheEntries": len(plancache.planning_cache()),
@@ -513,6 +525,9 @@ class PlanServer:
                 "inFlight": adm.in_flight,
                 "waitTimeNs": adm.wait_time_ns,
             },
+            # the query-recovery plane: how often serving survived a
+            # lost executor by recompute vs replica
+            "lineage": lineage_metrics().snapshot(),
         }
 
     def start(self) -> "PlanServer":

@@ -13,5 +13,7 @@ from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
 from .multithreaded import MultithreadedShuffleExchangeExec
 from .transport import (BlockCorruptError, BlockMissingError,
                         PeerUnreachableError, TransportError)
+from .lineage import (LineageMissError, LineageRegistry,
+                      LineageVerificationError, lineage_registry)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -80,11 +80,29 @@ class DeviceShuffleCache:
     def fetch(self, shuffle_id: int, map_id: int, reduce_id: int,
               schema: Schema) -> ColumnarBatch:
         """Local catalog hit or a transport pull from whichever LIVE peer
-        owns the block; the deserialized batch lands on THIS device."""
+        owns the block; the deserialized batch lands on THIS device.
+        A fetch that exhausts failover (missing everywhere / dead peer)
+        falls through to lineage recompute when the shuffle is
+        lineage-TRACKED in this process; otherwise (the CACHED mode's
+        device-resident blocks register no recompute recipe, or lineage
+        is disabled) the typed transport error propagates unchanged —
+        re-typing it as a lineage miss would charge the lineageMissCount
+        metric for a feature that was never in play."""
+        from .transport import BlockMissingError, PeerUnreachableError
         local = self.get_local(shuffle_id, map_id, reduce_id)
         if local is not None:
             return local
-        data = self.transport.fetch(shuffle_id, map_id, reduce_id)
+        try:
+            data = self.transport.fetch(shuffle_id, map_id, reduce_id)
+        except (BlockMissingError, PeerUnreachableError) as ex:
+            from .lineage import current_cancel, lineage_registry
+            reg = lineage_registry()
+            if not reg.knows_shuffle(shuffle_id):
+                raise
+            data = reg.recover(
+                shuffle_id, map_id, reduce_id, catalog=self.catalog,
+                cancel=current_cancel(), cause=ex)
+            self.transport.publish(shuffle_id, map_id, reduce_id, data)
         return deserialize_batch(data, schema)
 
     def remove_shuffle(self, shuffle_id: int) -> None:
@@ -178,6 +196,19 @@ def shared_device_cache(conf=None) -> DeviceShuffleCache:
                             CACHED_HEARTBEAT_INTERVAL_MS.key) / 1000.0)
                     transport.peer_source = client.peers
                     transport._registry_client = client
+                    # unreachable verdicts fan out to the DRIVER registry
+                    # too (suspect→dead promotion is cluster-wide): every
+                    # executor's listing drops the dead peer, and only a
+                    # fresh register handshake brings it back
+                    local_report = transport.on_unreachable
+
+                    def report(peer_id, _local=local_report,
+                               _client=client):
+                        if _local is not None:
+                            _local(peer_id)
+                        _client.report_unreachable(peer_id)
+
+                    transport.on_unreachable = report
             _SHARED = DeviceShuffleCache(transport, codec=codec)
         return _SHARED
 

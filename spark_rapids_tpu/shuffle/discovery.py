@@ -32,8 +32,27 @@ class _RegistryHandler(socketserver.StreamRequestHandler):
             msg = json.loads(line)
             reg = self.server.registry       # type: ignore
             op = msg.get("op")
-            if op in ("register", "heartbeat"):
-                reg._stamp(msg["id"], msg.get("host"), msg.get("port"))
+            if op == "register":
+                reg.register_peer(msg["id"], msg.get("host"),
+                                  msg.get("port"))
+                self.wfile.write(b'{"ok": true}\n')
+            elif op == "heartbeat":
+                status = reg.heartbeat_peer(msg["id"], msg.get("host"),
+                                            msg.get("port"))
+                # a heartbeat from an executor this registry declared
+                # DEAD is refused (not stamped): resurrection requires
+                # the explicit re-register handshake, and the reply
+                # tells the sender so it can perform it. UNKNOWN covers
+                # a registry that lost its table (restart): the sender
+                # believes it is heartbeating but nothing is stamped —
+                # it too must re-register (with its address).
+                self.wfile.write(
+                    b'{"ok": true}\n' if status == "ok" else
+                    b'{"ok": false, "dead": true}\n'
+                    if status == "dead" else
+                    b'{"ok": false, "unknown": true}\n')
+            elif op == "unreachable":
+                reg.mark_unreachable(msg["id"])
                 self.wfile.write(b'{"ok": true}\n')
             elif op == "list":
                 self.wfile.write(
@@ -52,12 +71,22 @@ class _RegistryServer(socketserver.ThreadingTCPServer):
 
 
 class PeerRegistry:
-    """Driver-side executor table: id -> (host, port, last_seen)."""
+    """Driver-side executor table: id -> (host, port, last_seen).
+
+    Death is PROMOTED state, not just staleness: an executor a transport
+    reported unreachable (``mark_unreachable``) leaves the live table
+    AND lands in the dead set — a stray late heartbeat from it is
+    refused, because its block server already proved unreachable and
+    resurrecting it on a one-line ping would put a half-dead peer back
+    into every reader's fetch ordering. Rehabilitation requires the
+    explicit ``register`` handshake (the executor restating its block
+    server address), after which it returns to normal ordering."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  timeout_s: float = 30.0):
         self.timeout_s = timeout_s
         self._table: Dict[int, Tuple[str, int, float]] = {}
+        self._dead: set = set()
         self._lock = threading.Lock()
         self._server = _RegistryServer((host, port), _RegistryHandler)
         self._server.registry = self         # type: ignore
@@ -67,15 +96,55 @@ class PeerRegistry:
             name="peer-registry")
         self._thread.start()
 
-    def _stamp(self, exec_id: int, host: Optional[str],
-               port: Optional[int]) -> None:
+    def _stamp_locked(self, exec_id: int, host: Optional[str],
+                      port: Optional[int]) -> bool:
+        """Insert/update one liveness entry; caller holds self._lock.
+        Returns False when nothing was stamped (address-less ping for an
+        executor this registry has no entry for — e.g. after a restart
+        emptied the table); the caller must surface that, or the sender
+        keeps heartbeating into the void while excluded from every
+        listing."""
+        prev = self._table.get(exec_id)
+        if host is None or port is None:
+            if prev is None:
+                return False
+            host, port = prev[0], prev[1]
+        self._table[exec_id] = (host, int(port), time.time())
+        return True
+
+    def register_peer(self, exec_id: int, host: Optional[str],
+                      port: Optional[int]) -> None:
+        """The explicit liveness handshake — clears promoted-dead state
+        and stamps in ONE atomic step under the lock."""
         with self._lock:
-            prev = self._table.get(exec_id)
-            if host is None or port is None:
-                if prev is None:
-                    return
-                host, port = prev[0], prev[1]
-            self._table[exec_id] = (host, int(port), time.time())
+            self._dead.discard(str(exec_id))
+            self._stamp_locked(exec_id, host, port)
+
+    def heartbeat_peer(self, exec_id: int, host: Optional[str] = None,
+                       port: Optional[int] = None) -> str:
+        """Stamp liveness and return "ok"; "dead" (refused — promoted
+        dead, must re-register) or "unknown" (nothing stamped: an
+        address-less ping for an executor this registry has no entry
+        for, i.e. the table was lost — must re-register with its
+        address). The dead check and the stamp happen under ONE lock
+        hold: a concurrent `unreachable` report between them must not
+        be undone by a heartbeat that already passed the check (that
+        would re-insert the half-dead peer into live_table for up to
+        timeout_s)."""
+        with self._lock:
+            if str(exec_id) in self._dead:
+                return "dead"
+            if not self._stamp_locked(exec_id, host, port):
+                return "unknown"
+        return "ok"
+
+    def mark_unreachable(self, exec_id) -> None:
+        """Suspect→dead promotion: a transport's fetch retry budget was
+        exhausted against this executor's block server."""
+        with self._lock:
+            self._dead.add(str(exec_id))
+            for k in [k for k in self._table if str(k) == str(exec_id)]:
+                del self._table[k]
 
     def live_table(self) -> Dict[str, Tuple[str, int]]:
         now = time.time()
@@ -115,11 +184,57 @@ class RegistryClient:
         return json.loads(data) if data else {}
 
     def _beat(self, interval_s: float) -> None:
+        # re-registers back off exponentially while refusals recur: a
+        # HALF-dead executor (beat loop alive, block server wedged)
+        # must not undo its dead promotion every interval and re-tax
+        # every reader's fetch ordering; a healthy stretch resets it
+        rereg_backoff = interval_s
+        last_rereg = time.time()
+        healthy = 0
         while not self._stop.wait(interval_s):
             try:
-                self._rpc({"op": "heartbeat", "id": self.exec_id})
-            except OSError:  # net-ok: registry down — peers see us expire
+                resp = self._rpc({"op": "heartbeat", "id": self.exec_id})
+                if resp.get("dead") or resp.get("unknown"):
+                    # dead: the registry promoted us dead (a peer's
+                    # transport reported our block server unreachable,
+                    # e.g. a transient partition) — a bare heartbeat can
+                    # NEVER resurrect us. unknown: the registry lost its
+                    # table (restart) and our address-less ping stamps
+                    # nothing. Both rehabilitate the same way: the
+                    # explicit re-register handshake restating our
+                    # address.
+                    healthy = 0
+                    now = time.time()
+                    if now - last_rereg >= rereg_backoff:
+                        self.reregister()
+                        last_rereg = now
+                        rereg_backoff = min(rereg_backoff * 2,
+                                            max(60.0, interval_s))
+                else:
+                    healthy += 1
+                    if healthy >= 10:
+                        rereg_backoff = interval_s
+            except (OSError, ValueError):
+                # net-ok: registry down — peers see us expire
                 pass
+
+    def reregister(self) -> None:
+        """Fresh register handshake (rehabilitation after a dead
+        promotion, or a registry restart that lost the table)."""
+        self._rpc({"op": "register", "id": self.exec_id,
+                   "host": self.block_addr[0],
+                   "port": self.block_addr[1]})
+
+    def report_unreachable(self, peer_id) -> None:
+        """Transport hook: tell the driver registry a peer's block
+        server proved unreachable, so every executor's listing excludes
+        it (suspect→dead promotion is cluster-wide, not just local)."""
+        try:
+            self._rpc({"op": "unreachable", "id": peer_id})
+        except (OSError, ValueError):
+            # net-ok: best-effort death report — the local suspect
+            # ordering still covers this transport's own fetches
+            pass
 
     def peers(self) -> Dict[int, Tuple[str, int]]:
         """Live peer table EXCLUDING self — TcpTransport peer_source."""

@@ -1,0 +1,464 @@
+"""Shuffle lineage registry: deterministic lost-partition recompute.
+
+Reference: Spark's MapOutputTracker + stage-resubmission story, compressed
+to the fragment level (SURVEY.md §5 names executor death as the one
+failure the plugin delegates to Spark's task retry; a standalone engine
+has to supply that recovery itself). Theseus (PAPERS.md) frames the same
+requirement as treating executor loss as a data-movement event, not a
+query abort.
+
+Every published map output records its LINEAGE: the producing plan
+fragment (a deterministic recompute closure over the exchange's child
+partition stream), a digest of its input splits (the PR-10 fingerprint
+machinery), and a content digest per published block. When a reduce-side
+fetch exhausts failover — ``BlockMissingError`` with no serving peer, or
+``PeerUnreachableError`` on a dead executor — the registry re-runs
+exactly the lost map partition:
+
+- the re-run rides the PR-7 ``with_retry`` state machine, so a recompute
+  that lands on a memory-pressured host survives OOM like any task;
+- partitioning is hash-deterministic and serialization is canonical, so
+  the recomputed block is BIT-FOR-BIT the lost one — and the recorded
+  content digest is verified to prove it (a nondeterministic fragment
+  fails loudly instead of resuming with silently-different rows);
+- recovered blocks are republished to the local transport so sibling
+  reads (and peers) fetch them without recomputing again.
+
+Replication (``spark.rapids.tpu.shuffle.replicas``) makes recompute the
+FALLBACK rather than the only path: map outputs written to K peers at
+publish time are served from a replica after the primary dies, and the
+``replicaBytes``/``recomputeCount`` counters make the difference
+observable in ``Session.metrics()`` and ``serving_stats()``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .transport import BlockMissingError, PeerUnreachableError, TransportError
+
+
+class LineageMissError(TransportError):
+    """The lost block has no recorded lineage — nothing can recompute it
+    (a foreign shuffle, lineage disabled, or the fragment was already
+    cleaned up). The fetch failure that triggered recovery propagates as
+    this error's ``__cause__``."""
+
+
+class LineageVerificationError(TransportError):
+    """The recomputed block does not match the content digest recorded at
+    publish time — the producing fragment is NOT deterministic (or its
+    inputs changed underneath it). Failing loudly here is the contract:
+    recovery must resume bit-for-bit or not at all."""
+
+
+class RecomputeCancelledError(RuntimeError):
+    """The server cancelled the query (stop()/watchdog) while its
+    recompute loop was running; the loop observed the flag and unwound."""
+
+
+def _digest(payload: bytes) -> str:
+    """Content digest of one serialized block (the PR-10 digest shape —
+    blake2b-128, same as plancache.digest_ipc)."""
+    return hashlib.blake2b(payload, digest_size=16).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# metrics (rolled into Session.metrics() as `lineage.*` deltas and into
+# PlanServer.serving_stats(), like the retry/net counter groups)
+# ---------------------------------------------------------------------------
+
+class LineageMetrics:
+    """Process-wide recovery counters; sessions report deltas."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.recompute_count = 0
+        self.recomputed_partitions = 0     # monotonic distinct-block count
+        self.replica_bytes = 0
+        self.lineage_miss_count = 0
+        #: distinct block ids currently deduping recomputedPartitions —
+        #: purged per shuffle at cleanup (forget_shuffle), so a
+        #: long-running serving process does not accumulate ids forever;
+        #: the counter above stays monotonic for delta reporting
+        self._recomputed_blocks = set()
+
+    def note_recompute(self, block_id: Tuple[int, int, int]) -> None:
+        with self._lock:
+            self.recompute_count += 1
+            if block_id not in self._recomputed_blocks:
+                self._recomputed_blocks.add(block_id)
+                self.recomputed_partitions += 1
+
+    def forget_shuffle(self, shuffle_id: int) -> None:
+        """Drop the dedup entries of one finished shuffle (its blocks
+        can never be recomputed again — the lineage is gone too)."""
+        with self._lock:
+            self._recomputed_blocks = {
+                b for b in self._recomputed_blocks if b[0] != shuffle_id}
+
+    def note_replica(self, nbytes: int) -> None:
+        with self._lock:
+            self.replica_bytes += int(nbytes)
+
+    def note_miss(self) -> None:
+        with self._lock:
+            self.lineage_miss_count += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "recomputeCount": self.recompute_count,
+                "recomputedPartitions": self.recomputed_partitions,
+                "replicaBytes": self.replica_bytes,
+                "lineageMissCount": self.lineage_miss_count,
+            }
+
+
+_METRICS = LineageMetrics()
+
+
+def metrics() -> LineageMetrics:
+    return _METRICS
+
+
+# ---------------------------------------------------------------------------
+# query-cancellation plumbing (the plan server installs its cancel flag
+# around collect; the recompute loop polls it between recoveries so
+# stop()/watchdog cancellation lands instead of riding out the recovery)
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+@contextmanager
+def cancel_scope(cancelled: Callable[[], bool], exc: type = None):
+    """Install ``cancelled`` as the calling thread's recompute-cancel
+    hook; ``exc`` (default RecomputeCancelledError) is raised when it
+    fires. The exchange read captures the hook via ``current_cancel()``
+    on the query thread and carries it into the recovery pool."""
+    prev = getattr(_TLS, "cancel", None)
+    _TLS.cancel = (cancelled, exc or RecomputeCancelledError)
+    try:
+        yield
+    finally:
+        _TLS.cancel = prev
+
+
+def current_cancel() -> Optional[Tuple[Callable[[], bool], type]]:
+    """The (cancelled, exc) hook installed on THIS thread, if any."""
+    return getattr(_TLS, "cancel", None)
+
+
+def in_active_recovery() -> bool:
+    """True while THIS thread is inside a recompute re-run — reads made
+    by the re-executed fragment are nested recoveries and must not wait
+    on the recover lock their outer recovery already holds."""
+    return bool(getattr(_TLS, "in_recovery", False))
+
+
+def _check_cancel(cancel) -> None:
+    if cancel is not None and cancel[0]():
+        raise cancel[1](
+            "recompute cancelled by the server (stop()/watchdog)")
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+class FragmentLineage:
+    """Lineage of ONE map output: the producing fragment's recompute
+    closure, its input-split digest, and the content digest of every
+    block it published."""
+
+    __slots__ = ("shuffle_id", "map_id", "recompute", "input_digest",
+                 "blocks", "recovered")
+
+    def __init__(self, shuffle_id: int, map_id: int,
+                 recompute: Callable[..., Dict[int, Optional[bytes]]],
+                 input_digest: str):
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        #: recompute(reduce_ids) -> {reduce_id: serialized block bytes}
+        #: for EVERY asked partition in one re-execution of the fragment
+        #: — a dead peer usually loses a whole map output, and one
+        #: child re-run must not be paid once per lost reducer
+        self.recompute = recompute
+        self.input_digest = input_digest
+        #: reduce_id -> content digest recorded at publish time
+        self.blocks: Dict[int, str] = {}
+        #: verified sibling blocks stashed by a recovery run, served to
+        #: later recover() calls without re-running the fragment
+        self.recovered: Dict[int, bytes] = {}
+
+
+class LineageRegistry:
+    """Map-output lineage of every shuffle this process produced.
+
+    Registration happens on the map side (one fragment per input batch,
+    one block note per published piece); ``recover`` is the reduce-side
+    entry point once transport failover is exhausted. Recoveries
+    serialize on one lock — a recompute re-executes a plan fragment on
+    the device, and racing several per lost host would multiply peak
+    memory exactly when a failure already has the fleet degraded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._recover_lock = threading.Lock()
+        #: shuffle_id -> {map_id: FragmentLineage}: listings and cleanup
+        #: touch ONLY their own shuffle — a flat (s, m)-keyed dict would
+        #: make every read partition of every query scan every live
+        #: fragment in the process under one lock (the serving tier
+        #: holds many concurrent queries' fragments at once)
+        self._shuffles: Dict[int, Dict[int, FragmentLineage]] = {}
+
+    # ---- map side -----------------------------------------------------
+
+    def register_shuffle(self, shuffle_id: int) -> None:
+        """Mark a shuffle as lineage-tracked even before (or without)
+        any fragment: a child that yields ZERO batches still needs
+        ``knows_shuffle`` true, or an empty shuffle behind a dead peer
+        would fail its listing instead of reading as provably empty."""
+        with self._lock:
+            self._shuffles.setdefault(shuffle_id, {})
+
+    def register_fragment(self, shuffle_id: int, map_id: int,
+                          recompute: Callable[..., Dict[int,
+                                                        Optional[bytes]]],
+                          input_digest: str) -> None:
+        with self._lock:
+            self._shuffles.setdefault(shuffle_id, {})[map_id] = \
+                FragmentLineage(shuffle_id, map_id, recompute,
+                                input_digest)
+
+    def note_block(self, shuffle_id: int, map_id: int, reduce_id: int,
+                   payload: bytes) -> None:
+        """Record a published block's content digest (the bit-for-bit
+        verification target for its eventual recompute)."""
+        # hash OUTSIDE the lock: every shuffle writer thread of every
+        # concurrent query funnels through here, and a multi-MB blake2b
+        # under the registry lock would serialize them all on it
+        digest = _digest(payload)
+        with self._lock:
+            ent = self._shuffles.get(shuffle_id, {}).get(map_id)
+            if ent is not None:
+                ent.blocks[reduce_id] = digest
+
+    # ---- reduce side --------------------------------------------------
+
+    def knows_shuffle(self, shuffle_id: int) -> bool:
+        """True when this process registered lineage for the shuffle —
+        the listing in ``blocks`` is then AUTHORITATIVE, including an
+        empty one (a reducer no map output produced rows for), so the
+        read side can survive a dead peer's failed listing outright."""
+        with self._lock:
+            return shuffle_id in self._shuffles
+
+    def blocks(self, shuffle_id: int, reduce_id: int
+               ) -> List[Tuple[int, int, int]]:
+        """Every block lineage knows for one reducer — the AUTHORITATIVE
+        listing the read side unions with the transport's: a dead peer
+        excluded from live listing must surface its blocks here (and be
+        recomputed), never silently drop their rows."""
+        with self._lock:
+            return sorted(
+                (shuffle_id, m, reduce_id)
+                for m, ent in self._shuffles.get(shuffle_id, {}).items()
+                if reduce_id in ent.blocks)
+
+    def recover(self, shuffle_id: int, map_id: int, reduce_id: int, *,
+                catalog=None, cancel=None,
+                cause: Optional[BaseException] = None,
+                nested: Optional[bool] = None) -> bytes:
+        """Deterministically recompute one lost block and verify it
+        against the digest recorded at publish. Raises LineageMissError
+        (chaining ``cause``) when the block has no lineage, and the
+        cancel-scope exception when the server cancelled the query.
+
+        Serialization vs deadlock: top-level recoveries take the
+        recover lock (racing several fragment re-runs would multiply
+        peak memory exactly when a failure has the fleet degraded), but
+        a NESTED recovery — the recompute of shuffle B re-executes a
+        child whose own exchange-A read needs recovery — must NOT wait
+        on it: the outer recompute holds the lock while blocking on the
+        inner fetch, a permanent circular wait. recover() marks its
+        recompute's thread ``in_active_recovery``; the nested fetcher
+        (created inside that re-execution) carries the flag to its pool
+        threads via ``nested=`` and skips the lock. The lock acquire
+        itself polls the cancel flag, so stop()/watchdog can always
+        unwind a recovery stuck waiting its turn."""
+        with self._lock:
+            ent = self._shuffles.get(shuffle_id, {}).get(map_id)
+            expect = ent.blocks.get(reduce_id) if ent is not None else None
+        if expect is None:
+            _METRICS.note_miss()
+            raise LineageMissError(
+                f"block s{shuffle_id}-m{map_id}-r{reduce_id} has no "
+                f"recorded lineage — cannot recompute the lost "
+                f"partition") from cause
+        _check_cancel(cancel)
+        if nested is None:
+            nested = in_active_recovery()
+        stashed = self._serve_stash(ent, reduce_id)
+        if stashed is not None:
+            return stashed
+        if not nested:
+            if cancel is None:
+                # no cancel hook to poll — a plain blocking acquire
+                # instead of a 20 Hz spin on an already-degraded host
+                self._recover_lock.acquire()  # retry-ok: threading lock, not a catalog pin
+            else:
+                while not self._recover_lock.acquire(timeout=0.05):
+                    _check_cancel(cancel)
+        try:
+            # the flag may have fired while this recovery waited behind
+            # another — observe it before starting device work, and let
+            # the retry loop observe it between OOM re-attempts too
+            _check_cancel(cancel)
+            # a racing recovery of a SIBLING block may have filled the
+            # stash while this one waited its turn for the lock
+            stashed = self._serve_stash(ent, reduce_id)
+            if stashed is not None:
+                return stashed
+            from ..memory.retry import RetryCancelledError, \
+                with_retry_no_split
+            # ONE fragment re-run recovers every block this map output
+            # published: a dead peer usually loses the whole output, and
+            # re-executing the child once per lost reducer would
+            # multiply recovery wall-time exactly when the fleet is
+            # degraded — siblings are verified and stashed for the
+            # other reducers' recover() calls
+            wanted = tuple(sorted(ent.blocks))
+            prev = getattr(_TLS, "in_recovery", False)
+            _TLS.in_recovery = True
+            try:
+                out = with_retry_no_split(
+                    lambda: ent.recompute(wanted), catalog=catalog,
+                    name=f"lineage.recompute(s{shuffle_id})",
+                    cancelled=cancel[0] if cancel is not None else None)
+            except RetryCancelledError as ce:
+                raise (cancel[1] if cancel is not None
+                       else RecomputeCancelledError)(str(ce)) from ce
+            finally:
+                _TLS.in_recovery = prev
+            out = out or {}
+            for r_, digest in ent.blocks.items():
+                got = out.get(r_)
+                if got is None or _digest(got) != digest:
+                    # the input digest NAMES the misbehaving recipe
+                    # (schema sig + fragment coordinates) so the report
+                    # identifies which plan fragment's re-run diverged
+                    raise LineageVerificationError(
+                        f"recomputed block s{shuffle_id}-m{map_id}-"
+                        f"r{r_} (fragment {ent.input_digest}) does not "
+                        f"match its publish-time digest — the producing "
+                        f"fragment is not deterministic; refusing to "
+                        f"resume with different rows") from cause
+            with self._lock:
+                ent.recovered.update(
+                    (r_, b) for r_, b in out.items() if r_ != reduce_id)
+        finally:
+            if not nested:
+                self._recover_lock.release()
+        _METRICS.note_recompute((shuffle_id, map_id, reduce_id))
+        return out[reduce_id]
+
+    def _serve_stash(self, ent: FragmentLineage,
+                     reduce_id: int) -> Optional[bytes]:
+        """Pop an already-verified sibling block from a prior recovery
+        run of the same fragment (or None when absent)."""
+        with self._lock:
+            got = ent.recovered.pop(reduce_id, None)
+        if got is not None:
+            _METRICS.note_recompute(
+                (ent.shuffle_id, ent.map_id, reduce_id))
+        return got
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._shuffles.pop(shuffle_id, None)
+        # the metrics dedup set follows the lineage out: its blocks can
+        # never recompute again, so keeping their ids would only leak
+        _METRICS.forget_shuffle(shuffle_id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._shuffles.values())
+
+
+_REGISTRY = LineageRegistry()
+
+
+def lineage_registry() -> LineageRegistry:
+    """The process-wide registry (the executor-singleton shape every
+    other recovery layer uses); tests construct private instances."""
+    return _REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# recovering fetch (the reduce-side seam)
+# ---------------------------------------------------------------------------
+
+class _NeedsRecovery:
+    """Sentinel a pool fetch task returns instead of BLOCKING in
+    recovery: pool workers must never wait on the recover lock or run a
+    recompute (whose re-executed child may submit work to the very same
+    shared reader pool — all workers occupied by waiting recoveries is
+    a process-wide deadlock). Recovery runs on the CONSUMING thread."""
+
+    __slots__ = ("cause",)
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+
+
+def fetch_many_with_recovery(transport, ids, registry: LineageRegistry,
+                             max_in_flight: int = 4, republish=None,
+                             catalog=None, cancel=None):
+    """``transport.fetch_many`` with per-block lineage recovery: a fetch
+    that exhausts failover (missing everywhere, or the serving peer is
+    dead) recomputes the block instead of raising, republishes it via
+    ``republish`` (normally the reading transport's local store, so
+    sibling reads and peers are served without recomputing again), and
+    resumes the pipelined read bit-for-bit. Yields (block_id, bytes) in
+    input order, like fetch_many.
+
+    Threading: pool tasks only FETCH (bounded by the transport's
+    deadlines); every recovery runs on the consuming thread, in yield
+    order — so neither the recover lock's wait nor the recompute itself
+    can tie up shared pool workers, and a read nested inside another
+    recompute fetches serially instead of competing for the pool."""
+    # evaluated on the CONSUMING thread at first next(): when this read
+    # runs inside a recompute re-run (nested recovery), its recoveries
+    # skip the recover lock the outer recovery already holds
+    nested = in_active_recovery()
+
+    def fetch_one(b):
+        try:
+            return transport.fetch(*b)
+        except (BlockMissingError, PeerUnreachableError) as ex:
+            return _NeedsRecovery(ex)
+
+    def stream():
+        if nested:
+            for b in list(ids):
+                yield b, fetch_one(b)
+            return
+        from ..io.source import bounded_map, reader_pool
+        pool = reader_pool(max(2, max_in_flight))
+        yield from bounded_map(pool, list(ids), fetch_one, max_in_flight,
+                               force_parallel=True)
+
+    for b, got in stream():
+        if isinstance(got, _NeedsRecovery):
+            got = registry.recover(*b, catalog=catalog, cancel=cancel,
+                                   cause=got.cause, nested=nested)
+            if republish is not None:
+                republish(*b, got)
+        yield b, got

@@ -56,8 +56,10 @@ class ShuffleManager:
         whole pipeline with one SPMD program when the plan shape allows,
         and the host exchange is the fallback for shapes it cannot fuse."""
         if self.mode == self.MULTITHREADED:
-            from ..config import (SHUFFLE_MT_MAX_BYTES_IN_FLIGHT,
+            from ..config import (SHUFFLE_LINEAGE_ENABLED,
+                                  SHUFFLE_MT_MAX_BYTES_IN_FLIGHT,
                                   SHUFFLE_MT_WRITER_THREADS,
+                                  SHUFFLE_REPLICAS,
                                   TRANSPORT_MAX_IN_FLIGHT)
             from .multithreaded import MultithreadedShuffleExchangeExec
             from ..config import SHUFFLE_MT_READER_THREADS
@@ -71,7 +73,10 @@ class ShuffleManager:
                     TRANSPORT_MAX_IN_FLIGHT.key)),
                 max_bytes_in_flight=int(self.conf.get(
                     SHUFFLE_MT_MAX_BYTES_IN_FLIGHT.key)),
-                codec=self.codec)
+                codec=self.codec,
+                replicas=int(self.conf.get(SHUFFLE_REPLICAS.key)),
+                lineage_enabled=bool(self.conf.get(
+                    SHUFFLE_LINEAGE_ENABLED.key)))
         if self.mode == self.CACHED:
             # device-resident blocks in the spillable cache, served P2P
             # (the reference's UCX cached mode)

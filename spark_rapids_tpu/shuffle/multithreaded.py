@@ -25,6 +25,7 @@ from ..exec.common import compact, concat_batches
 from ..expressions.base import EvalContext
 from .partitioning import Partitioning, RangePartitioning
 from .serializer import deserialize_batch, serialize_batch
+from .transport import BlockMissingError, PeerUnreachableError
 
 
 class BytesInFlightLimiter:
@@ -60,7 +61,10 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
                  ctx: Optional[EvalContext] = None,
                  transport=None,
                  read_transport=None,
-                 codec: Optional[str] = None):
+                 codec: Optional[str] = None,
+                 replicas: int = 0,
+                 lineage_enabled: bool = True,
+                 lineage_registry=None):
         super().__init__(child, ctx)
         self.partitioning = partitioning.bind(child.output_schema)
         self.shuffle_dir = shuffle_dir or os.path.join(
@@ -93,6 +97,21 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         # random 63-bit id: per-process counters COLLIDE when two
         # processes share one transport root (cross-process mode)
         self.shuffle_id = uuid.uuid4().int & ((1 << 63) - 1)
+        #: conf-gated map-output replication (shuffle.replicas): pieces
+        #: are pushed to K peers at publish so a dead primary's blocks
+        #: are served by failover, with recompute as the floor
+        self.replicas = max(int(replicas), 0)
+        # lineage (shuffle.lineage.enabled): every map output records
+        # its producing fragment so the read side can recompute a lost
+        # block deterministically once transport failover is exhausted.
+        # The recompute contract: the CHILD must be re-executable (true
+        # of the data plane's execs — scans re-read, exchanges re-fetch
+        # their still-published blocks).
+        if lineage_enabled:
+            from .lineage import lineage_registry as _global_registry
+            self._lineage = lineage_registry or _global_registry()
+        else:
+            self._lineage = None
 
         def slice_kernel(batch, pids, p: int):
             return compact(batch, pids == p)
@@ -122,21 +141,76 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
             pool = cf.ThreadPoolExecutor(self.num_threads,
                                          thread_name_prefix="shuffle-write")
             futures = []
-            seq = 0
+            # map_id identifies one INPUT BATCH (child partition cp,
+            # batch index bi) — the recompute unit: lineage re-executes
+            # that fragment ONCE and re-slices every lost reduce
+            # partition from it. Per-batch ids keep (map, reduce) keys
+            # unique and preserve the read side's sorted concat order.
+            if self._lineage is not None:
+                # even a zero-batch child marks the shuffle as tracked:
+                # an empty shuffle behind a dead peer must read as
+                # provably empty, not fail its listing
+                self._lineage.register_shuffle(self.shuffle_id)
+            m = 0
             for cp in range(self.child.num_partitions):
+                bi = 0
                 for batch in self.child.execute_partition(cp):
+                    if self._lineage is not None:
+                        self._lineage.register_fragment(
+                            self.shuffle_id, m,
+                            self._make_recompute(cp, bi),
+                            input_digest=self._fragment_digest(cp, bi))
                     pids = self._pids_jit(batch)
                     for p in range(n):
                         piece = self._slice_jit(batch, pids, p)
                         if int(piece.num_rows) == 0:
                             continue
                         futures.append(pool.submit(
-                            self._write_piece, piece, schema, seq, p))
-                        seq += 1
+                            self._write_piece, piece, schema, m, p))
+                    m += 1
+                    bi += 1
             for f in futures:
                 f.result()
             pool.shutdown()
             self._written = True
+
+    def _fragment_digest(self, cp: int, bi: int) -> str:
+        """Input-split digest of one map fragment (the PR-10 fingerprint
+        machinery): fragment coordinates + output schema — it names the
+        recompute recipe in LineageVerificationError reports, so a
+        nondeterministic fragment is identifiable across shuffles and
+        plan shapes. The schema leg is hashed once per exchange, not
+        per input batch (the registration runs on the write hot path)."""
+        from ..plan.plancache import _hash
+        sig = getattr(self, "_schema_sig", None)
+        if sig is None:
+            sig = _hash([[getattr(f, "name", str(i)), str(f.dtype)]
+                         for i, f in enumerate(self.output_schema)])
+            self._schema_sig = sig
+        return f"{sig}:s{self.shuffle_id}:f{cp}.{bi}"
+
+    def _make_recompute(self, cp: int, bi: int):
+        """Deterministic recompute of lost blocks: re-execute the child
+        partition stream to batch ``bi`` ONCE, slice every asked reduce
+        partition from it with the SAME jitted kernels, serialize with
+        the same codec — bit-for-bit the published bytes (hash
+        partitioning and the frame format are both deterministic; the
+        registry verifies the publish-time digests to prove it)."""
+        schema = self.output_schema
+
+        def recompute(reduce_ids):
+            for i, batch in enumerate(self.child.execute_partition(cp)):
+                if i == bi:
+                    pids = self._pids_jit(batch)
+                    out = {}
+                    for r in reduce_ids:
+                        piece = self._slice_jit(batch, pids, r)
+                        out[r] = None if int(piece.num_rows) == 0 else \
+                            serialize_batch(piece, schema, self.codec)
+                    return out
+            return {}
+
+        return recompute
 
     def _write_piece(self, piece: ColumnarBatch, schema: Schema,
                      map_id: int, reduce_id: int) -> None:
@@ -144,8 +218,16 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
                                self.codec)   # D2H + frame + compress
         self.limiter.acquire(len(data))
         try:
+            if self._lineage is not None:
+                # digest BEFORE publish: a peer death any time after the
+                # block becomes fetchable must find its lineage complete
+                self._lineage.note_block(self.shuffle_id, map_id,
+                                         reduce_id, data)
             self.transport.publish(self.shuffle_id, map_id, reduce_id,
                                    data)
+            if self.replicas > 0:
+                self.transport.replicate(self.shuffle_id, map_id,
+                                         reduce_id, data, self.replicas)
         finally:
             self.limiter.release(len(data))
 
@@ -153,18 +235,53 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
     # read side (reduce tasks)
     # ------------------------------------------------------------------
 
+    def _reduce_blocks(self, p: int):
+        """Block listing for one reducer: the transport's live listing
+        UNIONED with lineage's authoritative set. The union is what
+        makes a dead peer a recovery event instead of silent row loss —
+        blocks the heartbeat registry stopped listing (dead executor)
+        still surface here and get recomputed; and when the ONLY serving
+        peer is unreachable, the lineage listing stands in for the raise
+        the strict transport listing would otherwise be right to make."""
+        lineage_blocks = [] if self._lineage is None else \
+            self._lineage.blocks(self.shuffle_id, p)
+        try:
+            listed = self.read_transport.list_blocks(self.shuffle_id, p)
+        except (BlockMissingError, PeerUnreachableError):
+            if self._lineage is None or \
+                    not self._lineage.knows_shuffle(self.shuffle_id):
+                raise
+            # lineage registered this shuffle: its listing is
+            # authoritative even when EMPTY (a reducer that genuinely
+            # received no rows) — the strict transport listing's raise
+            # is survivable because no row can be silently dropped
+            listed = []
+        return sorted(set(listed) | set(lineage_blocks))
+
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         self._write_all()
-        blocks = self.read_transport.list_blocks(self.shuffle_id, p)
+        blocks = self._reduce_blocks(p)
         if not blocks:
             return
         schema = self.output_schema
         # pipelined fetch: decode each block the moment its bytes land
-        # while later fetches keep streaming (transport.fetch_many)
+        # while later fetches keep streaming. With lineage on, a fetch
+        # that exhausts failover recomputes the lost partition (riding
+        # with_retry) and resumes bit-for-bit instead of raising; the
+        # server's cancel flag (stop()/watchdog) is captured HERE on the
+        # query thread and polled by the recovery loop.
+        if self._lineage is not None:
+            from .lineage import current_cancel, fetch_many_with_recovery
+            fetched = fetch_many_with_recovery(
+                self.read_transport, blocks, self._lineage,
+                max_in_flight=self.max_in_flight_fetches,
+                republish=self.read_transport.publish,
+                cancel=current_cancel())
+        else:
+            fetched = self.read_transport.fetch_many(
+                blocks, max_in_flight=self.max_in_flight_fetches)
         batches = [deserialize_batch(data, schema)
-                   for _, data in self.read_transport.fetch_many(
-                       blocks,
-                       max_in_flight=self.max_in_flight_fetches)]
+                   for _, data in fetched]
         total = sum(int(b.num_rows) for b in batches)
         if total == 0:
             return
@@ -174,9 +291,17 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
             yield concat_batches(batches, bucket_capacity(total))
 
     def cleanup(self) -> None:
-        # always drop this shuffle's blocks; close the transport only if
-        # this exec created it (an injected transport may serve peers)
+        # always drop this shuffle's blocks (and their lineage — the
+        # recompute closures pin the child exec tree otherwise); close
+        # the transport only if this exec created it (an injected
+        # transport may serve peers)
         self.transport.remove_shuffle(self.shuffle_id)
+        if self.read_transport is not self.transport:
+            # recovered blocks were republished into the reading
+            # transport's local store; drop them with the shuffle
+            self.read_transport.remove_shuffle(self.shuffle_id)
+        if self._lineage is not None:
+            self._lineage.remove_shuffle(self.shuffle_id)
         if self._owns_transport:
             self.transport.close()
 

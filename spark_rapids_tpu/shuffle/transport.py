@@ -62,6 +62,12 @@ _HELLO, _FETCH, _OK, _MISSING, _ERROR, _LIST = 1, 2, 3, 4, 5, 6
 # windowed-block streaming (reference: WindowedBlockIterator +
 # BounceBufferManager — large blocks move in fixed-size staging windows)
 _SIZE, _FETCH_AT = 7, 8
+# map-output replication (spark.rapids.tpu.shuffle.replicas): the map
+# side PUTs a published piece onto K peers so a dead primary's blocks
+# are served from a replica instead of recomputed from lineage, and
+# REMOVEs them at end-of-query cleanup so replicas don't accumulate in
+# peer stores for the life of the peer process
+_PUT, _REMOVE = 9, 10
 
 #: default staging window for large-block fetches (one bounce buffer)
 DEFAULT_WINDOW_BYTES = 4 << 20
@@ -163,6 +169,13 @@ class ShuffleTransport:
         wire pipeline overlap the fetches."""
         for b in ids:
             yield b, self.fetch(*b)
+
+    def replicate(self, shuffle_id: int, map_id: int, reduce_id: int,
+                  payload: bytes, k: int) -> int:
+        """Write a published block to up to ``k`` peers; returns how many
+        replicas landed. Base/shared-filesystem transports are already
+        readable by every peer — nothing to do."""
+        return 0
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         """Drop every local block of one shuffle (end-of-query cleanup)."""
@@ -317,6 +330,20 @@ class _Handler(socketserver.BaseRequestHandler):
                         _send_frame(self.request, _OK,
                                     struct.pack("<q", len(blk)))
                     continue
+                if op == _PUT:
+                    # replica write: a peer pushes one of ITS published
+                    # blocks here so this executor can serve it after
+                    # the primary dies (conf-gated on the writing side)
+                    s, m, r = struct.unpack_from("<qqq", payload)
+                    store.publish(s, m, r, payload[24:])
+                    _send_frame(self.request, _OK, b"")
+                    continue
+                if op == _REMOVE:
+                    # end-of-query replica cleanup from the owner
+                    (s,) = struct.unpack("<q", payload)
+                    store.remove_shuffle(s)
+                    _send_frame(self.request, _OK, b"")
+                    continue
                 if op == _FETCH_AT:
                     s, m, r, off, ln = struct.unpack("<qqqqq", payload)
                     blk = store._resolve(s, m, r)
@@ -374,6 +401,9 @@ class TcpTransport(ShuffleTransport):
         #: two reducers stream two large blocks concurrently)
         self._resolved_cache: Dict[Tuple[int, int, int], bytes] = {}
         self._resolved_cache_slots = 8
+        #: shuffle_id -> peer addrs holding replicas we wrote (_PUT);
+        #: remove_shuffle sends them a best-effort _REMOVE
+        self._replicated: Dict[int, set] = {}
         self._index: Dict[Tuple[int, int], List[Tuple[int, int, int]]] = {}
         #: optional (s, m, r) -> bytes|None hook serving LAZY blocks whose
         #: payload lives elsewhere (the device-resident shuffle cache)
@@ -484,7 +514,17 @@ class TcpTransport(ShuffleTransport):
             try:
                 self.on_unreachable(peer_id)
             except Exception:
-                pass    # reporting must never mask the fetch error
+                # robust-ok: reporting must never mask the fetch error
+                pass
+
+    def _note_reachable(self, addr) -> None:
+        """A completed transaction proves the peer alive: clear it from
+        the suspect set IMMEDIATELY so a recovered peer returns to
+        normal fetch ordering, instead of being tried last (and eating
+        misdirected first-fetch latency) until suspect_ttl_s ages the
+        entry out."""
+        with self._conns_guard:
+            self._suspects.pop(addr, None)
 
     def list_blocks(self, s: int, r: int):
         """Local blocks UNION every LIVE peer's blocks (the shuffle
@@ -500,6 +540,7 @@ class TcpTransport(ShuffleTransport):
             except PeerUnreachableError:
                 self._note_unreachable(peer_id, addr)
                 raise
+            self._note_reachable(addr)
             out.update((s, m, r) for m in maps)
         return sorted(out)
 
@@ -509,6 +550,18 @@ class TcpTransport(ShuffleTransport):
                 del self._local[key]
             for key in [k for k in self._index if k[0] == s]:
                 del self._index[key]
+            replica_holders = self._replicated.pop(s, ())
+        for addr in replica_holders:
+            # best effort: a peer that died keeps nothing anyway, and
+            # cleanup must never fail the query's teardown
+            try:
+                op, resp = self._transact(addr, _REMOVE,
+                                          struct.pack("<q", s))
+                if op != _OK:
+                    raise TransportError(f"remove failed: {resp!r}")
+            except (TransportError, ConnectionError, OSError):
+                # net-ok: best-effort replica cleanup on teardown
+                pass
 
     # ---- retry policy -------------------------------------------------
 
@@ -573,8 +626,16 @@ class TcpTransport(ShuffleTransport):
         failed: List[Exception] = []
         for peer_id, addr in self._ordered_peers():
             try:
-                return self._retrying(addr, self._fetch_from, s, m, r)
+                data = self._retrying(addr, self._fetch_from, s, m, r)
+                # a suspect that served the block is rehabilitated NOW —
+                # later fetches order it normally again instead of
+                # waiting out suspect_ttl_s
+                self._note_reachable(addr)
+                return data
             except BlockMissingError as ex:
+                # a MISSING answer is still a completed round trip: the
+                # peer is alive, just not holding this block
+                self._note_reachable(addr)
                 missing.append(ex)
             except PeerUnreachableError as ex:
                 self._note_unreachable(peer_id, addr)
@@ -697,6 +758,60 @@ class TcpTransport(ShuffleTransport):
                     f"windowed read failed at {off} ({op})")
             buf[off:off + ln] = payload
         return bytes(buf)
+
+    # ---- replication (spark.rapids.tpu.shuffle.replicas) ----------------
+
+    def _put_to(self, addr, s: int, m: int, r: int,
+                payload: bytes) -> None:
+        op, resp = self._transact(
+            addr, _PUT, struct.pack("<qqq", s, m, r) + payload)
+        if op != _OK:
+            raise TransportError(f"replica put failed: {resp!r}")
+
+    def replicate(self, s: int, m: int, r: int, payload: bytes,
+                  k: int) -> int:
+        """Write one published block to up to ``k`` live peers (healthy
+        peers first — a suspect makes a poor replica target). Best
+        effort PER PEER: a replica that cannot be written is skipped
+        and the next peer tried — replication narrows the recovery path
+        to a failover, it must never widen a publish into a query
+        failure (lineage recompute remains the floor). Returns the
+        number of replicas actually written; replicaBytes counts them
+        for Session.metrics()/serving_stats()."""
+        if k <= 0:
+            return 0
+        from .lineage import metrics as lineage_metrics
+        # memoize the ordered peer list briefly: replicate runs once per
+        # published PIECE on the writer hot path, and _ordered_peers
+        # consults peer_source — in registry mode a framed-TCP 'list'
+        # RPC per call. The table changes on heartbeat timescales, so a
+        # 1-second memo drops B×P discovery round trips per shuffle to
+        # ~one without serving a stale view longer than a heartbeat.
+        now = time.time()
+        ts, peers = getattr(self, "_replicate_peers_memo", (0.0, None))
+        if peers is None or now - ts > 1.0:
+            peers = self._ordered_peers()
+            self._replicate_peers_memo = (now, peers)
+        written = 0
+        for peer_id, addr in peers:
+            if written >= k:
+                break
+            try:
+                self._retrying(addr, self._put_to, s, m, r, payload)
+            except PeerUnreachableError:
+                self._note_unreachable(peer_id, addr)
+                continue
+            except TransportError:
+                continue
+            self._note_reachable(addr)
+            with self._lock:
+                # remember who holds replicas of this shuffle, so
+                # remove_shuffle can clean them off the peers — replica
+                # bytes must not outlive the query in peer processes
+                self._replicated.setdefault(s, set()).add(addr)
+            lineage_metrics().note_replica(len(payload))
+            written += 1
+        return written
 
     def fetch_many(self, ids, max_in_flight: int = 4):
         """Pipelined fetch of many blocks: yields (id, bytes) in input

@@ -66,12 +66,23 @@ def pytest_configure(config):
                    "'net_inject and not slow'` is the tier-1 network "
                    "robustness job alongside oom_inject (the full "
                    "kind/schedule matrix is nightly)")
+    config.addinivalue_line(
+        "markers", "chaos: long-running chaos soak jobs "
+                   "(tools/chaos_soak.py wrappers) — excluded from "
+                   "tier-1 and smoke exactly like `slow` (the conftest "
+                   "adds `slow` to every chaos test), run nightly via "
+                   "`pytest -m chaos`")
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) in SMOKE_FILES:
             item.add_marker(pytest.mark.smoke)
+        if item.get_closest_marker("chaos") is not None:
+            # chaos implies slow: the tier-1 `-m 'not slow'` command and
+            # the smoke gate both exclude soak jobs without having to
+            # change their marker expressions
+            item.add_marker(pytest.mark.slow)
 
 
 # ---------------------------------------------------------------------------

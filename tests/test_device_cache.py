@@ -65,7 +65,10 @@ def test_local_blocks_skip_serialization():
 
 def test_dead_peer_liveness_excluded():
     """Heartbeat-driven expiry consumed: a peer the liveness registry
-    declares dead is skipped without a socket timeout."""
+    declares dead is skipped without a socket timeout. The shuffle is
+    not lineage-tracked (CACHED-mode blocks register no recompute
+    recipe), so the ORIGINAL typed transport error propagates — never
+    re-typed as a lineage miss for a feature that wasn't in play."""
     from spark_rapids_tpu.shuffle.device_cache import DeviceShuffleCache
     from spark_rapids_tpu.shuffle.transport import TcpTransport, \
         TransportError

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Unified robustness lint runner (tier-1, via tests/test_query_recovery.py).
+
+One entry point over the three robustness disciplines:
+
+1. **lint_retry** — catalog allocations outside a retry scope, swallowed
+   OOM-family excepts (tools/lint_retry.py).
+2. **lint_net** — sockets without deadlines, swallowed transport faults
+   (tools/lint_net.py).
+3. **silent swallows in the shuffle plane** (new) — in
+   ``spark_rapids_tpu/shuffle/``, an ``except Exception:`` /
+   ``except BaseException:`` / bare ``except:`` handler whose body is
+   ONLY ``pass`` (or ``...``) is rejected unless it carries a
+   ``# robust-ok: <reason>`` pragma. The shuffle plane is the recovery
+   plane: a silent catch-all there can eat a lost block, a failed
+   replica write, or a recompute verification error — and the chaos
+   soak's zero-wrong-results accounting (tools/chaos_soak.py) only
+   holds if failures stay typed and visible.
+
+Exit status 0 = clean, 1 = violations (printed one per line, prefixed
+with the sub-lint that found them).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import lint_net      # noqa: E402
+import lint_retry    # noqa: E402
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "spark_rapids_tpu")
+
+#: the recovery plane the swallow rule polices
+SWALLOW_DIRS = ("shuffle",)
+
+PRAGMA = "# robust-ok:"
+
+#: catch-all names rule 3 rejects when the handler body is only `pass`
+_CATCHALL = {"Exception", "BaseException"}
+
+
+def _is_silent_body(body) -> bool:
+    """True when the handler does literally nothing: only pass/... ."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant) and \
+                stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+def _handler_catchall(node: ast.ExceptHandler) -> bool:
+    t = node.type
+    if t is None:
+        return True                       # bare except
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        name = e.id if isinstance(e, ast.Name) else \
+            e.attr if isinstance(e, ast.Attribute) else None
+        if name in _CATCHALL:
+            return True
+    return False
+
+
+def lint_swallows(pkg_dir: str = PKG) -> List[str]:
+    problems: List[str] = []
+    for sub in SWALLOW_DIRS:
+        root = os.path.join(pkg_dir, sub)
+        for fn in sorted(os.listdir(root)):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            src = open(path).read()
+            lines = src.splitlines()
+            rel = os.path.join("spark_rapids_tpu", sub, fn)
+            for node in ast.walk(ast.parse(src, filename=path)):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _handler_catchall(node) or \
+                        not _is_silent_body(node.body):
+                    continue
+                lo = node.lineno
+                hi = node.end_lineno or node.lineno
+                if any(PRAGMA in lines[i - 1]
+                       for i in range(max(lo, 1),
+                                      min(hi, len(lines)) + 1)):
+                    continue
+                problems.append(
+                    f"{rel}:{node.lineno}: bare `except Exception: "
+                    f"pass` in the shuffle plane swallows failures the "
+                    f"recovery taxonomy (and the chaos soak's "
+                    f"accounting) must see — handle it, re-raise typed, "
+                    f"or annotate '{PRAGMA} <reason>'")
+    return problems
+
+
+def lint_all() -> List[str]:
+    """Every robustness lint, each violation prefixed by its source."""
+    problems: List[str] = []
+    problems += [f"[retry] {p}" for p in lint_retry.lint()]
+    problems += [f"[net] {p}" for p in lint_net.lint()]
+    problems += [f"[swallow] {p}" for p in lint_swallows()]
+    return problems
+
+
+def main() -> int:
+    problems = lint_all()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\nlint_robustness: {len(problems)} violation(s)")
+        return 1
+    print("lint_robustness: clean (retry + net + swallow)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
