@@ -638,6 +638,87 @@ SERVER_TEST_COLLECT_DELAY_MS = conf(
     "slices) so watchdog/cancellation paths are deterministic."
 ).internal().integer(0)
 
+FLEET_WORKERS = conf("spark.rapids.tpu.server.fleet.workers").doc(
+    "Worker-subprocess count a router starts when launched standalone "
+    "(python -m spark_rapids_tpu.server.router). Each worker is a full "
+    "plan-server process with its own planning cache and XLA compile "
+    "cache; the router keeps repeat plan shapes pinned to the same "
+    "worker so those caches stay warm (docs/serving.md).").integer(2)
+
+FLEET_VNODES = conf("spark.rapids.tpu.server.fleet.vnodes").doc(
+    "Virtual nodes per worker on the router's consistent-hash ring. "
+    "More vnodes spread hash slots more evenly and shrink the slice of "
+    "shapes that move when a worker drains or dies.").integer(64)
+
+FLEET_TENANT_ID = conf("spark.rapids.tpu.server.fleet.tenantId").doc(
+    "Tenant identity a client declares in its hello conf; the router's "
+    "per-tenant admission (quotas + weighted fair queueing) accounts "
+    "each plan against it. Empty = the 'default' tenant.").text("")
+
+FLEET_TENANT_MAX_CONCURRENT = conf(
+    "spark.rapids.tpu.server.fleet.tenant.maxConcurrent").doc(
+    "Per-tenant bound on concurrently in-flight plans at the router; "
+    "over it the tenant gets a structured 'unavailable' reply with "
+    "retry_after_ms instead of queueing without bound (0 = no quota)."
+).integer(0)
+
+FLEET_TENANT_WEIGHTS = conf(
+    "spark.rapids.tpu.server.fleet.tenant.weights").doc(
+    "Weighted-fair-queueing weights as 'tenantA=3,tenantB=1'; when a "
+    "worker's dispatch slots are contended, waiting tenants are served "
+    "inversely to (accumulated dispatches / weight), so a heavy tenant "
+    "cannot starve a light one. Unlisted tenants weigh 1.").text("")
+
+FLEET_MAX_INFLIGHT_PER_WORKER = conf(
+    "spark.rapids.tpu.server.fleet.maxInflightPerWorker").doc(
+    "Router-side dispatch bound per worker — plans over it queue in the "
+    "weighted-fair admission instead of piling onto the worker's own "
+    "concurrentCollects semaphore (0 = inherit concurrentCollects)."
+).integer(0)
+
+FLEET_ADMISSION_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.server.fleet.admissionTimeoutMs").doc(
+    "Bound on a plan's wait in the router's weighted-fair queue; past "
+    "it the client gets a structured 'unavailable' + retry_after_ms "
+    "reply (the PlanClient retry budget resubmits it).").integer(30000)
+
+FLEET_DRAIN_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.server.fleet.drainTimeoutMs").doc(
+    "Rolling restart: how long the router waits for a draining worker's "
+    "in-flight plans to finish before replacing it anyway. A worker "
+    "that DIES while draining is promoted dead immediately (the PR-11 "
+    "suspect/dead discipline) — the drain never waits on a corpse."
+).integer(30000)
+
+FLEET_SPILLOVER_QUEUE_DEPTH = conf(
+    "spark.rapids.tpu.server.fleet.spilloverQueueDepth").doc(
+    "Bounded-load consistent hashing: when a plan's home worker already "
+    "has this many plans in flight + queued, the router dispatches to "
+    "the least-loaded ring candidate instead — cache affinity yields to "
+    "utilization only under skew, so one hot shape cannot leave the "
+    "rest of the fleet idle (0 = never spill).").integer(8)
+
+FLEET_WORKER_RETRIES = conf(
+    "spark.rapids.tpu.server.fleet.workerRetries").doc(
+    "How many OTHER workers the router tries for a plan whose assigned "
+    "worker failed mid-query (connection drop / worker death). Each "
+    "retry replays the session's tables to the failover worker first, "
+    "so the resubmit is self-contained.").integer(2)
+
+FLEET_RESULT_STORE_PATH = conf(
+    "spark.rapids.tpu.server.fleet.resultStore.path").doc(
+    "Directory of the shared persistent result-cache tier. Every "
+    "worker reads through to it on an in-memory miss and writes "
+    "through on store, so cached results survive worker restarts and "
+    "are shared across the fleet; invalidation (drop_table/re-upload) "
+    "deletes entries from it too. Empty = tier disabled.").text("")
+
+FLEET_RESULT_STORE_MAX_BYTES = conf(
+    "spark.rapids.tpu.server.fleet.resultStore.maxBytes").doc(
+    "Byte budget of the persistent result-store directory; past it the "
+    "least-recently-touched entry files are deleted at write time."
+).bytes_(1 << 30)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "
     "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."

@@ -82,6 +82,14 @@ class ServingMetrics:
         self.result_misses = 0
         self.result_evictions = 0
         self.result_invalidations = 0
+        # persistent tier (the fleet's shared disk store): a store hit is
+        # a REHYDRATION — a result served from disk that this process's
+        # memory tier had never seen (worker restart, or a sibling
+        # worker computed it)
+        self.store_hits = 0
+        self.store_writes = 0
+        self.store_evictions = 0
+        self.store_invalidations = 0
 
     def note(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -97,6 +105,10 @@ class ServingMetrics:
                 "resultCacheMissCount": self.result_misses,
                 "resultCacheEvictionCount": self.result_evictions,
                 "resultCacheInvalidationCount": self.result_invalidations,
+                "resultStoreHitCount": self.store_hits,
+                "resultStoreWriteCount": self.store_writes,
+                "resultStoreEvictionCount": self.store_evictions,
+                "resultStoreInvalidationCount": self.store_invalidations,
             }
 
 
@@ -458,13 +470,20 @@ class ResultCache:
     """Byte-budgeted LRU over serialized results. Keys carry content
     digests, so a stale serve is impossible by construction; explicit
     invalidation (drop_table / re-upload) frees budget eagerly and is
-    the count the server acks back."""
+    the count the server acks back.
+
+    When a ``persistent`` tier (resultstore.PersistentResultStore) is
+    attached — the serving fleet's shared disk store — gets read
+    through to it on a memory miss (rehydration after a worker
+    restart), puts write through, and invalidation covers both tiers so
+    the drop_table ack is authoritative fleet-wide."""
 
     def __init__(self, max_bytes: int = 256 << 20):
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, ResultEntry]" = OrderedDict()
         self.max_bytes = max_bytes
         self.used_bytes = 0
+        self.persistent = None       # Optional[PersistentResultStore]
 
     def get(self, key: str) -> Optional[ResultEntry]:
         with self._lock:
@@ -472,12 +491,35 @@ class ResultCache:
             if e is not None:
                 e.hits += 1
                 self._entries.move_to_end(key)
-            return e
+        if e is None and self.persistent is not None:
+            loaded = self.persistent.get(key)
+            if loaded is not None:
+                e = ResultEntry(key=key, ipc=loaded["ipc"],
+                                digests=loaded["digests"],
+                                execs=loaded["execs"],
+                                fell_back=loaded["fell_back"],
+                                rows=loaded["rows"], hits=1)
+                _METRICS.note("store_hits")
+                # promote into the memory LRU (no write-through: the
+                # bytes came FROM the store)
+                self._put_memory(e)
+        return e
 
     def put(self, entry: ResultEntry,
             max_bytes: Optional[int] = None) -> bool:
         """Insert (idempotent per key); False when the entry alone
-        exceeds the budget and was not stored."""
+        exceeds the memory budget and was not stored there (the
+        persistent tier, with its own budget, is still written)."""
+        if self.persistent is not None:
+            if self.persistent.put(entry.key, entry.ipc, entry.digests,
+                                   execs=entry.execs,
+                                   fell_back=entry.fell_back,
+                                   rows=entry.rows):
+                _METRICS.note("store_writes")
+        return self._put_memory(entry, max_bytes)
+
+    def _put_memory(self, entry: ResultEntry,
+                    max_bytes: Optional[int] = None) -> bool:
         with self._lock:
             if max_bytes is not None:
                 self.max_bytes = max_bytes
@@ -500,8 +542,13 @@ class ResultCache:
             return True
 
     def invalidate_digest(self, digest: str) -> int:
-        """Drop every entry depending on ``digest``; returns the count
-        (the drop_table ack surface)."""
+        """Drop every entry depending on ``digest`` from BOTH tiers;
+        returns the combined count (the drop_table ack surface — with a
+        persistent tier attached the ack is authoritative across worker
+        restarts, not just this process's memory). Fan-out across a
+        fleet stays additive: file deletion is idempotent, so the
+        second worker reached finds the store already clean and its ack
+        counts only its own memory entries."""
         with self._lock:
             dead = [k for k, e in self._entries.items()
                     if digest in e.digests]
@@ -509,7 +556,12 @@ class ResultCache:
                 self.used_bytes -= len(self._entries.pop(k).ipc)
             if dead:
                 _METRICS.note("result_invalidations", len(dead))
-            return len(dead)
+        persisted = 0
+        if self.persistent is not None:
+            persisted = self.persistent.invalidate_digest(digest)
+            if persisted:
+                _METRICS.note("store_invalidations", persisted)
+        return len(dead) + persisted
 
     def clear(self) -> None:
         with self._lock:
@@ -518,9 +570,12 @@ class ResultCache:
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            return {"entries": len(self._entries),
-                    "usedBytes": self.used_bytes,
-                    "maxBytes": self.max_bytes}
+            out = {"entries": len(self._entries),
+                   "usedBytes": self.used_bytes,
+                   "maxBytes": self.max_bytes}
+        if self.persistent is not None:
+            out["persistent"] = self.persistent.stats()
+        return out
 
     def __len__(self):
         with self._lock:
@@ -550,3 +605,85 @@ def result_cache() -> ResultCache:
         if _RESULT_CACHE is None:
             _RESULT_CACHE = ResultCache()
         return _RESULT_CACHE
+
+
+#: set the moment a PlanServer configures the store (even to "off"):
+#: in a serving process the store is INFRASTRUCTURE, owned by the
+#: server's startup conf — a remote client's hello/plan conf, which the
+#: server merges into every Session, must never attach, repoint, or
+#: re-budget the fleet's shared tier (it would detach every tenant's
+#: cache and write files to a client-chosen path on the server host)
+_STORE_LOCKED = False
+
+
+def configure_result_store(conf: RapidsTpuConf, _server: bool = False):
+    """Attach the shared persistent result tier per the
+    ``server.fleet.resultStore.*`` confs. Attach-only, first-wins
+    semantics: the plan server's startup call (``_server=True``) is
+    authoritative and locks the process; a per-Session call attaches
+    only when the process is unlocked and nothing is attached yet (the
+    in-process, no-server use). Re-calling with the attached path is a
+    no-op; detaching at runtime is deliberate API
+    (``result_cache().persistent = None``), not a conf flip."""
+    from ..config import (FLEET_RESULT_STORE_MAX_BYTES,
+                          FLEET_RESULT_STORE_PATH)
+    global _STORE_LOCKED
+    if not _server:
+        # per-query fast paths — no global lock, no conf parse: (a)
+        # the process is server-locked or a store is already attached
+        # (both terminal for session-level calls); (b) the session
+        # never SET the path conf (the default), so there is nothing
+        # to attach
+        cache = _RESULT_CACHE
+        if _STORE_LOCKED or (cache is not None
+                             and cache.persistent is not None):
+            return cache.persistent if cache is not None else None
+        if FLEET_RESULT_STORE_PATH.key not in conf._settings:
+            return None
+    path = str(conf.get(FLEET_RESULT_STORE_PATH.key) or "").strip()
+    max_bytes = int(conf.get(FLEET_RESULT_STORE_MAX_BYTES.key))
+    cache = result_cache()
+    from .resultstore import PersistentResultStore
+    with _SINGLETON_LOCK:
+        store = cache.persistent
+        if _server:
+            _STORE_LOCKED = True
+            if not path:
+                # the server's startup conf is authoritative INCLUDING
+                # "off": an embedded server started without the tier
+                # must not keep serving a predecessor's store
+                cache.persistent = None
+            elif store is None or store.path != path:
+                cache.persistent = PersistentResultStore(
+                    path, max_bytes,
+                    on_evict=lambda n: _METRICS.note(
+                        "store_evictions", n))
+            else:
+                store.max_bytes = max_bytes
+            return cache.persistent
+        if not path or _STORE_LOCKED or store is not None:
+            return store
+        store = PersistentResultStore(
+            path, max_bytes,
+            on_evict=lambda n: _METRICS.note("store_evictions", n))
+        cache.persistent = store
+        return store
+
+
+# ---------------------------------------------------------------------------
+# router-side fingerprinting (the fleet seam)
+# ---------------------------------------------------------------------------
+
+
+def shape_fingerprint_doc(doc: dict, tables: Dict[str, pa.Table],
+                          conf: RapidsTpuConf) -> str:
+    """The SAME shape fingerprint ``shape_fingerprint`` computes, taken
+    from a wire plandoc instead of a logical plan — the router routes on
+    it without building a Session. The doc is decoded once (the window
+    overcap/CBO gate bits read the logical tree), then hashed via the
+    shared path so router placement and worker planning-cache keys
+    always agree: the worker a shape lands on is exactly the worker
+    whose cache is warm for it."""
+    from ..server.plandoc import doc_to_plan
+    plan = doc_to_plan(doc, tables)
+    return shape_fingerprint(plan, conf, encoded=(doc, tables))

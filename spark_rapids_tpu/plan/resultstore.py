@@ -1,0 +1,265 @@
+"""Persistent result-cache tier: a disk directory shared by every worker
+of a serving fleet.
+
+The in-memory ``ResultCache`` (plancache.py) dies with its process; a
+rolling worker restart would re-pay every cached query. This tier
+persists each entry as one file under a shared directory, keyed by the
+same digest-embedding RESULT key, so:
+
+- a replacement worker REHYDRATES on read-through: its first repeat
+  query misses memory, hits the file, promotes it, and serves the same
+  bytes the dead worker computed;
+- workers share entries across the fleet (two tenants, two workers,
+  identical bytes → one file), the Theseus data-movement argument
+  applied to results: the cheapest query is the one whose bytes never
+  move through the engine again.
+
+Entry layout (one file, ``<key>.res``, written atomically via a
+same-directory temp file + ``os.replace``):
+
+    u32 meta_len | meta (UTF-8 JSON) | Arrow IPC bytes
+
+``meta`` carries the dependency digests (the invalidation index — a
+drop_table scan reads only the bounded meta prefix, never the payload),
+the plan-capture surface (execs/fell_back/rows) and a CRC32 over the
+payload verified on every load (the PR-9 rule: a torn or bit-rotted
+file is a miss, never silently-wrong rows).
+
+Cross-process safety: writes are atomic replaces; reads of a
+concurrently-deleted file are misses; the byte budget is enforced at
+write time by deleting least-recently-touched files (mtime is bumped on
+every hit, so rehydration traffic keeps hot entries alive). Two
+processes may both evict — deletion is idempotent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+_META_MAX = 1 << 20         # a meta prefix larger than this is corrupt
+_SUFFIX = ".res"
+
+
+class PersistentResultStore:
+    def __init__(self, path: str, max_bytes: int = 1 << 30,
+                 on_evict=None):
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self.on_evict = on_evict          # callable(count) metric hook
+        self._lock = threading.Lock()     # serializes THIS process only
+        #: approximate directory usage, maintained incrementally so a
+        #: put does NOT pay an O(entries) listdir+stat on the hot path;
+        #: seeded lazily by one scan, resynced to truth at every
+        #: eviction pass. Sibling-process writes drift it — the resync
+        #: at the budget boundary is what keeps the budget honest.
+        self._approx_used: Optional[int] = None
+        os.makedirs(path, exist_ok=True)
+
+    # ---- paths ----
+    def _file(self, key: str) -> str:
+        # keys are blake2b hexdigests (filename-safe by construction);
+        # refuse anything else rather than traverse
+        if not key.isalnum():
+            raise ValueError(f"malformed result key {key!r}")
+        return os.path.join(self.path, key + _SUFFIX)
+
+    # ---- store ----
+    def put(self, key: str, ipc: bytes, digests: Tuple[str, ...],
+            execs: Tuple[str, ...] = (), fell_back: Tuple[str, ...] = (),
+            rows: int = 0) -> bool:
+        """Write-through one entry; False when it alone exceeds the
+        budget (never stored, matching the in-memory tier's rule)."""
+        meta = json.dumps({
+            "v": 1, "key": key, "digests": list(digests),
+            "execs": list(execs), "fell_back": list(fell_back),
+            "rows": int(rows), "crc": zlib.crc32(ipc) & 0xFFFFFFFF,
+        }).encode("utf-8")
+        blob = struct.pack("<I", len(meta)) + meta + ipc
+        if len(blob) > self.max_bytes:
+            return False
+        target = self._file(key)
+        tmp = f"{target}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with self._lock:
+            try:
+                replaced = os.stat(target).st_size
+            except OSError:
+                replaced = 0
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, target)
+            except OSError:
+                # robust-ok: a full/readonly store degrades to a smaller
+                # cache, never a failed query
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return False
+            if self._approx_used is None:
+                self._approx_used = sum(s for (_, _, s) in self._scan())
+            else:
+                self._approx_used += len(blob) - replaced
+            evicted = 0
+            if self._approx_used > self.max_bytes:
+                evicted = self._evict_over_budget(keep=target)
+        if evicted and self.on_evict is not None:
+            self.on_evict(evicted)
+        return True
+
+    def _evict_over_budget(self, keep: Optional[str] = None) -> int:
+        """Delete least-recently-touched entries until within budget;
+        returns how many were evicted, and resyncs the approximate
+        usage counter to the scanned truth. Concurrent deleters are
+        fine — a missing victim just wasn't ours to evict. Caller
+        holds self._lock."""
+        entries = self._scan()
+        total = sum(size for (_, _, size) in entries)
+        evicted = 0
+        for (fp, _, size) in sorted(entries, key=lambda e: e[1]):
+            if total <= self.max_bytes:
+                break
+            if fp == keep:        # never evict what we just stored
+                continue
+            try:
+                os.unlink(fp)
+                evicted += 1
+            except OSError:
+                continue
+            total -= size
+        self._approx_used = total
+        return evicted
+
+    def _scan(self) -> List[Tuple[str, float, int]]:
+        """(path, mtime, size) of every entry file; .tmp staging files
+        are ignored (the LocalFsTransport listing discipline)."""
+        out = []
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(_SUFFIX):
+                continue
+            fp = os.path.join(self.path, name)
+            try:
+                st = os.stat(fp)
+            except OSError:
+                continue
+            out.append((fp, st.st_mtime, st.st_size))
+        return out
+
+    # ---- load ----
+    def get(self, key: str) -> Optional[dict]:
+        """Load an entry: {"ipc", "digests", "execs", "fell_back",
+        "rows"} or None. A corrupt file (bad prefix, meta, or CRC) is
+        deleted and reported as a miss — never served."""
+        target = self._file(key)
+        try:
+            with open(target, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        entry = self._decode(blob, key)
+        if entry is None:
+            try:
+                os.unlink(target)     # corrupt: quarantine by deletion
+            except OSError:
+                pass
+            return None
+        try:
+            # bump recency so the eviction scan sees hits (utime over
+            # rewrite: no payload churn on the read path)
+            os.utime(target)
+        except OSError:
+            pass
+        return entry
+
+    @staticmethod
+    def _decode(blob: bytes, key: str) -> Optional[dict]:
+        if len(blob) < 4:
+            return None
+        (mlen,) = struct.unpack("<I", blob[:4])
+        if mlen > _META_MAX or len(blob) < 4 + mlen:
+            return None
+        try:
+            meta = json.loads(blob[4:4 + mlen].decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None
+        ipc = blob[4 + mlen:]
+        if meta.get("key") != key or \
+                (zlib.crc32(ipc) & 0xFFFFFFFF) != meta.get("crc"):
+            return None
+        return {"ipc": ipc, "digests": tuple(meta.get("digests", ())),
+                "execs": tuple(meta.get("execs", ())),
+                "fell_back": tuple(meta.get("fell_back", ())),
+                "rows": int(meta.get("rows", 0))}
+
+    @staticmethod
+    def _read_digests(fp: str) -> Optional[Tuple[str, List[str]]]:
+        """(key, digests) from the bounded meta prefix only — the
+        invalidation scan must not read result payloads."""
+        try:
+            with open(fp, "rb") as f:
+                head = f.read(4)
+                if len(head) < 4:
+                    return None
+                (mlen,) = struct.unpack("<I", head)
+                if mlen > _META_MAX:
+                    return None
+                meta = json.loads(f.read(mlen).decode("utf-8"))
+        except (OSError, ValueError, UnicodeDecodeError):
+            return None
+        return meta.get("key", ""), list(meta.get("digests", ()))
+
+    # ---- invalidation ----
+    def invalidate_digest(self, digest: str) -> int:
+        """Delete every entry depending on ``digest``; returns the count
+        actually deleted (idempotent across workers: the second worker
+        of a fan-out finds the files already gone and reports 0)."""
+        if not digest:
+            return 0
+        dead = 0
+        for (fp, _, _) in self._scan():
+            kd = self._read_digests(fp)
+            if kd is None or digest not in kd[1]:
+                continue
+            try:
+                os.unlink(fp)
+                dead += 1
+            except OSError:
+                continue
+        if dead:
+            with self._lock:
+                self._approx_used = None   # reseed on the next put
+        return dead
+
+    def invalidate_key(self, key: str) -> int:
+        try:
+            os.unlink(self._file(key))
+        except OSError:
+            return 0
+        with self._lock:
+            self._approx_used = None       # reseed on the next put
+        return 1
+
+    # ---- introspection ----
+    def stats(self) -> Dict[str, int]:
+        entries = self._scan()
+        return {"entries": len(entries),
+                "usedBytes": int(sum(s for (_, _, s) in entries)),
+                "maxBytes": self.max_bytes}
+
+    def clear(self) -> None:
+        for (fp, _, _) in self._scan():
+            try:
+                os.unlink(fp)
+            except OSError:
+                pass
+        with self._lock:
+            self._approx_used = None

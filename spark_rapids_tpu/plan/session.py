@@ -245,6 +245,9 @@ class Session:
             self.last_cache.setdefault("result", "off")
             return None
         from . import plancache
+        # attach the fleet's shared persistent tier when configured
+        # (idempotent per path; a read-through miss there is free)
+        plancache.configure_result_store(self.conf)
         try:
             return plancache.result_key(df.plan, self.conf,
                                         encoded=self._encoded_plan(df))

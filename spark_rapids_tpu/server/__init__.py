@@ -10,10 +10,26 @@ server runs planning (tagging/fallback/explain) + execution server-side,
 streaming Arrow results back.
 
 Run a server:  ``python -m spark_rapids_tpu.server --port 9099``
+Run a fleet:   ``python -m spark_rapids_tpu.server.router --workers 4``
 Connect:       ``PlanClient("127.0.0.1", 9099).collect(df)``
+
+The router (``router.py``) fronts N plan-server worker subprocesses with
+consistent-hash routing on the plan-shape fingerprint, per-tenant
+admission, and zero-downtime rolling restarts — clients speak to it with
+the unchanged ``PlanClient`` (docs/serving.md, "Serving fleet").
 """
 
 from .client import PlanClient
 from .server import PlanServer
 
-__all__ = ["PlanClient", "PlanServer"]
+
+def __getattr__(name):
+    # Router pulls in subprocess/fleet machinery; import lazily so the
+    # plan-builder-only client surface stays light
+    if name == "Router":
+        from .router import Router
+        return Router
+    raise AttributeError(name)
+
+
+__all__ = ["PlanClient", "PlanServer", "Router"]

@@ -4,11 +4,23 @@ The client process needs only the plan-builder surface (logical plan +
 expressions + pyarrow) — no JAX, no device. ``collect`` walks the plan,
 ships every in-memory scan table as an Arrow IPC stream (deduplicated per
 connection), submits the serialized plan, and decodes the Arrow result.
+
+Backpressure contract: a server (or router) under admission pressure —
+maxSessions, an open circuit breaker, a tenant quota, a saturated
+weighted-fair queue — answers a structured ``unavailable`` reply carrying
+``retry_after_ms``. The client honors it: ``collect`` resubmits up to
+``unavailable_retries`` times within a bounded total budget, sleeping a
+jittered ``retry_after_ms`` between attempts (jitter breaks the thundering
+herd of N clients all told "retry in 1000ms"). A *fatal* unavailable reply
+(the server closed the connection, e.g. maxSessions at handshake)
+transparently reconnects and re-ships the session's tables first.
 """
 
 from __future__ import annotations
 
+import random
 import socket
+import time
 from typing import Dict, List, Optional
 
 import pyarrow as pa
@@ -21,25 +33,46 @@ class PlanServerError(RuntimeError):
     """Structured server-side failure. ``retryable`` marks transient
     conditions (deadline overrun, admission pressure) a client scheduler
     should resubmit; ``unavailable`` + ``retry_after_ms`` carry the
-    circuit-breaker / maxSessions backpressure signal."""
+    circuit-breaker / maxSessions / tenant-quota backpressure signal;
+    ``fatal`` means the server closed the connection with the reply."""
 
     def __init__(self, message: str, remote_traceback: str = "",
                  retryable: bool = False, unavailable: bool = False,
                  timeout: bool = False,
-                 retry_after_ms: Optional[int] = None):
+                 retry_after_ms: Optional[int] = None,
+                 fatal: bool = False):
         super().__init__(message)
         self.remote_traceback = remote_traceback
         self.retryable = retryable
         self.unavailable = unavailable
         self.timeout = timeout
         self.retry_after_ms = retry_after_ms
+        self.fatal = fatal
 
 
 class PlanClient:
     def __init__(self, host: str, port: int,
-                 conf: Optional[dict] = None, timeout: float = 600.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+                 conf: Optional[dict] = None, timeout: float = 600.0,
+                 unavailable_retries: int = 0,
+                 retry_budget_ms: int = 30000,
+                 _sleep=time.sleep):
+        """``unavailable_retries`` > 0 turns on the bounded retry loop
+        for ``unavailable`` replies: each attempt sleeps a jittered
+        ``retry_after_ms`` (server-chosen; default 1000ms) and the whole
+        loop never exceeds ``retry_budget_ms`` wall time. ``_sleep`` is
+        injectable for deterministic tests."""
+        self._host, self._port = host, port
+        self._conf = dict(conf or {})
+        self._timeout = timeout
+        self.unavailable_retries = int(unavailable_retries)
+        self.retry_budget_ms = int(retry_budget_ms)
+        self._sleep = _sleep
+        self._rng = random.Random()
+        self._sock: Optional[socket.socket] = None
         self._known: Dict[str, pa.Table] = {}    # tables the server holds
+        #: how many unavailable replies the retry loop absorbed (test +
+        #: loadbench surface)
+        self.retried_unavailable = 0
         #: plan-capture info from the last collect (test harness surface)
         self.last_execs: List[str] = []
         self.last_fell_back: List[str] = []
@@ -50,14 +83,10 @@ class PlanClient:
         #: "result": ...}) and whether it was served from the result cache
         self.last_cache: dict = {}
         self.last_cached: bool = False
+        #: worker id that served the last collect (through a router)
+        self.last_worker: str = ""
         try:
-            protocol.send_preamble(self._sock)
-            version = protocol.recv_preamble(self._sock)
-            if version != protocol.PROTOCOL_VERSION:
-                raise PlanServerError(
-                    f"protocol version mismatch: server {version}, "
-                    f"client {protocol.PROTOCOL_VERSION}")
-            self._request({"msg": "hello", "conf": conf or {}})
+            self._connect()
         except BaseException:
             # a rejected handshake (version mismatch, maxSessions
             # unavailable reply) must not leak the connection — callers
@@ -66,11 +95,34 @@ class PlanClient:
             raise
 
     # ---- lifecycle ----
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout)
+        protocol.send_preamble(self._sock)
+        version = protocol.recv_preamble(self._sock)
+        if version != protocol.PROTOCOL_VERSION:
+            raise PlanServerError(
+                f"protocol version mismatch: server {version}, "
+                f"client {protocol.PROTOCOL_VERSION}")
+        self._request({"msg": "hello", "conf": self._conf})
+
+    def _reconnect(self) -> None:
+        """Fresh connection + handshake, then re-ship every table this
+        session had registered — the new server-side session starts
+        empty (a fatal unavailable reply or a restarted worker dropped
+        the old one)."""
+        self.close()
+        self._connect()
+        self._ship_tables(dict(self._known))
+
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:  # net-ok: teardown, socket may already be dead
             pass
+        self._sock = None
 
     def __enter__(self):
         return self
@@ -80,17 +132,54 @@ class PlanClient:
 
     # ---- core ----
     def _request(self, header: dict, body: bytes = b""):
-        protocol.send_msg(self._sock, header, body)
-        reply, reply_body = protocol.recv_msg(self._sock)
+        try:
+            protocol.send_msg(self._sock, header, body)
+            reply, reply_body = protocol.recv_msg(self._sock)
+        except (OSError, protocol.ProtocolError):
+            # an abrupt drop (worker/router restart) kills the socket
+            # WITHOUT a fatal reply: close it so the next public call
+            # reconnects + re-ships tables instead of failing forever
+            # on the same dead fd
+            self.close()
+            raise
         if reply.get("msg") == "error":
+            if reply.get("fatal"):
+                # the server closes its side with a fatal reply; drop
+                # ours so a later retry knows to reconnect
+                self.close()
             raise PlanServerError(
                 reply.get("error", "server error"),
                 reply.get("traceback", ""),
                 retryable=bool(reply.get("retryable")),
                 unavailable=bool(reply.get("unavailable")),
                 timeout=bool(reply.get("timeout")),
-                retry_after_ms=reply.get("retry_after_ms"))
+                retry_after_ms=reply.get("retry_after_ms"),
+                fatal=bool(reply.get("fatal")))
         return reply, reply_body
+
+    def _retrying_request(self, header: dict, body: bytes = b"",
+                          retries: Optional[int] = None):
+        """``_request`` under the bounded unavailable-retry budget."""
+        retries = self.unavailable_retries if retries is None else retries
+        deadline = time.monotonic() + self.retry_budget_ms / 1000.0
+        attempt = 0
+        while True:
+            try:
+                if self._sock is None:
+                    self._reconnect()
+                return self._request(header, body)
+            except PlanServerError as e:
+                if not e.unavailable or attempt >= retries:
+                    raise
+                # jittered retry-after: nominal..2x nominal, so N
+                # clients given the same hint don't stampede together
+                delay = ((e.retry_after_ms or 1000) / 1000.0) \
+                    * (1.0 + self._rng.random())
+                if time.monotonic() + delay > deadline:
+                    raise   # honoring the hint would blow the budget
+                attempt += 1
+                self.retried_unavailable += 1
+                self._sleep(delay)
 
     def _ship_tables(self, tables: Dict[str, pa.Table]) -> None:
         for name, t in tables.items():
@@ -110,28 +199,35 @@ class PlanClient:
 
     # ---- public surface ----
     def collect(self, df: DataFrame, conf: Optional[dict] = None,
-                timeout_ms: Optional[int] = None) -> pa.Table:
+                timeout_ms: Optional[int] = None,
+                retries: Optional[int] = None) -> pa.Table:
         """``timeout_ms`` sets the server-side per-query deadline (the
         watchdog cancels and answers a retryable error past it); 0 means
         explicitly unbounded; None defers to
-        spark.rapids.tpu.server.queryTimeoutMs."""
+        spark.rapids.tpu.server.queryTimeoutMs. ``retries`` overrides
+        the client's ``unavailable_retries`` for this one query."""
+        if self._sock is None:
+            self._reconnect()
         doc = self._serialize(df)
         header = {"msg": "plan", "mode": "collect", "plan": doc,
                   "conf": conf or {}}
         if timeout_ms is not None:
             header["timeout_ms"] = int(timeout_ms)
-        reply, body = self._request(header)
+        reply, body = self._retrying_request(header, retries=retries)
         self.last_execs = reply.get("execs", [])
         self.last_fell_back = reply.get("fell_back", [])
         self.last_metrics = reply.get("metrics", {})
         self.last_cache = reply.get("cache", {})
         self.last_cached = bool(reply.get("cached"))
+        self.last_worker = str(reply.get("worker", ""))
         return protocol.ipc_to_table(body)
 
     def register_table(self, name: str, table: pa.Table) -> dict:
         """Upload (or REPLACE) a named server-side table. The ack
         reports the content digest and how many cached results the
-        replacement invalidated."""
+        replacement invalidated (memory + persistent tiers)."""
+        if self._sock is None:
+            self._reconnect()
         reply, _ = self._request({"msg": "table", "name": name},
                                  protocol.table_to_ipc(table))
         self._known[name] = table
@@ -139,14 +235,27 @@ class PlanClient:
 
     def drop_table(self, name: str) -> dict:
         """Drop a server-side table; the ack's ``invalidated`` counts
-        the cached results that depended on it."""
+        the cached results that depended on it across every tier (and,
+        through a router, every worker)."""
+        if self._sock is None:
+            self._reconnect()
         reply, _ = self._request({"msg": "drop_table", "name": name})
         self._known.pop(name, None)
         return reply
 
+    def stats(self) -> dict:
+        """The server's serving_stats() (stable schema; through a
+        router: the fleet-wide aggregate + per-worker breakdown)."""
+        if self._sock is None:
+            self._reconnect()
+        reply, _ = self._request({"msg": "stats"})
+        return reply["stats"]
+
     def explain(self, df: DataFrame, conf: Optional[dict] = None) -> str:
+        if self._sock is None:
+            self._reconnect()
         doc = self._serialize(df)
-        _, body = self._request(
+        _, body = self._retrying_request(
             {"msg": "plan", "mode": "explain", "plan": doc,
              "conf": conf or {}})
         return body.decode("utf-8")

@@ -301,6 +301,24 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"msg": "hello_ack",
                     "server": "spark-rapids-tpu",
                     "version": protocol.PROTOCOL_VERSION}, b""
+        if msg == "stats":
+            # fleet-ops surface: the router aggregates these per worker
+            return {"msg": "stats",
+                    "stats": srv.plan_server.serving_stats()}, b""
+        if msg == "shutdown":
+            # graceful drain hook for subprocess workers (the rolling
+            # restart's stop() seam, reachable over the wire): ack, then
+            # stop off-thread so the reply reaches the caller before the
+            # listener closes its connections
+            grace = float(header.get("grace_s", 10.0))
+
+            def _stop():
+                time.sleep(0.05)      # let the ack flush
+                srv.plan_server.stop(grace_s=grace)
+
+            threading.Thread(target=_stop, daemon=True,
+                             name="server-shutdown").start()
+            return {"msg": "shutdown_ack", "fatal": True}, b""
         if msg == "table":
             from ..plan import plancache
             name = header["name"]
@@ -487,8 +505,16 @@ class PlanServer:
         srv.active_conns = set()
         srv.active_queries: List[_ActiveQuery] = []
         srv.session_count = 0
+        srv.plan_server = self          # the stats/shutdown op target
         self._server = srv
         self._thread: Optional[threading.Thread] = None
+        # attach the fleet's shared persistent result tier when the conf
+        # names one, BEFORE serving: a replacement worker must rehydrate
+        # from its very first read-through. _server=True LOCKS the
+        # store for this process — session confs (which merge remote
+        # clients' hello/plan conf) can no longer attach or repoint it
+        from ..plan import plancache
+        plancache.configure_result_store(tconf, _server=True)
 
     @property
     def address(self):
@@ -510,12 +536,25 @@ class PlanServer:
             return len(self._server.active_queries)
 
     def serving_stats(self) -> dict:
-        """Cache + admission + recovery snapshot (the loadbench/ops
-        surface)."""
+        """Cache + admission + recovery snapshot — the loadbench/ops
+        surface AND the ``stats`` wire op's reply body. The schema is
+        stable (``schemaVersion`` guards it): the router aggregates
+        these fleet-wide and ``readiness_line`` formats from the
+        ``server`` block, so every field here is load-bearing."""
         from ..plan import plancache
         from ..shuffle.lineage import metrics as lineage_metrics
         adm = self._server.query_admission
         return {
+            "schemaVersion": 1,
+            "server": {
+                "host": str(self.address[0]),
+                "port": int(self.port),
+                "activeSessions": self.active_sessions,
+                "activeQueries": self.active_query_count,
+                "maxSessions": self._server.max_sessions,
+                "concurrentCollects": self._server.concurrent_collects,
+                "shuttingDown": self._server.shutting_down.is_set(),
+            },
             "planCacheEntries": len(plancache.planning_cache()),
             "resultCache": plancache.result_cache().stats(),
             "counters": plancache.metrics().snapshot(),
@@ -571,10 +610,14 @@ class PlanServer:
 
 def readiness_line(server: PlanServer) -> str:
     """The stdout readiness signal wrapping process managers (and the
-    test harness) parse: ``listening on <host>:<port>`` with the BOUND
-    port, so ``--port 0`` deployments learn the real one."""
+    router's worker spawner) parse: ``listening on <host>:<port>`` with
+    the BOUND port, so ``--port 0`` deployments learn the real one.
+    Formatted from ``serving_stats()['server']`` — the stable stats
+    schema is the single source for every ops surface, not ad-hoc
+    string assembly from server internals."""
+    info = server.serving_stats()["server"]
     return (f"spark-rapids-tpu plan server listening on "
-            f"{server.address[0]}:{server.port}")
+            f"{info['host']}:{info['port']}")
 
 
 def main(argv=None) -> int:

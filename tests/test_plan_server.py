@@ -823,3 +823,162 @@ def test_register_table_name_never_collides_with_auto_names():
                 "query bound to the registered table, not its own scan"
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet seams on the single server (ISSUE 12): the stats wire op + stable
+# schema, the shutdown wire op, and the PlanClient unavailable-retry budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serving
+def test_stats_wire_op_and_stable_schema():
+    """serving_stats() is a wire op now, with a schema the router (and
+    any ops tooling) can rely on: versioned, with the server block the
+    readiness line formats from."""
+    server = PlanServer(conf=_SERVING_CONF).start()
+    try:
+        t = pa.table({"x": np.arange(20, dtype=np.int64)})
+        df = table(t).select((col("x") * lit(3)).alias("y"))
+        with PlanClient("127.0.0.1", server.port) as c:
+            c.collect(df)
+            c.collect(df)
+            st = c.stats()
+        assert st["schemaVersion"] == 1
+        info = st["server"]
+        assert info["host"] == "127.0.0.1"
+        assert info["port"] == server.port
+        assert info["maxSessions"] >= 1 and not info["shuttingDown"]
+        assert st["counters"]["resultCacheHitCount"] >= 1
+        assert set(st["admission"]) == {"concurrentCollects", "admitted",
+                                        "inFlight", "waitTimeNs"}
+        # every counter the fleet aggregates exists, including the
+        # persistent-tier ones
+        for k in ("resultStoreHitCount", "resultStoreWriteCount",
+                  "resultStoreInvalidationCount",
+                  "resultStoreEvictionCount"):
+            assert k in st["counters"], k
+        # readiness_line is a projection OF the stats schema
+        from spark_rapids_tpu.server.server import readiness_line
+        line = readiness_line(server)
+        assert f"{info['host']}:{info['port']}" in line
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_shutdown_wire_op_stops_server():
+    """The rolling restart's drain seam: a ``shutdown`` op acks, then
+    the server stops via the PR-9 stop() contract (in-flight cancel +
+    bounded join) without the caller holding a process handle."""
+    server = PlanServer().start()
+    port = server.port
+    import socket as _socket
+    from spark_rapids_tpu.server import protocol as _proto
+    with _socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        _proto.send_preamble(s)
+        _proto.recv_preamble(s)
+        _proto.send_msg(s, {"msg": "hello", "conf": {}})
+        _proto.recv_msg(s)
+        _proto.send_msg(s, {"msg": "shutdown", "grace_s": 5})
+        reply, _ = _proto.recv_msg(s)
+        assert reply["msg"] == "shutdown_ack"
+    assert _poll(lambda: server._server.shutting_down.is_set(),
+                 timeout_s=10)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            _socket.create_connection(("127.0.0.1", port),
+                                      timeout=0.2).close()
+            time.sleep(0.05)
+        except OSError:
+            break
+    else:
+        raise AssertionError("server still accepting after shutdown op")
+
+
+@pytest.mark.serving
+def test_client_retry_honors_retry_after_with_jitter_and_budget():
+    """The PlanClient retry loop: sleeps ride the server's
+    retry_after_ms hint (jittered within [1x, 2x]), attempts are
+    bounded, and a budget too small to honor the hint raises instead of
+    sleeping past it."""
+    server = PlanServer(
+        health_check=lambda: (_ for _ in ()).throw(
+            RuntimeError("poisoned")),
+        conf={"spark.rapids.tpu.server.retryAfterMs": "40"}).start()
+    try:
+        t = pa.table({"x": np.arange(5, dtype=np.int64)})
+        df = table(t).select((col("x") + lit(1)).alias("y"))
+        sleeps = []
+        with PlanClient("127.0.0.1", server.port,
+                        unavailable_retries=3,
+                        _sleep=sleeps.append) as c:
+            with pytest.raises(PlanServerError) as ei:
+                c.collect(df)
+            assert ei.value.unavailable and ei.value.retry_after_ms == 40
+        assert len(sleeps) == 3                  # bounded attempts
+        assert c.retried_unavailable == 3
+        for s in sleeps:
+            assert 0.04 <= s <= 0.08 + 1e-9      # hint x [1, 2) jitter
+        # a budget smaller than one hint raises WITHOUT sleeping
+        sleeps2 = []
+        with PlanClient("127.0.0.1", server.port,
+                        unavailable_retries=3, retry_budget_ms=10,
+                        _sleep=sleeps2.append) as c2:
+            with pytest.raises(PlanServerError):
+                c2.collect(df)
+        assert sleeps2 == []
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_client_retry_succeeds_after_breaker_closes():
+    """Transient unavailability is absorbed: the breaker opens for the
+    first attempts and closes before the budget runs out; the collect
+    completes without the caller hand-rolling a loop."""
+    calls = []
+
+    def flaky_health():
+        calls.append(1)
+        if len(calls) <= 2:
+            raise RuntimeError("transient device sickness")
+
+    server = PlanServer(
+        health_check=flaky_health,
+        conf={"spark.rapids.tpu.server.retryAfterMs": "20"}).start()
+    try:
+        t = pa.table({"x": np.arange(7, dtype=np.int64)})
+        df = table(t).select((col("x") * lit(2)).alias("y"))
+        with PlanClient("127.0.0.1", server.port,
+                        unavailable_retries=5) as c:
+            out = c.collect(df)
+            assert out.column("y").to_pylist() == \
+                [x * 2 for x in range(7)]
+            assert c.retried_unavailable == 2
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_client_heals_after_abrupt_connection_drop():
+    """An abrupt transport drop (server restart, no fatal reply)
+    surfaces ONE error and closes the client's socket; the next call
+    reconnects, re-ships the session's tables, and succeeds — the
+    client must never be permanently wedged on a dead fd."""
+    server = PlanServer(conf=_SERVING_CONF).start()
+    try:
+        t = pa.table({"x": np.arange(30, dtype=np.int64)})
+        with PlanClient("127.0.0.1", server.port) as c:
+            c.register_table("t", t)
+            df = table(t).agg(Sum(col("x")).alias("s"))
+            first = c.collect(df)
+            c._sock.close()                  # simulate the abrupt drop
+            with pytest.raises(OSError):
+                c.collect(df)
+            assert c._sock is None           # _request cleaned it up
+            healed = c.collect(df)           # reconnect + table replay
+            assert healed.equals(first)
+    finally:
+        server.stop()

@@ -1,0 +1,184 @@
+"""Persistent result-store tier units + the cross-tier invalidation fix.
+
+The fleet's shared disk tier (plan/resultstore.py) must: round-trip
+entries bit-for-bit, treat corruption as a miss (never serve it), hold
+its byte budget by deleting least-recently-touched files, invalidate by
+digest idempotently across processes, and — through ResultCache — make
+the drop_table ack authoritative across BOTH tiers (the ISSUE 12
+satellite fix: the ack used to count only the in-process cache).
+"""
+
+import os
+import threading
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.plan.resultstore import PersistentResultStore
+
+pytestmark = pytest.mark.serving
+
+
+def _ipc(n=10):
+    from spark_rapids_tpu.server import protocol
+    return protocol.table_to_ipc(pa.table({"x": list(range(n))}))
+
+
+KEY_A = "a" * 32
+KEY_B = "b" * 32
+DIG_1 = "d1"
+DIG_2 = "d2"
+
+
+def test_roundtrip_and_meta(tmp_path):
+    store = PersistentResultStore(str(tmp_path))
+    ipc = _ipc()
+    assert store.put(KEY_A, ipc, (DIG_1, DIG_2), execs=("ScanExec",),
+                     fell_back=(), rows=10)
+    got = store.get(KEY_A)
+    assert got is not None
+    assert got["ipc"] == ipc                      # bit-for-bit
+    assert got["digests"] == (DIG_1, DIG_2)
+    assert got["execs"] == ("ScanExec",)
+    assert got["rows"] == 10
+    assert store.get(KEY_B) is None               # miss
+    assert store.stats()["entries"] == 1
+
+
+def test_corrupt_file_is_a_miss_and_quarantined(tmp_path):
+    store = PersistentResultStore(str(tmp_path))
+    ipc = _ipc()
+    store.put(KEY_A, ipc, (DIG_1,))
+    fp = os.path.join(str(tmp_path), KEY_A + ".res")
+    blob = bytearray(open(fp, "rb").read())
+    blob[-3] ^= 0xFF                              # payload bit-flip
+    open(fp, "wb").write(bytes(blob))
+    assert store.get(KEY_A) is None               # CRC catches it
+    assert not os.path.exists(fp)                 # quarantined
+    # truncated prefix is also a miss, never a crash
+    store.put(KEY_B, ipc, (DIG_1,))
+    fpb = os.path.join(str(tmp_path), KEY_B + ".res")
+    open(fpb, "wb").write(b"\x02")
+    assert store.get(KEY_B) is None
+
+
+def test_malformed_key_refused(tmp_path):
+    store = PersistentResultStore(str(tmp_path))
+    with pytest.raises(ValueError):
+        store.put("../evil", b"x", ())
+    with pytest.raises(ValueError):
+        store.get("ha/ha")
+
+
+def test_byte_budget_evicts_least_recently_touched(tmp_path):
+    ipc = _ipc(50)
+    entry_size = 4 + 120 + len(ipc)   # meta is ~120B; oversize the bound
+    store = PersistentResultStore(str(tmp_path),
+                                  max_bytes=3 * (len(ipc) + 200))
+    evicted = []
+    store.on_evict = evicted.append
+    keys = [c * 32 for c in "abcde"]
+    for i, k in enumerate(keys):
+        store.put(k, ipc, (DIG_1,))
+        os.utime(os.path.join(str(tmp_path), k + ".res"),
+                 (1000 + i, 1000 + i))    # deterministic recency order
+    store.put("f" * 32, ipc, (DIG_1,))
+    stats = store.stats()
+    assert stats["usedBytes"] <= store.max_bytes
+    assert sum(evicted) > 0
+    # the oldest entries went first; the newest survives
+    assert store.get("f" * 32) is not None
+    assert store.get("a" * 32) is None
+    assert entry_size > 0
+
+
+def test_single_entry_over_budget_never_stored(tmp_path):
+    store = PersistentResultStore(str(tmp_path), max_bytes=64)
+    assert store.put(KEY_A, _ipc(1000), (DIG_1,)) is False
+    assert store.stats()["entries"] == 0
+
+
+def test_invalidate_digest_idempotent_across_handles(tmp_path):
+    """Two store handles on one directory model two workers sharing the
+    tier: the first invalidation deletes, the second finds nothing —
+    fan-out acks stay additive."""
+    a = PersistentResultStore(str(tmp_path))
+    b = PersistentResultStore(str(tmp_path))
+    a.put(KEY_A, _ipc(), (DIG_1,))
+    a.put(KEY_B, _ipc(), (DIG_2,))
+    assert b.invalidate_digest(DIG_1) == 1      # worker B reaches first
+    assert a.invalidate_digest(DIG_1) == 0      # idempotent
+    assert a.get(KEY_A) is None
+    assert a.get(KEY_B) is not None             # other digest untouched
+
+
+def test_result_cache_reads_through_and_rehydrates(tmp_path):
+    """A fresh ResultCache (= a restarted worker) with the same store
+    attached serves the entry from disk and promotes it to memory —
+    the rolling restart's rehydration path, single-process model."""
+    from spark_rapids_tpu.plan import plancache
+    store = PersistentResultStore(str(tmp_path))
+    c1 = plancache.ResultCache()
+    c1.persistent = store
+    ipc = _ipc()
+    c1.put(plancache.ResultEntry(key=KEY_A, ipc=ipc, digests=(DIG_1,),
+                                 execs=("X",), rows=10))
+    assert store.get(KEY_A) is not None         # write-through
+    hits0 = plancache.metrics().snapshot()["resultStoreHitCount"]
+    c2 = plancache.ResultCache()                # "restarted" worker
+    c2.persistent = store
+    e = c2.get(KEY_A)
+    assert e is not None and e.ipc == ipc and e.execs == ("X",)
+    assert plancache.metrics().snapshot()["resultStoreHitCount"] \
+        == hits0 + 1
+    # promoted: a second get hits memory, not the store
+    assert c2.get(KEY_A) is not None
+    assert plancache.metrics().snapshot()["resultStoreHitCount"] \
+        == hits0 + 1
+
+
+def test_invalidation_ack_covers_both_tiers(tmp_path):
+    """The satellite fix: invalidate_digest must count memory AND
+    persistent entries, so a drop_table ack is authoritative even for
+    entries only the disk tier still holds."""
+    from spark_rapids_tpu.plan import plancache
+    store = PersistentResultStore(str(tmp_path))
+    cache = plancache.ResultCache()
+    cache.persistent = store
+    cache.put(plancache.ResultEntry(key=KEY_A, ipc=_ipc(),
+                                    digests=(DIG_1,)))
+    # model a sibling worker's write that THIS memory tier never saw
+    store.put(KEY_B, _ipc(), (DIG_1,))
+    n = cache.invalidate_digest(DIG_1)
+    assert n == 3          # 1 memory + 2 persistent files
+    assert cache.get(KEY_A) is None
+    assert store.get(KEY_B) is None
+
+
+def test_concurrent_writers_one_directory(tmp_path):
+    """Atomic replace + idempotent eviction: racing writers never
+    corrupt the store or crash each other."""
+    store = PersistentResultStore(str(tmp_path),
+                                  max_bytes=10 * (len(_ipc()) + 300))
+    errs = []
+
+    def writer(tag):
+        try:
+            for i in range(30):
+                k = f"{tag}{i:02d}".ljust(32, "0")
+                store.put(k, _ipc(), (DIG_1,))
+                store.get(k)
+        except Exception as e:      # pragma: no cover - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=writer, args=(c,)) for c in "abcd"]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs == []
+    assert store.stats()["usedBytes"] <= store.max_bytes
+    # every surviving file decodes
+    for (fp, _, _) in store._scan():
+        key = os.path.basename(fp)[:-4]
+        store.get(key)

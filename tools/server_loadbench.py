@@ -1,18 +1,31 @@
 """Serving-tier load bench: many concurrent framed-TCP clients against
-one embedded PlanServer, mixed repeated/unique query shapes.
+one embedded PlanServer — or, with ``--fleet N``, against a Router in
+front of N plan-server worker subprocesses.
 
-The acceptance instrument for ISSUE 10: it reports QPS + p50/p99 latency
-split by repeated vs unique shapes, the plan/result cache hit counters,
-and admission stats — and with ``--compare`` it re-runs the identical
-workload with the planning cache disabled so the repeated-shape p50
-improvement is measured on the same machine in the same process.
+The acceptance instrument for ISSUE 10 (single server) and ISSUE 12
+(fleet): it reports QPS + p50/p99 latency split by repeated vs unique
+shapes, the plan/result cache hit counters, and admission stats; fleet
+mode adds the per-tenant breakdown, router overhead p50/p99, and
+per-worker QPS. ``--compare`` re-runs the identical workload with the
+caches disabled (single) or with ONE worker (fleet) so the scaling is
+measured on the same machine.
 
     python tools/server_loadbench.py --clients 100 --rounds 5 --compare \
         --json-out BENCH_loadbench.json
+    python tools/server_loadbench.py --fleet 4 --clients 500 --rounds 3 \
+        --tenants 4 --compare --json-out BENCH_fleet.json
 
-Results land in docs/profiling.md; the <2-min smoke-tier mini run is
-``pytest -m "serving and smoke"`` (tests/test_serving_concurrent.py),
-which drives this module with small parameters.
+Fleet legs: the *repeat-shape* leg re-submits the SAME four shapes with
+fresh literals — every query plans against a warm planning cache (and a
+warm XLA compile cache on its home worker) but still executes, so QPS
+scales with workers; the *unique-shape* leg pays cold planning. The
+result cache is left OFF in fleet scaling runs for exactly that reason:
+a byte-serving router measures the router's GIL, not the fleet.
+
+Results land in docs/profiling.md; the <2-min smoke-tier mini runs are
+``pytest -m "serving and smoke"`` (tests/test_serving_concurrent.py and
+tests/test_serving_fleet.py), which drive this module with small
+parameters.
 """
 
 from __future__ import annotations
@@ -200,6 +213,228 @@ def run_load(clients: int, rounds: int, rows: int,
     return out
 
 
+def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
+                   tenants: int = 1,
+                   unique_fraction: float = 0.25,
+                   concurrent_collects: int = 4,
+                   result_cache: bool = False,
+                   repeat_literals: bool = False,
+                   rolling_restart: bool = False,
+                   retries: int = 8,
+                   shape_variants: int = 0,
+                   shapes_per_client: int = 0,
+                   cpus_per_worker: int = 0,
+                   host: str = "127.0.0.1",
+                   client_timeout: float = 900.0) -> dict:
+    """Drive ``clients`` threads through a Router over ``fleet`` worker
+    subprocesses. The *repeat* leg re-submits the same shapes with
+    fresh literals (warm planning cache, real execution — the scaling
+    leg) unless ``repeat_literals`` (same literals: the result-cache /
+    rehydration leg); the *unique* leg varies the plan STRUCTURE (a
+    distinct limit node) so planning is cold. ``rolling_restart``
+    triggers a full fleet restart once round 0 completes — the
+    zero-downtime acceptance: the report carries every client error and
+    the persistent-tier rehydration hit count.
+
+    ``shape_variants`` > 0 expands the 4 base shapes into that many
+    structurally-distinct variants (an extra limit node each) so the
+    consistent-hash ring load-balances — with only 4 shapes on 4
+    workers the hash can pin 2 shapes to one worker and idle another,
+    which measures ring imbalance, not fleet throughput.
+    ``shapes_per_client`` > 0 gives each client a deterministic subset
+    (variants stay shared ACROSS clients, so repeats still hit warm
+    caches) to bound total query count at high client counts."""
+    from spark_rapids_tpu.server import PlanClient
+    from spark_rapids_tpu.server.router import Router
+
+    cpusets = None
+    if cpus_per_worker > 0:
+        # equal core slices per worker: the 1-vs-N comparison measures
+        # fleet structure, not one worker's XLA thread pool grabbing
+        # the whole machine in the 1-worker leg
+        ncpu = os.cpu_count() or 1
+        cpusets = []
+        for i in range(fleet):
+            lo = (i * cpus_per_worker) % ncpu
+            hi = min(lo + cpus_per_worker - 1, ncpu - 1)
+            cpusets.append(f"{lo}-{hi}")
+    tabs = _tables(rows)
+    base = _shapes(tabs)
+    if shape_variants and shape_variants > len(base):
+        shapes = []
+        for j in range(shape_variants):
+            name, build = base[j % len(base)]
+            shapes.append((
+                f"{name}~v{j}",
+                # bind j now; the limit bound makes variant j a distinct
+                # plan SHAPE with identical rows/semantics
+                lambda v, _b=build, _j=j: _b(v).limit(10**9 - _j)))
+    else:
+        shapes = base
+    router = Router(
+        workers=fleet,
+        worker_cpusets=cpusets,
+        conf={"spark.rapids.tpu.server.fleet.tenant.weights":
+              ",".join(f"t{i}={1 + i % 2}" for i in range(tenants))},
+        worker_conf={
+            "spark.rapids.tpu.server.resultCache.enabled":
+                str(result_cache),
+            "spark.rapids.tpu.server.concurrentCollects":
+                str(concurrent_collects),
+            "spark.rapids.tpu.server.maxSessions":
+                str(max(64, clients + 8)),
+        }).start()
+    samples = []          # (shape, kind, ms, tenant, worker, cached)
+    lock = threading.Lock()
+    errors = []
+    finished_clients = [0]
+    restart_report = {}
+    restart_done = threading.Event()
+
+    def worker(ci: int):
+        tenant = f"t{ci % tenants}"
+        my_shapes = list(enumerate(shapes))
+        if shapes_per_client and shapes_per_client < len(shapes):
+            my_shapes = [my_shapes[(ci * 7 + m * 13) % len(shapes)]
+                         for m in range(shapes_per_client)]
+        try:
+            with PlanClient(
+                    host, router.port, timeout=client_timeout,
+                    unavailable_retries=retries,
+                    retry_budget_ms=int(client_timeout * 1000),
+                    conf={"spark.rapids.tpu.server.fleet.tenantId":
+                          tenant}) as c:
+                # a rolling-restart leg keeps the load on until the
+                # roll completes, then runs ONE more full round against
+                # the replacements (that round is what proves
+                # rehydration); bounded in case the roll wedges
+                r, extra = 0, 0
+                while True:
+                    for si, (name, build) in my_shapes:
+                        unique = r > 0 and \
+                            ((ci * 31 + r * 7 + si) % 100) < \
+                            unique_fraction * 100
+                        lit_v = 25 if (repeat_literals or r == 0) else \
+                            1 + (ci * 131 + r * 17 + si * 7) % 900
+                        df = build(lit_v)
+                        if unique:
+                            # a distinct limit bound = a distinct plan
+                            # SHAPE (plan fields stay in the
+                            # fingerprint): cold planning, same rows
+                            df = df.limit(
+                                10**9 - (ci * 997 + r * 131 + si))
+                            kind = "unique"
+                        else:
+                            kind = "first" if r == 0 else "repeat"
+                        t0 = time.perf_counter()
+                        c.collect(df)
+                        ms = (time.perf_counter() - t0) * 1e3
+                        with lock:
+                            samples.append(
+                                (name, kind, ms, tenant,
+                                 c.last_worker, c.last_cached))
+                    r += 1
+                    if r < rounds:
+                        continue
+                    if not rolling_restart or r >= rounds * 50:
+                        break
+                    if restart_done.is_set():
+                        if extra >= 1:
+                            break
+                        extra += 1      # the proving post-restart round
+        except Exception as e:    # surfaced in the report
+            with lock:
+                errors.append(f"client {ci}: {type(e).__name__}: {e}")
+        finally:
+            with lock:
+                finished_clients[0] += 1
+
+    def restarter():
+        # wait for round 0 (every shape planted fleet-wide), then roll
+        per_client = shapes_per_client \
+            if shapes_per_client and shapes_per_client < len(shapes) \
+            else len(shapes)
+        target = clients * per_client
+        while True:
+            with lock:
+                n = len(samples)
+                # the target can become unreachable (erroring clients
+                # produce no samples): never outlive the client fleet
+                done = finished_clients[0] >= clients
+            if n >= target or done:
+                break
+            time.sleep(0.05)
+        restart_report.update(router.rolling_restart(grace_s=30))
+        restart_done.set()
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    rt = None
+    if rolling_restart:
+        rt = threading.Thread(target=restarter, daemon=True)
+        rt.start()
+    for t in threads:
+        t.join()
+    if rt is not None:
+        rt.join()
+    wall = time.perf_counter() - t_start
+    deadline = time.monotonic() + 5.0
+    while router.active_sessions and time.monotonic() < deadline:
+        time.sleep(0.02)
+    stats = router.serving_stats()
+    leaked_sessions = router.active_sessions
+    router.stop(grace_s=10)
+
+    def agg(pred):
+        xs = [s[2] for s in samples if pred(s)]
+        return {"n": len(xs), "p50_ms": round(_pct(xs, 50), 3),
+                "p99_ms": round(_pct(xs, 99), 3),
+                "qps": round(len(xs) / wall, 1) if wall else 0.0}
+
+    per_worker_plans = stats["routing"]["perWorkerPlans"]
+    tenant_stats = {}
+    for i in range(tenants):
+        tn = f"t{i}"
+        t_agg = agg(lambda s, tn=tn: s[3] == tn)
+        t_agg.update(stats["tenants"].get(tn, {}))
+        tenant_stats[tn] = t_agg
+    rehydration = sum(
+        (ws or {}).get("counters", {}).get("resultStoreHitCount", 0)
+        for ws in stats["workers"].values())
+    return {
+        "fleet": fleet, "clients": clients, "rounds": rounds,
+        "rows": rows, "tenants_n": tenants,
+        "result_cache": result_cache,
+        "repeat_literals": repeat_literals,
+        "concurrent_collects": concurrent_collects,
+        "wall_s": round(wall, 3),
+        "qps": round(len(samples) / wall, 1) if wall else 0.0,
+        "queries": len(samples),
+        "errors": len(errors),
+        "error_samples": errors[:5],
+        "all": agg(lambda s: True),
+        "repeat": agg(lambda s: s[1] == "repeat"),
+        "unique": agg(lambda s: s[1] == "unique"),
+        "first": agg(lambda s: s[1] == "first"),
+        "result_cache_served": sum(1 for s in samples if s[5]),
+        "per_worker_qps": {
+            "plans": per_worker_plans,
+            "qps": {w: round(n / wall, 1) if wall else 0.0
+                    for w, n in per_worker_plans.items()},
+        },
+        "router_overhead_ms": stats["routing"]["overheadMs"],
+        "failovers": stats["routing"]["failovers"],
+        "fingerprint_fallbacks": stats["routing"]["fingerprintFallbacks"],
+        "tenants": tenant_stats,
+        "rolling_restart": restart_report or None,
+        "rehydration_hits": rehydration,
+        "leaked_sessions": leaked_sessions,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--clients", type=int, default=100)
@@ -217,25 +452,92 @@ def main(argv=None) -> int:
     p.add_argument("--client-timeout", type=float, default=900.0,
                    help="per-client socket timeout, seconds; uncached "
                         "high-fan-in runs queue long on a CPU host")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="drive a Router over N worker subprocesses "
+                        "instead of one embedded server; --compare "
+                        "re-runs with ONE worker for the scaling ratio")
+    p.add_argument("--tenants", type=int, default=1,
+                   help="fleet mode: spread clients over this many "
+                        "tenant ids (per-tenant breakdown in the report)")
+    p.add_argument("--shape-variants", type=int, default=0,
+                   help="fleet mode: expand the 4 base shapes into this "
+                        "many structurally-distinct variants so the "
+                        "hash ring load-balances")
+    p.add_argument("--shapes-per-client", type=int, default=0,
+                   help="fleet mode: each client drives only this many "
+                        "(shared) shapes, bounding total queries at "
+                        "high client counts")
+    p.add_argument("--compare-clients", type=int, default=0,
+                   help="client count for the --compare 1-worker leg "
+                        "(default: same as --clients; a saturated "
+                        "1-worker leg needs far fewer clients for the "
+                        "same QPS measurement)")
+    p.add_argument("--cpus-per-worker", type=int, default=0,
+                   help="taskset-pin each worker to this many cores so "
+                        "a single-host 1-vs-N comparison holds "
+                        "per-worker compute constant across legs")
+    p.add_argument("--restart-under-load", action="store_true",
+                   help="fleet mode: add a leg that rolls the whole "
+                        "fleet mid-run (result cache ON, repeated "
+                        "literals) — zero errors + nonzero rehydration "
+                        "hits is the acceptance")
     args = p.parse_args(argv)
 
-    report = {"loadbench": run_load(
-        args.clients, args.rounds, args.rows,
-        plan_cache=not args.no_plan_cache,
-        result_cache=not args.no_result_cache,
-        concurrent_collects=args.concurrent_collects,
-        unique_fraction=args.unique_fraction,
-        client_timeout=args.client_timeout)}
-    if args.compare:
-        report["loadbench_uncached"] = run_load(
+    if args.fleet > 0:
+        report = {"fleet_loadbench": run_fleet_load(
+            args.clients, args.rounds, args.rows, fleet=args.fleet,
+            tenants=args.tenants,
+            unique_fraction=args.unique_fraction,
+            concurrent_collects=args.concurrent_collects,
+            shape_variants=args.shape_variants,
+            shapes_per_client=args.shapes_per_client,
+            cpus_per_worker=args.cpus_per_worker,
+            client_timeout=args.client_timeout)}
+        if args.compare:
+            cc = args.compare_clients or args.clients
+            report["fleet_loadbench_1worker"] = run_fleet_load(
+                cc, args.rounds, args.rows, fleet=1,
+                tenants=args.tenants,
+                unique_fraction=args.unique_fraction,
+                concurrent_collects=args.concurrent_collects,
+                shape_variants=args.shape_variants,
+                shapes_per_client=args.shapes_per_client,
+                cpus_per_worker=args.cpus_per_worker,
+                client_timeout=args.client_timeout)
+            for leg in ("repeat", "unique"):
+                a = report["fleet_loadbench"][leg]["qps"]
+                b = report["fleet_loadbench_1worker"][leg]["qps"]
+                report[f"{leg}_qps_scaling"] = \
+                    round(a / b, 3) if b else None
+        if args.restart_under_load:
+            report["fleet_rolling_restart"] = run_fleet_load(
+                min(args.clients, 48), 4, args.rows, fleet=args.fleet,
+                tenants=args.tenants, unique_fraction=0.0,
+                concurrent_collects=args.concurrent_collects,
+                shape_variants=args.shape_variants,
+                shapes_per_client=args.shapes_per_client,
+                cpus_per_worker=args.cpus_per_worker,
+                result_cache=True, repeat_literals=True,
+                rolling_restart=True,
+                client_timeout=args.client_timeout)
+    else:
+        report = {"loadbench": run_load(
             args.clients, args.rounds, args.rows,
-            plan_cache=False, result_cache=False,
+            plan_cache=not args.no_plan_cache,
+            result_cache=not args.no_result_cache,
             concurrent_collects=args.concurrent_collects,
             unique_fraction=args.unique_fraction,
-            client_timeout=args.client_timeout)
-        a = report["loadbench"]["repeat"]["p50_ms"]
-        b = report["loadbench_uncached"]["repeat"]["p50_ms"]
-        report["repeat_p50_speedup"] = round(b / a, 3) if a else None
+            client_timeout=args.client_timeout)}
+        if args.compare:
+            report["loadbench_uncached"] = run_load(
+                args.clients, args.rounds, args.rows,
+                plan_cache=False, result_cache=False,
+                concurrent_collects=args.concurrent_collects,
+                unique_fraction=args.unique_fraction,
+                client_timeout=args.client_timeout)
+            a = report["loadbench"]["repeat"]["p50_ms"]
+            b = report["loadbench_uncached"]["repeat"]["p50_ms"]
+            report["repeat_p50_speedup"] = round(b / a, 3) if a else None
     print(json.dumps(report, indent=2))
     if args.json_out:
         existing = {}
