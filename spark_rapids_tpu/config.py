@@ -719,6 +719,27 @@ FLEET_RESULT_STORE_MAX_BYTES = conf(
     "least-recently-touched entry files are deleted at write time."
 ).bytes_(1 << 30)
 
+BRIDGE_ACCEPTED_SCHEMA_VERSIONS = conf(
+    "spark.rapids.tpu.bridge.acceptedSchemaVersions").doc(
+    "Comma-separated Catalyst fixture schemaVersions the Spark driver "
+    "bridge accepts (server/spark_client.py). A plan document declaring "
+    "any other version is rejected with an actionable error instead of "
+    "being misparsed — the guard against Spark-side plan-format drift."
+).text("1")
+
+BRIDGE_DEFAULT_STRING_LEN = conf(
+    "spark.rapids.tpu.bridge.defaultStringLen").doc(
+    "Byte budget assigned to Spark 'string' attributes during Catalyst "
+    "translation (Spark strings are unbounded; the device layout is a "
+    "fixed-width padded matrix, the same policy the scan boundary "
+    "applies to arrow strings).").integer(64)
+
+BRIDGE_DEFAULT_ARRAY_ELEMS = conf(
+    "spark.rapids.tpu.bridge.defaultArrayElems").doc(
+    "Element budget assigned to Spark array/map attributes during "
+    "Catalyst translation (fixed-budget device layout)."
+).integer(256)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "
     "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."

@@ -353,6 +353,22 @@ class Literal(Expression):
             return DeviceColumn(data, batch.row_mask(),
                                 jnp.full(cap, len(b), jnp.int32), d)
         v = self.value
+        if d.kind in (TypeKind.DATE, TypeKind.TIMESTAMP):
+            # rich datetime values (what the Spark bridge and the row
+            # interpreter carry) internalize to epoch days/micros here;
+            # already-internal ints pass through
+            import datetime as _dtm
+            if d.kind is TypeKind.DATE and isinstance(v, _dtm.date):
+                if isinstance(v, _dtm.datetime):
+                    v = v.date()
+                v = v.toordinal() - _dtm.date(1970, 1, 1).toordinal()
+            elif d.kind is TypeKind.TIMESTAMP and \
+                    isinstance(v, _dtm.datetime):
+                vv = v if v.tzinfo is not None \
+                    else v.replace(tzinfo=_dtm.timezone.utc)
+                epoch = _dtm.datetime(1970, 1, 1,
+                                      tzinfo=_dtm.timezone.utc)
+                v = round((vv - epoch) / _dtm.timedelta(microseconds=1))
         if d.kind is TypeKind.DECIMAL:
             import decimal as pydec
             with pydec.localcontext() as lctx:

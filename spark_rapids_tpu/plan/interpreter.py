@@ -69,7 +69,20 @@ class RowEvaluator:
         return row[e.ordinal]
 
     def _eval_Literal(self, e, row):
-        return e.value
+        v = e.value
+        if isinstance(v, int) and not isinstance(v, bool):
+            # internal-representation date/timestamp literals (epoch
+            # days/micros — what device kernels consume) re-hydrate to
+            # the rich python values this row interpreter computes with
+            import datetime as _dt
+            k = e.dtype.kind
+            if k is TypeKind.DATE:
+                return _dt.date.fromordinal(
+                    v + _dt.date(1970, 1, 1).toordinal())
+            if k is TypeKind.TIMESTAMP:
+                return (_dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+                        + _dt.timedelta(microseconds=v))
+        return v
 
     def _eval_Alias(self, e, row):
         return self.eval(e.child, row)

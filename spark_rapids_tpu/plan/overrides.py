@@ -294,6 +294,56 @@ EXPR_RULES = _expr_rules()
 # Meta wrappers (RapidsMeta analogue)
 # ---------------------------------------------------------------------------
 
+_HOST_ONLY_PREFIX = "input data requires host execution: "
+
+
+def scan_host_only_reason(tbl) -> Optional[str]:
+    """Data-dependent device gate for in-memory scans: arrays carrying
+    NULL elements have no device layout (fixed-budget element matrices
+    hold non-null values; batch.py raises at the H2D boundary). Tagging
+    it at plan time turns the runtime TypeError into a recorded
+    willNotWork fallback — degrade loudly, never wrongly (ROADMAP item 7
+    / VERDICT weak #5)."""
+    import pyarrow as pa
+    for i, f in enumerate(tbl.schema):
+        if not (pa.types.is_list(f.type) or pa.types.is_large_list(f.type)):
+            continue
+        for chunk in tbl.column(i).chunks:
+            # .values of a sliced chunk can over-count trailing nulls
+            # outside the window; a conservative extra fallback is safe,
+            # a missed null element is not
+            if chunk.values.null_count:
+                return (f"{_HOST_ONLY_PREFIX}column {f.name!r} holds "
+                        f"arrays with null elements, which are outside "
+                        f"the device subset (fixed-budget element "
+                        f"matrices are non-null); CPU fallback")
+    return None
+
+
+def propagate_host_only_data(meta: "PlanMeta") -> None:
+    """A host-only-data reason on any scan poisons the WHOLE meta tree:
+    the offending column cannot cross the H2D boundary at any later
+    exec either, so partial device islands would just move the crash.
+    One fallback island keeps the data host-side end to end."""
+    reasons: List[str] = []
+
+    def collect(m: "PlanMeta") -> None:
+        reasons.extend(r for r in m.reasons
+                       if r.startswith(_HOST_ONLY_PREFIX))
+        for c in m.children:
+            collect(c)
+
+    def apply(m: "PlanMeta") -> None:
+        for r in reasons:
+            m.will_not_work(r)
+        for c in m.children:
+            apply(c)
+
+    collect(meta)
+    if reasons:
+        apply(meta)
+
+
 class PlanMeta:
     def __init__(self, node: L.LogicalPlan, conf: RapidsTpuConf):
         self.node = node
@@ -368,6 +418,10 @@ class PlanMeta:
         """Per-node-type tagging beyond TypeSig — the reference's per-meta
         tagForGpu overrides (GpuWindowExecMeta, agg metas)."""
         n = self.node
+        if isinstance(n, L.LogicalScan) and n.data is not None:
+            reason = scan_host_only_reason(n.data)
+            if reason is not None:
+                self.will_not_work(reason)
         if isinstance(n, L.LogicalScan) and n.source is not None:
             # per-format enables (reference: spark.rapids.sql.format.*)
             fmt = getattr(n.source, "format_name", None)
@@ -817,6 +871,7 @@ class Overrides:
     def plan(self, logical: L.LogicalPlan) -> Exec:
         meta = PlanMeta(logical, self.conf)
         meta.tag()
+        propagate_host_only_data(meta)
         from .cbo import CBO_ENABLED, CostBasedOptimizer
         if self.conf.get(CBO_ENABLED.key):
             CostBasedOptimizer(self.conf).optimize(meta)

@@ -312,6 +312,26 @@ def _window_overcap_bits(plan: L.LogicalPlan,
     return bits
 
 
+def _host_only_data_bits(plan: L.LogicalPlan) -> List[int]:
+    """Data-dependent placement gates bucketed scan shapes cannot stand
+    in for: whether an in-memory scan's arrays carry null elements
+    (overrides.scan_host_only_reason forces a whole-plan CPU fallback).
+    Without this bit, a same-bucket clean table could replay a cached
+    all-CPU placement — or worse, a cached device placement would crash
+    at the H2D boundary of a null-element input."""
+    from .overrides import scan_host_only_reason
+    bits: List[int] = []
+
+    def walk(n: L.LogicalPlan):
+        if isinstance(n, L.LogicalScan) and n.data is not None:
+            bits.append(int(scan_host_only_reason(n.data) is not None))
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    return bits
+
+
 def _hash(payload) -> str:
     blob = json.dumps(payload, sort_keys=True, separators=(",", ":"),
                       default=str)
@@ -341,6 +361,7 @@ def shape_fingerprint(plan: L.LogicalPlan, conf: RapidsTpuConf,
     shape = _walk_doc(doc, None, tables, "shape")
     payload = {"v": 1, "plan": shape,
                "overcap": _window_overcap_bits(plan, conf),
+               "hostonly": _host_only_data_bits(plan),
                "conf": conf_fingerprint(conf)}
     from .cbo import CBO_ENABLED
     if conf.get(CBO_ENABLED.key):

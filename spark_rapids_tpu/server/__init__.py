@@ -13,6 +13,13 @@ Run a server:  ``python -m spark_rapids_tpu.server --port 9099``
 Run a fleet:   ``python -m spark_rapids_tpu.server.router --workers 4``
 Connect:       ``PlanClient("127.0.0.1", 9099).collect(df)``
 
+A REAL Spark driver plugs in through the Catalyst bridge
+(``spark_client`` + ``catalyst``): export
+``df.queryExecution.executedPlan.toJSON`` driver-side, then
+``PlanClient.collect_catalyst(json, tables=...)`` translates it into the
+plandoc dialect client-side and executes it bit-for-bit (docs/serving.md,
+"Spark driver bridge"; golden corpus under tests/fixtures/catalyst/).
+
 The router (``router.py``) fronts N plan-server worker subprocesses with
 consistent-hash routing on the plan-shape fingerprint, per-tenant
 admission, and zero-downtime rolling restarts — clients speak to it with

@@ -1,0 +1,327 @@
+"""Catalyst-plan ingestion primitives: the Spark `queryExecution` JSON
+dialect (TreeNode.toJSON) parsed into a navigable tree, plus the Spark
+node/expression registries the translator dispatches on.
+
+This is the driver half of the bridge the reference calls SQLPlugin
+(Plugin.scala:44-51): a real Spark driver serializes its physical plan
+(`df.queryExecution.executedPlan.toJSON`) and ships it here;
+`spark_client.translate` turns it into the plandoc dialect the serving
+tier (PR 10/12) already speaks.
+
+Wire shape (Spark's TreeNode.toJSON, fixture-corpus schemaVersion 1):
+
+- A *tree* is a JSON array of node objects in PRE-ORDER; each node carries
+  ``class`` (fully-qualified Spark class name), ``num-children``, and its
+  case-class fields. The ``num-children`` prefix encoding reassembles the
+  tree unambiguously.
+- Fields that reference the node's own children (expression operands,
+  plan-node ``child``) are encoded as integer indices into the child list
+  (lists of indices for Seq[child] fields like ``partitionSpec``).
+- Fields holding expression trees that are NOT tree children (a plan
+  node's ``condition`` / ``projectList`` / ``sortOrder``) are encoded as
+  fully nested flattened arrays, one per expression.
+- Case objects (``Inner$``, ``Ascending$``) appear as
+  ``{"object": "org.apache...Inner$"}``; small products (``ExprId``,
+  ``Tuple2``) as ``{"product-class": ..., fields...}``.
+
+Everything unmapped raises a typed :class:`CatalystUnsupportedError`
+carrying the node path from the root — the bridge analogue of the
+reference's willNotWorkOnGpu tagging: never a silent partial translation.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import decimal as _pydec
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import types as T
+
+#: fixture-corpus schema version this translator understands (satellite:
+#: version-gated corpus; bump on any change to the encoding rules above)
+SCHEMA_VERSION = 1
+
+#: conf keys (registered in config.py; read via plain dict here so the
+#: client-side translator needs no engine imports)
+ACCEPTED_VERSIONS_CONF = "spark.rapids.tpu.bridge.acceptedSchemaVersions"
+STRING_LEN_CONF = "spark.rapids.tpu.bridge.defaultStringLen"
+ARRAY_ELEMS_CONF = "spark.rapids.tpu.bridge.defaultArrayElems"
+
+_CONF_DEFAULTS = {
+    ACCEPTED_VERSIONS_CONF: str(SCHEMA_VERSION),
+    STRING_LEN_CONF: 64,
+    ARRAY_ELEMS_CONF: 256,
+}
+
+
+def bridge_conf(conf: Optional[dict], key: str):
+    v = (conf or {}).get(key)
+    if v is None:
+        from ..config import _REGISTRY
+        entry = _REGISTRY.get(key)
+        v = entry.default if entry is not None else _CONF_DEFAULTS[key]
+    return int(v) if key != ACCEPTED_VERSIONS_CONF else str(v)
+
+
+# ---------------------------------------------------------------------------
+# errors
+# ---------------------------------------------------------------------------
+
+class CatalystBridgeError(ValueError):
+    """Base: any failure translating a Catalyst plan document. ``path``
+    is the node path from the plan root (e.g.
+    ``ProjectExec/projectList[1]/Alias/Add``)."""
+
+    def __init__(self, message: str, path: str = "$"):
+        super().__init__(f"{message} [at {path}]")
+        self.reason = message
+        self.path = path
+
+
+class CatalystUnsupportedError(CatalystBridgeError):
+    """A structurally valid construct the bridge has no mapping for —
+    the translation analogue of the reference's willNotWork tagging.
+    Always carries the node path; a driver sees exactly which subtree
+    to keep on the CPU."""
+
+
+class CatalystMalformedError(CatalystBridgeError):
+    """The document violates the encoding rules (bad child counts,
+    missing required fields, type mismatches against the data)."""
+
+
+class CatalystVersionError(CatalystBridgeError):
+    """Unknown fixture ``schemaVersion`` — Spark-side plan-format drift
+    must fail actionably, not misparse."""
+
+
+# ---------------------------------------------------------------------------
+# tree reassembly
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CNode:
+    """One reassembled Catalyst tree node."""
+
+    cls: str                       # fully-qualified Spark class name
+    fields: Dict[str, Any]
+    children: List["CNode"] = field(default_factory=list)
+
+    @property
+    def simple(self) -> str:
+        return self.cls.rsplit(".", 1)[-1]
+
+    def child_field(self, name: str, path: str) -> "CNode":
+        """A required single-child reference field (``child``/``left``)."""
+        v = self.fields.get(name)
+        if not isinstance(v, int) or not 0 <= v < len(self.children):
+            raise CatalystMalformedError(
+                f"{self.simple}.{name} must index a child "
+                f"(got {v!r}, {len(self.children)} children)", path)
+        return self.children[v]
+
+
+def build_tree(nodes: Any, path: str = "$") -> CNode:
+    """Reassemble one flattened pre-order array into a CNode tree."""
+    if not isinstance(nodes, list) or not nodes:
+        raise CatalystMalformedError(
+            f"expected a non-empty flattened node array, got {nodes!r}",
+            path)
+
+    def build(i: int) -> Tuple[CNode, int]:
+        raw = nodes[i]
+        if not isinstance(raw, dict) or "class" not in raw:
+            raise CatalystMalformedError(
+                f"node {i} is not an object with a 'class' field: {raw!r}",
+                path)
+        n = int(raw.get("num-children", 0))
+        fields = {k: v for k, v in raw.items()
+                  if k not in ("class", "num-children")}
+        node = CNode(str(raw["class"]), fields)
+        j = i + 1
+        for _ in range(n):
+            if j >= len(nodes):
+                raise CatalystMalformedError(
+                    f"{node.simple} declares {n} children but the array "
+                    f"ends early", path)
+            c, j = build(j)
+            node.children.append(c)
+        return node, j
+
+    root, end = build(0)
+    if end != len(nodes):
+        raise CatalystMalformedError(
+            f"{len(nodes) - end} trailing nodes after the root subtree "
+            f"(bad num-children somewhere)", path)
+    return root
+
+
+def parse_object_name(v: Any, path: str) -> str:
+    """Case-object reference -> simple name: ``{"object": "...Inner$"}``,
+    ``{"product-class": "...Inner$"}`` or a bare string all parse."""
+    if isinstance(v, dict):
+        v = v.get("object") or v.get("product-class")
+    if not isinstance(v, str) or not v:
+        raise CatalystMalformedError(f"expected a case-object name, "
+                                     f"got {v!r}", path)
+    return v.rsplit(".", 1)[-1].rstrip("$")
+
+
+def parse_expr_id(v: Any, path: str) -> int:
+    """``{"product-class": "...ExprId", "id": 7, "jvmId": uuid}`` -> 7."""
+    if isinstance(v, dict) and isinstance(v.get("id"), int):
+        return v["id"]
+    if isinstance(v, int):
+        return v
+    raise CatalystMalformedError(f"malformed exprId {v!r}", path)
+
+
+# ---------------------------------------------------------------------------
+# Spark DataType JSON -> types.py
+# ---------------------------------------------------------------------------
+
+_PRIMITIVES = {
+    "boolean": T.BOOLEAN, "byte": T.INT8, "short": T.INT16,
+    "integer": T.INT32, "long": T.INT64, "float": T.FLOAT32,
+    "double": T.FLOAT64, "date": T.DATE, "null": T.NULL, "void": T.NULL,
+}
+_DECIMAL_RE = re.compile(r"^decimal\((\d+),\s*(-?\d+)\)$")
+
+
+def parse_spark_type(t: Any, conf: Optional[dict] = None,
+                     path: str = "$") -> T.SqlType:
+    """Spark's DataType JSON (``df.schema.json`` vocabulary) -> SqlType.
+
+    Spark strings are unbounded; the device layout needs a byte budget,
+    so they type as ``string[bridge.defaultStringLen]`` (same policy the
+    scan boundary applies to arrow strings)."""
+    if isinstance(t, str):
+        if t in _PRIMITIVES:
+            return _PRIMITIVES[t]
+        if t == "string":
+            return T.string(bridge_conf(conf, STRING_LEN_CONF))
+        if t == "timestamp":
+            return T.TIMESTAMP
+        m = _DECIMAL_RE.match(t)
+        if m:
+            return T.decimal(int(m.group(1)), int(m.group(2)))
+        raise CatalystUnsupportedError(f"Spark data type {t!r}", path)
+    if isinstance(t, dict):
+        kind = t.get("type")
+        if kind == "array":
+            elem = parse_spark_type(t.get("elementType"), conf,
+                                    path + "/array")
+            return T.array(elem, bridge_conf(conf, ARRAY_ELEMS_CONF))
+        if kind == "map":
+            return T.map_(
+                parse_spark_type(t.get("keyType"), conf, path + "/map.key"),
+                parse_spark_type(t.get("valueType"), conf,
+                                 path + "/map.value"),
+                bridge_conf(conf, ARRAY_ELEMS_CONF))
+        if kind == "struct":
+            fields = t.get("fields") or []
+            return T.struct(
+                *(parse_spark_type(f.get("type"), conf,
+                                   path + f"/struct.{f.get('name')}")
+                  for f in fields),
+                names=tuple(str(f.get("name")) for f in fields))
+        if kind == "udt":
+            raise CatalystUnsupportedError("Spark user-defined types", path)
+    raise CatalystMalformedError(f"unparseable Spark data type {t!r}", path)
+
+
+# ---------------------------------------------------------------------------
+# Spark literal values (Catalyst internal representation -> rich python)
+# ---------------------------------------------------------------------------
+
+_EPOCH_ORDINAL = _dt.date(1970, 1, 1).toordinal()
+_INT_KINDS = {T.TypeKind.INT8, T.TypeKind.INT16, T.TypeKind.INT32,
+              T.TypeKind.INT64}
+
+
+def parse_literal_value(v: Any, t: T.SqlType, path: str) -> Any:
+    """Catalyst serializes literal values as strings of their INTERNAL
+    representation (dates as epoch days, timestamps as epoch micros,
+    decimals as unscaled-preserving strings). Return the rich python
+    value our ``Literal`` carries — both the device kernel (which
+    re-internalizes) and the row interpreter consume that form."""
+    if v is None:
+        return None
+    k = t.kind
+    try:
+        if k in _INT_KINDS:
+            return int(v)
+        if k in (T.TypeKind.FLOAT32, T.TypeKind.FLOAT64):
+            if isinstance(v, str) and v in ("NaN", "Infinity", "-Infinity"):
+                return float({"NaN": "nan", "Infinity": "inf",
+                              "-Infinity": "-inf"}[v])
+            return float(v)
+        if k is T.TypeKind.BOOLEAN:
+            if isinstance(v, bool):
+                return v
+            return str(v).strip().lower() == "true"
+        if k is T.TypeKind.STRING:
+            return str(v)
+        if k is T.TypeKind.DECIMAL:
+            return _pydec.Decimal(str(v))
+        if k is T.TypeKind.DATE:
+            return _dt.date.fromordinal(int(v) + _EPOCH_ORDINAL)
+        if k is T.TypeKind.TIMESTAMP:
+            return (_dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+                    + _dt.timedelta(microseconds=int(v)))
+        if k is T.TypeKind.NULL:
+            return None
+    except (ValueError, OverflowError, _pydec.InvalidOperation) as e:
+        raise CatalystMalformedError(
+            f"literal value {v!r} does not parse as {t}: {e}", path)
+    raise CatalystUnsupportedError(f"literal of type {t}", path)
+
+
+# ---------------------------------------------------------------------------
+# registries (populated by spark_client; keyed by SIMPLE class name)
+# ---------------------------------------------------------------------------
+
+#: Spark physical plan node class -> handler(tr, node, path) -> (plan, scope)
+PLAN_HANDLERS: Dict[str, Callable] = {}
+#: Spark expression class -> handler(tr, node, scope, path) -> Expression
+EXPR_HANDLERS: Dict[str, Callable] = {}
+
+
+def plan_node(*names: str):
+    def deco(fn):
+        for n in names:
+            PLAN_HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+def expression(*names: str):
+    def deco(fn):
+        for n in names:
+            EXPR_HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+def check_schema_version(doc: dict, conf: Optional[dict] = None) -> int:
+    """Version-gate the corpus: an unknown ``schemaVersion`` (Spark-side
+    plan-format drift) fails with an actionable message instead of a
+    misparse deeper in."""
+    accepted = {s.strip() for s in
+                bridge_conf(conf, ACCEPTED_VERSIONS_CONF).split(",")
+                if s.strip()}
+    v = doc.get("schemaVersion")
+    if v is None:
+        raise CatalystVersionError(
+            "Catalyst plan document has no schemaVersion header; this "
+            f"bridge speaks version(s) {sorted(accepted)} — re-export the "
+            "plan with the matching driver plugin")
+    if str(v) not in accepted:
+        raise CatalystVersionError(
+            f"Catalyst plan schemaVersion {v!r} is not accepted (accepted: "
+            f"{sorted(accepted)}). Either re-export the plan with a "
+            f"matching driver plugin, or — after verifying the encoding "
+            f"rules still hold — extend {ACCEPTED_VERSIONS_CONF}")
+    return int(v)

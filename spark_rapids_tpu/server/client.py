@@ -222,6 +222,34 @@ class PlanClient:
         self.last_worker = str(reply.get("worker", ""))
         return protocol.ipc_to_table(body)
 
+    def collect_catalyst(self, plan_json, tables: Optional[Dict[
+            str, pa.Table]] = None, conf: Optional[dict] = None,
+            timeout_ms: Optional[int] = None,
+            retries: Optional[int] = None) -> pa.Table:
+        """Translate a Catalyst ``queryExecution`` JSON document
+        CLIENT-side (``spark_client.translate``) and collect the result
+        through this connection — a plan server or a router fleet, which
+        routes it on the plandoc shape fingerprint like any native plan.
+
+        In-memory scans resolve their ``rtpuTable`` names against
+        ``tables`` plus tables this session already registered; newly
+        referenced tables are registered under those names first, so
+        repeat queries reuse the server-side copies (and result-cache
+        invalidation on re-upload keeps working). ``conf`` merges over
+        the session conf for ``spark.rapids.tpu.bridge.*`` translation
+        settings and rides the query as usual otherwise."""
+        from . import spark_client
+        merged = dict(self._conf)
+        merged.update(conf or {})
+        pool: Dict[str, pa.Table] = dict(self._known)
+        pool.update(tables or {})
+        tr = spark_client.translate(plan_json, tables=pool, conf=merged)
+        for name in tr.table_names:
+            if self._known.get(name) is not pool[name]:
+                self.register_table(name, pool[name])
+        return self.collect(tr.dataframe, conf=conf,
+                            timeout_ms=timeout_ms, retries=retries)
+
     def register_table(self, name: str, table: pa.Table) -> dict:
         """Upload (or REPLACE) a named server-side table. The ack
         reports the content digest and how many cached results the

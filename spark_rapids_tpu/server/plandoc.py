@@ -53,7 +53,16 @@ PROTOCOL_VERSION = 1
 
 
 class PlanDecodeError(ValueError):
-    pass
+    """Wire-dialect violation. Decode-side failures carry ``path`` — the
+    ``$p``/``$e`` node path from the document root (e.g.
+    ``$p:LogicalProject/exprs[1]/$e:Add[0]``) — the same discipline the
+    Catalyst bridge's CatalystUnsupportedError uses, so a client sees
+    WHICH subtree of its submitted plan failed, not just the tag."""
+
+    def __init__(self, message: str, path: Optional[str] = None):
+        super().__init__(f"{message} [at {path}]" if path else message)
+        self.reason = message
+        self.path = path
 
 
 # ---------------------------------------------------------------------------
@@ -151,11 +160,11 @@ def encode_value(v: Any) -> Any:
         f"cannot serialize {type(v).__name__} ({v!r}) into the plan dialect")
 
 
-def decode_value(v: Any) -> Any:
+def decode_value(v: Any, path: str = "$") -> Any:
     if v is None or isinstance(v, (bool, int, float, str)):
         return v
     if not isinstance(v, dict) or len(v) != 1:
-        raise PlanDecodeError(f"malformed document value: {v!r}")
+        raise PlanDecodeError(f"malformed document value: {v!r}", path)
     (tag, payload), = v.items()
     if tag == "$f":
         return {"nan": math.nan, "inf": math.inf,
@@ -164,35 +173,42 @@ def decode_value(v: Any) -> Any:
         name, *args = payload
         cls = Expression._registry.get(name)
         if cls is None:
-            raise PlanDecodeError(f"unknown expression class {name}")
-        return cls(*[decode_value(a) for a in args])
+            raise PlanDecodeError(f"unknown expression class {name}",
+                                  path)
+        return cls(*[decode_value(a, f"{path}/$e:{name}[{i}]")
+                     for i, a in enumerate(args)])
     if tag == "$sort":
         child, desc, nf = payload
-        return SortOrder(decode_value(child), desc, nf)
+        return SortOrder(decode_value(child, f"{path}/$sort"), desc, nf)
     if tag == "$t":
         kind, precision, scale, max_len, children, names = payload
         return T.SqlType(T.TypeKind(kind), precision, scale, max_len,
-                         tuple(decode_value(c) for c in children),
+                         tuple(decode_value(c, f"{path}/$t")
+                               for c in children),
                          tuple(names))
     if tag == "$schema":
-        return Schema([SField(n, decode_value(t), nullable)
+        return Schema([SField(n, decode_value(t, f"{path}/$schema:{n}"),
+                              nullable)
                        for n, t, nullable in payload])
     if tag == "$enum":
         name, member = payload
         cls = _ENUMS.get(name)
         if cls is None:
-            raise PlanDecodeError(f"unknown enum type {name}")
+            raise PlanDecodeError(f"unknown enum type {name}", path)
         return cls[member]
     if tag == "$dc":
         name, *args = payload
         cls = _plain_dataclasses().get(name)
         if cls is None:
-            raise PlanDecodeError(f"unknown dataclass {name}")
-        return cls(*[decode_value(a) for a in args])
+            raise PlanDecodeError(f"unknown dataclass {name}", path)
+        return cls(*[decode_value(a, f"{path}/$dc:{name}[{i}]")
+                     for i, a in enumerate(args)])
     if tag == "$l":
-        return tuple(decode_value(x) for x in payload)
+        return tuple(decode_value(x, f"{path}[{i}]")
+                     for i, x in enumerate(payload))
     if tag == "$d":
-        return {decode_value(k): decode_value(x) for k, x in payload}
+        return {decode_value(k, f"{path}<key>"):
+                decode_value(x, f"{path}[{k!r}]") for k, x in payload}
     if tag == "$b":
         return base64.b64decode(payload)
     if tag == "$ts":
@@ -201,7 +217,7 @@ def decode_value(v: Any) -> Any:
         return _dt.date.fromordinal(payload)
     if tag == "$dec":
         return _pydec.Decimal(payload)
-    raise PlanDecodeError(f"unknown document tag {tag!r}")
+    raise PlanDecodeError(f"unknown document tag {tag!r}", path)
 
 
 # ---------------------------------------------------------------------------
@@ -300,34 +316,46 @@ def plan_to_doc(plan: L.LogicalPlan,
 
 
 def doc_to_plan(doc: dict, tables: Dict[str, pa.Table]) -> L.LogicalPlan:
-    def dec(d: dict) -> L.LogicalPlan:
+    def dec(d: dict, path: str) -> L.LogicalPlan:
         if not isinstance(d, dict) or "$p" not in d:
-            raise PlanDecodeError(f"malformed plan node: {d!r}")
+            raise PlanDecodeError(f"malformed plan node: {d!r}", path)
         payload = d["$p"]
         name, children = payload[0], payload[1]
-        kids = tuple(dec(c) for c in children)
+        here = f"{path}/$p:{name}"
+        kids = tuple(dec(c, f"{here}[{i}]")
+                     for i, c in enumerate(children))
         if name == "LogicalScan":
             if "table" in d:
                 ref = d["table"]
                 if ref not in tables:
                     raise PlanDecodeError(
-                        f"plan references table {ref!r} that was not sent")
+                        f"plan references table {ref!r} that was not sent",
+                        here)
                 return L.LogicalScan(kids, data=tables[ref],
                                      num_slices=d.get("num_slices", 1),
                                      batch_rows=d.get("batch_rows"))
             if "source" in d:
-                src = _decode_source(d["source"])
+                try:
+                    src = _decode_source(d["source"])
+                except PlanDecodeError as e:
+                    raise PlanDecodeError(
+                        e.reason, e.path if e.path not in (None, "$")
+                        else f"{here}.source")
                 return L.LogicalScan(kids, source=src, _schema=src.schema(),
                                      num_slices=d.get("num_slices", 1),
                                      batch_rows=d.get("batch_rows"))
             return L.LogicalScan(kids,
-                                 _schema=decode_value(d.get("schema")),
+                                 _schema=decode_value(d.get("schema"),
+                                                      f"{here}.schema"),
                                  num_slices=d.get("num_slices", 1),
                                  batch_rows=d.get("batch_rows"))
         cls = _PLAN_NODES.get(name)
         if cls is None:
-            raise PlanDecodeError(f"unknown plan node {name}")
-        args = [decode_value(a) for a in payload[2:]]
+            raise PlanDecodeError(f"unknown plan node {name}", path)
+        fields = [f for f in cls.__dataclass_fields__ if f != "children"]
+        args = [decode_value(a, f"{here}.{fields[i]}"
+                             if i < len(fields) else f"{here}.arg{i}")
+                for i, a in enumerate(payload[2:])]
         return cls(kids, *args)
 
-    return dec(doc)
+    return dec(doc, "$")

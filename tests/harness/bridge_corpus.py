@@ -1,0 +1,386 @@
+"""Shared corpus for the Spark driver bridge: the deterministic input
+tables every golden Catalyst fixture references (by ``rtpuTable`` name)
+and, per fixture, the SAME query built through the native DataFrame API.
+
+Three consumers stay in sync through this module:
+- ``tools/make_catalyst_fixtures.py`` regenerates the committed JSON
+  under tests/fixtures/catalyst/ against these schemas;
+- the differential suite (tests/test_spark_bridge_differential.py) runs
+  fixture-translated vs native plans through a live plan server and
+  asserts bit-for-bit equality;
+- ``tools/lint_bridge.py`` computes fixture coverage of the plandoc
+  registries from the same corpus.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import decimal
+import os
+from typing import Callable, Dict
+
+import numpy as np
+import pyarrow as pa
+
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "fixtures", "catalyst")
+
+#: substituted into file-scan fixture paths by the harness
+DATA_PLACEHOLDER = "${RTPU_FIXTURE_DATA}"
+
+N = 400
+
+
+def make_tables(n: int = N) -> Dict[str, pa.Table]:
+    rng = np.random.default_rng(41)
+    names = ["Alice", "bob", "Carol", "dave", "Erin", "mallory",
+             "Trent", "peggy"]
+    name_col = [None if rng.random() < 0.15
+                else names[int(rng.integers(0, len(names)))] + str(i % 7)
+                for i in range(n)]
+    salary = [None if rng.random() < 0.1
+              else round(float(rng.uniform(200.0, 9000.0)), 2)
+              for _ in range(n)]
+    bonus = [None if rng.random() < 0.3
+             else decimal.Decimal(int(rng.integers(0, 500000))) / 100
+             for _ in range(n)]
+    hired = [dt.date(2015, 1, 1) + dt.timedelta(
+        days=int(rng.integers(0, 3650))) for _ in range(n)]
+    ts = [dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+          + dt.timedelta(seconds=int(rng.integers(0, 200_000_000)))
+          for _ in range(n)]
+    tag_lens = rng.integers(0, 6, n)
+    tags = [list(map(int, rng.integers(0, 50, int(m)))) for m in tag_lens]
+    arr_null = []
+    for i in range(n):
+        row = [None if rng.random() < 0.2 else int(x)
+               for x in rng.integers(0, 50, int(rng.integers(0, 5)))]
+        arr_null.append(row)
+    return {
+        "lineitem": pa.table({
+            "k": rng.integers(0, 3, n).astype(np.int32),
+            "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+            "l_extendedprice": rng.uniform(1.0, 1e5, n),
+        }),
+        "sales": pa.table({
+            "k": rng.integers(0, 64, n).astype(np.int64),
+            "ss_quantity": rng.integers(1, 100, n).astype(np.int64),
+        }),
+        "facts": pa.table({
+            "k": rng.integers(0, 64, n).astype(np.int64),
+            "v": rng.integers(-1000, 1000, n).astype(np.int64),
+        }),
+        "dims": pa.table({
+            "k": np.arange(64, dtype=np.int64),
+            "w": rng.integers(0, 10, 64).astype(np.int64),
+        }),
+        "people": pa.table({
+            "id": np.arange(n, dtype=np.int64),
+            "name": pa.array(name_col, type=pa.string()),
+            "dept": rng.integers(0, 6, n).astype(np.int32),
+            "salary": pa.array(salary, type=pa.float64()),
+            "hired": pa.array(hired, type=pa.date32()),
+            "ts": pa.array(ts, type=pa.timestamp("us", tz="UTC")),
+            "bonus": pa.array(bonus, type=pa.decimal128(10, 2)),
+        }),
+        "events": pa.table({
+            "k": rng.integers(0, 20, n).astype(np.int64),
+            "tags": pa.array(tags, type=pa.list_(pa.int64())),
+            "s": pa.array([f"ev{i % 13}" for i in range(n)],
+                          type=pa.string()),
+        }),
+        "arrnull": pa.table({
+            "k": rng.integers(0, 10, n).astype(np.int64),
+            "a": pa.array(arr_null, type=pa.list_(pa.int64())),
+        }),
+    }
+
+
+def parquet_dir(base: str) -> str:
+    """Write the file-scan fixture's parquet data under ``base`` and
+    return the directory fixtures' ``${RTPU_FIXTURE_DATA}`` resolves
+    to."""
+    import pyarrow.parquet as pq
+    d = os.path.join(base, "bench_parquet")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "part-0.parquet")
+    if not os.path.exists(path):
+        rng = np.random.default_rng(13)
+        pq.write_table(pa.table({
+            "k": rng.integers(0, 100, N).astype(np.int64),
+            "v": rng.uniform(-10.0, 10.0, N),
+        }), path)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# native builders — the same query via the DataFrame API, per fixture
+# ---------------------------------------------------------------------------
+
+def _q_project_filter(tabs, data_dir):
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.arithmetic import Abs
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["lineitem"])
+            .where((col("l_quantity") > lit(5))
+                   & ((col("k") == lit(1))
+                      | (col("l_extendedprice") > lit(100.0))))
+            .select(col("k"), col("l_quantity"),
+                    (col("l_extendedprice")
+                     * col("l_quantity").cast(T.FLOAT64)).alias("gross"),
+                    (col("l_quantity") + lit(1)).alias("q1"),
+                    (col("l_extendedprice") - lit(1.5)).alias("disc"),
+                    (col("l_extendedprice") / lit(2.0)).alias("half"),
+                    (col("l_quantity") % lit(7)).alias("m7"),
+                    Abs(col("l_quantity") - lit(25)).alias("aq")))
+
+
+def _q_types_literals(tabs, data_dir):
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.base import Literal
+    from spark_rapids_tpu.expressions.comparison import (EqualNullSafe, In,
+                                                         Not)
+    from spark_rapids_tpu.expressions.conditional import (CaseWhen,
+                                                          Coalesce, If)
+    from spark_rapids_tpu.expressions.datetime import (DateAddSub,
+                                                       ExtractDatePart)
+    from spark_rapids_tpu.expressions.regex import Like
+    from spark_rapids_tpu.expressions.strings import (Concat, Length,
+                                                      StringPredicate,
+                                                      Substring, Upper)
+    from spark_rapids_tpu.plan import table
+    name, sal = col("name"), col("salary")
+    return (table(tabs["people"])
+            .where(name.is_not_null()
+                   & (col("hired") >= lit(dt.date(2016, 6, 1)))
+                   & Not(col("dept") == lit(np.int32(5))))
+            .select(
+                col("id"), name,
+                Upper(name).alias("uname"),
+                Substring(name, lit(1), lit(3)).alias("pre"),
+                Length(name).alias("ln"),
+                Concat((name, lit("!"))).alias("bang"),
+                CaseWhen(((sal < lit(1000.0), lit("low")),
+                          (sal <= lit(5000.0), lit("mid"))),
+                         lit("high")).alias("band"),
+                If(sal.is_null(), lit(0.0), sal).alias("sal0"),
+                Coalesce((col("bonus"),
+                          Literal(decimal.Decimal("0.00"),
+                                  T.decimal(10, 2)))).alias("bonus0"),
+                EqualNullSafe(sal, sal).alias("selfsafe"),
+                In(col("dept"), (np.int32(1), np.int32(2),
+                                 np.int32(3))).alias("indept"),
+                ExtractDatePart(col("hired"), "year").alias("yr"),
+                ExtractDatePart(col("hired"), "month").alias("mo"),
+                DateAddSub(col("hired"), lit(30)).alias("due"),
+                (col("ts") > lit(dt.datetime(2022, 1, 1,
+                                             tzinfo=dt.timezone.utc))
+                 ).alias("recent"),
+                StringPredicate(name, lit("a"), "contains").alias("has_a"),
+                Like(name, "A%").alias("like_a"),
+                Literal(None, T.FLOAT64).alias("nodouble")))
+
+
+def _q_agg_complete(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Average, Max, Min
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["people"])
+            .group_by("dept")
+            .agg(Min(col("salary")).alias("lo"),
+                 Max(col("salary")).alias("hi"),
+                 Average(col("salary")).alias("avg")))
+
+
+def _q_join_dup_names(tabs, data_dir):
+    from spark_rapids_tpu.exec.join import JoinType
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["facts"])
+            .join(table(tabs["dims"]), ["k"], ["k"], JoinType.LEFT_OUTER,
+                  condition=col("v") < (col("w") * lit(200)))
+            .select(col("v").alias("fv"), col("w"), col("k")))
+
+
+def _q_sort_limit(tabs, data_dir):
+    from spark_rapids_tpu.exec.sort import asc, desc
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["facts"])
+            .order_by(desc(col("v")), asc(col("k")))
+            .limit(20))
+
+
+def _q_take_ordered(tabs, data_dir):
+    from spark_rapids_tpu.exec.sort import desc
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["sales"])
+            .order_by(desc(col("ss_quantity")))
+            .limit(10))
+
+
+def _q_window(tabs, data_dir):
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    from spark_rapids_tpu.expressions.window import (LagLead, Rank,
+                                                     RowNumber, WindowAgg,
+                                                     WindowFrame, over)
+    from spark_rapids_tpu.plan import table
+    k, v = col("k"), col("v")
+    return (table(tabs["facts"])
+            .window(
+                over(RowNumber(), [k], [asc(v)],
+                     WindowFrame(True, None, 0)).alias("rn"),
+                over(Rank(), [k], [asc(v)]).alias("rk"),
+                over(LagLead(v, 1, None, True), [k], [asc(v)],
+                     WindowFrame(True, -1, -1)).alias("prev"),
+                over(WindowAgg(Sum(v)), [k], [asc(v)],
+                     WindowFrame(True, -2, 0)).alias("run2"))
+            .window(
+                over(WindowAgg(Sum(v)), [k], [],
+                     WindowFrame(False, None, None)).alias("total")))
+
+
+def _q_exchange_repartition(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.plan import table
+    return table(tabs["facts"], num_slices=2).where(
+        col("v") > lit(np.int64(0)))
+
+
+def _q_union(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.arithmetic import UnaryMinus
+    from spark_rapids_tpu.plan import table
+    a = table(tabs["facts"]).select(col("k"), col("v"))
+    b = table(tabs["facts"]).select(col("k"),
+                                    UnaryMinus(col("v")).alias("v"))
+    return a.union(b)
+
+
+def _q_expand_rollup(tabs, data_dir):
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.base import Literal
+    from spark_rapids_tpu.plan import table
+    from spark_rapids_tpu.plan.logical import DataFrame, LogicalExpand
+    base = table(tabs["sales"]).plan
+    projections = [
+        [col("k").alias("k"), col("ss_quantity").alias("q"),
+         lit(np.int32(0)).alias("gid")],
+        [col("k").alias("k"), Literal(None, T.INT64).alias("q"),
+         lit(np.int32(1)).alias("gid")],
+    ]
+    return DataFrame(LogicalExpand((base,), projections))
+
+
+def _q_generate_explode(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.plan import table
+    return table(tabs["events"]).explode(col("tags"), alias="tag",
+                                         outer=True, pos=True,
+                                         pos_alias="pos")
+
+
+def _q_sample_range(tabs, data_dir):
+    from spark_rapids_tpu.plan.logical import range_
+    return range_(0, 1000).sample(0.35, 7)
+
+
+def _q_bench_q1_stage(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.aggregates import Count, Sum
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["lineitem"])
+            .where(col("l_quantity") > lit(25))
+            .group_by("k")
+            .agg(Sum(col("l_extendedprice")).alias("rev"),
+                 Count().alias("n")))
+
+
+def _q_bench_hash_agg(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["sales"])
+            .where(col("ss_quantity") > lit(25))
+            .group_by("k").agg(Sum(col("ss_quantity")).alias("q")))
+
+
+def _q_bench_join_sort(tabs, data_dir):
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["facts"])
+            .where(col("v") > lit(25))
+            .join(table(tabs["dims"]), ["k"], ["k"])
+            .group_by("w").agg(Sum(col("v")).alias("s"))
+            .order_by(asc(col("w"))))
+
+
+def _q_bench_parquet_scan(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.aggregates import Count
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.plan.logical import DataFrame, LogicalScan
+    path = os.path.join(data_dir, "bench_parquet", "part-0.parquet")
+    src = ParquetSource([path])
+    df = DataFrame(LogicalScan((), source=src, _schema=src.schema()))
+    return (df.where(col("k") > lit(25))
+            .group_by("k").agg(Count().alias("n")))
+
+
+def _q_bench_exchange(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.expressions.aggregates import Sum
+    from spark_rapids_tpu.plan import table
+    return (table(tabs["facts"], num_slices=4)
+            .where(col("v") > lit(25))
+            .group_by("k").agg(Sum(col("v")).alias("s")))
+
+
+def _q_array_nulls(tabs, data_dir):
+    from spark_rapids_tpu.expressions import col, lit
+    from spark_rapids_tpu.plan import table
+    return table(tabs["arrnull"]).where(col("k") > lit(1))
+
+
+#: fixture-file stem -> native builder(tables, data_dir) -> DataFrame
+NATIVE_BUILDERS: Dict[str, Callable] = {
+    "project_filter": _q_project_filter,
+    "types_literals": _q_types_literals,
+    "agg_complete": _q_agg_complete,
+    "join_dup_names": _q_join_dup_names,
+    "sort_limit": _q_sort_limit,
+    "take_ordered": _q_take_ordered,
+    "window_functions": _q_window,
+    "exchange_repartition": _q_exchange_repartition,
+    "union_minus": _q_union,
+    "expand_rollup": _q_expand_rollup,
+    "generate_explode": _q_generate_explode,
+    "sample_range": _q_sample_range,
+    "bench_q1_stage": _q_bench_q1_stage,
+    "bench_hash_agg": _q_bench_hash_agg,
+    "bench_join_sort": _q_bench_join_sort,
+    "bench_parquet_scan": _q_bench_parquet_scan,
+    "bench_exchange": _q_bench_exchange,
+    "array_nulls": _q_array_nulls,
+}
+
+
+def load_fixture(name: str, data_dir: str) -> str:
+    """Read a committed fixture, substituting the data placeholder."""
+    with open(os.path.join(FIXTURE_DIR, f"{name}.json")) as f:
+        text = f.read()
+    return text.replace(DATA_PLACEHOLDER, data_dir.rstrip("/"))
+
+
+def fixture_names() -> list:
+    return sorted(os.path.splitext(f)[0]
+                  for f in os.listdir(FIXTURE_DIR)
+                  if f.endswith(".json"))

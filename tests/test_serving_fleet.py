@@ -548,3 +548,34 @@ def test_mini_fleet_loadbench_smoke():
         == rep["queries"]
     assert rep["router_overhead_ms"]["n"] > 0
     assert set(rep["tenants"]) == {"t0", "t1"}
+
+
+# ---------------------------------------------------------------------------
+# Catalyst bridge through the fleet (ISSUE 14 satellite): a fixture
+# translated client-side routes through the router on the plandoc shape
+# fingerprint like any native plan, bit-for-bit vs the native twin
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_catalyst_fixture_vs_native_through_router(tabs):
+    from harness import bridge_corpus as BC
+    router = Router(workers=2).start()
+    try:
+        with PlanClient("127.0.0.1", router.port) as c:
+            text = BC.load_fixture("bench_hash_agg", "/nonexistent")
+            translated = c.collect_catalyst(
+                text, tables={"sales": tabs["sales"]})
+            worker_a = c.last_worker
+            native = BC.NATIVE_BUILDERS["bench_hash_agg"](tabs, "")
+            expected = c.collect(native)
+            assert translated.equals(expected)
+            assert worker_a, "router must report the serving worker"
+            # repeat translation routes to the SAME worker: the router
+            # fingerprints the translated plandoc exactly like a native
+            # plan, so the bridge inherits shape-affinity caching
+            c.collect_catalyst(text, tables={"sales": tabs["sales"]})
+            assert c.last_worker == worker_a
+    finally:
+        router.stop(grace_s=5)
+        _assert_no_worker_leak(router)

@@ -1,0 +1,389 @@
+"""Spark driver bridge units: Catalyst JSON parsing, translation errors
+with node paths, schema versioning, literal re-hydration, plandoc decode
+paths, and the fixture-coverage lint (ISSUE 14).
+
+The live-server differential suite is tests/test_spark_bridge_differential
+.py; these tests stay socket-free.
+"""
+
+import datetime as dt
+import decimal
+import json
+import os
+import sys
+
+import pyarrow as pa
+import pytest
+
+from harness import bridge_corpus as BC
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.server import catalyst as C
+from spark_rapids_tpu.server import plandoc
+from spark_rapids_tpu.server import spark_client as SC
+
+
+@pytest.fixture(scope="module")
+def tabs():
+    return BC.make_tables(120)
+
+
+def _doc(plan_nodes, version=1):
+    return {"schemaVersion": version, "plan": plan_nodes}
+
+
+def _mini_scan(table="facts", extra=None):
+    """A one-node LocalTableScan doc over the corpus 'facts' table."""
+    out = [
+        [{"class": "org.apache.spark.sql.catalyst.expressions."
+          "AttributeReference", "num-children": 0, "name": "k",
+          "dataType": "long", "nullable": True, "metadata": {},
+          "exprId": {"id": 1, "jvmId": "x"}, "qualifier": []}],
+        [{"class": "org.apache.spark.sql.catalyst.expressions."
+          "AttributeReference", "num-children": 0, "name": "v",
+          "dataType": "long", "nullable": True, "metadata": {},
+          "exprId": {"id": 2, "jvmId": "x"}, "qualifier": []}],
+    ]
+    node = {"class": "org.apache.spark.sql.execution.LocalTableScanExec",
+            "num-children": 0, "output": out, "rtpuTable": table}
+    node.update(extra or {})
+    return node
+
+
+# ---------------------------------------------------------------------------
+# schema versioning (satellite: versioned corpus)
+# ---------------------------------------------------------------------------
+
+class TestSchemaVersion:
+    def test_missing_header_rejected_actionably(self, tabs):
+        with pytest.raises(C.CatalystVersionError) as ei:
+            SC.translate({"plan": [_mini_scan()]}, tables=tabs)
+        assert "schemaVersion" in str(ei.value)
+        assert "driver plugin" in str(ei.value)
+
+    def test_unknown_version_rejected_with_accepted_list_and_conf(
+            self, tabs):
+        with pytest.raises(C.CatalystVersionError) as ei:
+            SC.translate(_doc([_mini_scan()], version=99), tables=tabs)
+        msg = str(ei.value)
+        assert "99" in msg and "'1'" in msg
+        assert C.ACCEPTED_VERSIONS_CONF in msg
+
+    def test_conf_extends_accepted_versions(self, tabs):
+        conf = {C.ACCEPTED_VERSIONS_CONF: "1, 2"}
+        tr = SC.translate(_doc([_mini_scan()], version=2), tables=tabs,
+                          conf=conf)
+        assert tr.schema_version == 2
+
+    def test_every_committed_fixture_declares_version_1(self):
+        for name in BC.fixture_names():
+            with open(os.path.join(BC.FIXTURE_DIR, f"{name}.json")) as f:
+                doc = json.load(f)
+            assert doc.get("schemaVersion") == 1, name
+
+
+# ---------------------------------------------------------------------------
+# unsupported constructs carry node paths (never silent)
+# ---------------------------------------------------------------------------
+
+class TestUnsupportedPaths:
+    def test_unknown_plan_node(self, tabs):
+        node = {"class": "org.apache.spark.sql.execution."
+                "DataWritingCommandExec", "num-children": 0}
+        with pytest.raises(C.CatalystUnsupportedError) as ei:
+            SC.translate(_doc([node]), tables=tabs)
+        assert "DataWritingCommandExec" in str(ei.value)
+        assert ei.value.path.endswith("DataWritingCommandExec")
+
+    def test_unknown_expression_path_names_the_subtree(self, tabs):
+        cond = [{"class": "org.apache.spark.sql.catalyst.expressions."
+                 "ScalaUDF", "num-children": 0}]
+        flt = {"class": "org.apache.spark.sql.execution.FilterExec",
+               "num-children": 1, "condition": cond, "child": 0}
+        with pytest.raises(C.CatalystUnsupportedError) as ei:
+            SC.translate(_doc([flt, _mini_scan()]), tables=tabs)
+        assert "ScalaUDF" in str(ei.value)
+        assert "FilterExec/condition" in ei.value.path
+
+    def test_distinct_aggregate_unsupported(self, tabs):
+        fixture = json.loads(BC.load_fixture("bench_hash_agg", "/tmp"))
+        for node in fixture["plan"]:
+            for ae in node.get("aggregateExpressions", []):
+                ae[0]["isDistinct"] = True
+        with pytest.raises(C.CatalystUnsupportedError) as ei:
+            SC.translate(fixture, tables=tabs)
+        assert "DISTINCT" in str(ei.value)
+
+    def test_ansi_eval_mode_unsupported(self, tabs):
+        fixture = json.loads(BC.load_fixture("project_filter", "/tmp"))
+        for node in fixture["plan"]:
+            for arr in node.get("projectList", []):
+                for e in arr:
+                    if e.get("evalMode"):
+                        e["evalMode"] = "ANSI"
+        with pytest.raises(C.CatalystUnsupportedError) as ei:
+            SC.translate(fixture, tables=tabs)
+        assert "evalMode" in str(ei.value)
+
+    def test_file_scan_format_gate(self, tabs):
+        fixture = json.loads(BC.load_fixture("bench_parquet_scan", "/tmp"))
+        for node in fixture["plan"]:
+            if "rtpuLocation" in node:
+                node["rtpuLocation"]["format"] = "orc"
+        with pytest.raises(C.CatalystUnsupportedError) as ei:
+            SC.translate(fixture, tables=tabs)
+        assert "orc" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# malformed documents
+# ---------------------------------------------------------------------------
+
+class TestMalformed:
+    def test_unresolvable_expr_id_lists_child_output(self, tabs):
+        cond = [{"class": "org.apache.spark.sql.catalyst.expressions."
+                 "IsNotNull", "num-children": 1, "child": 0},
+                {"class": "org.apache.spark.sql.catalyst.expressions."
+                 "AttributeReference", "num-children": 0, "name": "ghost",
+                 "dataType": "long", "nullable": True, "metadata": {},
+                 "exprId": {"id": 777, "jvmId": "x"}, "qualifier": []}]
+        flt = {"class": "org.apache.spark.sql.execution.FilterExec",
+               "num-children": 1, "condition": cond, "child": 0}
+        with pytest.raises(C.CatalystMalformedError) as ei:
+            SC.translate(_doc([flt, _mini_scan()]), tables=tabs)
+        msg = str(ei.value)
+        assert "ghost#777" in msg and "k#1" in msg
+
+    def test_truncated_child_array(self, tabs):
+        flt = {"class": "org.apache.spark.sql.execution.FilterExec",
+               "num-children": 1, "condition": [], "child": 0}
+        with pytest.raises(C.CatalystMalformedError):
+            SC.translate(_doc([flt]), tables=tabs)
+
+    def test_scan_type_mismatch_against_table(self, tabs):
+        scan = _mini_scan()
+        scan["output"][0][0]["dataType"] = "string"
+        with pytest.raises(C.CatalystMalformedError) as ei:
+            SC.translate(_doc([scan]), tables=tabs)
+        assert "types as" in str(ei.value)
+
+    def test_unknown_table_reference(self, tabs):
+        with pytest.raises(C.CatalystMalformedError) as ei:
+            SC.translate(_doc([_mini_scan(table="nope")]), tables=tabs)
+        assert "nope" in str(ei.value)
+        assert "facts" in str(ei.value)   # lists what IS known
+
+    def test_agg_attr_count_mismatch(self, tabs):
+        fixture = json.loads(BC.load_fixture("bench_hash_agg", "/tmp"))
+        top = fixture["plan"][0]
+        assert "aggregateAttributes" in top
+        top["aggregateAttributes"] = []
+        with pytest.raises(C.CatalystMalformedError):
+            SC.translate(fixture, tables=tabs)
+
+
+# ---------------------------------------------------------------------------
+# Spark type / literal parsing
+# ---------------------------------------------------------------------------
+
+class TestTypesAndLiterals:
+    def test_primitives(self):
+        assert C.parse_spark_type("long") is T.INT64
+        assert C.parse_spark_type("integer") is T.INT32
+        assert C.parse_spark_type("decimal(12,3)") == T.decimal(12, 3)
+        assert C.parse_spark_type("string").max_len == 64
+        assert C.parse_spark_type(
+            "string", {C.STRING_LEN_CONF: 17}).max_len == 17
+
+    def test_nested(self):
+        arr = C.parse_spark_type({"type": "array", "elementType": "long",
+                                  "containsNull": True})
+        assert arr.kind is T.TypeKind.ARRAY
+        st = C.parse_spark_type({"type": "struct", "fields": [
+            {"name": "a", "type": "long", "nullable": True,
+             "metadata": {}},
+            {"name": "b", "type": "double", "nullable": True,
+             "metadata": {}}]})
+        assert st.names == ("a", "b")
+        with pytest.raises(C.CatalystUnsupportedError):
+            C.parse_spark_type("interval")
+
+    def test_internal_reps_rehydrate(self):
+        d = C.parse_literal_value("19000", T.DATE, "$")
+        assert d == dt.date(1970, 1, 1) + dt.timedelta(days=19000)
+        ts = C.parse_literal_value(str(86_400_000_000), T.TIMESTAMP, "$")
+        assert ts == dt.datetime(1970, 1, 2, tzinfo=dt.timezone.utc)
+        assert C.parse_literal_value("12.34", T.decimal(10, 2), "$") == \
+            decimal.Decimal("12.34")
+        assert C.parse_literal_value(None, T.INT64, "$") is None
+        assert C.parse_literal_value("NaN", T.FLOAT64, "$") != \
+            C.parse_literal_value("NaN", T.FLOAT64, "$")  # nan
+        with pytest.raises(C.CatalystMalformedError):
+            C.parse_literal_value("notanint", T.INT64, "$")
+
+    def test_rich_and_internal_date_literals_agree_on_device(self):
+        """The Literal canonicalization seam the bridge relies on:
+        dt.date values and internal epoch-days ints compute identically
+        on the device path AND the interpreter path."""
+        from spark_rapids_tpu.expressions import col
+        from spark_rapids_tpu.expressions.base import Literal
+        from spark_rapids_tpu.plan import Session, table
+        t = pa.table({"d": pa.array([dt.date(2024, 1, 1),
+                                     dt.date(2025, 6, 1)],
+                                    type=pa.date32()),
+                      "x": [1, 2]})
+        cut = dt.date(2024, 6, 1)
+        days = (cut - dt.date(1970, 1, 1)).days
+        rich = table(t).where(col("d") > Literal(cut, T.DATE))
+        internal = table(t).where(col("d") > Literal(days, T.DATE))
+        dev_rich = Session().collect(rich)
+        dev_int = Session().collect(internal)
+        cpu_rich = Session({"spark.rapids.tpu.sql.enabled":
+                            "false"}).collect(rich)
+        cpu_int = Session({"spark.rapids.tpu.sql.enabled":
+                           "false"}).collect(internal)
+        assert dev_rich.equals(dev_int)
+        assert dev_rich.equals(cpu_rich)
+        assert dev_rich.equals(cpu_int)
+        assert dev_rich.num_rows == 1
+
+
+# ---------------------------------------------------------------------------
+# translation structure
+# ---------------------------------------------------------------------------
+
+class TestTranslationStructure:
+    def test_duplicate_names_resolve_by_expr_id(self, tabs):
+        """Both join sides expose column 'k'; the translated project
+        must pick the LEFT one (exprId), not rely on name lookup."""
+        tr = SC.translate(BC.load_fixture("join_dup_names", "/tmp"),
+                          tables=tabs)
+        from spark_rapids_tpu.expressions.base import Alias, \
+            BoundReference
+        from spark_rapids_tpu.plan.logical import LogicalProject
+        assert isinstance(tr.plan, LogicalProject)
+        last = tr.plan.exprs[-1]
+        ref = last.child if isinstance(last, Alias) else last
+        assert isinstance(ref, BoundReference)
+        assert ref.ordinal == 0        # left k, not right k (ordinal 2)
+
+    def test_partial_final_pair_collapses(self, tabs):
+        tr = SC.translate(BC.load_fixture("bench_hash_agg", "/tmp"),
+                          tables=tabs)
+        from spark_rapids_tpu.plan.logical import (LogicalAggregate,
+                                                   LogicalFilter)
+        classes = SC.engine_classes(tr.plan)
+        # ONE logical aggregate, no exchange artifacts
+        n_aggs = 0
+
+        def count(p):
+            nonlocal n_aggs
+            if isinstance(p, LogicalAggregate):
+                n_aggs += 1
+            for c in p.children:
+                count(c)
+        count(tr.plan)
+        assert n_aggs == 1
+        assert "LogicalFilter" in classes
+
+    def test_table_names_recorded(self, tabs):
+        tr = SC.translate(BC.load_fixture("join_dup_names", "/tmp"),
+                          tables=tabs)
+        assert tr.table_names == ["facts", "dims"]
+
+    def test_engine_classes_walker_sees_window_spec_internals(self, tabs):
+        tr = SC.translate(BC.load_fixture("window_functions", "/tmp"),
+                          tables=tabs)
+        cls = SC.engine_classes(tr.plan)
+        assert {"WindowExpression", "RowNumber", "Rank", "LagLead",
+                "WindowAgg", "Sum", "BoundReference"} <= cls
+
+
+# ---------------------------------------------------------------------------
+# plandoc decode errors carry node paths (satellite)
+# ---------------------------------------------------------------------------
+
+class TestPlanDecodePaths:
+    def _doc_for(self, df):
+        doc, tables = plandoc.plan_to_doc(df.plan)
+        return json.loads(json.dumps(doc)), tables
+
+    def _native(self, tabs):
+        from spark_rapids_tpu.expressions import col, lit
+        from spark_rapids_tpu.plan import table
+        return (table(tabs["facts"]).where(col("v") > lit(5))
+                .select((col("v") + lit(1)).alias("w")))
+
+    def test_unknown_expression_class_path(self, tabs):
+        doc, tables = self._doc_for(self._native(tabs))
+        # corrupt the filter condition's expression class
+        flt = doc["$p"][1][0]
+        flt["$p"][2]["$e"][0] = "NoSuchExpr"
+        with pytest.raises(plandoc.PlanDecodeError) as ei:
+            plandoc.doc_to_plan(doc, tables)
+        assert "NoSuchExpr" in str(ei.value)
+        assert "$p:LogicalFilter" in ei.value.path
+        assert ".condition" in ei.value.path
+
+    def test_nested_expression_path_includes_parents(self, tabs):
+        doc, tables = self._doc_for(self._native(tabs))
+        proj_expr = doc["$p"][2]["$l"][0]     # Alias(Add(...))
+        alias_args = proj_expr["$e"]
+        add = alias_args[1]
+        add["$e"][0] = "Bogus"
+        with pytest.raises(plandoc.PlanDecodeError) as ei:
+            plandoc.doc_to_plan(doc, tables)
+        assert "$e:Alias" in ei.value.path
+        assert "$p:LogicalProject" in ei.value.path
+
+    def test_missing_table_path(self, tabs):
+        doc, tables = self._doc_for(self._native(tabs))
+        with pytest.raises(plandoc.PlanDecodeError) as ei:
+            plandoc.doc_to_plan(doc, {})
+        assert "$p:LogicalScan" in ei.value.path
+
+    def test_unknown_plan_node_has_path(self, tabs):
+        doc, tables = self._doc_for(self._native(tabs))
+        doc["$p"][1][0]["$p"][0] = "LogicalNope"
+        with pytest.raises(plandoc.PlanDecodeError) as ei:
+            plandoc.doc_to_plan(doc, tables)
+        assert ei.value.path is not None
+
+    def test_clean_roundtrip_still_works(self, tabs):
+        doc, tables = self._doc_for(self._native(tabs))
+        plan = plandoc.doc_to_plan(doc, tables)
+        doc2, _ = plandoc.plan_to_doc(plan, tables)
+        assert doc2 == doc
+
+
+# ---------------------------------------------------------------------------
+# the coverage lint runs in tier-1 (satellite)
+# ---------------------------------------------------------------------------
+
+def _tools_path():
+    p = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools")
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def test_lint_bridge_zero_gaps():
+    _tools_path()
+    import lint_bridge
+    assert lint_bridge.run() == 0
+
+
+def test_committed_fixtures_match_generator():
+    """Golden means golden: the committed corpus must be byte-for-byte
+    what tools/make_catalyst_fixtures.py deterministically emits —
+    hand-edits to fixture JSON (or generator edits without
+    regeneration) fail here."""
+    _tools_path()
+    import make_catalyst_fixtures as gen
+    committed = set(BC.fixture_names())
+    assert committed == set(gen.FIXTURES), (
+        "fixture files on disk and generator entries diverge")
+    for name, build in gen.FIXTURES.items():
+        with open(os.path.join(BC.FIXTURE_DIR, f"{name}.json")) as f:
+            on_disk = json.load(f)
+        assert on_disk["plan"] == gen.flat_plan(build()), name
+        assert on_disk["schemaVersion"] == 1, name

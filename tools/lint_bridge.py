@@ -1,0 +1,136 @@
+"""Bridge coverage lint: no silent gaps between the plandoc registries
+and the Catalyst fixture corpus.
+
+Every plandoc-registered plan node and expression class must either be
+exercised by >= 1 golden fixture under tests/fixtures/catalyst/ (its
+translated plan actually CONTAINS the class, per
+spark_client.engine_classes) or carry an explicit reasoned entry in
+spark_client.UNSUPPORTED. Both drift directions fail:
+
+- **missing**: a registered class with neither fixture coverage nor an
+  UNSUPPORTED entry (someone added an engine expression without telling
+  the bridge) — the reference's api_validation failure mode;
+- **stale**: an UNSUPPORTED entry whose class IS covered by a fixture
+  (the table lies about the corpus).
+
+Also re-checks that every committed fixture translates cleanly and
+declares an accepted schemaVersion.
+
+Run standalone (``python tools/lint_bridge.py``) or in tier-1 via
+tests/test_spark_bridge.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from typing import Dict, List, Set
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _registered_classes() -> Set[str]:
+    """Everything the plandoc wire dialect can name: plan nodes plus the
+    full expression registry (imported deterministically)."""
+    import spark_rapids_tpu.expressions.aggregates      # noqa: F401
+    import spark_rapids_tpu.expressions.arithmetic      # noqa: F401
+    import spark_rapids_tpu.expressions.boolean         # noqa: F401
+    import spark_rapids_tpu.expressions.cast            # noqa: F401
+    import spark_rapids_tpu.expressions.collections     # noqa: F401
+    import spark_rapids_tpu.expressions.comparison      # noqa: F401
+    import spark_rapids_tpu.expressions.conditional     # noqa: F401
+    import spark_rapids_tpu.expressions.datetime        # noqa: F401
+    import spark_rapids_tpu.expressions.decimal128      # noqa: F401
+    import spark_rapids_tpu.expressions.hashing         # noqa: F401
+    import spark_rapids_tpu.expressions.json            # noqa: F401
+    import spark_rapids_tpu.expressions.math            # noqa: F401
+    import spark_rapids_tpu.expressions.regex           # noqa: F401
+    import spark_rapids_tpu.expressions.strings         # noqa: F401
+    import spark_rapids_tpu.expressions.window          # noqa: F401
+    import spark_rapids_tpu.expressions.zorder          # noqa: F401
+    from spark_rapids_tpu.expressions.base import Expression
+    from spark_rapids_tpu.server.plandoc import _PLAN_NODES
+    names = set(_PLAN_NODES) | set(Expression._registry)
+    try:
+        import spark_rapids_tpu.udf.compiler            # noqa: F401
+        names |= set(Expression._registry)
+    except Exception:
+        # the UDF compiler is optional in constrained environments; its
+        # private expression classes are engine-internal anyway
+        pass
+    return names
+
+
+def run() -> int:
+    from harness import bridge_corpus as BC
+    from spark_rapids_tpu.server import spark_client as SC
+
+    registered = _registered_classes()
+    tabs = BC.make_tables()
+    with tempfile.TemporaryDirectory(prefix="lint_bridge_") as data_dir:
+        BC.parquet_dir(data_dir)
+        covered: Set[str] = set()
+        coverage: Dict[str, List[str]] = {}
+        errors: List[str] = []
+        names = BC.fixture_names()
+        if not names:
+            print("lint_bridge: NO fixtures found under "
+                  f"{BC.FIXTURE_DIR}")
+            return 1
+        for name in names:
+            try:
+                tr = SC.translate(BC.load_fixture(name, data_dir),
+                                  tables=tabs)
+            except Exception as e:
+                errors.append(f"fixture {name}: {type(e).__name__}: {e}")
+                continue
+            cls = SC.engine_classes(tr.plan)
+            covered |= cls
+            for c in cls:
+                coverage.setdefault(c, []).append(name)
+
+    unsupported = set(SC.UNSUPPORTED)
+    missing = sorted(registered - covered - unsupported)
+    stale = sorted(covered & unsupported)
+    phantom = sorted(unsupported - registered)
+
+    rc = 0
+    if errors:
+        rc = 1
+        print("lint_bridge: fixtures that fail to translate:")
+        for e in errors:
+            print(f"  {e}")
+    if missing:
+        rc = 1
+        print("lint_bridge: registered classes with NO fixture coverage "
+              "and NO spark_client.UNSUPPORTED entry:")
+        for m in missing:
+            print(f"  {m}")
+        print("  -> add a fixture exercising the mapping, or an explicit "
+              "UNSUPPORTED entry with a reason")
+    if stale:
+        rc = 1
+        print("lint_bridge: STALE spark_client.UNSUPPORTED entries "
+              "(already fixture-covered — delete them):")
+        for s in stale:
+            print(f"  {s} (covered by {', '.join(coverage[s][:3])})")
+    if phantom:
+        rc = 1
+        print("lint_bridge: UNSUPPORTED entries naming classes that are "
+              "not registered at all (typo or removed class):")
+        for p in phantom:
+            print(f"  {p}")
+    if rc == 0:
+        print(f"lint_bridge: OK — {len(covered & registered)} classes "
+              f"fixture-covered, {len(unsupported)} explicitly "
+              f"unsupported, {len(names)} fixtures, 0 gaps")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(run())

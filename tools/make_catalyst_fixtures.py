@@ -1,0 +1,725 @@
+"""Regenerate the golden Catalyst fixture corpus (tests/fixtures/catalyst).
+
+Each fixture is one Spark `queryExecution.executedPlan.toJSON` document
+(schemaVersion 1, see server/catalyst.py for the encoding rules) with
+realistic node/expression class names, exprIds, nested output attributes,
+partial/final aggregate pairs, exchanges and codegen wrappers — the
+shapes a real driver would export. Fixture table schemas come from
+tests/harness/bridge_corpus.py, which also holds the native-API twin of
+every fixture query for the differential suite.
+
+Run: python tools/make_catalyst_fixtures.py
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+CAT = "org.apache.spark.sql.catalyst.expressions."
+AGGP = CAT + "aggregate."
+EXEC = "org.apache.spark.sql.execution."
+PHYS = "org.apache.spark.sql.catalyst.plans.physical."
+PLANS = "org.apache.spark.sql.catalyst.plans."
+JVM = "b50b93f5-29a4-4d4b-ae9e-2f5854f5a4f1"
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "tests", "fixtures", "catalyst")
+
+
+class E:
+    """Expression tree node; child-valued fields wire by index on
+    flatten (Spark's TreeNode.toJSON convention for tree children)."""
+
+    def __init__(self, cls: str, **fields):
+        self.cls = cls
+        self.fields = fields
+
+
+class P:
+    """Plan tree node; expression-valued fields flatten to NESTED
+    arrays, plan children wire by explicit index fields."""
+
+    def __init__(self, cls: str, children: Sequence["P"] = (),
+                 fields: Optional[dict] = None, **kw):
+        self.cls = cls
+        self.children = list(children)
+        self.fields = dict(fields or {})
+        self.fields.update(kw)
+
+
+def _is_elist(v) -> bool:
+    return isinstance(v, list) and bool(v) and all(
+        isinstance(x, E) for x in v)
+
+
+def flat_expr(root: E) -> List[dict]:
+    nodes: List[dict] = []
+
+    def emit(n: E) -> None:
+        children: List[E] = []
+        rec: Dict[str, Any] = {"class": n.cls}
+        outf: Dict[str, Any] = {}
+        for k, v in n.fields.items():
+            if isinstance(v, E):
+                outf[k] = len(children)
+                children.append(v)
+            elif _is_elist(v):
+                idxs = []
+                for x in v:
+                    idxs.append(len(children))
+                    children.append(x)
+                outf[k] = idxs
+            elif isinstance(v, list) and v and all(
+                    isinstance(x, tuple) and len(x) == 2
+                    and isinstance(x[0], E) for x in v):
+                # CaseWhen branches: Seq[(Expression, Expression)]
+                brs = []
+                for p_, v_ in v:
+                    i1 = len(children)
+                    children.append(p_)
+                    i2 = len(children)
+                    children.append(v_)
+                    brs.append({"product-class": "scala.Tuple2",
+                                "_1": i1, "_2": i2})
+                outf[k] = brs
+            else:
+                outf[k] = v
+        rec["num-children"] = len(children)
+        rec.update(outf)
+        nodes.append(rec)
+        for c in children:
+            emit(c)
+
+    emit(root)
+    return nodes
+
+
+def flat_plan(root: P) -> List[dict]:
+    nodes: List[dict] = []
+
+    def emit(n: P) -> None:
+        rec: Dict[str, Any] = {"class": n.cls,
+                               "num-children": len(n.children)}
+        for k, v in n.fields.items():
+            if isinstance(v, E):
+                rec[k] = flat_expr(v)
+            elif _is_elist(v):
+                rec[k] = [flat_expr(x) for x in v]
+            elif isinstance(v, list) and v and all(_is_elist(x) for x in v):
+                rec[k] = [[flat_expr(y) for y in x] for x in v]
+            else:
+                rec[k] = v
+        nodes.append(rec)
+        for c in n.children:
+            emit(c)
+
+    emit(root)
+    return nodes
+
+
+# ---- expression shorthands ------------------------------------------------
+
+def obj(full: str) -> dict:
+    return {"object": full}
+
+
+def xid(i: int) -> dict:
+    return {"product-class": CAT + "ExprId", "id": int(i), "jvmId": JVM}
+
+
+def attr(name: str, dtype, i: int, nullable: bool = True) -> E:
+    return E(CAT + "AttributeReference", name=name, dataType=dtype,
+             nullable=nullable, metadata={}, exprId=xid(i), qualifier=[])
+
+
+def slit(v, dtype) -> E:
+    if v is None:
+        value = None
+    elif isinstance(v, bool):
+        value = "true" if v else "false"
+    else:
+        value = str(v)
+    return E(CAT + "Literal", value=value, dataType=dtype)
+
+
+def alias(child: E, name: str, i: int) -> E:
+    return E(CAT + "Alias", child=child, name=name, exprId=xid(i),
+             qualifier=[], explicitMetadata=None,
+             nonInheritableMetadataKeys=[])
+
+
+def so(child: E, desc: bool = False) -> E:
+    return E(CAT + "SortOrder", child=child,
+             direction=obj(CAT + ("Descending$" if desc else "Ascending$")),
+             nullOrdering=obj(CAT + ("NullsLast$" if desc
+                                     else "NullsFirst$")),
+             sameOrderExpressions=[])
+
+
+def agg_expr(fn: E, mode: str, rid: int) -> E:
+    return E(AGGP + "AggregateExpression", aggregateFunction=fn,
+             mode=obj(AGGP + mode + "$"), isDistinct=False, filter=None,
+             resultId=xid(rid))
+
+
+def cast(child: E, dtype) -> E:
+    return E(CAT + "Cast", child=child, dataType=dtype, timeZoneId="UTC",
+             evalMode="LEGACY")
+
+
+def binop(name: str, left: E, right: E, **kw) -> E:
+    return E(CAT + name, left=left, right=right, **kw)
+
+
+def days(d: dt.date) -> int:
+    return (d - dt.date(1970, 1, 1)).days
+
+
+def micros(t: dt.datetime) -> int:
+    epoch = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+    return round((t - epoch) / dt.timedelta(microseconds=1))
+
+
+# ---- plan shorthands ------------------------------------------------------
+
+def scan(table_name: str, attrs: List[E], num_slices: Optional[int] = None
+         ) -> P:
+    f: Dict[str, Any] = {"output": attrs, "rows": None,
+                         "rtpuTable": table_name}
+    if num_slices is not None:
+        f["rtpuNumSlices"] = num_slices
+    return P(EXEC + "LocalTableScanExec", fields=f)
+
+
+def codegen(child: P, stage: int = 1) -> P:
+    return P(EXEC + "WholeStageCodegenExec", [child], child=0,
+             codegenStageId=stage)
+
+
+def filter_(cond: E, child: P) -> P:
+    return P(EXEC + "FilterExec", [child], condition=cond, child=0)
+
+
+def project(plist: List[E], child: P) -> P:
+    return P(EXEC + "ProjectExec", [child], projectList=plist, child=0)
+
+
+def exchange(child: P, part_exprs: List[E], n: int = 8) -> P:
+    part = E(PHYS + "HashPartitioning", expressions=part_exprs,
+             numPartitions=n) if part_exprs else \
+        E(PHYS + "RoundRobinPartitioning", numPartitions=n)
+    return P(EXEC + "exchange.ShuffleExchangeExec", [child],
+             outputPartitioning=part, child=0,
+             shuffleOrigin=obj(EXEC + "exchange.ENSURE_REQUIREMENTS$"))
+
+
+def local_sort(orders: List[E], child: P) -> P:
+    return P(EXEC + "SortExec", [child],
+             fields={"sortOrder": orders, "global": False, "child": 0,
+                     "testSpillFrequency": 0})
+
+
+def hash_agg(child: P, grouping: List[E], aggs: List[E],
+             agg_attrs: List[E], result: List[E]) -> P:
+    return P(EXEC + "aggregate.HashAggregateExec", [child],
+             requiredChildDistributionExpressions=None,
+             isStreaming=False, numShufflePartitions=None,
+             groupingExpressions=grouping, aggregateExpressions=aggs,
+             aggregateAttributes=agg_attrs, initialInputBufferOffset=0,
+             resultExpressions=result, child=0)
+
+
+def two_stage_agg(child: P, grouping: List[E], fns: List[Tuple[E, str, str]],
+                  ids, result_extra=None) -> P:
+    """Partial -> Exchange -> Final, the executedPlan shape. ``fns`` is
+    [(agg_fn_expr_over_input, buffer_name, result_alias)]; grouping
+    entries must be AttributeReference Es (reused across stages, the way
+    Catalyst keeps bare grouping attr ids stable)."""
+    buf_ids = [next(ids) for _ in fns]
+    part_aggs = [agg_expr(fn, "Partial", rid)
+                 for (fn, _, _), rid in zip(fns, buf_ids)]
+    buf_attrs = [attr(bname, "long", rid)
+                 for (_, bname, _), rid in zip(fns, buf_ids)]
+    partial = hash_agg(child, grouping, part_aggs, buf_attrs,
+                       grouping + buf_attrs)
+    ex = exchange(partial, grouping)
+    out_ids = [next(ids) for _ in fns]
+    fin_aggs = [agg_expr(E(AGGP + type_of(fn), child=attr(bn, "long", rid)),
+                         "Final", oid)
+                for (fn, bn, _), rid, oid in zip(fns, buf_ids, out_ids)]
+    fin_attrs = [attr(f"{type_of(fn).lower()}({bn})", dtype_of(fn), oid)
+                 for (fn, bn, _), oid in zip(fns, out_ids)]
+    result = list(grouping) + [
+        alias(attr(f"{type_of(fn).lower()}({bn})", dtype_of(fn), oid),
+              out_name, next(ids))
+        for (fn, bn, out_name), oid in zip(fns, out_ids)]
+    if result_extra:
+        result = result_extra(result)
+    return hash_agg(ex, grouping, fin_aggs, fin_attrs, result)
+
+
+def type_of(fn: E) -> str:
+    return fn.cls.rsplit(".", 1)[-1]
+
+
+def dtype_of(fn: E) -> str:
+    name = type_of(fn)
+    if name == "Count":
+        return "long"
+    if name == "Sum":
+        cd = fn.fields.get("child")
+        if isinstance(cd, E) and cd.fields.get("dataType") == "double":
+            return "double"
+        return "long"
+    return "double"
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def ids_from(start: int = 1):
+    i = start - 1
+
+    def nxt():
+        nonlocal i
+        i += 1
+        return i
+    # also usable via next()
+    class _It:
+        def __next__(self):
+            return nxt()
+
+        def __call__(self):
+            return nxt()
+    return _It()
+
+
+def fx_project_filter() -> P:
+    ids = ids_from()
+    k = attr("k", "integer", next(ids))
+    lq = attr("l_quantity", "long", next(ids))
+    price = attr("l_extendedprice", "double", next(ids))
+    cond = binop("And",
+                 binop("GreaterThan", lq, slit(5, "long")),
+                 binop("Or",
+                       binop("EqualTo", k, slit(1, "integer")),
+                       binop("GreaterThan", price, slit(100.0, "double"))))
+    plist = [
+        k, lq,
+        alias(binop("Multiply", price, cast(lq, "double"), evalMode="LEGACY"),
+              "gross", next(ids)),
+        alias(binop("Add", lq, slit(1, "long"), evalMode="LEGACY"),
+              "q1", next(ids)),
+        alias(binop("Subtract", price, slit(1.5, "double"),
+                    evalMode="LEGACY"), "disc", next(ids)),
+        alias(binop("Divide", price, slit(2.0, "double"),
+                    evalMode="LEGACY"), "half", next(ids)),
+        alias(binop("Remainder", lq, slit(7, "long"), evalMode="LEGACY"),
+              "m7", next(ids)),
+        alias(E(CAT + "Abs",
+                child=binop("Subtract", lq, slit(25, "long"),
+                            evalMode="LEGACY"), failOnError=False),
+              "aq", next(ids)),
+    ]
+    return codegen(project(plist, filter_(cond, scan(
+        "lineitem", [k, lq, price]))))
+
+
+def fx_types_literals() -> P:
+    ids = ids_from(100)
+    pid = attr("id", "long", next(ids))
+    name = attr("name", "string", next(ids))
+    dept = attr("dept", "integer", next(ids))
+    sal = attr("salary", "double", next(ids))
+    hired = attr("hired", "date", next(ids))
+    ts = attr("ts", "timestamp", next(ids))
+    bonus = attr("bonus", "decimal(10,2)", next(ids))
+    cond = binop(
+        "And",
+        binop("And",
+              E(CAT + "IsNotNull", child=name),
+              binop("GreaterThanOrEqual", hired,
+                    slit(days(dt.date(2016, 6, 1)), "date"))),
+        E(CAT + "Not",
+          child=binop("EqualTo", dept, slit(5, "integer"))))
+    plist = [
+        pid, name,
+        alias(E(CAT + "Upper", child=name), "uname", next(ids)),
+        alias(E(CAT + "Substring", str=name, pos=slit(1, "integer"),
+                len=slit(3, "integer")), "pre", next(ids)),
+        alias(E(CAT + "Length", child=name), "ln", next(ids)),
+        alias(E(CAT + "Concat", children=[name, slit("!", "string")]),
+              "bang", next(ids)),
+        alias(E(CAT + "CaseWhen",
+                branches=[(binop("LessThan", sal, slit(1000.0, "double")),
+                           slit("low", "string")),
+                          (binop("LessThanOrEqual", sal,
+                                 slit(5000.0, "double")),
+                           slit("mid", "string"))],
+                elseValue=slit("high", "string")), "band", next(ids)),
+        alias(E(CAT + "If", predicate=E(CAT + "IsNull", child=sal),
+                trueValue=slit(0.0, "double"), falseValue=sal),
+              "sal0", next(ids)),
+        alias(E(CAT + "Coalesce",
+                children=[bonus, slit("0.00", "decimal(10,2)")]),
+              "bonus0", next(ids)),
+        alias(binop("EqualNullSafe", sal, sal), "selfsafe", next(ids)),
+        alias(E(CAT + "In", value=dept,
+                list=[slit(1, "integer"), slit(2, "integer"),
+                      slit(3, "integer")]), "indept", next(ids)),
+        alias(E(CAT + "Year", child=hired), "yr", next(ids)),
+        alias(E(CAT + "Month", child=hired), "mo", next(ids)),
+        alias(E(CAT + "DateAdd", startDate=hired,
+                days=slit(30, "integer")), "due", next(ids)),
+        alias(binop("GreaterThan", ts,
+                    slit(micros(dt.datetime(2022, 1, 1,
+                                            tzinfo=dt.timezone.utc)),
+                         "timestamp")), "recent", next(ids)),
+        alias(binop("Contains", name, slit("a", "string")),
+              "has_a", next(ids)),
+        alias(E(CAT + "Like", left=name, right=slit("A%", "string"),
+                escapeChar="\\"), "like_a", next(ids)),
+        alias(slit(None, "double"), "nodouble", next(ids)),
+    ]
+    all_attrs = [pid, name, dept, sal, hired, ts, bonus]
+    return project(plist, filter_(cond, scan("people", all_attrs)))
+
+
+def fx_agg_complete() -> P:
+    ids = ids_from(200)
+    dept = attr("dept", "integer", next(ids))
+    sal = attr("salary", "double", next(ids))
+    people = scan("people", [
+        attr("id", "long", next(ids)), attr("name", "string", next(ids)),
+        dept, sal, attr("hired", "date", next(ids)),
+        attr("ts", "timestamp", next(ids)),
+        attr("bonus", "decimal(10,2)", next(ids))])
+    fns = [("Min", "lo"), ("Max", "hi"), ("Average", "avg")]
+    rids = [next(ids) for _ in fns]
+    aggs = [agg_expr(E(AGGP + fname, child=sal), "Complete", rid)
+            for (fname, _), rid in zip(fns, rids)]
+    agg_attrs = [attr(f"{fname.lower()}(salary)", "double", rid)
+                 for (fname, _), rid in zip(fns, rids)]
+    result = [dept] + [
+        alias(attr(f"{fname.lower()}(salary)", "double", rid), out,
+              next(ids))
+        for (fname, out), rid in zip(fns, rids)]
+    return hash_agg(people, [dept], aggs, agg_attrs, result)
+
+
+def fx_join_dup_names() -> P:
+    ids = ids_from(300)
+    fk = attr("k", "long", next(ids))
+    fv = attr("v", "long", next(ids))
+    dk = attr("k", "long", next(ids))
+    dw = attr("w", "long", next(ids))
+    left = local_sort([so(fk)], exchange(scan("facts", [fk, fv]), [fk]))
+    right = local_sort([so(dk)], exchange(scan("dims", [dk, dw]), [dk]))
+    cond = binop("LessThan", fv,
+                 binop("Multiply", dw, slit(200, "integer"),
+                       evalMode="LEGACY"))
+    join = P(EXEC + "joins.SortMergeJoinExec", [left, right],
+             leftKeys=[fk], rightKeys=[dk],
+             joinType=obj(PLANS + "LeftOuter$"), condition=cond,
+             left=0, right=1, isSkewJoin=False)
+    plist = [alias(fv, "fv", next(ids)), dw, fk]
+    return project(plist, join)
+
+
+def fx_sort_limit() -> P:
+    ids = ids_from(400)
+    k = attr("k", "long", next(ids))
+    v = attr("v", "long", next(ids))
+    srt = P(EXEC + "SortExec", [exchange(scan("facts", [k, v]), [])],
+            fields={"sortOrder": [so(v, desc=True), so(k)],
+                    "global": True, "child": 0, "testSpillFrequency": 0})
+    loc = P(EXEC + "LocalLimitExec", [srt], limit=20, child=0)
+    return P(EXEC + "GlobalLimitExec", [loc], limit=20, child=0)
+
+
+def fx_take_ordered() -> P:
+    ids = ids_from(450)
+    k = attr("k", "long", next(ids))
+    q = attr("ss_quantity", "long", next(ids))
+    return P(EXEC + "TakeOrderedAndProjectExec", [scan("sales", [k, q])],
+             limit=10, sortOrder=[so(q, desc=True)], projectList=[k, q],
+             child=0)
+
+
+def _frame(rows: bool, lower, upper) -> E:
+    def bound(b):
+        if b is None:
+            return E(CAT + "UnboundedPreceding$")
+        if b == "uf":
+            return E(CAT + "UnboundedFollowing$")
+        if b == 0:
+            return E(CAT + "CurrentRow$")
+        return slit(b, "integer")
+    return E(CAT + "SpecifiedWindowFrame",
+             frameType=obj(CAT + ("RowFrame$" if rows else "RangeFrame$")),
+             lower=bound(lower), upper=bound(upper))
+
+
+def _wspec(part: List[E], orders: List[E], frame: E) -> E:
+    return E(CAT + "WindowSpecDefinition", partitionSpec=part,
+             orderSpec=orders, frameSpecification=frame)
+
+
+def fx_window_functions() -> P:
+    ids = ids_from(500)
+    k = attr("k", "long", next(ids))
+    v = attr("v", "long", next(ids))
+    child = local_sort([so(k), so(v)],
+                       exchange(scan("facts", [k, v]), [k]))
+    # one WindowExec per (partition, order) spec — Spark's planner
+    # splits differing specs into chained execs exactly like this
+    wx1 = [
+        alias(E(CAT + "WindowExpression",
+                windowFunction=E(CAT + "RowNumber"),
+                windowSpec=_wspec([k], [so(v)], _frame(True, None, 0))),
+              "rn", next(ids)),
+        alias(E(CAT + "WindowExpression",
+                windowFunction=E(CAT + "Rank", children=[v]),
+                windowSpec=_wspec([k], [so(v)], _frame(False, None, 0))),
+              "rk", next(ids)),
+        alias(E(CAT + "WindowExpression",
+                windowFunction=E(CAT + "Lag", input=v,
+                                 offset=slit(-1, "integer"),
+                                 default=slit(None, "long"),
+                                 ignoreNulls=False),
+                windowSpec=_wspec([k], [so(v)], _frame(True, -1, -1))),
+              "prev", next(ids)),
+        alias(E(CAT + "WindowExpression",
+                windowFunction=agg_expr(
+                    E(AGGP + "Sum", child=v), "Complete", next(ids)),
+                windowSpec=_wspec([k], [so(v)], _frame(True, -2, 0))),
+              "run2", next(ids)),
+    ]
+    w1 = P(EXEC + "window.WindowExec", [child], windowExpression=wx1,
+           partitionSpec=[k], orderSpec=[so(v)], child=0)
+    wx2 = [
+        alias(E(CAT + "WindowExpression",
+                windowFunction=agg_expr(
+                    E(AGGP + "Sum", child=v), "Complete", next(ids)),
+                windowSpec=_wspec([k], [], _frame(False, None, "uf"))),
+              "total", next(ids)),
+    ]
+    return P(EXEC + "window.WindowExec", [local_sort([so(k)], w1)],
+             windowExpression=wx2, partitionSpec=[k], orderSpec=[],
+             child=0)
+
+
+def fx_exchange_repartition() -> P:
+    ids = ids_from(600)
+    k = attr("k", "long", next(ids))
+    v = attr("v", "long", next(ids))
+    flt = filter_(binop("GreaterThan", v, slit(0, "long")),
+                  scan("facts", [k, v], num_slices=2))
+    return exchange(flt, [], n=4)
+
+
+def fx_union_minus() -> P:
+    ids = ids_from(650)
+    k1 = attr("k", "long", next(ids))
+    v1 = attr("v", "long", next(ids))
+    k2 = attr("k", "long", next(ids))
+    v2 = attr("v", "long", next(ids))
+    a = project([k1, v1], scan("facts", [k1, v1]))
+    b = project([k2, alias(E(CAT + "UnaryMinus", child=v2,
+                             failOnError=False), "v", next(ids))],
+                scan("facts", [k2, v2]))
+    return P(EXEC + "UnionExec", [a, b])
+
+
+def fx_expand_rollup() -> P:
+    ids = ids_from(700)
+    k = attr("k", "long", next(ids))
+    q = attr("ss_quantity", "long", next(ids))
+    out = [attr("k", "long", next(ids)), attr("q", "long", next(ids)),
+           attr("gid", "integer", next(ids), nullable=False)]
+    projections = [
+        [k, q, slit(0, "integer")],
+        [k, slit(None, "long"), slit(1, "integer")],
+    ]
+    return P(EXEC + "ExpandExec", [scan("sales", [k, q])],
+             projections=projections, output=out, child=0)
+
+
+def fx_generate_explode() -> P:
+    ids = ids_from(750)
+    k = attr("k", "long", next(ids))
+    tags = attr("tags", {"type": "array", "elementType": "long",
+                         "containsNull": False}, next(ids))
+    s = attr("s", "string", next(ids))
+    gout = [attr("pos", "integer", next(ids)),
+            attr("tag", "long", next(ids))]
+    return P(EXEC + "GenerateExec", [scan("events", [k, tags, s])],
+             generator=E(CAT + "PosExplode", child=tags),
+             requiredChildOutput=[k, tags, s], outer=True,
+             generatorOutput=gout, child=0)
+
+
+def fx_sample_range() -> P:
+    ids = ids_from(800)
+    out_id = next(ids)
+    rng_node = [{
+        "class": "org.apache.spark.sql.catalyst.plans.logical.Range",
+        "num-children": 0, "start": 0, "end": 1000, "step": 1,
+        "numSlices": None,
+        "output": [flat_expr(attr("id", "long", out_id,
+                                  nullable=False))],
+    }]
+    rng = P(EXEC + "RangeExec", fields={"range": rng_node})
+    return P(EXEC + "SampleExec", [rng], lowerBound=0.0, upperBound=0.35,
+             withReplacement=False, seed=7, child=0)
+
+
+def fx_bench_q1_stage() -> P:
+    ids = ids_from(900)
+    k = attr("k", "integer", next(ids))
+    lq = attr("l_quantity", "long", next(ids))
+    price = attr("l_extendedprice", "double", next(ids))
+    flt = filter_(binop("GreaterThan", lq, slit(25, "integer")),
+                  scan("lineitem", [k, lq, price]))
+    return two_stage_agg(
+        codegen(flt), [k],
+        [(E(AGGP + "Sum", child=price), "sum", "rev"),
+         (E(AGGP + "Count", children=[slit(1, "integer")]), "count", "n")],
+        ids)
+
+
+def fx_bench_hash_agg() -> P:
+    ids = ids_from(1000)
+    k = attr("k", "long", next(ids))
+    q = attr("ss_quantity", "long", next(ids))
+    flt = filter_(binop("GreaterThan", q, slit(25, "integer")),
+                  scan("sales", [k, q]))
+    return two_stage_agg(flt, [k],
+                         [(E(AGGP + "Sum", child=q), "sum", "q")], ids)
+
+
+def fx_bench_join_sort() -> P:
+    ids = ids_from(1100)
+    fk = attr("k", "long", next(ids))
+    fv = attr("v", "long", next(ids))
+    dk = attr("k", "long", next(ids))
+    dw = attr("w", "long", next(ids))
+    left = local_sort([so(fk)], exchange(
+        filter_(binop("GreaterThan", fv, slit(25, "integer")),
+                scan("facts", [fk, fv])), [fk]))
+    right = local_sort([so(dk)], exchange(scan("dims", [dk, dw]), [dk]))
+    join = P(EXEC + "joins.SortMergeJoinExec", [left, right],
+             leftKeys=[fk], rightKeys=[dk],
+             joinType=obj(PLANS + "Inner$"), condition=None,
+             left=0, right=1, isSkewJoin=False)
+    agg = two_stage_agg(join, [dw],
+                        [(E(AGGP + "Sum", child=fv), "sum", "s")], ids)
+    return P(EXEC + "SortExec", [exchange(agg, [])],
+             fields={"sortOrder": [so(dw)], "global": True, "child": 0,
+                     "testSpillFrequency": 0})
+
+
+def fx_bench_parquet_scan() -> P:
+    ids = ids_from(1200)
+    k = attr("k", "long", next(ids))
+    v = attr("v", "double", next(ids))
+    fscan = P(
+        EXEC + "FileSourceScanExec",
+        fields={
+            "relation": None,
+            "output": [k, v],
+            "requiredSchema": {
+                "type": "struct",
+                "fields": [
+                    {"name": "k", "type": "long", "nullable": True,
+                     "metadata": {}},
+                    {"name": "v", "type": "double", "nullable": True,
+                     "metadata": {}}]},
+            "partitionFilters": [],
+            "optionalBucketSet": None,
+            "optionalNumCoalescedBuckets": None,
+            "dataFilters": [binop("GreaterThan", k, slit(25, "integer"))],
+            "tableIdentifier": {
+                "product-class":
+                    "org.apache.spark.sql.catalyst.TableIdentifier",
+                "table": "bench_parquet", "database": "default"},
+            "disableBucketedScan": False,
+            "rtpuLocation": {
+                "format": "parquet",
+                "paths": ["${RTPU_FIXTURE_DATA}/bench_parquet/"
+                          "part-0.parquet"]},
+        })
+    flt = filter_(binop("GreaterThan", k, slit(25, "integer")), fscan)
+    return two_stage_agg(
+        flt, [k],
+        [(E(AGGP + "Count", children=[slit(1, "integer")]), "count", "n")],
+        ids)
+
+
+def fx_bench_exchange() -> P:
+    ids = ids_from(1300)
+    k = attr("k", "long", next(ids))
+    v = attr("v", "long", next(ids))
+    flt = filter_(binop("GreaterThan", v, slit(25, "integer")),
+                  scan("facts", [k, v], num_slices=4))
+    return two_stage_agg(flt, [k],
+                         [(E(AGGP + "Sum", child=v), "sum", "s")], ids)
+
+
+def fx_array_nulls() -> P:
+    ids = ids_from(1400)
+    k = attr("k", "long", next(ids))
+    a = attr("a", {"type": "array", "elementType": "long",
+                   "containsNull": True}, next(ids))
+    return filter_(binop("GreaterThan", k, slit(1, "long")),
+                   scan("arrnull", [k, a]))
+
+
+FIXTURES = {
+    "project_filter": fx_project_filter,
+    "types_literals": fx_types_literals,
+    "agg_complete": fx_agg_complete,
+    "join_dup_names": fx_join_dup_names,
+    "sort_limit": fx_sort_limit,
+    "take_ordered": fx_take_ordered,
+    "window_functions": fx_window_functions,
+    "exchange_repartition": fx_exchange_repartition,
+    "union_minus": fx_union_minus,
+    "expand_rollup": fx_expand_rollup,
+    "generate_explode": fx_generate_explode,
+    "sample_range": fx_sample_range,
+    "bench_q1_stage": fx_bench_q1_stage,
+    "bench_hash_agg": fx_bench_hash_agg,
+    "bench_join_sort": fx_bench_join_sort,
+    "bench_parquet_scan": fx_bench_parquet_scan,
+    "bench_exchange": fx_bench_exchange,
+    "array_nulls": fx_array_nulls,
+}
+
+
+def main() -> None:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    for name, build in sorted(FIXTURES.items()):
+        doc = {
+            "schemaVersion": 1,
+            "spark": "3.5.1",
+            "generator": "tools/make_catalyst_fixtures.py",
+            "plan": flat_plan(build()),
+        }
+        path = os.path.join(OUT_DIR, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=False)
+            f.write("\n")
+        print(f"wrote {os.path.relpath(path)}")
+
+
+if __name__ == "__main__":
+    main()
