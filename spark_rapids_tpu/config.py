@@ -740,6 +740,59 @@ BRIDGE_DEFAULT_ARRAY_ELEMS = conf(
     "Catalyst translation (fixed-budget device layout)."
 ).integer(256)
 
+TRACE_ENABLED = conf("spark.rapids.tpu.trace.enabled").doc(
+    "Query tracing (spark_rapids_tpu/trace.py): mint/adopt a query_id per "
+    "collect and record a span timeline — admission wait, cache lookups, "
+    "per-operator execution, serializer pack/unpack, per-peer transport "
+    "fetches with failover/backoff sub-spans, lineage recomputes. Results "
+    "are bit-for-bit identical either way; off-path overhead is one "
+    "thread-local read per span site (docs/observability.md)."
+).boolean(False)
+
+TRACE_MAX_SPANS = conf("spark.rapids.tpu.trace.maxSpansPerQuery").doc(
+    "Span budget per traced query; past it further spans are counted as "
+    "dropped (trace.droppedSpanCount, the flight recorder's "
+    "droppedSpans) instead of growing the tree without bound."
+).integer(2048)
+
+TRACE_SINK_PATH = conf("spark.rapids.tpu.trace.sink.path").doc(
+    "When set, append every finished query profile as one JSON line "
+    "(JSONL) to this file; tools/trace_viewer.py renders the file as "
+    "Chrome/Perfetto trace-event JSON. Sink failures never fail the "
+    "query. Empty = no sink.").text("")
+
+TRACE_COST_STORE_ENABLED = conf(
+    "spark.rapids.tpu.trace.costStore.enabled").doc(
+    "Record per-(shape-fingerprint, operator) observed wall/rows/bytes "
+    "EWMAs at collect close from the exec metric roll-up — the "
+    "empirical feed for CBO/AQE re-planning. Independent of "
+    "trace.enabled (the metrics exist regardless); requires a plan "
+    "fingerprint (planCache.enabled) to key on.").boolean(True)
+
+TRACE_COST_STORE_ALPHA = conf(
+    "spark.rapids.tpu.trace.costStore.alpha").doc(
+    "EWMA smoothing factor of the observed-cost store (new = old + "
+    "alpha * (sample - old)); higher tracks load shifts faster, lower "
+    "resists outliers.").floating(0.2)
+
+TRACE_COST_STORE_MAX_FPS = conf(
+    "spark.rapids.tpu.trace.costStore.maxFingerprints").doc(
+    "LRU bound on distinct shape fingerprints the observed-cost store "
+    "retains.").integer(1024)
+
+SERVER_TRACE_RECORDER_ENTRIES = conf(
+    "spark.rapids.tpu.server.trace.recorderEntries").doc(
+    "Capacity of the plan server/router flight recorder: a bounded "
+    "in-memory ring of the last N query profiles (plus a same-sized "
+    "slow-query log) exposed over the 'trace' wire op and the "
+    "serving_stats() trace block.").integer(128)
+
+SERVER_TRACE_SLOW_QUERY_MS = conf(
+    "spark.rapids.tpu.server.trace.slowQueryMs").doc(
+    "Queries slower than this land in the flight recorder's slow-query "
+    "log (and count serving_stats()['trace']['recorder']"
+    "['slowQueries']). 0 disables the slow log.").integer(1000)
+
 UDF_COMPILER_ENABLED = conf("spark.rapids.tpu.sql.udfCompiler.enabled").doc(
     "Translate Python UDF bytecode into expression trees so UDF bodies "
     "become TPU-plannable (reference: spark.rapids.sql.udfCompiler.enabled)."

@@ -109,24 +109,36 @@ class Exec:
     def execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         """Iterate one partition, maintaining the op's metrics: batch and
         row counts plus opTime (ns spent INSIDE this operator's iterator,
-        including its children — the reference's NS_TIMING convention)."""
+        including its children — the reference's NS_TIMING convention).
+        With query tracing active, the whole partition iteration is one
+        operator span (same name as the metric prefix; the NS_TIMING
+        caveat applies — children nest inside, and the span closes when
+        the consumer exhausts or abandons the iterator)."""
+        from .. import trace as qtrace
         from ..utils import tracing
         it = self.do_execute_partition(p)
-        while True:
-            t0 = time.perf_counter_ns()
-            try:
-                # metric-linked profiler range: the slice name in xprof is
-                # the same exec name collect_metrics() reports (the
-                # reference wraps operators in NVTX ranges the same way)
-                with tracing.op_range(self.name):
-                    batch = next(it)
-            except StopIteration:
+        with qtrace.span(self.name, kind="operator", partition=p) as sp:
+            rows = 0
+            while True:
+                t0 = time.perf_counter_ns()
+                try:
+                    # metric-linked profiler range: the slice name in
+                    # xprof is the same exec name collect_metrics()
+                    # reports (the reference wraps operators in NVTX
+                    # ranges the same way)
+                    with tracing.op_range(self.name):
+                        batch = next(it)
+                except StopIteration:
+                    self.metrics["opTime"].add(time.perf_counter_ns() - t0)
+                    if sp is not None:
+                        sp.attrs["rows"] = rows
+                    return
                 self.metrics["opTime"].add(time.perf_counter_ns() - t0)
-                return
-            self.metrics["opTime"].add(time.perf_counter_ns() - t0)
-            self.metrics["numOutputBatches"].add(1)
-            self.metrics["numOutputRows"].add_lazy(batch.num_rows)
-            yield batch
+                self.metrics["numOutputBatches"].add(1)
+                self.metrics["numOutputRows"].add_lazy(batch.num_rows)
+                if sp is not None:
+                    rows += int(batch.num_rows)
+                yield batch
 
     def collect_metrics(self, max_level: int = DEBUG) -> Dict[str, int]:
         """Aggregate this subtree's metrics up to a level (the

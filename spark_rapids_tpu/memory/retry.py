@@ -475,25 +475,31 @@ def _recover(cat: BufferCatalog, pin_snapshot, attempt: int,
     the store to spill, and back off while other semaphore holders drain
     (reference: the block/spill state transitions in RmmSpark's per-task
     state machine)."""
+    from ..trace import span as _trace_span
     cat.restore_pins(pin_snapshot)
     spill0 = cat.spilled_to_host + cat.spilled_to_disk
     cat.synchronous_spill(max(cat.device_used, 1))
-    _METRICS.note_spill(cat.spilled_to_host + cat.spilled_to_disk - spill0)
+    spilled = cat.spilled_to_host + cat.spilled_to_disk - spill0
+    _METRICS.note_spill(spilled)
     # bounded exponential backoff; release the admission semaphore across
-    # the sleep so concurrent tasks can finish and free device memory
+    # the sleep so concurrent tasks can finish and free device memory.
+    # The span makes retry stalls attributable on a query's timeline —
+    # "14 seconds" spent here is OOM pressure, not operator work.
     delay = min(0.001 * (1 << min(attempt, 6)), 0.05)
     t0 = time.perf_counter_ns()
-    depth = 0
-    if semaphore is not None:
-        depth = semaphore.held_depth()
-        for _ in range(depth):
-            semaphore.release_if_held()
-    try:
-        time.sleep(delay)
-    finally:
+    with _trace_span("retry.backoff", kind="retry", attempt=attempt,
+                     spillBytes=int(spilled)):
+        depth = 0
         if semaphore is not None:
+            depth = semaphore.held_depth()
             for _ in range(depth):
-                semaphore.acquire_if_necessary()
+                semaphore.release_if_held()
+        try:
+            time.sleep(delay)
+        finally:
+            if semaphore is not None:
+                for _ in range(depth):
+                    semaphore.acquire_if_necessary()
     _METRICS.note_block(time.perf_counter_ns() - t0)
 
 

@@ -114,6 +114,8 @@ class QueryAdmission:
         and retry — admission pressure queues, it does not fail the
         query."""
         import time
+
+        from ..trace import span as _trace_span
         from .catalog import OutOfBudgetError
         # an explicit reservation larger than the whole device budget
         # could never be satisfied — the wait loop would spin forever
@@ -121,13 +123,25 @@ class QueryAdmission:
         # accounting, not a guarantee of exclusive HBM)
         reserve_bytes = min(int(reserve_bytes), self._cat().device_limit)
         t0 = time.perf_counter_ns()
-        while not self._sem.acquire(timeout=poll_s):
-            if cancelled is not None and cancelled():
-                self._note_wait(t0)
-                raise AdmissionCancelledError(
-                    "cancelled while waiting for a collect slot")
+        # the admission wait is its own span: "where did this query's
+        # time go" must separate queueing behind other tenants from the
+        # query's own execution
+        # the admission wait is its own span, closed the moment the
+        # query is admitted: "where did this query's time go" must
+        # separate queueing behind other tenants from execution
+        wait_span = _trace_span("admission.wait", kind="admission",
+                                reserveBytes=int(reserve_bytes))
+        wait_span.__enter__()
+        wait_open = True
         reserved = 0
+        acquired_slot = False
         try:
+            while not self._sem.acquire(timeout=poll_s):
+                if cancelled is not None and cancelled():
+                    self._note_wait(t0)
+                    raise AdmissionCancelledError(
+                        "cancelled while waiting for a collect slot")
+            acquired_slot = True
             while reserve_bytes > 0:
                 if cancelled is not None and cancelled():
                     # count the aborted wait too: long waits are exactly
@@ -146,6 +160,8 @@ class QueryAdmission:
                     # retry framework takes over once it executes
                     time.sleep(poll_s)
             self._note_wait(t0)
+            wait_span.__exit__(None, None, None)
+            wait_open = False
             with self._lock:
                 self.admitted_count += 1
                 self.in_flight += 1
@@ -155,9 +171,12 @@ class QueryAdmission:
                 with self._lock:
                     self.in_flight -= 1
         finally:
+            if wait_open:
+                wait_span.__exit__(None, None, None)
             if reserved:
                 self._cat().unreserve(reserved)
-            self._sem.release()
+            if acquired_slot:
+                self._sem.release()
 
     def _note_wait(self, t0: int) -> None:
         import time

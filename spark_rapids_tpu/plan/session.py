@@ -23,6 +23,12 @@ class Session:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = RapidsTpuConf(conf)
         self.last_plan = None          # captured physical plan (exec tree)
+        #: shape fingerprint of the last prepared plan (None when the
+        #: plan cache is off or the plan is uncacheable) — the key the
+        #: observed-cost store records per-operator costs under
+        self.last_fingerprint: Optional[str] = None
+        #: query_id of the last collect (None when tracing is off)
+        self.last_query_id: Optional[str] = None
         #: how the serving caches treated the last query:
         #: {"plan": hit|miss|uncacheable: ..., "result": hit|miss|off|...}
         self.last_cache: Dict[str, str] = {}
@@ -52,6 +58,8 @@ class Session:
         plans and ICI mesh lowering. Returns ("interpret", None) when the
         query must run on the row interpreter, ("fallback", plan) for a
         CPU-topped plan, or ("exec", plan) for a device plan."""
+        from .. import trace as qtrace
+        self.last_fingerprint = None
         if not self.conf.sql_enabled:
             self.last_plan = None
             return "interpret", None
@@ -61,24 +69,31 @@ class Session:
             self.last_plan = Overrides(self.conf).plan(df.plan)
             return "interpret", None
         from ..config import SERVER_PLAN_CACHE_ENABLED
-        fp = None
-        if self.conf.get(SERVER_PLAN_CACHE_ENABLED.key):
-            from . import plancache
-            try:
-                fp = plancache.shape_fingerprint(
-                    df.plan, self.conf, encoded=self._encoded_plan(df))
-            except plancache.Uncacheable as e:
-                # never silent: the reason rides the cache-info surface
-                self.last_cache["plan"] = f"uncacheable: {e.reason}"
-            if fp is not None:
-                decisions = plancache.planning_cache().get(fp)
-                if decisions is not None:
-                    prepared = self._plan_from_decisions(df, decisions)
-                    if prepared is not None:
-                        plancache.metrics().note("plan_hits")
-                        self.last_cache["plan"] = "hit"
-                        return prepared
-        return self._plan_fresh(df, fp)
+        with qtrace.span("plan.prepare", kind="plan") as sp:
+            fp = None
+            if self.conf.get(SERVER_PLAN_CACHE_ENABLED.key):
+                from . import plancache
+                try:
+                    fp = plancache.shape_fingerprint(
+                        df.plan, self.conf, encoded=self._encoded_plan(df))
+                except plancache.Uncacheable as e:
+                    # never silent: the reason rides the cache-info surface
+                    self.last_cache["plan"] = f"uncacheable: {e.reason}"
+                self.last_fingerprint = fp
+                if fp is not None:
+                    decisions = plancache.planning_cache().get(fp)
+                    if decisions is not None:
+                        prepared = self._plan_from_decisions(df, decisions)
+                        if prepared is not None:
+                            plancache.metrics().note("plan_hits")
+                            self.last_cache["plan"] = "hit"
+                            if sp is not None:
+                                sp.attrs["planCache"] = "hit"
+                            return prepared
+            if sp is not None:
+                sp.attrs["planCache"] = "miss" if fp is not None \
+                    else "uncacheable"
+            return self._plan_fresh(df, fp)
 
     def _plan_fresh(self, df: DataFrame, fp: Optional[str]):
         """The uncached planning pipeline; when ``fp`` is set, the
@@ -180,6 +195,7 @@ class Session:
         fallback / cached serve) — an interpret collect after an exec one
         must report deltas against ITS OWN start, not the older exec
         watermark."""
+        from .. import trace as qtrace
         from ..exec.python_exec import _python_semaphore
         from ..memory.retry import metrics as _retry_metrics
         from ..shuffle.lineage import metrics as _lineage_metrics
@@ -190,28 +206,39 @@ class Session:
         self._lineage0 = _lineage_metrics().snapshot()
         self._sem_wait0 = _python_semaphore.wait_time_ns
         self._cache0 = plancache.metrics().snapshot()
+        self._trace0 = qtrace.metrics().snapshot()
 
     def try_cached_result(self, df: DataFrame) -> Optional[pa.Table]:
         """Serving-tier fast path: consult the result cache WITHOUT
         planning. Returns the cached table (bit-for-bit: the stored
         Arrow IPC bytes of the original run) or None; the computed key
         is kept so the collect() that follows stores under it."""
+        from .. import trace as qtrace
         from . import plancache
         self.last_cache = {}
         self._cached_serve = None
         self.last_result_ipc = b""
+        self.last_query_id = qtrace.current_query_id()
         self._watermark()
-        kd = self._result_cache_key(df)
-        self._rc_state = (df, kd)
-        if kd is None:
-            return None
-        entry = plancache.result_cache().get(kd[0])
-        if entry is None:
-            plancache.metrics().note("result_misses")
-            self.last_cache["result"] = "miss"
-            return None
-        plancache.metrics().note("result_hits")
-        self.last_cache["result"] = "hit"
+        with qtrace.span("resultCache.lookup", kind="cache") as sp:
+            kd = self._result_cache_key(df)
+            self._rc_state = (df, kd)
+            if kd is None:
+                if sp is not None:
+                    sp.attrs["outcome"] = \
+                        self.last_cache.get("result", "off")
+                return None
+            entry = plancache.result_cache().get(kd[0])
+            if entry is None:
+                plancache.metrics().note("result_misses")
+                self.last_cache["result"] = "miss"
+                if sp is not None:
+                    sp.attrs["outcome"] = "miss"
+                return None
+            plancache.metrics().note("result_hits")
+            self.last_cache["result"] = "hit"
+            if sp is not None:
+                sp.attrs["outcome"] = "hit"
         self.last_plan = None
         self._cached_serve = (list(entry.execs), list(entry.fell_back))
         #: the stored bytes, so the server can forward them verbatim
@@ -257,11 +284,15 @@ class Session:
 
     def _store_result(self, kd, result: pa.Table) -> pa.Table:
         if kd is not None:
+            from .. import trace as qtrace
             from ..config import SERVER_RESULT_CACHE_MAX_BYTES
             from ..server import protocol
             from . import plancache
             key, digests = kd
-            ipc = protocol.table_to_ipc(result)
+            with qtrace.span("serializer.pack", kind="serializer") as sp:
+                ipc = protocol.table_to_ipc(result)
+                if sp is not None:
+                    sp.attrs["bytes"] = len(ipc)
             # the server's reply body IS these bytes: publish them so a
             # cacheable miss serializes once, not once to store and once
             # to reply
@@ -280,7 +311,25 @@ class Session:
         """``_prepared`` lets a caller that already ran ``prepare(df)``
         (the plan server separates the bind phase from execution for
         its failure classification) hand the result in, so the planning
-        pipeline runs once per query."""
+        pipeline runs once per query. With ``trace.enabled`` and no
+        trace already active (the plan server opens its own around the
+        whole request), this collect opens one — spans land in the
+        process flight recorder and the conf'd JSONL sink."""
+        from .. import trace as qtrace
+        from ..config import TRACE_ENABLED
+        if qtrace.active() or not self.conf.get(TRACE_ENABLED.key):
+            return self._collect_inner(df, _prepared)
+        from ..config import TRACE_MAX_SPANS, TRACE_SINK_PATH
+        qid = qtrace.mint_query_id()
+        with qtrace.query_trace(
+                qid, component="session",
+                max_spans=int(self.conf.get(TRACE_MAX_SPANS.key)),
+                recorder=qtrace.flight_recorder(),
+                sink_path=str(self.conf.get(TRACE_SINK_PATH.key))):
+            return self._collect_inner(df, _prepared)
+
+    def _collect_inner(self, df: DataFrame, _prepared=None) -> pa.Table:
+        from .. import trace as qtrace
         state = self._rc_state
         if state is None or state[0] is not df:
             # in-process path: this collect opens the query (the server
@@ -294,10 +343,17 @@ class Session:
         kind, plan = _prepared if _prepared is not None \
             else self.prepare(df)
         if kind == "interpret":
-            return self._store_result(
-                kd, Interpreter(ansi=self.conf.ansi).execute(df.plan))
+            with qtrace.span("interpret", kind="execute"):
+                result = Interpreter(ansi=self.conf.ansi).execute(df.plan)
+            return self._store_result(kd, result)
         if kind == "fallback":
-            return self._store_result(kd, plan.interpret())
+            with qtrace.span("cpuFallback", kind="execute"):
+                result = plan.interpret()
+            # CPU-topped plans feed the cost store too: a measured
+            # host-side operator cost is exactly the comparison point
+            # an offload-decision CBO needs against the device path
+            self._note_costs(plan)
+            return self._store_result(kd, result)
         from ..exec.base import collect as collect_exec
         from ..memory.retry import apply_session_conf
         # install this session's retry/OOM-injection/oomDumpDir settings
@@ -305,9 +361,27 @@ class Session:
         # the metric watermarks were taken at query open in _watermark()
         apply_session_conf(self.conf)
         try:
-            return self._store_result(kd, collect_exec(plan))
+            with qtrace.span("execute", kind="execute"):
+                result = collect_exec(plan)
+            self._note_costs(plan)
+            return self._store_result(kd, result)
         finally:
             plan.close()    # free catalog-registered exchange/broadcast state
+
+    def _note_costs(self, plan) -> None:
+        """Fold the executed plan's per-operator metrics into the
+        observed-cost store under the query's shape fingerprint — the
+        measured feed AQE/CBO re-planning consumes. Requires a
+        fingerprint (plan cache on + cacheable shape) to key on."""
+        from ..config import (TRACE_COST_STORE_ALPHA,
+                              TRACE_COST_STORE_ENABLED)
+        if self.last_fingerprint is None or \
+                not self.conf.get(TRACE_COST_STORE_ENABLED.key):
+            return
+        from .. import trace as qtrace
+        qtrace.note_operator_costs(
+            self.last_fingerprint, plan,
+            alpha=float(self.conf.get(TRACE_COST_STORE_ALPHA.key)))
 
     def _mesh(self):
         """1-axis data-parallel mesh over the visible devices."""
@@ -431,6 +505,12 @@ class Session:
         from . import plancache
         emit_deltas("cache", plancache.metrics().snapshot(),
                     getattr(self, "_cache0", None))
+        # query-tracing counters (spans recorded/dropped, profiles,
+        # slow queries, cost observations) — the observability plane's
+        # own cost is itself observable
+        from .. import trace as qtrace
+        emit_deltas("trace", qtrace.metrics().snapshot(),
+                    getattr(self, "_trace0", None))
         return out
 
     def executed_exec_names(self) -> List[str]:

@@ -40,7 +40,8 @@ class PlanServerError(RuntimeError):
                  retryable: bool = False, unavailable: bool = False,
                  timeout: bool = False,
                  retry_after_ms: Optional[int] = None,
-                 fatal: bool = False):
+                 fatal: bool = False,
+                 query_id: Optional[str] = None):
         super().__init__(message)
         self.remote_traceback = remote_traceback
         self.retryable = retryable
@@ -48,6 +49,9 @@ class PlanServerError(RuntimeError):
         self.timeout = timeout
         self.retry_after_ms = retry_after_ms
         self.fatal = fatal
+        #: the query this failure belongs to (the client-minted id the
+        #: server echoes) — a fleet error is attributable to a request
+        self.query_id = query_id
 
 
 class PlanClient:
@@ -85,6 +89,12 @@ class PlanClient:
         self.last_cached: bool = False
         #: worker id that served the last collect (through a router)
         self.last_worker: str = ""
+        #: query identity of the last collect (minted HERE: the client
+        #: is where a query is born, so the id it carries across the
+        #: fleet is the client's) + the client-side leg of its timeline
+        self.last_query_id: str = ""
+        self.last_fingerprint: str = ""
+        self._last_client_profile: Optional[dict] = None
         try:
             self._connect()
         except BaseException:
@@ -154,7 +164,8 @@ class PlanClient:
                 unavailable=bool(reply.get("unavailable")),
                 timeout=bool(reply.get("timeout")),
                 retry_after_ms=reply.get("retry_after_ms"),
-                fatal=bool(reply.get("fatal")))
+                fatal=bool(reply.get("fatal")),
+                query_id=reply.get("query_id"))
         return reply, reply_body
 
     def _retrying_request(self, header: dict, body: bytes = b"",
@@ -206,20 +217,39 @@ class PlanClient:
         explicitly unbounded; None defers to
         spark.rapids.tpu.server.queryTimeoutMs. ``retries`` overrides
         the client's ``unavailable_retries`` for this one query."""
+        from .. import trace as qtrace
         if self._sock is None:
             self._reconnect()
-        doc = self._serialize(df)
-        header = {"msg": "plan", "mode": "collect", "plan": doc,
-                  "conf": conf or {}}
-        if timeout_ms is not None:
-            header["timeout_ms"] = int(timeout_ms)
-        reply, body = self._retrying_request(header, retries=retries)
+        # mint the query identity HERE: every span, error reply, and
+        # flight-recorder profile of this query — client, router,
+        # worker, shuffle peers — shares it
+        qid = qtrace.mint_query_id()
+        self.last_query_id = qid
+        tr = qtrace.QueryTrace(qid, component="client", max_spans=64)
+        try:
+            with qtrace.attached((tr, None)):
+                with qtrace.span("client.collect", kind="client"):
+                    with qtrace.span("client.serialize", kind="client"):
+                        doc = self._serialize(df)
+                    header = {"msg": "plan", "mode": "collect",
+                              "plan": doc, "conf": conf or {},
+                              "query_id": qid}
+                    if timeout_ms is not None:
+                        header["timeout_ms"] = int(timeout_ms)
+                    with qtrace.span("client.request", kind="client"):
+                        reply, body = self._retrying_request(
+                            header, retries=retries)
+        finally:
+            # a failed collect still leaves its client-side leg behind
+            # (the error names qid too, via PlanServerError.query_id)
+            self._last_client_profile = tr.finish()
         self.last_execs = reply.get("execs", [])
         self.last_fell_back = reply.get("fell_back", [])
         self.last_metrics = reply.get("metrics", {})
         self.last_cache = reply.get("cache", {})
         self.last_cached = bool(reply.get("cached"))
         self.last_worker = str(reply.get("worker", ""))
+        self.last_fingerprint = str(reply.get("fingerprint", ""))
         return protocol.ipc_to_table(body)
 
     def collect_catalyst(self, plan_json, tables: Optional[Dict[
@@ -278,6 +308,51 @@ class PlanClient:
             self._reconnect()
         reply, _ = self._request({"msg": "stats"})
         return reply["stats"]
+
+    def last_trace(self) -> Optional[dict]:
+        """The last collect's stitched timeline: this client's own leg
+        plus every profile the server (or router + the worker that
+        served it) flight-recorded under the same query_id. Returns
+        ``{"queryId", "profiles": [...]}`` — feed it to
+        tools/trace_viewer.py for Chrome/Perfetto trace-event JSON —
+        or None before any collect. Remote profiles exist only when
+        the session ran with spark.rapids.tpu.trace.enabled."""
+        if not self.last_query_id:
+            return None
+        if self._sock is None:
+            self._reconnect()
+        reply, _ = self._request({"msg": "trace",
+                                  "query_id": self.last_query_id})
+        profiles = list(reply.get("profiles") or [])
+        if self._last_client_profile is not None:
+            profiles.insert(0, self._last_client_profile)
+        return {"queryId": self.last_query_id, "profiles": profiles}
+
+    def trace_profiles(self, query_id: Optional[str] = None,
+                       last: int = 0) -> dict:
+        """Raw flight-recorder read: profiles (all, the most recent
+        ``last``, or one query_id) + recorder occupancy stats."""
+        if self._sock is None:
+            self._reconnect()
+        reply, _ = self._request({"msg": "trace",
+                                  "query_id": query_id or "",
+                                  "last": int(last)})
+        return {"profiles": reply.get("profiles", []),
+                "recorder": reply.get("recorder", {})}
+
+    def observed_costs(self, fingerprint: Optional[str] = None) -> dict:
+        """The server-side observed-cost store: per-(shape-fingerprint,
+        operator) wall/rows/bytes EWMAs (``fingerprint`` narrows to one
+        shape — e.g. ``last_fingerprint`` after a collect). Through a
+        router the per-worker stores are merged (highest observation
+        count wins per operator)."""
+        if self._sock is None:
+            self._reconnect()
+        header = {"msg": "trace", "what": "costs"}
+        if fingerprint:
+            header["fingerprint"] = fingerprint
+        reply, _ = self._request(header)
+        return reply.get("costs", {})
 
     def explain(self, df: DataFrame, conf: Optional[dict] = None) -> str:
         if self._sock is None:

@@ -57,7 +57,7 @@ import tempfile
 import threading
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 from ..config import (FLEET_ADMISSION_TIMEOUT_MS, FLEET_DRAIN_TIMEOUT_MS,
@@ -67,7 +67,10 @@ from ..config import (FLEET_ADMISSION_TIMEOUT_MS, FLEET_DRAIN_TIMEOUT_MS,
                       FLEET_VNODES, FLEET_WORKER_RETRIES, FLEET_WORKERS,
                       FLEET_RESULT_STORE_PATH, RapidsTpuConf,
                       SERVER_CONCURRENT_COLLECTS, SERVER_RESULT_CACHE_ENABLED,
-                      SERVER_RETRY_AFTER_MS)
+                      SERVER_RETRY_AFTER_MS, SERVER_TRACE_RECORDER_ENTRIES,
+                      SERVER_TRACE_SLOW_QUERY_MS, TRACE_ENABLED,
+                      TRACE_MAX_SPANS, TRACE_SINK_PATH)
+from .. import trace as qtrace
 from . import protocol
 
 _READY_RE = re.compile(r"listening on ([\d.]+):(\d+)")
@@ -596,10 +599,13 @@ class _RouterSession:
             try:
                 reply, reply_body = self.serve_one(header, body)
             except Exception as e:   # per-request isolation
-                reply, reply_body = (
-                    {"msg": "error",
-                     "error": f"{type(e).__name__}: {e}",
-                     "traceback": traceback.format_exc()}, b"")
+                reply = {"msg": "error",
+                         "error": f"{type(e).__name__}: {e}",
+                         "traceback": traceback.format_exc()}
+                # a fleet error names the query it belongs to
+                if header.get("query_id"):
+                    reply["query_id"] = str(header["query_id"])
+                reply_body = b""
             if not _RouterHandler._try_send(self.sock, reply, reply_body):
                 return
             if reply.get("fatal"):
@@ -669,9 +675,59 @@ class _RouterSession:
         if msg == "stats":
             return {"msg": "stats",
                     "stats": self.router.serving_stats()}, b""
+        if msg == "trace":
+            return self.serve_trace(header)
         if msg == "plan":
             return self.serve_plan(header)
         raise ValueError(f"unknown message {msg!r}")
+
+    def serve_trace(self, header: dict):
+        """The fleet's stitched-timeline read: the router's own
+        flight-recorder leg for the query, PLUS the leg of the worker
+        that served it (looked up in the query->worker LRU and fetched
+        over an admin connection). ``what=costs`` merges the per-worker
+        observed-cost stores instead (highest observation count wins
+        per operator)."""
+        router = self.router
+        if header.get("what") == "costs":
+            fp = header.get("fingerprint")
+            merged: Dict[str, Dict[str, dict]] = {}
+            for w in router.routable_workers():
+                try:
+                    reply = _admin_request(
+                        w.host, w.port,
+                        {"msg": "trace", "what": "costs",
+                         **({"fingerprint": fp} if fp else {})})
+                except (OSError, protocol.ProtocolError):
+                    continue    # net-ok: costs are best-effort reads
+                for fprint, ops in (reply.get("costs") or {}).items():
+                    if not ops:
+                        continue
+                    dst = merged.setdefault(fprint, {})
+                    for op, e in ops.items():
+                        if op not in dst or \
+                                e.get("count", 0) > \
+                                dst[op].get("count", 0):
+                            dst[op] = e
+            return {"msg": "trace_ack", "costs": merged}, b""
+        qid = header.get("query_id") or None
+        profiles = router.recorder.profiles(
+            qid, last=int(header.get("last", 0) or 0))
+        wid = router.worker_for_query(qid) if qid else None
+        if wid is not None:
+            with router._lock:
+                w = router.workers.get(wid)
+            if w is not None and w.alive():
+                try:
+                    reply = _admin_request(w.host, w.port,
+                                           {"msg": "trace",
+                                            "query_id": qid})
+                    profiles = profiles + list(
+                        reply.get("profiles") or [])
+                except (OSError, protocol.ProtocolError):
+                    pass    # net-ok: the router leg still answers
+        return {"msg": "trace_ack", "profiles": profiles,
+                "recorder": router.recorder.stats()}, b""
 
     def serve_table(self, header: dict, body: bytes):
         from ..plan import plancache
@@ -743,42 +799,67 @@ class _RouterSession:
             conf = RapidsTpuConf(dict(router.worker_conf, **self.conf,
                                       **(header.get("conf") or {})))
         except KeyError as e:
-            return {"msg": "error", "error": f"unknown config: {e}"}, b""
-        fp = router.fingerprint(header.get("plan"),
-                                {n: r["table"]
-                                 for n, r in self.tables.items()}, conf)
-        if header.get("mode") == "explain":
-            # no device work: route by fingerprint, skip admission
-            return self._attempt_on_ring(header, fp, admission=False,
-                                         t_open=t_open,
-                                         spent_ns_box=[0])
-        # --- tenant quota ---
-        try:
-            router.admission.open_plan(self.tenant)
-        except QuotaExceeded as e:
-            return {"msg": "error", "unavailable": True,
-                    "retryable": True,
-                    "retry_after_ms": router.retry_after_ms,
-                    "quota": True,
-                    "error": f"tenant quota: {e}"}, b""
-        try:
-            # worker round-trips AND admission-queue waits accumulate
-            # here; overhead = router CPU only (fingerprint, routing,
-            # framing), the number a "thin coordinator" must keep flat
-            spent_ns_box = [0]
-            reply, body = self._attempt_on_ring(
-                header, fp, admission=True, t_open=t_open,
-                spent_ns_box=spent_ns_box)
-            if reply.get("msg") == "result":
-                overhead = (time.perf_counter_ns() - t_open
-                            - spent_ns_box[0])
-                router.note_plan_served(reply.get("worker", ""),
-                                        overhead)
-                reply["router_overhead_ms"] = round(overhead / 1e6, 3)
-                reply["tenant"] = self.tenant
-            return reply, body
-        finally:
-            router.admission.close_plan(self.tenant)
+            reply = {"msg": "error", "error": f"unknown config: {e}"}
+            if header.get("query_id"):
+                reply["query_id"] = str(header["query_id"])
+            return reply, b""
+        # adopt the client-minted query identity (mint for bare
+        # clients) and stamp it into the forwarded header, so the
+        # worker's spans/errors and the router's own leg all share it
+        query_id = str(header.get("query_id") or qtrace.mint_query_id())
+        header["query_id"] = query_id
+        import contextlib
+        with contextlib.ExitStack() as _stack:
+            if conf.get(TRACE_ENABLED.key):
+                _stack.enter_context(qtrace.query_trace(
+                    query_id, component="router",
+                    max_spans=int(conf.get(TRACE_MAX_SPANS.key)),
+                    recorder=router.recorder,
+                    sink_path=str(conf.get(TRACE_SINK_PATH.key))))
+            with qtrace.span("router.fingerprint", kind="router"):
+                fp = router.fingerprint(
+                    header.get("plan"),
+                    {n: r["table"] for n, r in self.tables.items()},
+                    conf)
+            if header.get("mode") == "explain":
+                # no device work: route by fingerprint, skip admission
+                return self._attempt_on_ring(header, fp, admission=False,
+                                             t_open=t_open,
+                                             spent_ns_box=[0])
+            # --- tenant quota ---
+            try:
+                router.admission.open_plan(self.tenant)
+            except QuotaExceeded as e:
+                return {"msg": "error", "unavailable": True,
+                        "retryable": True,
+                        "retry_after_ms": router.retry_after_ms,
+                        "quota": True, "query_id": query_id,
+                        "error": f"tenant quota: {e}"}, b""
+            try:
+                # worker round-trips AND admission-queue waits
+                # accumulate here; overhead = router CPU only
+                # (fingerprint, routing, framing), the number a "thin
+                # coordinator" must keep flat
+                spent_ns_box = [0]
+                reply, body = self._attempt_on_ring(
+                    header, fp, admission=True, t_open=t_open,
+                    spent_ns_box=spent_ns_box)
+                if reply.get("msg") == "result":
+                    overhead = (time.perf_counter_ns() - t_open
+                                - spent_ns_box[0])
+                    router.note_plan_served(reply.get("worker", ""),
+                                            overhead)
+                    router.note_query_worker(query_id,
+                                             reply.get("worker", ""))
+                    reply["router_overhead_ms"] = round(overhead / 1e6,
+                                                        3)
+                    reply["tenant"] = self.tenant
+                elif reply.get("msg") == "error" and \
+                        not reply.get("query_id"):
+                    reply["query_id"] = query_id
+                return reply, body
+            finally:
+                router.admission.close_plan(self.tenant)
 
     def _attempt_on_ring(self, header: dict, fp: str, admission: bool,
                          t_open: int, spent_ns_box: List[int]):
@@ -808,6 +889,11 @@ class _RouterSession:
                 acquired = False
                 if admission:
                     t_adm = time.perf_counter_ns()
+                    adm_span = qtrace.span("router.admission",
+                                           kind="admission",
+                                           worker=w.wid,
+                                           tenant=self.tenant)
+                    adm_span.__enter__()
                     try:
                         router.admission.acquire(self.tenant, w.wid)
                         acquired = True
@@ -823,9 +909,13 @@ class _RouterSession:
                                  "retry_after_ms": router.retry_after_ms,
                                  "error": str(e)}, b"")
                     finally:
+                        adm_span.__exit__(None, None, None)
                         spent_ns_box[0] += \
                             time.perf_counter_ns() - t_adm
                 t_w = time.perf_counter_ns()
+                disp_span = qtrace.span("router.dispatch", kind="router",
+                                        worker=w.wid)
+                disp_span.__enter__()
                 try:
                     reply, body = self.backend(w).request(header)
                 except WorkerUnavailable as e:
@@ -851,6 +941,7 @@ class _RouterSession:
                                   f"{type(e).__name__}: {e}"}, b"")
                     continue
                 finally:
+                    disp_span.__exit__(None, None, None)
                     spent_ns_box[0] += time.perf_counter_ns() - t_w
                     if acquired:
                         router.admission.release(w.wid)
@@ -986,6 +1077,15 @@ class Router:
         self.spillovers = 0
         self._overhead_ns = deque(maxlen=8192)
 
+        # --- observability: the router's own flight recorder (its leg
+        # of each traced query's timeline) + which worker served which
+        # query_id, so the 'trace' op can fetch the worker's leg and
+        # answer ONE stitched timeline ---
+        self.recorder = qtrace.FlightRecorder(
+            capacity=int(tconf.get(SERVER_TRACE_RECORDER_ENTRIES.key)),
+            slow_query_ms=int(tconf.get(SERVER_TRACE_SLOW_QUERY_MS.key)))
+        self._served: "OrderedDict[str, str]" = OrderedDict()
+
         # --- frontend ---
         srv = _ThreadingRouterServer((host, port), _RouterHandler)
         srv.router = self                      # type: ignore
@@ -1070,6 +1170,21 @@ class Router:
         with self._lock:
             self.plans_routed += 1
             self._overhead_ns.append(overhead_ns)
+
+    def note_query_worker(self, query_id: str, wid: str) -> None:
+        """Remember which worker served a query_id (bounded LRU) so the
+        ``trace`` op can fetch that worker's flight-recorder leg."""
+        if not query_id:
+            return
+        with self._lock:
+            self._served[query_id] = wid
+            self._served.move_to_end(query_id)
+            while len(self._served) > 4096:
+                self._served.popitem(last=False)
+
+    def worker_for_query(self, query_id: str) -> Optional[str]:
+        with self._lock:
+            return self._served.get(query_id)
 
     def fingerprint(self, doc, tables, conf: RapidsTpuConf) -> str:
         """The plan-shape fingerprint, computed router-side. A plan the
@@ -1183,8 +1298,14 @@ class Router:
                 per_worker[w.wid] = None   # net-ok: stats are
                 #                            best-effort; null marks it
         return {
-            "schemaVersion": 1,
+            # v2: adds the `trace` block (the router's flight-recorder
+            # occupancy/slow/dropped counters; each worker's own trace
+            # block rides its per-worker stats below)
+            "schemaVersion": 2,
             "router": True,
+            "trace": {
+                "recorder": self.recorder.stats(),
+            },
             "server": {
                 "host": str(self.address[0]), "port": int(self.port),
                 "activeSessions": self.active_sessions,

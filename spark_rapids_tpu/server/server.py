@@ -219,8 +219,13 @@ class _Handler(socketserver.BaseRequestHandler):
                                   srv.shutting_down.is_set)
         except Exception as e:   # per-request isolation: report, keep conn
             srv.breaker.record_failure(e)
-            return {"msg": "error", "error": f"{type(e).__name__}: {e}",
-                    "traceback": traceback.format_exc()}, b""
+            reply = {"msg": "error", "error": f"{type(e).__name__}: {e}",
+                     "traceback": traceback.format_exc()}
+            # every error reply names the query it belongs to — a fleet
+            # failure must be attributable to a client request
+            if header.get("query_id"):
+                reply["query_id"] = str(header["query_id"])
+            return reply, b""
 
     def _serve_with_watchdog(self, header, body, tables, conf,
                              timeout_ms: int):
@@ -277,19 +282,28 @@ class _Handler(socketserver.BaseRequestHandler):
                     # handler's finally skips the release)
                     query.owns_admission = True
                     self._admission_transferred = True
-            return {"msg": "error", "fatal": True, "retryable": True,
-                    "timeout": True,
-                    "error": f"query exceeded its {timeout_ms}ms deadline; "
-                             f"cancelled — resubmit (possibly with a "
-                             f"larger timeout_ms)"}, b""
+            reply = {"msg": "error", "fatal": True, "retryable": True,
+                     "timeout": True,
+                     "error": f"query exceeded its {timeout_ms}ms "
+                              f"deadline; cancelled — resubmit (possibly "
+                              f"with a larger timeout_ms)"}
+            if header.get("query_id"):
+                # name the abandoned query: its trace (when enabled) is
+                # in the flight recorder under this id once the worker
+                # actually ends
+                reply["query_id"] = str(header["query_id"])
+            return reply, b""
         if "exc" in box:
             e = box["exc"]      # already breaker-classified by the worker
             # the exception was caught on the WORKER thread — format its
             # own traceback, not this handler thread's (empty) one
-            return {"msg": "error", "error": f"{type(e).__name__}: {e}",
-                    "retryable": isinstance(e, QueryCancelledError),
-                    "traceback": "".join(traceback.format_exception(
-                        type(e), e, e.__traceback__))}, b""
+            reply = {"msg": "error", "error": f"{type(e).__name__}: {e}",
+                     "retryable": isinstance(e, QueryCancelledError),
+                     "traceback": "".join(traceback.format_exception(
+                         type(e), e, e.__traceback__))}
+            if header.get("query_id"):
+                reply["query_id"] = str(header["query_id"])
+            return reply, b""
         return box["reply"]
 
     def _dispatch(self, header, body, tables, conf,
@@ -347,7 +361,24 @@ class _Handler(socketserver.BaseRequestHandler):
                 .invalidate_digest(digest) if digest else 0
             return {"msg": "table_ack", "name": name,
                     "invalidated": invalidated}, b""
+        if msg == "trace":
+            # the flight-recorder surface: profiles of recent queries
+            # (or one query_id), or the observed-cost store — the ops
+            # seam PlanClient.last_trace()/observed_costs() read
+            from .. import trace as qtrace
+            if header.get("what") == "costs":
+                store = qtrace.observed_costs()
+                fp = header.get("fingerprint")
+                costs = {fp: store.get(fp)} if fp else store.snapshot()
+                return {"msg": "trace_ack", "costs": costs}, b""
+            rec = srv.trace_recorder
+            return {"msg": "trace_ack",
+                    "profiles": rec.profiles(
+                        header.get("query_id") or None,
+                        last=int(header.get("last", 0) or 0)),
+                    "recorder": rec.stats()}, b""
         if msg == "plan":
+            from .. import trace as qtrace
             plan = plandoc.doc_to_plan(header["plan"], tables)
             df = DataFrame(plan)
             ses = Session(dict(conf, **(header.get("conf") or {})))
@@ -358,74 +389,107 @@ class _Handler(socketserver.BaseRequestHandler):
                 raise ValueError(f"unknown plan mode {mode!r}")
             if cancelled():
                 raise QueryCancelledError("query cancelled by the server")
-            # result-set cache first: a hit serves the stored IPC bytes
-            # verbatim — no planning, no admission, no device work
-            result = ses.try_cached_result(df)
-            cached = result is not None
-            if not cached:
-                # plan/bind, untagged: binding errors echo client-chosen
-                # names (a column literally called "...halted...") and
-                # must never reach the breaker's substring classifier
-                prepared = ses.prepare(df)
-                from ..memory.semaphore import AdmissionCancelledError
-                # interpret/fallback queries never touch the device:
-                # admit them through the slot (they still consume CPU)
-                # but reserve no HBM — a CPU-query stream must not spill
-                # device-resident state of concurrent device tenants
-                reserve = srv.query_reserve_for(df) \
-                    if prepared[0] == "exec" else 0
-                from ..shuffle import lineage
-                try:
-                    with srv.query_admission.admit(
-                            reserve, cancelled=cancelled), \
-                            lineage.cancel_scope(
-                                cancelled, exc=QueryCancelledError):
-                        # the test-only collect delay runs INSIDE the
-                        # admitted region so collectDelayMs holds a real
-                        # collect slot — deterministic admission
-                        # contention for the watchdog/serialization
-                        # tests (cancellation semantics are unchanged:
-                        # the delay loop polls the same cancel flag).
-                        # The lineage cancel scope makes stop()/watchdog
-                        # cancellation observable INSIDE a collect whose
-                        # exchange read is recomputing lost partitions:
-                        # the recompute loop polls the flag between
-                        # recoveries (and between retry attempts),
-                        # raises QueryCancelledError, and this admit
-                        # context releases the slot on unwind.
-                        self._check_cancel(cancelled, ses)
-                        try:
-                            result = ses.collect(df, _prepared=prepared)
-                        except Exception as e:
-                            if prepared[0] == "exec":
-                                # planning succeeded and the plan ran on
-                                # DEVICE — only these failures may reach
-                                # the breaker's fatal-marker
-                                # classification (interpreter/fallback
-                                # paths never touch the device)
-                                e._rtpu_exec_phase = True
-                            raise
-                except AdmissionCancelledError:
-                    raise QueryCancelledError(
-                        "query cancelled while waiting for admission")
-            # cached serves AND cacheable misses publish their IPC bytes
-            # on the session (one serialization per result, verbatim)
-            body_out = ses.last_result_ipc or protocol.table_to_ipc(result)
-            return ({"msg": "result",
-                     "rows": result.num_rows,
-                     "execs": ses.executed_exec_names(),
-                     "fell_back": ses.fell_back(),
-                     "cached": cached,
-                     # how each cache layer treated this query, plus the
-                     # admission the execution paid — the loadbench and
-                     # the acceptance counters read these
-                     "cache": dict(ses.last_cache),
-                     # operator metrics ride back to the driver the way
-                     # the reference posts SQLMetrics to the Spark UI
-                     "metrics": {k: int(v)
-                                 for k, v in ses.metrics().items()}},
-                    body_out)
+            # adopt the client-minted query identity (mint one for bare
+            # clients) and, when this session traces, open the span tree
+            # here so admission/cache/operator/transport spans all share
+            # it; the profile lands in this server's flight recorder
+            query_id = str(header.get("query_id") or
+                           qtrace.mint_query_id())
+            import contextlib
+            from ..config import (TRACE_ENABLED, TRACE_MAX_SPANS,
+                                  TRACE_SINK_PATH)
+            with contextlib.ExitStack() as _stack:
+                if ses.conf.get(TRACE_ENABLED.key):
+                    _stack.enter_context(qtrace.query_trace(
+                        query_id, component="server",
+                        max_spans=int(ses.conf.get(TRACE_MAX_SPANS.key)),
+                        recorder=srv.trace_recorder,
+                        sink_path=str(ses.conf.get(TRACE_SINK_PATH.key))))
+                return self._collect_plan(header, srv, ses, df,
+                                          cancelled, query_id)
         raise ValueError(f"unknown message {msg!r}")
+
+    def _collect_plan(self, header, srv, ses, df,
+                      cancelled: Callable[[], bool], query_id: str):
+        # result-set cache first: a hit serves the stored IPC bytes
+        # verbatim — no planning, no admission, no device work
+        result = ses.try_cached_result(df)
+        cached = result is not None
+        if not cached:
+            # plan/bind, untagged: binding errors echo client-chosen
+            # names (a column literally called "...halted...") and
+            # must never reach the breaker's substring classifier
+            prepared = ses.prepare(df)
+            from ..memory.semaphore import AdmissionCancelledError
+            # interpret/fallback queries never touch the device:
+            # admit them through the slot (they still consume CPU)
+            # but reserve no HBM — a CPU-query stream must not spill
+            # device-resident state of concurrent device tenants
+            reserve = srv.query_reserve_for(df) \
+                if prepared[0] == "exec" else 0
+            from ..shuffle import lineage
+            try:
+                with srv.query_admission.admit(
+                        reserve, cancelled=cancelled), \
+                        lineage.cancel_scope(
+                            cancelled, exc=QueryCancelledError):
+                    # the test-only collect delay runs INSIDE the
+                    # admitted region so collectDelayMs holds a real
+                    # collect slot — deterministic admission
+                    # contention for the watchdog/serialization
+                    # tests (cancellation semantics are unchanged:
+                    # the delay loop polls the same cancel flag).
+                    # The lineage cancel scope makes stop()/watchdog
+                    # cancellation observable INSIDE a collect whose
+                    # exchange read is recomputing lost partitions:
+                    # the recompute loop polls the flag between
+                    # recoveries (and between retry attempts),
+                    # raises QueryCancelledError, and this admit
+                    # context releases the slot on unwind.
+                    self._check_cancel(cancelled, ses)
+                    try:
+                        result = ses.collect(df, _prepared=prepared)
+                    except Exception as e:
+                        if prepared[0] == "exec":
+                            # planning succeeded and the plan ran on
+                            # DEVICE — only these failures may reach
+                            # the breaker's fatal-marker
+                            # classification (interpreter/fallback
+                            # paths never touch the device)
+                            e._rtpu_exec_phase = True
+                        raise
+            except AdmissionCancelledError:
+                raise QueryCancelledError(
+                    "query cancelled while waiting for admission")
+        # cached serves AND cacheable misses publish their IPC bytes
+        # on the session (one serialization per result, verbatim)
+        from ..trace import span as _trace_span
+        with _trace_span("serializer.reply", kind="serializer") as sp:
+            body_out = ses.last_result_ipc or \
+                protocol.table_to_ipc(result)
+            if sp is not None:
+                sp.attrs["bytes"] = len(body_out)
+        reply = {"msg": "result",
+                 "rows": result.num_rows,
+                 "execs": ses.executed_exec_names(),
+                 "fell_back": ses.fell_back(),
+                 "cached": cached,
+                 # the query identity every span/error of this request
+                 # shares (client-minted when the client sent one)
+                 "query_id": query_id,
+                 # how each cache layer treated this query, plus the
+                 # admission the execution paid — the loadbench and
+                 # the acceptance counters read these
+                 "cache": dict(ses.last_cache),
+                 # operator metrics ride back to the driver the way
+                 # the reference posts SQLMetrics to the Spark UI
+                 "metrics": {k: int(v)
+                             for k, v in ses.metrics().items()}}
+        if ses.last_fingerprint:
+            # lets a client ask the observed-cost store about exactly
+            # this query's shape (trace op, what="costs")
+            reply["fingerprint"] = ses.last_fingerprint
+        return reply, body_out
 
     @staticmethod
     def _check_cancel(cancelled: Callable[[], bool], ses: Session) -> None:
@@ -480,7 +544,9 @@ class PlanServer:
                               SERVER_MAX_SESSIONS,
                               SERVER_QUERY_RESERVE_BYTES,
                               SERVER_QUERY_TIMEOUT_MS,
-                              SERVER_RETRY_AFTER_MS)
+                              SERVER_RETRY_AFTER_MS,
+                              SERVER_TRACE_RECORDER_ENTRIES,
+                              SERVER_TRACE_SLOW_QUERY_MS)
         tconf = RapidsTpuConf(dict(conf or {}))
         srv = _ThreadingServer((host, port), _Handler)
         srv.base_conf = dict(conf or {})              # type: ignore
@@ -499,6 +565,14 @@ class PlanServer:
             tconf.get(SERVER_QUERY_RESERVE_BYTES.key))
         from ..memory.semaphore import QueryAdmission
         srv.query_admission = QueryAdmission(srv.concurrent_collects)
+        # this server's flight recorder: the bounded ring of recent
+        # query profiles + slow-query log the 'trace' wire op serves
+        # (per-server, not the process singleton — embedded test
+        # servers must not read each other's queries)
+        from ..trace import FlightRecorder
+        srv.trace_recorder = FlightRecorder(
+            capacity=int(tconf.get(SERVER_TRACE_RECORDER_ENTRIES.key)),
+            slow_query_ms=int(tconf.get(SERVER_TRACE_SLOW_QUERY_MS.key)))
         srv.breaker = CircuitBreaker(health_check, srv.retry_after_ms)
         srv.shutting_down = threading.Event()
         srv.track_lock = threading.Lock()
@@ -543,9 +617,16 @@ class PlanServer:
         ``server`` block, so every field here is load-bearing."""
         from ..plan import plancache
         from ..shuffle.lineage import metrics as lineage_metrics
+        from ..trace import observed_costs
         adm = self._server.query_admission
         return {
-            "schemaVersion": 1,
+            # v2: adds the `trace` block (flight-recorder occupancy,
+            # slow-query count, dropped spans, cost-store size)
+            "schemaVersion": 2,
+            "trace": {
+                "recorder": self._server.trace_recorder.stats(),
+                "costFingerprints": len(observed_costs()),
+            },
             "server": {
                 "host": str(self.address[0]),
                 "port": int(self.port),

@@ -332,14 +332,23 @@ class LineageRegistry:
             # multiply recovery wall-time exactly when the fleet is
             # degraded — siblings are verified and stashed for the
             # other reducers' recover() calls
+            from ..trace import span as _trace_span
             wanted = tuple(sorted(ent.blocks))
             prev = getattr(_TLS, "in_recovery", False)
             _TLS.in_recovery = True
             try:
-                out = with_retry_no_split(
-                    lambda: ent.recompute(wanted), catalog=catalog,
-                    name=f"lineage.recompute(s{shuffle_id})",
-                    cancelled=cancel[0] if cancel is not None else None)
+                # the recompute span carries the originating query's id
+                # through the active trace: a kill-mid-query recovery is
+                # attributable to the collect that paid for it
+                with _trace_span("lineage.recompute", kind="lineage",
+                                 block=f"s{shuffle_id}-m{map_id}-"
+                                       f"r{reduce_id}",
+                                 fragment=ent.input_digest):
+                    out = with_retry_no_split(
+                        lambda: ent.recompute(wanted), catalog=catalog,
+                        name=f"lineage.recompute(s{shuffle_id})",
+                        cancelled=cancel[0] if cancel is not None
+                        else None)
             except RetryCancelledError as ce:
                 raise (cancel[1] if cancel is not None
                        else RecomputeCancelledError)(str(ce)) from ce
@@ -438,12 +447,18 @@ def fetch_many_with_recovery(transport, ids, registry: LineageRegistry,
     # runs inside a recompute re-run (nested recovery), its recoveries
     # skip the recover lock the outer recovery already holds
     nested = in_active_recovery()
+    # pool fetch tasks inherit the consuming thread's trace context so
+    # their per-peer fetch spans carry the originating query_id (None —
+    # and free — when tracing is off)
+    from ..trace import attached, capture
+    tok = capture()
 
     def fetch_one(b):
-        try:
-            return transport.fetch(*b)
-        except (BlockMissingError, PeerUnreachableError) as ex:
-            return _NeedsRecovery(ex)
+        with attached(tok):
+            try:
+                return transport.fetch(*b)
+            except (BlockMissingError, PeerUnreachableError) as ex:
+                return _NeedsRecovery(ex)
 
     def stream():
         if nested:

@@ -133,6 +133,7 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
     # ------------------------------------------------------------------
 
     def _write_all(self) -> None:
+        from ..trace import call_attached, capture, span
         with self._write_lock:
             if self._written:
                 return
@@ -141,6 +142,10 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
             pool = cf.ThreadPoolExecutor(self.num_threads,
                                          thread_name_prefix="shuffle-write")
             futures = []
+            # writer-pool tasks inherit this thread's trace context so
+            # their serializer.pack / transport.replicate spans join the
+            # query's tree (tok is None — and the shim free — untraced)
+            tok = capture()
             # map_id identifies one INPUT BATCH (child partition cp,
             # batch index bi) — the recompute unit: lineage re-executes
             # that fragment ONCE and re-slices every lost reduce
@@ -151,27 +156,31 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
                 # an empty shuffle behind a dead peer must read as
                 # provably empty, not fail its listing
                 self._lineage.register_shuffle(self.shuffle_id)
-            m = 0
-            for cp in range(self.child.num_partitions):
-                bi = 0
-                for batch in self.child.execute_partition(cp):
-                    if self._lineage is not None:
-                        self._lineage.register_fragment(
-                            self.shuffle_id, m,
-                            self._make_recompute(cp, bi),
-                            input_digest=self._fragment_digest(cp, bi))
-                    pids = self._pids_jit(batch)
-                    for p in range(n):
-                        piece = self._slice_jit(batch, pids, p)
-                        if int(piece.num_rows) == 0:
-                            continue
-                        futures.append(pool.submit(
-                            self._write_piece, piece, schema, m, p))
-                    m += 1
-                    bi += 1
-            for f in futures:
-                f.result()
-            pool.shutdown()
+            with span("shuffle.write", kind="shuffle",
+                      shuffleId=self.shuffle_id):
+                m = 0
+                for cp in range(self.child.num_partitions):
+                    bi = 0
+                    for batch in self.child.execute_partition(cp):
+                        if self._lineage is not None:
+                            self._lineage.register_fragment(
+                                self.shuffle_id, m,
+                                self._make_recompute(cp, bi),
+                                input_digest=self._fragment_digest(
+                                    cp, bi))
+                        pids = self._pids_jit(batch)
+                        for p in range(n):
+                            piece = self._slice_jit(batch, pids, p)
+                            if int(piece.num_rows) == 0:
+                                continue
+                            futures.append(pool.submit(
+                                call_attached, tok, self._write_piece,
+                                piece, schema, m, p))
+                        m += 1
+                        bi += 1
+                for f in futures:
+                    f.result()
+                pool.shutdown()
             self._written = True
 
     def _fragment_digest(self, cp: int, bi: int) -> str:
@@ -270,6 +279,7 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         # with_retry) and resumes bit-for-bit instead of raising; the
         # server's cancel flag (stop()/watchdog) is captured HERE on the
         # query thread and polled by the recovery loop.
+        from ..trace import span
         if self._lineage is not None:
             from .lineage import current_cancel, fetch_many_with_recovery
             fetched = fetch_many_with_recovery(
@@ -280,8 +290,10 @@ class MultithreadedShuffleExchangeExec(UnaryExec):
         else:
             fetched = self.read_transport.fetch_many(
                 blocks, max_in_flight=self.max_in_flight_fetches)
-        batches = [deserialize_batch(data, schema)
-                   for _, data in fetched]
+        with span("shuffle.read", kind="shuffle", partition=p,
+                  blocks=len(blocks)):
+            batches = [deserialize_batch(data, schema)
+                       for _, data in fetched]
         total = sum(int(b.num_rows) for b in batches)
         if total == 0:
             return

@@ -233,7 +233,12 @@ def serialize_batch(batch: ColumnarBatch, schema: Schema,
     table, then frame directly from it (reference: the serialize-once
     contiguous-split + JCudfSerialization write path,
     GpuPartitioning.scala:52)."""
-    return frame_packed(pack_batch(batch), codec)
+    from ..trace import span as _trace_span
+    with _trace_span("serializer.pack", kind="serializer") as sp:
+        data = frame_packed(pack_batch(batch), codec)
+        if sp is not None:
+            sp.attrs["bytes"] = len(data)
+        return data
 
 
 def iter_framed(batches, codec: Optional[str] = None,
@@ -263,8 +268,12 @@ def iter_framed(batches, codec: Optional[str] = None,
 
 def deserialize_batch(data: bytes, schema: Schema) -> ColumnarBatch:
     import jax.numpy as jnp
-    arrays, num_rows = deserialize_host(data)
-    cols: List[DeviceColumn] = []
-    for i, f in enumerate(schema):
-        cols.append(_col_from_arrays(f.dtype, str(i), arrays))
-    return ColumnarBatch(tuple(cols), jnp.asarray(num_rows, jnp.int32))
+
+    from ..trace import span as _trace_span
+    with _trace_span("serializer.unpack", kind="serializer",
+                     bytes=len(data)):
+        arrays, num_rows = deserialize_host(data)
+        cols: List[DeviceColumn] = []
+        for i, f in enumerate(schema):
+            cols.append(_col_from_arrays(f.dtype, str(i), arrays))
+        return ColumnarBatch(tuple(cols), jnp.asarray(num_rows, jnp.int32))

@@ -570,11 +570,14 @@ class TcpTransport(ShuffleTransport):
         retry wait) — full jitter in [base/2, base] * 2^(attempt-1)."""
         if self.backoff_base_s <= 0:
             return
+        from ..trace import span as _trace_span
         delay = min(self.backoff_base_s * (1 << min(attempt - 1, 10)),
                     self.backoff_max_s)
         delay *= 0.5 + random.random() * 0.5
         t0 = time.perf_counter_ns()
-        time.sleep(delay)
+        with _trace_span("transport.backoff", kind="transport",
+                         attempt=attempt):
+            time.sleep(delay)
         _METRICS.note_backoff(time.perf_counter_ns() - t0)
 
     def _retrying(self, addr, fn, *args):
@@ -622,28 +625,50 @@ class TcpTransport(ShuffleTransport):
         blk = self._local.get((s, m, r))
         if blk is not None:
             return blk
+        from ..trace import span as _trace_span
         missing: List[Exception] = []
         failed: List[Exception] = []
-        for peer_id, addr in self._ordered_peers():
-            try:
-                data = self._retrying(addr, self._fetch_from, s, m, r)
-                # a suspect that served the block is rehabilitated NOW —
-                # later fetches order it normally again instead of
-                # waiting out suspect_ttl_s
-                self._note_reachable(addr)
-                return data
-            except BlockMissingError as ex:
-                # a MISSING answer is still a completed round trip: the
-                # peer is alive, just not holding this block
-                self._note_reachable(addr)
-                missing.append(ex)
-            except PeerUnreachableError as ex:
-                self._note_unreachable(peer_id, addr)
-                _METRICS.note_failover()
-                failed.append(ex)
-            except TransportError as ex:    # corrupt past the budget
-                _METRICS.note_failover()
-                failed.append(ex)
+        with _trace_span("transport.fetch", kind="transport",
+                         block=f"s{s}-m{m}-r{r}") as fsp:
+            for peer_id, addr in self._ordered_peers():
+                # per-peer sub-span: a failover shows as one failed peer
+                # attempt next to the successful one, with the backoff
+                # sleeps (transport.backoff) nested inside
+                with _trace_span("transport.peer", kind="transport",
+                                 peer=f"{addr[0]}:{addr[1]}") as psp:
+                    try:
+                        data = self._retrying(addr, self._fetch_from,
+                                              s, m, r)
+                        # a suspect that served the block is
+                        # rehabilitated NOW — later fetches order it
+                        # normally again instead of waiting out
+                        # suspect_ttl_s
+                        self._note_reachable(addr)
+                        if psp is not None:
+                            psp.attrs["outcome"] = "served"
+                            psp.attrs["bytes"] = len(data)
+                        if fsp is not None:
+                            fsp.attrs["bytes"] = len(data)
+                        return data
+                    except BlockMissingError as ex:
+                        # a MISSING answer is still a completed round
+                        # trip: the peer is alive, just not holding
+                        # this block
+                        self._note_reachable(addr)
+                        missing.append(ex)
+                        if psp is not None:
+                            psp.attrs["outcome"] = "missing"
+                    except PeerUnreachableError as ex:
+                        self._note_unreachable(peer_id, addr)
+                        _METRICS.note_failover()
+                        failed.append(ex)
+                        if psp is not None:
+                            psp.attrs["outcome"] = "unreachable"
+                    except TransportError as ex:  # corrupt past budget
+                        _METRICS.note_failover()
+                        failed.append(ex)
+                        if psp is not None:
+                            psp.attrs["outcome"] = "corrupt"
         if failed:
             if all(isinstance(ex, BlockCorruptError) for ex in failed):
                 # every serving peer is reachable but the bytes keep
@@ -792,17 +817,21 @@ class TcpTransport(ShuffleTransport):
         if peers is None or now - ts > 1.0:
             peers = self._ordered_peers()
             self._replicate_peers_memo = (now, peers)
+        from ..trace import span as _trace_span
         written = 0
         for peer_id, addr in peers:
             if written >= k:
                 break
-            try:
-                self._retrying(addr, self._put_to, s, m, r, payload)
-            except PeerUnreachableError:
-                self._note_unreachable(peer_id, addr)
-                continue
-            except TransportError:
-                continue
+            with _trace_span("transport.replicate", kind="transport",
+                             peer=f"{addr[0]}:{addr[1]}",
+                             bytes=len(payload)):
+                try:
+                    self._retrying(addr, self._put_to, s, m, r, payload)
+                except PeerUnreachableError:
+                    self._note_unreachable(peer_id, addr)
+                    continue
+                except TransportError:
+                    continue
             self._note_reachable(addr)
             with self._lock:
                 # remember who holds replicas of this shuffle, so
@@ -824,9 +853,15 @@ class TcpTransport(ShuffleTransport):
         read) — one dead peer degrades the latency of the blocks only
         it held, instead of aborting the whole exchange read."""
         from ..io.source import bounded_map, reader_pool
+        from ..trace import call_attached, capture
         pool = reader_pool(max(2, max_in_flight))
+        # pool workers inherit the consuming thread's trace context so
+        # per-peer fetch spans land in the query's tree (token is None —
+        # and the shim free — when tracing is off)
+        tok = capture()
         yield from bounded_map(pool, list(ids),
-                               lambda b: self.fetch(*b), max_in_flight,
+                               lambda b: call_attached(
+                                   tok, self.fetch, *b), max_in_flight,
                                force_parallel=True)
 
     def close(self) -> None:

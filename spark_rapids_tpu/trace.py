@@ -1,0 +1,573 @@
+"""Query tracing: end-to-end span timelines, a flight recorder, and
+observed per-operator costs.
+
+The engine counts everything (per-collect ``retry.*``/``net.*``/
+``lineage.*``/``cache.*`` deltas, ``serving_stats()`` at the fleet tier)
+but until this layer it could not answer "where did *this* query's time
+go": there was no query identity stitched across client → router →
+worker → shuffle peers, and no per-operator timeline. Theseus
+(PAPERS.md) argues a distributed query platform lives or dies on knowing
+where data movement and compute overlap — you cannot tune overlap you
+cannot see — and the GPU-offloading cost models in PAPERS.md need
+*measured*, not modeled, per-operator costs. Three surfaces:
+
+1. **Span tree per collect** — a ``query_id`` minted at the client (or
+   at query open) and propagated through the plan/router wire headers,
+   recompute closures, and replicated-fetch peers. Spans wrap admission
+   wait, cache lookups, per-operator execution, serializer pack/unpack,
+   per-peer transport fetches (with failover/backoff sub-spans), and
+   lineage recomputes. ``span()`` is a no-op costing one thread-local
+   read when no trace is active, so the off path stays untouched;
+   tracing NEVER changes results (the differential suite proves
+   bit-for-bit equality with it on).
+
+2. **Flight recorder** — a bounded ring of the last N query profiles
+   plus a slow-query log (``server.trace.slowQueryMs``), held by the
+   plan server / router and exposed over the ``trace`` wire op; plus a
+   conf-gated JSONL sink (``trace.sink.path``) that
+   ``tools/trace_viewer.py`` renders as Chrome/Perfetto trace-event
+   JSON — a fleet query becomes one stitched timeline.
+
+3. **Observed-cost store** — per-(shape-fingerprint, operator)
+   wall/rows/bytes EWMAs recorded at collect close from the existing
+   exec metric hooks, living next to the PR-10 planning cache. This is
+   the empirical feed the AQE/CBO re-planning loop (ROADMAP item 3)
+   consumes: speedup scores become measured, not modeled.
+
+Clock model: every span carries a wall-clock ``tsUs`` (time.time_ns at
+open) and a monotonic ``durUs`` (perf_counter delta). Stitching across
+processes relies on a shared host clock; cross-host skew shifts whole
+process tracks, never distorts durations (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# metrics (process-wide; Session.metrics() reports `trace.*` deltas the
+# way the retry/net/lineage/cache groups do)
+# ---------------------------------------------------------------------------
+
+
+class TraceMetrics:
+    """Process-wide tracing counters; sessions report deltas."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.span_count = 0
+        self.dropped_span_count = 0
+        self.profile_count = 0
+        self.slow_query_count = 0
+        self.cost_observation_count = 0
+
+    def note(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "spanCount": self.span_count,
+                "droppedSpanCount": self.dropped_span_count,
+                "profileCount": self.profile_count,
+                "slowQueryCount": self.slow_query_count,
+                "costObservationCount": self.cost_observation_count,
+            }
+
+
+_METRICS = TraceMetrics()
+
+
+def metrics() -> TraceMetrics:
+    return _METRICS
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One timed section of a query. Durations are monotonic
+    (perf_counter); ``ts_us`` is the wall-clock open instant used to
+    stitch process tracks together."""
+
+    __slots__ = ("span_id", "parent_id", "name", "kind", "ts_us",
+                 "t0_ns", "dur_us", "attrs")
+
+    def __init__(self, span_id: int, parent_id: Optional[int], name: str,
+                 kind: str, attrs: Dict[str, Any]):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind
+        self.ts_us = time.time_ns() // 1000
+        self.t0_ns = time.perf_counter_ns()
+        self.dur_us: Optional[int] = None    # None while open
+        self.attrs = attrs
+
+    def to_dict(self) -> dict:
+        d = {"id": self.span_id, "parent": self.parent_id,
+             "name": self.name, "kind": self.kind, "tsUs": self.ts_us,
+             "durUs": self.dur_us if self.dur_us is not None else 0}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        return d
+
+
+class QueryTrace:
+    """Thread-safe span tree of one query. Span ids are allocated under
+    a lock so producer threads (writer pools, fetch pools, recompute)
+    append concurrently; the per-thread parent chain lives in the
+    activation thread-local, not here. Span count is bounded
+    (``trace.maxSpansPerQuery``): past the cap spans are counted as
+    dropped instead of growing without bound."""
+
+    def __init__(self, query_id: str, component: str = "engine",
+                 max_spans: int = 2048):
+        self.query_id = query_id
+        self.component = component
+        self.max_spans = max(1, int(max_spans))
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._open: Dict[int, Span] = {}
+        self._next_id = 1
+        self.dropped = 0
+        self.ts_us = time.time_ns() // 1000
+        self._t0_ns = time.perf_counter_ns()
+        self.dur_us = 0
+
+    def open_span(self, name: str, kind: str, parent_id: Optional[int],
+                  attrs: Dict[str, Any]) -> Optional[Span]:
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self.dropped += 1
+                _METRICS.note("dropped_span_count")
+                return None
+            s = Span(self._next_id, parent_id, name, kind, attrs)
+            self._next_id += 1
+            self._spans.append(s)
+            self._open[s.span_id] = s
+        _METRICS.note("span_count")
+        return s
+
+    def close_span(self, s: Span) -> None:
+        dur = (time.perf_counter_ns() - s.t0_ns) // 1000
+        with self._lock:
+            if self._open.pop(s.span_id, None) is not None:
+                s.dur_us = dur
+
+    def finish(self) -> dict:
+        """Close every still-open span (an abandoned iterator never
+        exhausts its operator span) and return the profile dict."""
+        end = time.perf_counter_ns()
+        with self._lock:
+            for s in self._open.values():
+                s.dur_us = (end - s.t0_ns) // 1000
+            self._open.clear()
+            self.dur_us = (end - self._t0_ns) // 1000
+            return self.profile_locked()
+
+    def profile(self) -> dict:
+        with self._lock:
+            return self.profile_locked()
+
+    def profile_locked(self) -> dict:
+        return {
+            "queryId": self.query_id,
+            "component": self.component,
+            "tsUs": self.ts_us,
+            "durUs": self.dur_us or
+            (time.perf_counter_ns() - self._t0_ns) // 1000,
+            "droppedSpans": self.dropped,
+            "spans": [s.to_dict() for s in self._spans],
+        }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+# ---------------------------------------------------------------------------
+# thread-local activation + cross-thread propagation
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+def mint_query_id() -> str:
+    """A fresh query identity — minted at the client and propagated in
+    the wire headers, so every process a query touches logs the same
+    id."""
+    return uuid.uuid4().hex[:16]
+
+
+def active() -> bool:
+    return getattr(_TLS, "trace", None) is not None
+
+
+def current_trace() -> Optional[QueryTrace]:
+    return getattr(_TLS, "trace", None)
+
+
+def current_query_id() -> Optional[str]:
+    tr = getattr(_TLS, "trace", None)
+    return tr.query_id if tr is not None else None
+
+
+class _Noop:
+    """Shared reusable no-op context manager: the whole cost of a span
+    site with tracing off is one thread-local read + this return."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class _SpanCm:
+    __slots__ = ("_trace", "_span", "_name", "_kind", "_attrs")
+
+    def __init__(self, trace: QueryTrace, name: str, kind: str,
+                 attrs: Dict[str, Any]):
+        self._trace = trace
+        self._name = name
+        self._kind = kind
+        self._attrs = attrs
+        self._span = None
+
+    def __enter__(self):
+        stack = getattr(_TLS, "stack", None)
+        parent = stack[-1] if stack else None
+        s = self._trace.open_span(self._name, self._kind, parent,
+                                  self._attrs)
+        self._span = s
+        if s is not None:
+            if stack is None:
+                stack = _TLS.stack = []
+            stack.append(s.span_id)
+        return s
+
+    def __exit__(self, *exc):
+        s = self._span
+        if s is not None:
+            stack = getattr(_TLS, "stack", None)
+            if stack and stack[-1] == s.span_id:
+                stack.pop()
+            elif stack is not None:
+                try:                        # out-of-order close (rare:
+                    stack.remove(s.span_id)  # interleaved generators)
+                except ValueError:
+                    pass
+            self._trace.close_span(s)
+        return False
+
+
+def span(name: str, kind: str = "span", **attrs):
+    """Open a child span of the calling thread's current span. With no
+    active trace this is a shared no-op — safe on every hot path."""
+    tr = getattr(_TLS, "trace", None)
+    if tr is None:
+        return _NOOP
+    return _SpanCm(tr, name, kind, attrs)
+
+
+def capture() -> Optional[Tuple[QueryTrace, Optional[int]]]:
+    """Snapshot (trace, current span id) for handoff to a pool thread;
+    None with no active trace."""
+    tr = getattr(_TLS, "trace", None)
+    if tr is None:
+        return None
+    stack = getattr(_TLS, "stack", None)
+    return (tr, stack[-1] if stack else None)
+
+
+@contextmanager
+def attached(token: Optional[Tuple[QueryTrace, Optional[int]]]):
+    """Activate a captured trace context on THIS thread (writer pools,
+    fetch pools, recompute runners) so their spans land in the right
+    tree under the right parent. No-op for a None token."""
+    if token is None:
+        yield
+        return
+    prev_tr = getattr(_TLS, "trace", None)
+    prev_stack = getattr(_TLS, "stack", None)
+    _TLS.trace = token[0]
+    _TLS.stack = [token[1]] if token[1] is not None else []
+    try:
+        yield
+    finally:
+        _TLS.trace = prev_tr
+        _TLS.stack = prev_stack
+
+
+def call_attached(token, fn: Callable, *args, **kwargs):
+    """Run ``fn`` under ``attached(token)`` — the pool.submit shim."""
+    with attached(token):
+        return fn(*args, **kwargs)
+
+
+@contextmanager
+def query_trace(query_id: Optional[str] = None,
+                component: str = "engine",
+                max_spans: int = 2048,
+                recorder: Optional["FlightRecorder"] = None,
+                sink_path: str = ""):
+    """Open (and activate) a trace for one query on this thread; on
+    exit, finish it and hand the profile to ``recorder`` and the JSONL
+    ``sink_path`` when given. Yields the QueryTrace."""
+    tr = QueryTrace(query_id or mint_query_id(), component=component,
+                    max_spans=max_spans)
+    prev_tr = getattr(_TLS, "trace", None)
+    prev_stack = getattr(_TLS, "stack", None)
+    _TLS.trace = tr
+    _TLS.stack = []
+    try:
+        with span("query", kind="query"):
+            yield tr
+    finally:
+        _TLS.trace = prev_tr
+        _TLS.stack = prev_stack
+        profile = tr.finish()
+        _METRICS.note("profile_count")
+        if recorder is not None:
+            recorder.record(profile)
+        if sink_path:
+            sink_profile(sink_path, profile)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded in-memory ring of the last N query profiles plus a
+    slow-query log (queries over ``slow_query_ms``). The plan server and
+    the router each own one; the process singleton serves in-process
+    sessions and tools. ``stats()`` is the ``serving_stats()`` trace
+    block."""
+
+    def __init__(self, capacity: int = 128, slow_query_ms: int = 1000):
+        self._lock = threading.Lock()
+        self.capacity = max(1, int(capacity))
+        self.slow_query_ms = int(slow_query_ms)
+        self._ring: "deque[dict]" = deque(maxlen=self.capacity)
+        self._slow: "deque[dict]" = deque(maxlen=self.capacity)
+        self.recorded = 0
+        self.slow_queries = 0
+        self.dropped_spans = 0
+
+    def record(self, profile: dict) -> None:
+        with self._lock:
+            self._ring.append(profile)
+            self.recorded += 1
+            self.dropped_spans += int(profile.get("droppedSpans", 0))
+            if self.slow_query_ms > 0 and \
+                    profile.get("durUs", 0) >= self.slow_query_ms * 1000:
+                self._slow.append(profile)
+                self.slow_queries += 1
+                _METRICS.note("slow_query_count")
+
+    def profiles(self, query_id: Optional[str] = None,
+                 last: int = 0) -> List[dict]:
+        """Profiles for one query id, or the most recent ``last`` (0 =
+        all) in arrival order."""
+        with self._lock:
+            if query_id is not None:
+                return [p for p in self._ring
+                        if p.get("queryId") == query_id]
+            out = list(self._ring)
+        return out[-last:] if last > 0 else out
+
+    def slow(self) -> List[dict]:
+        with self._lock:
+            return list(self._slow)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._ring),
+                    "capacity": self.capacity,
+                    "recorded": self.recorded,
+                    "slowQueries": self.slow_queries,
+                    "slowQueryMs": self.slow_query_ms,
+                    "droppedSpans": self.dropped_spans}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._slow.clear()
+
+
+_RECORDER: Optional[FlightRecorder] = None
+_SINGLETON_LOCK = threading.Lock()
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-wide recorder (in-process sessions and tools record
+    here; a PlanServer/Router owns its own instance)."""
+    global _RECORDER
+    with _SINGLETON_LOCK:
+        if _RECORDER is None:
+            _RECORDER = FlightRecorder()
+        return _RECORDER
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink
+# ---------------------------------------------------------------------------
+
+_SINK_LOCK = threading.Lock()
+
+
+def sink_profile(path: str, profile: dict) -> None:
+    """Append one profile as a JSON line (``trace.sink.path``). Sink
+    failures never fail the query — tracing is observability, not the
+    data path."""
+    try:
+        line = json.dumps(profile, separators=(",", ":"),
+                          default=str) + "\n"
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with _SINK_LOCK:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(line)
+    except OSError:  # robust-ok: best-effort sink, documented contract
+        pass
+
+
+# ---------------------------------------------------------------------------
+# observed-cost store (the AQE feed, next to the PR-10 planning cache)
+# ---------------------------------------------------------------------------
+
+
+class ObservedCostStore:
+    """Per-(shape-fingerprint, operator) EWMAs of observed wall time,
+    rows, and bytes — recorded at collect close from the exec metric
+    roll-up, so the CBO's speedup scores (ROADMAP item 3) can consult
+    measured reality instead of its static model. LRU-bounded by
+    fingerprint; an entry's ``count`` says how many collects fed it."""
+
+    def __init__(self, max_fingerprints: int = 1024, alpha: float = 0.2):
+        self._lock = threading.Lock()
+        self.max_fingerprints = max(1, int(max_fingerprints))
+        self.alpha = float(alpha)
+        #: fp -> {op: {"wallNs","rows","bytes","count"}}
+        self._fps: "OrderedDict[str, Dict[str, dict]]" = OrderedDict()
+
+    def observe(self, fingerprint: str, op: str, wall_ns: int,
+                rows: int = 0, nbytes: int = 0,
+                alpha: Optional[float] = None) -> None:
+        a = self.alpha if alpha is None else float(alpha)
+        with self._lock:
+            ops = self._fps.get(fingerprint)
+            if ops is None:
+                ops = self._fps[fingerprint] = {}
+            self._fps.move_to_end(fingerprint)
+            e = ops.get(op)
+            if e is None:
+                ops[op] = {"wallNs": float(wall_ns), "rows": float(rows),
+                           "bytes": float(nbytes), "count": 1}
+            else:
+                e["wallNs"] += a * (wall_ns - e["wallNs"])
+                e["rows"] += a * (rows - e["rows"])
+                e["bytes"] += a * (nbytes - e["bytes"])
+                e["count"] += 1
+            while len(self._fps) > self.max_fingerprints:
+                self._fps.popitem(last=False)
+        _METRICS.note("cost_observation_count")
+
+    def get(self, fingerprint: str) -> Dict[str, dict]:
+        """{op: {"wallNs","rows","bytes","count"}} — empty when this
+        fingerprint was never observed."""
+        with self._lock:
+            ops = self._fps.get(fingerprint)
+            return {op: dict(e) for op, e in ops.items()} if ops else {}
+
+    def fingerprints(self) -> List[str]:
+        with self._lock:
+            return list(self._fps)
+
+    def snapshot(self) -> Dict[str, Dict[str, dict]]:
+        with self._lock:
+            return {fp: {op: dict(e) for op, e in ops.items()}
+                    for fp, ops in self._fps.items()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._fps.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._fps)
+
+
+_COSTS: Optional[ObservedCostStore] = None
+
+
+def observed_costs() -> ObservedCostStore:
+    global _COSTS
+    with _SINGLETON_LOCK:
+        if _COSTS is None:
+            _COSTS = ObservedCostStore()
+        return _COSTS
+
+
+def note_operator_costs(fingerprint: Optional[str], plan,
+                        alpha: Optional[float] = None) -> None:
+    """Fold one executed plan's per-operator metrics into the store:
+    wall from ``opTime`` (the NS_TIMING convention: time inside the
+    operator's iterator), rows from ``numOutputRows``, bytes from any
+    declared ``*Bytes`` metric the exec emitted. The walk includes
+    ``child_execs`` refs (exchange inputs, CPU-fallback islands) that
+    ``collect_metrics``'s plain-children walk misses — a CPU-topped
+    plan's measured host costs are exactly the comparison point an
+    offload-decision CBO needs. No fingerprint (plan cache off /
+    uncacheable) → nothing to key on, skip."""
+    if fingerprint is None or plan is None:
+        return
+    agg: Dict[str, Dict[str, int]] = {}
+    stack, seen = [plan], set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.extend(getattr(node, "children", ()) or ())
+        stack.extend(getattr(node, "child_execs", ()) or ())
+        mdict = getattr(node, "metrics", None)
+        if not isinstance(mdict, dict):
+            continue
+        e = agg.setdefault(getattr(node, "name", type(node).__name__),
+                           {"wallNs": 0, "rows": 0, "bytes": 0})
+        for mname, m in mdict.items():
+            total = getattr(m, "total", None)
+            if total is None:
+                continue
+            v = int(total())
+            if mname == "opTime":
+                e["wallNs"] += v
+            elif mname == "numOutputRows":
+                e["rows"] += v
+            elif mname.endswith("Bytes") or mname.endswith("bytes"):
+                e["bytes"] += v
+    store = observed_costs()
+    for op, e in agg.items():
+        if e["wallNs"] or e["rows"] or e["bytes"]:
+            store.observe(fingerprint, op, e["wallNs"], e["rows"],
+                          e["bytes"], alpha=alpha)

@@ -844,7 +844,14 @@ def test_stats_wire_op_and_stable_schema():
             c.collect(df)
             c.collect(df)
             st = c.stats()
-        assert st["schemaVersion"] == 1
+        # v2: the trace block (flight-recorder occupancy, slow-query
+        # count, dropped spans, cost-store size) joined the schema
+        assert st["schemaVersion"] == 2
+        tr = st["trace"]
+        assert set(tr) == {"recorder", "costFingerprints"}
+        assert set(tr["recorder"]) == {
+            "entries", "capacity", "recorded", "slowQueries",
+            "slowQueryMs", "droppedSpans"}
         info = st["server"]
         assert info["host"] == "127.0.0.1"
         assert info["port"] == server.port

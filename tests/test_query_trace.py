@@ -1,0 +1,495 @@
+"""Query-tracing suite (ISSUE 15 acceptance).
+
+The contract under test, in order of importance:
+
+1. **bit-for-bit**: tracing on vs off changes NOTHING about results —
+   the five bench shapes through the in-process engine, a real wire
+   exchange (incl. a kill-mid-query lineage recompute), and a 2-worker
+   router fleet;
+2. **one stitched timeline**: a fleet query yields client + router +
+   worker profiles all carrying the client-minted query_id, renderable
+   by tools/trace_viewer.py as valid Chrome trace-event JSON;
+3. **observed costs**: after a traced (or merely fingerprinted) collect
+   the cost store holds nonzero per-operator wall/rows EWMAs for that
+   shape fingerprint — the AQE feed;
+4. **attribution**: every error reply (traceback, watchdog timeout)
+   names its query_id;
+5. **bounded overhead**: the traced cached repeat path stays within
+   budget, span budgets drop (counted) instead of growing unbounded,
+   and tools/lint_metrics.py keeps the metrics plumbing honest.
+"""
+
+import importlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import trace as qtrace
+from spark_rapids_tpu.exec.sort import asc
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Count, Sum
+from spark_rapids_tpu.plan import table
+from spark_rapids_tpu.plan.session import Session
+
+
+def _load_tool(name):
+    tools = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+TRACE_ON = {"spark.rapids.tpu.trace.enabled": "true"}
+N = 1500
+
+
+@pytest.fixture(scope="module")
+def tabs(tmp_path_factory):
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(23)
+    lineitem = pa.table({
+        "k": rng.integers(0, 3, N).astype(np.int32),
+        "l_quantity": rng.integers(1, 51, N).astype(np.int64),
+        "l_extendedprice": rng.uniform(1.0, 1e5, N),
+    })
+    sales = pa.table({
+        "k": rng.integers(0, 256, N).astype(np.int64),
+        "ss_quantity": rng.integers(1, 100, N).astype(np.int64),
+    })
+    facts = pa.table({
+        "k": rng.integers(0, 64, N).astype(np.int64),
+        "v": rng.integers(-1000, 1000, N).astype(np.int64),
+    })
+    dims = pa.table({
+        "k": np.arange(64, dtype=np.int64),
+        "w": (np.arange(64) % 10).astype(np.int64),
+    })
+    pdir = tmp_path_factory.mktemp("trace_pq")
+    ppath = str(pdir / "part-0.parquet")
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 100, N).astype(np.int64),
+        "v": rng.uniform(-10.0, 10.0, N),
+    }), ppath)
+    return {"lineitem": lineitem, "sales": sales, "facts": facts,
+            "dims": dims, "parquet_path": ppath}
+
+
+def _shapes(tabs):
+    """(name, builder(literal)) for the five bench shapes (the
+    test_serving_fleet definition, so the differential covers the same
+    surface the fleet suite certifies)."""
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    from spark_rapids_tpu.plan.logical import DataFrame, LogicalScan
+
+    def q1(v):
+        return (table(tabs["lineitem"])
+                .where(col("l_quantity") > lit(int(v)))
+                .group_by("k")
+                .agg(Sum(col("l_extendedprice")).alias("rev"),
+                     Count().alias("n")))
+
+    def hash_agg(v):
+        return (table(tabs["sales"])
+                .where(col("ss_quantity") > lit(int(v)))
+                .group_by("k").agg(Sum(col("ss_quantity")).alias("q")))
+
+    def join_sort(v):
+        return (table(tabs["facts"])
+                .where(col("v") > lit(int(v)))
+                .join(table(tabs["dims"]), ["k"], ["k"])
+                .group_by("w").agg(Sum(col("v")).alias("s"))
+                .order_by(asc(col("w"))))
+
+    def parquet_scan(v):
+        src = ParquetSource([tabs["parquet_path"]])
+        df = DataFrame(LogicalScan((), source=src,
+                                   _schema=src.schema()))
+        return (df.where(col("k") > lit(int(v)))
+                .group_by("k").agg(Count().alias("n")))
+
+    def exchange(v):
+        return (table(tabs["facts"], num_slices=4)
+                .where(col("v") > lit(int(v)))
+                .group_by("k").agg(Sum(col("v")).alias("s")))
+
+    return [("q1_stage", q1), ("hash_agg", hash_agg),
+            ("join_sort", join_sort), ("parquet_scan", parquet_scan),
+            ("exchange", exchange)]
+
+
+# ---------------------------------------------------------------------------
+# span mechanics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_span_noop_when_disabled():
+    assert not qtrace.active()
+    with qtrace.span("X", kind="test") as sp:
+        assert sp is None
+    assert qtrace.capture() is None
+    # attaching a None token is a no-op too (the pool-thread shim)
+    with qtrace.attached(None):
+        assert not qtrace.active()
+
+
+@pytest.mark.smoke
+def test_span_tree_shape(tabs):
+    """The smoke-tier span-tree test: one traced collect produces a
+    rooted tree — query → cache lookup / prepare / execute → operator
+    spans — whose parent ids all resolve and whose query_id is the
+    session's."""
+    name, build = _shapes(tabs)[1]       # hash_agg: device path
+    ses = Session(dict(TRACE_ON))
+    out = ses.collect(build(25))
+    assert out.num_rows > 0
+    assert ses.last_query_id
+    profs = qtrace.flight_recorder().profiles(ses.last_query_id)
+    assert len(profs) == 1
+    p = profs[0]
+    assert p["component"] == "session"
+    spans = p["spans"]
+    by_id = {s["id"]: s for s in spans}
+    names = [s["name"] for s in spans]
+    assert names[0] == "query"
+    for want in ("resultCache.lookup", "plan.prepare", "execute"):
+        assert want in names, names
+    # at least one operator span, nested (transitively) under execute
+    ops = [s for s in spans if s["kind"] == "operator"]
+    assert ops, names
+    exec_id = next(s["id"] for s in spans if s["name"] == "execute")
+    for s in ops:
+        anc = s
+        seen = set()
+        while anc["parent"] is not None and anc["id"] not in seen:
+            seen.add(anc["id"])
+            if anc["parent"] == exec_id:
+                break
+            anc = by_id[anc["parent"]]
+        else:
+            pytest.fail(f"operator span {s['name']} not under execute")
+    # every parent resolves; every span closed with a duration
+    for s in spans:
+        assert s["parent"] is None or s["parent"] in by_id
+        assert s["durUs"] >= 0
+    # rows attributed on the root operator span
+    assert any(s.get("attrs", {}).get("rows", 0) > 0 for s in ops)
+
+
+@pytest.mark.smoke
+def test_session_metrics_trace_deltas(tabs):
+    _, build = _shapes(tabs)[1]
+    ses = Session(dict(TRACE_ON))
+    ses.collect(build(30))
+    m = ses.metrics()
+    assert m.get("trace.spanCount", 0) > 0
+    assert m.get("trace.profileCount", 0) == 1
+    # an untraced session reports NO trace deltas for its own collect
+    ses2 = Session()
+    ses2.collect(build(31))
+    assert not any(k == "trace.spanCount" for k in ses2.metrics())
+
+
+def test_span_budget_drops_counted(tabs):
+    """Past maxSpansPerQuery further spans are dropped and counted —
+    never unbounded growth, never an error, same results."""
+    _, build = _shapes(tabs)[0]
+    base = Session().collect(build(25))
+    ses = Session(dict(TRACE_ON,
+                       **{"spark.rapids.tpu.trace.maxSpansPerQuery":
+                          "3"}))
+    out = ses.collect(build(25))
+    assert out.equals(base)
+    p = qtrace.flight_recorder().profiles(ses.last_query_id)[0]
+    assert len(p["spans"]) <= 3
+    assert p["droppedSpans"] > 0
+
+
+# ---------------------------------------------------------------------------
+# bit-for-bit differentials (tracing must never change results)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", ["q1_stage", "hash_agg", "join_sort",
+                                   "parquet_scan", "exchange"])
+def test_tracing_differential_five_shapes(tabs, shape):
+    build = dict(_shapes(tabs))[shape]
+    base = Session().collect(build(25))
+    ses = Session(dict(TRACE_ON))
+    traced = ses.collect(build(25))
+    assert traced.equals(base), f"{shape}: tracing changed the result"
+    # the observed-cost store fed nonzero per-operator costs for this
+    # shape's fingerprint (parquet scans fingerprint by file stats)
+    assert ses.last_fingerprint
+    costs = qtrace.observed_costs().get(ses.last_fingerprint)
+    assert costs, f"{shape}: no observed costs recorded"
+    assert any(e["wallNs"] > 0 for e in costs.values())
+    assert any(e["rows"] > 0 for e in costs.values())
+
+
+def test_traced_wire_exchange_kill_recompute_carries_query_id():
+    """PR-11 seam: a kill-mid-query lineage recompute under tracing is
+    (a) still bit-for-bit and (b) attributed — the recompute and
+    per-peer fetch spans carry the originating query_id."""
+    soak = _load_tool("chaos_soak")
+    t = soak.make_tables(1200)["exchange"]
+    clean = soak.run_query(t)
+    rec = qtrace.FlightRecorder(capacity=8, slow_query_ms=0)
+    qid = qtrace.mint_query_id()
+    with qtrace.query_trace(qid, component="soak", recorder=rec):
+        killed = soak.run_query(t, replicas=0, kill="mid_read")
+    assert soak.same(killed, clean), \
+        "traced kill-mid-query recovery diverged from the clean run"
+    p = rec.profiles(qid)[0]
+    assert p["queryId"] == qid
+    names = {s["name"] for s in p["spans"]}
+    assert "lineage.recompute" in names, sorted(names)
+    assert "transport.fetch" in names, sorted(names)
+    # the failed-over fetch shows its per-peer attempts
+    peer_outcomes = {s["attrs"].get("outcome")
+                    for s in p["spans"]
+                    if s["name"] == "transport.peer" and "attrs" in s}
+    assert "served" in peer_outcomes or "missing" in peer_outcomes
+
+
+# ---------------------------------------------------------------------------
+# the serving tier: wire op, error attribution, overhead
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serving
+def test_server_trace_op_and_error_query_id(tabs):
+    from spark_rapids_tpu.server import PlanClient
+    from spark_rapids_tpu.server.client import PlanServerError
+    from spark_rapids_tpu.server.server import PlanServer
+    server = PlanServer(conf=dict(TRACE_ON)).start()
+    try:
+        _, build = _shapes(tabs)[1]
+        with PlanClient("127.0.0.1", server.port) as c:
+            base = Session().collect(build(40))
+            out = c.collect(build(40))
+            assert out.equals(base)
+            qid = c.last_query_id
+            assert qid
+            # the stitched read: client leg + the worker leg recorded
+            # under the same id
+            tr = c.last_trace()
+            comps = [p["component"] for p in tr["profiles"]]
+            assert comps[0] == "client" and "server" in comps
+            assert {p["queryId"] for p in tr["profiles"]} == {qid}
+            # raw recorder read
+            raw = c.trace_profiles(last=5)
+            assert raw["recorder"]["entries"] >= 1
+            # observed costs for exactly this query's shape
+            assert c.last_fingerprint
+            costs = c.observed_costs(c.last_fingerprint)
+            ops = costs.get(c.last_fingerprint, {})
+            assert ops and all(e["wallNs"] > 0 for e in ops.values())
+            # a failing query's error reply names the query
+            with pytest.raises(PlanServerError) as ei:
+                c.collect(table(tabs["sales"]).select(
+                    (col("nope") + lit(1)).alias("x")))
+            assert ei.value.query_id == c.last_query_id
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_watchdog_timeout_reply_names_query(tabs):
+    from spark_rapids_tpu.server import PlanClient
+    from spark_rapids_tpu.server.client import PlanServerError
+    from spark_rapids_tpu.server.server import PlanServer
+    server = PlanServer(conf={
+        "spark.rapids.tpu.server.test.collectDelayMs": "600",
+    }).start()
+    try:
+        _, build = _shapes(tabs)[0]
+        with PlanClient("127.0.0.1", server.port) as c:
+            with pytest.raises(PlanServerError) as ei:
+                c.collect(build(25), timeout_ms=150)
+            assert ei.value.timeout
+            assert ei.value.query_id == c.last_query_id
+    finally:
+        server.stop()
+
+
+@pytest.mark.serving
+def test_traced_repeat_path_overhead_within_budget(tabs):
+    """Overhead regression gate: the traced cached repeat path must
+    stay near the untraced one. The committed loadbench number is the
+    ≤3% acceptance; this in-process gate uses a loose 2x+5ms budget so
+    a scheduling hiccup cannot flake the tier while a real regression
+    (per-span syscalls, lock contention) still fails."""
+    _, build = _shapes(tabs)[1]
+    cache_on = {"spark.rapids.tpu.server.resultCache.enabled": "true"}
+
+    def p50(ses, reps=40):
+        df = build(55)
+        ses.collect(df)                  # plant the entry
+        xs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            ses.collect(df)
+            xs.append(time.perf_counter() - t0)
+        assert ses.last_cache.get("result") == "hit"
+        return sorted(xs)[len(xs) // 2]
+
+    base = p50(Session(dict(cache_on)))
+    traced = p50(Session(dict(cache_on, **TRACE_ON)))
+    assert traced <= base * 2 + 0.005, \
+        f"traced repeat p50 {traced * 1e3:.2f}ms vs untraced " \
+        f"{base * 1e3:.2f}ms — tracing is no longer cheap"
+
+
+# ---------------------------------------------------------------------------
+# the fleet: ONE stitched timeline through a 2-worker router
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serving
+@pytest.mark.smoke
+def test_fleet_stitched_trace_bit_for_bit(tabs):
+    """ISSUE 15 acceptance: a bench shape through a real 2-subprocess-
+    worker fleet is bit-for-bit vs the in-process oracle with tracing
+    on, and last_trace() yields ONE stitched timeline — client, router,
+    worker profiles sharing the minted query_id — that trace_viewer
+    renders as valid Chrome trace-event JSON; the worker's observed-
+    cost store holds nonzero costs for the fingerprint afterward."""
+    from spark_rapids_tpu.server import PlanClient
+    from spark_rapids_tpu.server.router import Router
+    viewer = _load_tool("trace_viewer")
+    router = Router(workers=2, conf=dict(TRACE_ON)).start()
+    try:
+        shapes = _shapes(tabs)[:2]          # q1_stage + hash_agg
+        with PlanClient("127.0.0.1", router.port,
+                        unavailable_retries=3) as c:
+            for name, build in shapes:
+                base = Session().collect(build(12))
+                out = c.collect(build(12))
+                assert out.equals(base), \
+                    f"{name}: traced fleet result diverged"
+            qid = c.last_query_id
+            tr = c.last_trace()
+            comps = [p["component"] for p in tr["profiles"]]
+            assert set(comps) >= {"client", "router", "server"}, comps
+            assert {p["queryId"] for p in tr["profiles"]} == {qid}
+            # the router leg shows routing work; the worker leg the
+            # engine's
+            rnames = {s["name"] for p in tr["profiles"]
+                      if p["component"] == "router"
+                      for s in p["spans"]}
+            assert {"router.fingerprint", "router.dispatch"} <= rnames
+            wnames = {s["name"] for p in tr["profiles"]
+                      if p["component"] == "server"
+                      for s in p["spans"]}
+            assert "execute" in wnames and "plan.prepare" in wnames
+            # chrome trace-event rendering: valid JSON, required keys
+            events = viewer.to_trace_events(tr["profiles"])
+            blob = json.loads(json.dumps(events))
+            assert blob and isinstance(blob, list)
+            xs = [e for e in blob if e.get("ph") == "X"]
+            assert xs
+            for e in xs:
+                assert {"name", "ph", "ts", "dur", "pid",
+                        "tid"} <= set(e)
+            # the spans of all three components landed as distinct
+            # tracks of one timeline
+            assert len({e["pid"] for e in blob}) >= 3
+            # observed costs for the routed fingerprint (merged across
+            # the fleet) are nonzero
+            assert c.last_fingerprint
+            costs = c.observed_costs(c.last_fingerprint)
+            ops = costs.get(c.last_fingerprint, {})
+            assert ops and all(e["wallNs"] > 0 for e in ops.values())
+            # fleet stats carry the router's recorder occupancy
+            st = c.stats()
+            assert st["schemaVersion"] == 2
+            assert st["trace"]["recorder"]["entries"] >= 1
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder / cost store / sink units
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_slow_log():
+    rec = qtrace.FlightRecorder(capacity=3, slow_query_ms=50)
+    for i in range(5):
+        rec.record({"queryId": f"q{i}", "durUs": 1000,
+                    "droppedSpans": i % 2, "spans": []})
+    st = rec.stats()
+    assert st["entries"] == 3 and st["capacity"] == 3
+    assert st["recorded"] == 5 and st["droppedSpans"] == 2
+    assert [p["queryId"] for p in rec.profiles()] == ["q2", "q3", "q4"]
+    assert rec.profiles("q3")[0]["queryId"] == "q3"
+    assert rec.profiles(last=1)[0]["queryId"] == "q4"
+    assert st["slowQueries"] == 0
+    rec.record({"queryId": "slow", "durUs": 60_000, "spans": []})
+    assert rec.stats()["slowQueries"] == 1
+    assert rec.slow()[0]["queryId"] == "slow"
+
+
+def test_observed_cost_store_ewma_and_lru():
+    store = qtrace.ObservedCostStore(max_fingerprints=2, alpha=0.5)
+    store.observe("fpA", "Filter", 1000, rows=10, nbytes=100)
+    store.observe("fpA", "Filter", 2000, rows=20, nbytes=200)
+    e = store.get("fpA")["Filter"]
+    assert e["count"] == 2
+    assert e["wallNs"] == pytest.approx(1500)      # 1000 + .5*(2000-1000)
+    assert e["rows"] == pytest.approx(15)
+    store.observe("fpB", "Scan", 10)
+    store.observe("fpC", "Scan", 10)               # evicts LRU fpA
+    assert len(store) == 2
+    assert store.get("fpA") == {}
+    assert set(store.fingerprints()) == {"fpB", "fpC"}
+
+
+@pytest.mark.smoke
+def test_jsonl_sink_and_trace_viewer(tabs, tmp_path):
+    viewer = _load_tool("trace_viewer")
+    sink = str(tmp_path / "trace.jsonl")
+    _, build = _shapes(tabs)[0]
+    conf = dict(TRACE_ON,
+                **{"spark.rapids.tpu.trace.sink.path": sink})
+    ses = Session(conf)
+    ses.collect(build(25))
+    ses.collect(build(26))
+    lines = [json.loads(ln) for ln in open(sink)
+             if ln.strip()]
+    assert len(lines) == 2
+    assert all(p["component"] == "session" and p["spans"]
+               for p in lines)
+    out = str(tmp_path / "timeline.json")
+    assert viewer.main([sink, "-o", out]) == 0
+    events = json.load(open(out))
+    assert any(e.get("ph") == "X" for e in events)
+    assert any(e.get("ph") == "M" for e in events)
+    # filtered render keeps only the asked query
+    only = viewer.to_trace_events(lines,
+                                  query_id=lines[0]["queryId"])
+    qids = {e["args"]["queryId"] for e in only if e.get("ph") == "X"}
+    assert qids == {lines[0]["queryId"]}
+
+
+# ---------------------------------------------------------------------------
+# lint
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_lint_metrics_clean():
+    """tools/lint_metrics.py in the tier-1 flow: metrics groups are all
+    rolled into Session.metrics(), declared exec metrics are emitted,
+    and docs/configs.md matches the conf registry exactly."""
+    lint = _load_tool("lint_metrics")
+    problems = lint.lint_all()
+    assert problems == [], "\n".join(problems)

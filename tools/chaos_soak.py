@@ -71,6 +71,7 @@ from spark_rapids_tpu.shuffle.multithreaded import (           # noqa: E402
 from spark_rapids_tpu.shuffle.netfault import (                # noqa: E402
     net_injection, net_injector)
 from spark_rapids_tpu.shuffle.transport import TcpTransport    # noqa: E402
+from spark_rapids_tpu import trace as qtrace                   # noqa: E402
 
 N_PARTS = 4
 BATCH_ROWS = 700
@@ -192,6 +193,11 @@ def soak(duration_s: float, seed: int = 0, rows: int = 3000,
              "wrong_results": 0, "leaked_pins": 0, "leaked_threads": 0,
              "errors": 0}
     failures = []
+    # every round runs under a traced query_id into this recorder; when
+    # a round fails, its flight-recorder dump rides the JSON summary —
+    # a red soak names the query AND shows where its time went
+    recorder = qtrace.FlightRecorder(capacity=64, slow_query_ms=0)
+    flight = {}
     names = sorted(tables)
     while time.monotonic() - t0 < duration_s:
         name = names[int(rng.integers(len(names)))]
@@ -200,37 +206,49 @@ def soak(duration_s: float, seed: int = 0, rows: int = 3000,
         net_mode = NET_MODES[int(rng.integers(len(NET_MODES)))]
         net_kind = NET_KINDS[int(rng.integers(len(NET_KINDS)))]
         oom_mode = OOM_MODES[int(rng.integers(len(OOM_MODES)))]
-        leg = (f"{name} kill={kill} replicas={replicas} "
+        qid = qtrace.mint_query_id()
+        leg = (f"query={qid} {name} kill={kill} replicas={replicas} "
                f"net={net_mode or 'off'}/{net_kind} "
                f"oom={oom_mode or 'off'}")
         stats["rounds"] += 1
         stats["kills"] += kill != "none"
         stats["net_rounds"] += bool(net_mode)
         stats["oom_rounds"] += bool(oom_mode)
+
+        def _flight_dump():
+            flight[qid] = {"leg": leg,
+                           "profiles": recorder.profiles(qid)}
+
         try:
             with net_injection(net_mode, seed=int(rng.integers(1 << 30)),
                                fault_kind=net_kind, delay_ms=5), \
                     oom_injection(oom_mode,
-                                  seed=int(rng.integers(1 << 30))):
+                                  seed=int(rng.integers(1 << 30))), \
+                    qtrace.query_trace(qid, component="soak",
+                                       recorder=recorder):
                 parts = run_query(tables[name], replicas=replicas,
                                   kill=kill)
         except Exception as e:           # soak accounting: count + go on
             stats["errors"] += 1
             failures.append(f"{leg}: {type(e).__name__}: {e}")
+            _flight_dump()
             net_injector().configure("")
             continue
         if not same(parts, baselines[name]):
             stats["wrong_results"] += 1
             failures.append(f"{leg}: WRONG RESULT")
+            _flight_dump()
         if cat.total_pinned() != 0:
             stats["leaked_pins"] += 1
             failures.append(f"{leg}: {cat.total_pinned()} leaked pins")
+            _flight_dump()
         if not threads_drained(baseline_threads):
             stats["leaked_threads"] += 1
             failures.append(
                 f"{leg}: threads not drained "
                 f"({threading.active_count()} > {baseline_threads}: "
                 f"{sorted(t.name for t in threading.enumerate())})")
+            _flight_dump()
             baseline_threads = threading.active_count()   # don't cascade
         if verbose and stats["rounds"] % 20 == 0:
             print(f"[{time.monotonic() - t0:7.1f}s] "
@@ -247,6 +265,9 @@ def soak(duration_s: float, seed: int = 0, rows: int = 3000,
     stats["lineageMissCount"] = (lm1["lineageMissCount"]
                                  - lm0["lineageMissCount"])
     stats["failures"] = failures
+    #: flight-recorder dump per failed round (query_id -> {leg,
+    #: profiles}): the span timeline of exactly the rounds that went red
+    stats["flight"] = flight
     stats["ok"] = not (failures or stats["wrong_results"]
                        or stats["leaked_pins"] or stats["errors"])
     return stats

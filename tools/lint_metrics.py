@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Repo lint: the observability surfaces cannot drift silently.
+
+Three rules, enforced over the AST (no imports of the checked code —
+the lint_retry/lint_net discipline), run in tier-1 via
+tests/test_query_trace.py::test_lint_metrics_clean:
+
+1. **Metrics-group roll-up** — every process-wide metrics group module
+   (a ``class *Metrics`` with a ``snapshot()`` method under
+   ``spark_rapids_tpu/``) must be registered in the GROUPS table below
+   AND its prefix must appear in ``Session.metrics()``'s
+   ``emit_deltas`` roll-up (plan/session.py). A new counter group that
+   never reaches Session.metrics() is invisible to every serving
+   surface; a GROUPS entry whose module lost its class is stale.
+   (The exec-level ``Metric`` value holder and recorder/cost stores are
+   not counter groups — only snapshot()-bearing ``*Metrics`` classes
+   count.)
+
+2. **Declared-vs-emitted exec metrics** — every metric name declared
+   anywhere in the package (``Metric("name", ...)`` construction) must
+   actually be emitted somewhere: read back through a
+   ``...metrics["name"]`` subscript (``.add``/``.add_lazy``/``total``)
+   or a ``metrics.setdefault("name", ...)`` chain. A declared-but-
+   never-emitted metric reports a permanent zero — dead weight that
+   reads as "this never happens".
+
+3. **Conf docs** — every non-internal conf key registered in
+   config.py appears in docs/configs.md, and docs/configs.md carries no
+   key that is no longer registered. Missing and stale both fail (the
+   docs are generated; failing here means "rerun
+   tools/generate_docs.py and commit").
+
+Exit status 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "spark_rapids_tpu")
+
+#: registered metrics groups: package-relative module -> (class, the
+#: prefix Session.metrics() emits its deltas under)
+GROUPS: Dict[str, Tuple[str, str]] = {
+    "memory/retry.py": ("RetryMetrics", "retry"),
+    "shuffle/transport.py": ("TransportMetrics", "net"),
+    "shuffle/lineage.py": ("LineageMetrics", "lineage"),
+    "plan/plancache.py": ("ServingMetrics", "cache"),
+    "trace.py": ("TraceMetrics", "trace"),
+}
+
+SESSION = os.path.join(PKG, "plan", "session.py")
+CONFIG = os.path.join(PKG, "config.py")
+CONFIGS_MD = os.path.join(ROOT, "docs", "configs.md")
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, _, names in os.walk(root):
+        for n in names:
+            if n.endswith(".py"):
+                out.append(os.path.join(dirpath, n))
+    return sorted(out)
+
+
+def _parse(path: str) -> ast.Module:
+    with open(path, "r", encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+# ---------------------------------------------------------------------------
+# rule 1: metrics groups <-> Session roll-up
+# ---------------------------------------------------------------------------
+
+
+def _discover_groups() -> Dict[str, str]:
+    """package-relative path -> *Metrics class name, for every class
+    with a snapshot() method."""
+    found: Dict[str, str] = {}
+    for path in _py_files(PKG):
+        rel = os.path.relpath(path, PKG).replace(os.sep, "/")
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name.endswith("Metrics"):
+                has_snapshot = any(
+                    isinstance(b, ast.FunctionDef)
+                    and b.name == "snapshot" for b in node.body)
+                if has_snapshot:
+                    found[rel] = node.name
+    return found
+
+
+def _session_prefixes() -> Set[str]:
+    prefixes: Set[str] = set()
+    for node in ast.walk(_parse(SESSION)):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "emit_deltas" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            prefixes.add(node.args[0].value)
+    return prefixes
+
+
+def lint_groups() -> List[str]:
+    problems: List[str] = []
+    discovered = _discover_groups()
+    prefixes = _session_prefixes()
+    for rel, cls in sorted(discovered.items()):
+        if rel not in GROUPS:
+            problems.append(
+                f"{rel}: metrics group {cls} is not registered in "
+                f"tools/lint_metrics.py GROUPS (and so may be missing "
+                f"from Session.metrics()'s emit_deltas roll-up)")
+        elif GROUPS[rel][0] != cls:
+            problems.append(
+                f"{rel}: GROUPS registers class {GROUPS[rel][0]} but "
+                f"the module defines {cls} (stale table)")
+    for rel, (cls, prefix) in sorted(GROUPS.items()):
+        if rel not in discovered:
+            problems.append(
+                f"tools/lint_metrics.py GROUPS: {rel} ({cls}) no longer "
+                f"defines a snapshot()-bearing *Metrics class (stale "
+                f"entry)")
+        if prefix not in prefixes:
+            problems.append(
+                f"plan/session.py: metrics group prefix {prefix!r} "
+                f"({rel}) is missing from the emit_deltas roll-up in "
+                f"Session.metrics()")
+    for prefix in sorted(prefixes):
+        if prefix not in {p for _, p in GROUPS.values()}:
+            problems.append(
+                f"plan/session.py: emit_deltas prefix {prefix!r} has no "
+                f"registered metrics group in tools/lint_metrics.py")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# rule 2: declared metric names must be emitted
+# ---------------------------------------------------------------------------
+
+
+def lint_declared_emitted() -> List[str]:
+    declared: Dict[str, str] = {}    # name -> first declaring file
+    used: Set[str] = set()
+    for path in _py_files(PKG):
+        rel = os.path.relpath(path, PKG).replace(os.sep, "/")
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "Metric" and \
+                        node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    declared.setdefault(node.args[0].value, rel)
+                if ((isinstance(f, ast.Attribute)
+                     and f.attr in ("setdefault", "get"))
+                    or (isinstance(f, ast.Name) and f.id == "get")) and \
+                        node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    # metrics.setdefault("x", Metric(...)).add(...) and
+                    # the pipeline's metrics.get("x").add(...) idiom
+                    # both emit; plain dict .get over-matching errs
+                    # toward clean, never toward lint noise
+                    used.add(node.args[0].value)
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    isinstance(node.value, ast.Attribute) and \
+                    node.value.attr == "metrics" and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                used.add(node.slice.value)
+    return [f"{rel}: metric {name!r} is declared but never emitted "
+            f"(no metrics[{name!r}] read / setdefault chain anywhere "
+            f"in the package)"
+            for name, rel in sorted(declared.items())
+            if name not in used]
+
+
+# ---------------------------------------------------------------------------
+# rule 3: conf registry <-> docs/configs.md
+# ---------------------------------------------------------------------------
+
+
+def _registered_confs() -> Dict[str, bool]:
+    """conf key -> internal?  — from config.py's builder-chain AST."""
+    out: Dict[str, bool] = {}
+    tree = _parse(CONFIG)
+    for stmt in tree.body:
+        keys: List[str] = []
+        internal = False
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "conf" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                keys.append(node.args[0].value)
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "internal":
+                internal = True
+        for k in keys:
+            out[k] = internal
+    return out
+
+
+def _documented_confs() -> Set[str]:
+    keys: Set[str] = set()
+    with open(CONFIGS_MD, "r", encoding="utf-8") as f:
+        for line in f:
+            m = re.match(r"\|\s*(spark\.rapids\.tpu\.[\w.]+)\s*\|", line)
+            if m:
+                keys.add(m.group(1))
+    return keys
+
+
+def lint_conf_docs() -> List[str]:
+    problems: List[str] = []
+    registered = _registered_confs()
+    documented = _documented_confs()
+    public = {k for k, internal in registered.items() if not internal}
+    for k in sorted(public - documented):
+        problems.append(
+            f"docs/configs.md: conf {k} is registered but undocumented "
+            f"— rerun tools/generate_docs.py and commit")
+    for k in sorted(documented - public):
+        problems.append(
+            f"docs/configs.md: conf {k} is documented but no longer "
+            f"registered (stale docs) — rerun tools/generate_docs.py")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+
+
+def lint_all() -> List[str]:
+    return lint_groups() + lint_declared_emitted() + lint_conf_docs()
+
+
+def main() -> int:
+    problems = lint_all()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\n{len(problems)} metrics-lint violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

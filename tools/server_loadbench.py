@@ -118,10 +118,13 @@ def run_load(clients: int, rounds: int, rows: int,
              concurrent_collects: int = 4,
              unique_fraction: float = 0.25,
              host: str = "127.0.0.1",
-             client_timeout: float = 900.0) -> dict:
+             client_timeout: float = 900.0,
+             trace: bool = False) -> dict:
     """Drive ``clients`` threads x ``rounds`` x shapes; round 0 plants
     each shape, later rounds repeat it (same literal) except a
-    ``unique_fraction`` of queries that draw a fresh literal."""
+    ``unique_fraction`` of queries that draw a fresh literal.
+    ``trace`` turns query tracing on server-side — the --trace legs
+    measure its overhead against the identical untraced workload."""
     from spark_rapids_tpu.server import PlanClient, PlanServer
     conf = {
         "spark.rapids.tpu.server.planCache.enabled": str(plan_cache),
@@ -129,6 +132,7 @@ def run_load(clients: int, rounds: int, rows: int,
         "spark.rapids.tpu.server.concurrentCollects":
             str(concurrent_collects),
         "spark.rapids.tpu.server.maxSessions": str(max(64, clients + 8)),
+        "spark.rapids.tpu.trace.enabled": str(trace),
     }
     tabs = _tables(rows)
     shapes = _shapes(tabs)
@@ -481,6 +485,11 @@ def main(argv=None) -> int:
                         "fleet mid-run (result cache ON, repeated "
                         "literals) — zero errors + nonzero rehydration "
                         "hits is the acceptance")
+    p.add_argument("--trace", action="store_true",
+                   help="single-server mode: add traced legs (query "
+                        "tracing ON, identical workload) and report the "
+                        "cached repeat-path and uncached p50 overhead "
+                        "of tracing vs the untraced legs")
     args = p.parse_args(argv)
 
     if args.fleet > 0:
@@ -538,6 +547,45 @@ def main(argv=None) -> int:
             a = report["loadbench"]["repeat"]["p50_ms"]
             b = report["loadbench_uncached"]["repeat"]["p50_ms"]
             report["repeat_p50_speedup"] = round(b / a, 3) if a else None
+        if args.trace:
+            # tracing-overhead legs: IDENTICAL workload with
+            # trace.enabled on the server. The cached repeat path (a
+            # result-cache serve wrapped in a span tree) is the
+            # acceptance number — observability must cost ≲3% there;
+            # the uncached leg bounds the worst case (every operator /
+            # serializer / admission span live)
+            traced_cached = run_load(
+                args.clients, args.rounds, args.rows,
+                plan_cache=not args.no_plan_cache,
+                result_cache=not args.no_result_cache,
+                concurrent_collects=args.concurrent_collects,
+                unique_fraction=args.unique_fraction,
+                client_timeout=args.client_timeout, trace=True)
+            base_rep = report["loadbench"]["repeat"]["p50_ms"]
+            tr_rep = traced_cached["repeat"]["p50_ms"]
+            trace_report = {
+                "repeat_p50_ms_untraced": base_rep,
+                "repeat_p50_ms_traced": tr_rep,
+                "repeat_p50_overhead_pct": round(
+                    (tr_rep - base_rep) / base_rep * 100, 2)
+                if base_rep else None,
+                "traced": traced_cached,
+            }
+            if "loadbench_uncached" in report:
+                traced_uncached = run_load(
+                    args.clients, args.rounds, args.rows,
+                    plan_cache=False, result_cache=False,
+                    concurrent_collects=args.concurrent_collects,
+                    unique_fraction=args.unique_fraction,
+                    client_timeout=args.client_timeout, trace=True)
+                bu = report["loadbench_uncached"]["repeat"]["p50_ms"]
+                tu = traced_uncached["repeat"]["p50_ms"]
+                trace_report["uncached_repeat_p50_ms_untraced"] = bu
+                trace_report["uncached_repeat_p50_ms_traced"] = tu
+                trace_report["uncached_repeat_p50_overhead_pct"] = \
+                    round((tu - bu) / bu * 100, 2) if bu else None
+                trace_report["traced_uncached"] = traced_uncached
+            report["loadbench_trace"] = trace_report
     print(json.dumps(report, indent=2))
     if args.json_out:
         existing = {}

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Render query-trace profiles as Chrome/Perfetto trace-event JSON.
+
+Input: either the JSONL sink (``spark.rapids.tpu.trace.sink.path`` —
+one query profile per line) or a stitched ``PlanClient.last_trace()``
+dump saved as JSON (``{"queryId": ..., "profiles": [...]}``). Output:
+the Trace Event Format's JSON-array form — load it in
+``chrome://tracing`` or https://ui.perfetto.dev and a fleet query reads
+as ONE timeline: the client, router, and worker legs appear as separate
+"processes" (tracks) whose spans all carry the same minted query_id.
+
+Mapping:
+
+- every profile becomes one pid (track) named ``component queryId``
+  via ``process_name`` metadata events;
+- every span becomes one complete ("ph": "X") event: ``ts``/``dur`` in
+  microseconds — ``ts`` is the span's wall-clock open instant, so legs
+  from different processes on one host line up (cross-host skew shifts
+  whole tracks, never distorts durations);
+- nesting rides the span's recorded parent chain: each span is placed
+  on the tid of its depth so overlapping siblings (writer-pool /
+  fetch-pool work) render side by side instead of fused;
+- span attrs land in ``args`` (peer addresses, byte counts, cache
+  outcomes, failover verdicts).
+
+Usage:
+    python tools/trace_viewer.py trace.jsonl -o timeline.json
+    python tools/trace_viewer.py --query-id 1234abcd trace.jsonl
+    python tools/trace_viewer.py last_trace.json   # stitched dump
+
+Exit 0 on success; the output is always a VALID trace-event JSON array
+(the acceptance check loads it back and verifies the required keys).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List, Optional
+
+
+def load_profiles(path: str) -> List[dict]:
+    """Accept the JSONL sink (one profile per line) or a stitched
+    last_trace() dump ({"profiles": [...]}) or a bare profile/array."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") or stripped.startswith("["):
+        try:
+            doc = json.loads(text)
+            if isinstance(doc, dict) and "profiles" in doc:
+                return list(doc["profiles"])
+            if isinstance(doc, dict) and "spans" in doc:
+                return [doc]
+            if isinstance(doc, list):
+                return list(doc)
+        except json.JSONDecodeError:
+            pass    # fall through to JSONL
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        out.append(json.loads(line))
+    return out
+
+
+def _depths(spans: List[dict]) -> Dict[int, int]:
+    """Span id -> nesting depth (root spans at 0); a missing parent
+    (dropped past the span budget) renders at the root level."""
+    by_id = {s["id"]: s for s in spans}
+    memo: Dict[int, int] = {}
+
+    def depth(sid: int) -> int:
+        if sid in memo:
+            return memo[sid]
+        s = by_id.get(sid)
+        parent = s.get("parent") if s else None
+        d = 0 if not parent or parent not in by_id \
+            else depth(parent) + 1
+        memo[sid] = d
+        return d
+
+    for s in spans:
+        depth(s["id"])
+    return memo
+
+
+def to_trace_events(profiles: Iterable[dict],
+                    query_id: Optional[str] = None) -> List[dict]:
+    events: List[dict] = []
+    for pid, prof in enumerate(profiles, start=1):
+        if query_id and prof.get("queryId") != query_id:
+            continue
+        label = f"{prof.get('component', 'engine')} " \
+                f"{prof.get('queryId', '?')}"
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        spans = prof.get("spans", [])
+        depths = _depths(spans)
+        for s in spans:
+            args = dict(s.get("attrs") or {})
+            args["queryId"] = prof.get("queryId")
+            args["kind"] = s.get("kind", "span")
+            events.append({
+                "name": s["name"],
+                "cat": s.get("kind", "span"),
+                "ph": "X",
+                "ts": int(s.get("tsUs", 0)),
+                "dur": max(1, int(s.get("durUs") or 0)),
+                "pid": pid,
+                "tid": depths.get(s["id"], 0),
+                "args": args,
+            })
+    return events
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="query-trace profiles -> Chrome trace-event JSON")
+    p.add_argument("input", help="JSONL sink file or stitched "
+                                 "last_trace() JSON dump")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: stdout)")
+    p.add_argument("--query-id", default=None,
+                   help="render only this query's profiles")
+    args = p.parse_args(argv)
+    profiles = load_profiles(args.input)
+    events = to_trace_events(profiles, query_id=args.query_id)
+    blob = json.dumps(events, indent=1)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(blob)
+        print(f"wrote {len(events)} trace events to {args.out}",
+              file=sys.stderr)
+    else:
+        print(blob)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
