@@ -467,6 +467,52 @@ SKEW_SPLIT_ROWS = conf(
     "analogue, in rows)."
 ).integer(1 << 21)
 
+ADAPTIVE_COST_ENABLED = conf(
+    "spark.rapids.tpu.sql.adaptive.costFeedback.enabled").doc(
+    "Cost-fed planning: when the observed-cost store holds measured "
+    "whole-query wall times for this plan's shape fingerprint "
+    "(query:device / query:cpu entries), CPU-vs-device placement "
+    "replays the measured winner instead of the modeled CBO scores. "
+    "Cost-fed plans bypass the planning cache in both directions so a "
+    "measured decision never poisons a cached fingerprint (see "
+    "docs/adaptive.md). Requires planCache.enabled and "
+    "trace.costStore.enabled to have anything to consume."
+).boolean(False)
+
+ADAPTIVE_COST_MIN_COUNT = conf(
+    "spark.rapids.tpu.sql.adaptive.costFeedback.minObservations").doc(
+    "Observed-cost EWMA count a query:device / query:cpu entry needs "
+    "before cost-fed planning trusts it; below this the modeled "
+    "pipeline decides."
+).integer(1)
+
+ADAPTIVE_EXPLORE_EVERY = conf(
+    "spark.rapids.tpu.sql.adaptive.costFeedback.exploreEvery").doc(
+    "Exploration floor for cost-fed planning: every Nth cost-fed plan "
+    "of a fingerprint runs the losing — or never-measured — placement "
+    "so its wall-time EWMA exists and stays fresh (a placement that "
+    "was never measured still gets tried). 0 disables exploration "
+    "(pure exploitation of the measured winner)."
+).integer(16)
+
+ADAPTIVE_BROADCAST_ENABLED = conf(
+    "spark.rapids.tpu.sql.adaptive.broadcastJoin.enabled").doc(
+    "Runtime shuffled-to-broadcast join switch: after the build-side "
+    "shuffle materializes, a build that measures at or under "
+    "adaptive.broadcastJoin.maxBuildRows is replicated to every "
+    "stream partition instead of co-partition-probed — the planner's "
+    "byte ESTIMATE said shuffle, the measured rows say broadcast "
+    "(spark.sql.adaptive OptimizeShuffledHashJoin/broadcast demotion "
+    "analogue). Join types with build-side null tails (RIGHT/FULL "
+    "outer) never switch."
+).boolean(True)
+
+ADAPTIVE_BROADCAST_MAX_BUILD_ROWS = conf(
+    "spark.rapids.tpu.sql.adaptive.broadcastJoin.maxBuildRows").doc(
+    "Measured build-side row total at or under which a shuffled hash "
+    "join switches to broadcast at runtime."
+).integer(1 << 16)
+
 WINDOW_BATCH_ROWS = conf("spark.rapids.tpu.sql.window.batchRows").doc(
     "Row target for key-complete window batches: a window partition's "
     "rows are re-chunked on group-key boundaries so one batch never holds "
@@ -718,6 +764,17 @@ FLEET_RESULT_STORE_MAX_BYTES = conf(
     "Byte budget of the persistent result-store directory; past it the "
     "least-recently-touched entry files are deleted at write time."
 ).bytes_(1 << 30)
+
+FLEET_COST_SYNC_PLANS = conf(
+    "spark.rapids.tpu.server.fleet.costSync.everyPlans").doc(
+    "Router-driven observed-cost fan-out: every N served plans the "
+    "router pulls each worker's cost store, merges them "
+    "(highest-observation-count entry wins, the trace-wire-op merge "
+    "rule) and pushes the merged snapshot back to every worker over "
+    "the costs_load op — so worker B takes cost-fed planning "
+    "decisions for shapes only worker A measured. 0 = no automatic "
+    "sync (Router.sync_costs() still works on demand)."
+).integer(0)
 
 BRIDGE_ACCEPTED_SCHEMA_VERSIONS = conf(
     "spark.rapids.tpu.bridge.acceptedSchemaVersions").doc(

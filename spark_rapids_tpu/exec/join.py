@@ -126,8 +126,18 @@ class HashJoinExec(BinaryExec):
                  broadcast_build: bool = True,
                  ctx: Optional[EvalContext] = None,
                  max_build_rows: int = 1 << 22,
-                 skew_split_rows: Optional[int] = None):
+                 skew_split_rows: Optional[int] = None,
+                 broadcast_switch_rows: Optional[int] = None):
         super().__init__(left, right, ctx)
+        # AQE runtime broadcast switch: in the co-partitioned mode, a
+        # build side that MEASURES at or under this many rows after its
+        # shuffle materializes is replicated to every stream partition
+        # instead of co-partition-probed (the planner's byte estimate
+        # said shuffle; the measured rows say broadcast). None = off.
+        # do_close restores the planned mode so a re-execute re-decides
+        # from fresh statistics.
+        self.broadcast_switch_rows = broadcast_switch_rows
+        self._planned_broadcast = broadcast_build
         # AQE skew-join: in the co-partitioned mode, a stream-side reader
         # partition larger than this is split, replicating the matching
         # build partition (reference: OptimizeSkewedJoin /
@@ -136,6 +146,14 @@ class HashJoinExec(BinaryExec):
         # CONSISTENT across the two exchanges — see _maybe_coordinate.
         self.skew_split_rows = skew_split_rows
         self._coordinated = False
+        # Build relation materialized ONCE for a runtime broadcast
+        # switch: the switched-to build side is a ShuffleExchangeExec
+        # whose spillable pieces are freed after their single
+        # refcounted read, so re-reading it per stream partition would
+        # hit closed pieces. A PLANNED broadcast reads a
+        # BroadcastExchangeExec, which is multi-read safe, and keeps
+        # its per-read spill discipline (no caching there).
+        self._switch_build_cache: Optional[List[ColumnarBatch]] = None
         # broadcast_build: build side replicated (broadcast hash join).
         # False = co-partitioned inputs (shuffled hash join); requires both
         # children hash-partitioned on the join keys by an exchange.
@@ -595,6 +613,8 @@ class HashJoinExec(BinaryExec):
         if not (isinstance(l, ShuffleExchangeExec) and
                 isinstance(r, ShuffleExchangeExec)):
             return
+        if self._maybe_broadcast_switch(r):
+            return
         if not (l.adaptive or r.adaptive or self.skew_split_rows):
             return
         split = self.skew_split_rows
@@ -604,11 +624,41 @@ class HashJoinExec(BinaryExec):
             split = None
         coordinate_join_reads(l, r, l.target_rows, split)
 
+    def _maybe_broadcast_switch(self, build_ex) -> bool:
+        """Runtime shuffled->broadcast switch: the build exchange has
+        materialized (or is about to — reading its row counts forces
+        it), so compare MEASURED build rows against the conf'd ceiling
+        and replicate a small build instead of co-partition-probing it.
+        Restricted to join types without build-side null tails
+        (RIGHT/FULL outer fold to one partition under broadcast and are
+        not worth re-planning into that shape at runtime). Bit-for-bit:
+        a replicated build probes the same pairs per stream partition
+        as the co-partitioned layout probes across partitions."""
+        if self.broadcast_switch_rows is None or \
+                self.join_type in (JoinType.RIGHT_OUTER,
+                                   JoinType.FULL_OUTER):
+            return False
+        build_rows = sum(build_ex.partition_row_counts())
+        if build_rows > self.broadcast_switch_rows:
+            return False
+        from ..plan.adaptive import record_decision
+        record_decision(
+            "broadcastSwitch",
+            f"shuffled {self.join_type.name} join: build side measured "
+            f"{build_rows} rows <= maxBuildRows="
+            f"{self.broadcast_switch_rows} -> replicating build "
+            f"(runtime broadcast)")
+        self.broadcast_build = True
+        return True
+
     def do_close(self) -> None:
         # the exchanges drop their materialization + reader specs on
         # close; a re-execute must re-coordinate or the two sides would
-        # fall back to inconsistent solo layouts
+        # fall back to inconsistent solo layouts — and a runtime
+        # broadcast switch must re-decide from fresh statistics
         self._coordinated = False
+        self.broadcast_build = self._planned_broadcast
+        self._switch_build_cache = None
 
     @property
     def num_partitions(self) -> int:
@@ -627,7 +677,19 @@ class HashJoinExec(BinaryExec):
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         self._maybe_coordinate()
-        if self.broadcast_build:
+        if self.broadcast_build and not self._planned_broadcast:
+            # Runtime switch: the build side is still a shuffle
+            # exchange — read the whole relation exactly once (its
+            # pieces close after their refcounted read) and reuse it
+            # across stream partitions. Bounded: the switch only fires
+            # at <= broadcastJoin.maxBuildRows measured rows. Partition
+            # execution is sequential, so no synchronization needed.
+            if self._switch_build_cache is None:
+                self._switch_build_cache = [
+                    b for cp in range(self.right.num_partitions)
+                    for b in self.right.execute_partition(cp)]
+            build_batches = self._switch_build_cache
+        elif self.broadcast_build:
             build_batches = [b for cp in range(self.right.num_partitions)
                              for b in self.right.execute_partition(cp)]
         else:
@@ -717,9 +779,14 @@ class HashJoinExec(BinaryExec):
         for stream in stream_iter:
             inp = SpillableInput.admit(stream, stream_schema, cat,
                                        name=self.name)
+            # adaptive skew seam: a stream batch the shuffle statistics
+            # already measured over the skew row target pre-splits
+            # through the same split-and-retry machinery instead of
+            # OOMing its way down to size
             for out, mb in with_retry(inp, probe_body,
                                       split=split_input_halves,
-                                      catalog=cat, name=self.name):
+                                      catalog=cat, name=self.name,
+                                      presplit_rows=self.skew_split_rows):
                 if mb is not None:
                     matched_build = mb
                 yield out

@@ -123,6 +123,10 @@ class RetryMetrics:
         self.split_and_retry_count = 0
         self.retry_block_time_ns = 0
         self.spill_bytes_triggered = 0
+        # adaptive skew pre-splits: inputs cut to the skew row target
+        # BEFORE the first device attempt (with_retry presplit_rows) —
+        # splits the OOM state machine never had to discover
+        self.pre_split_count = 0
         #: per-operator {name: [retries, splits]} for the OOM dump
         self.per_op: Dict[str, List[int]] = {}
 
@@ -134,6 +138,11 @@ class RetryMetrics:
     def note_split(self, name: str) -> None:
         with self._lock:
             self.split_and_retry_count += 1
+            self.per_op.setdefault(name, [0, 0])[1] += 1
+
+    def note_presplit(self, name: str) -> None:
+        with self._lock:
+            self.pre_split_count += 1
             self.per_op.setdefault(name, [0, 0])[1] += 1
 
     def note_block(self, ns: int) -> None:
@@ -151,6 +160,7 @@ class RetryMetrics:
                 "splitAndRetryCount": self.split_and_retry_count,
                 "retryBlockTime": self.retry_block_time_ns,
                 "retrySpillBytes": self.spill_bytes_triggered,
+                "preSplitCount": self.pre_split_count,
             }
 
 
@@ -453,6 +463,30 @@ def split_input_halves(item):
     return item.split(_POLICY.split_floor_rows)
 
 
+def presplit_inputs(inp, target_rows: int,
+                    split: Callable = split_input_halves) -> List:
+    """Adaptive pre-split: cut an input measured over ``target_rows``
+    rows into in-order chunks BEFORE the first device attempt, using
+    the same split policy with_retry applies on OOM. A skew re-plan
+    that already measured one hot batch far over the row target should
+    not have to burn OOM attempts to discover what the shuffle
+    statistics already said; the split floor still bounds recursion.
+    Inputs without a ``rows`` measure pass through untouched."""
+    work, out = deque([inp]), []
+    while work:
+        item = work.popleft()
+        rows = getattr(item, "rows", None)
+        if rows is not None and rows > target_rows:
+            halves = split(item)
+            if halves:
+                _METRICS.note_presplit(getattr(item, "name", "presplit"))
+                for h in reversed(halves):
+                    work.appendleft(h)
+                continue
+        out.append(item)
+    return out
+
+
 def split_host_table(t):
     """Split policy for host-side (pyarrow) tables at the H2D boundary:
     device_put of half the rows needs half the fresh HBM. Zero-copy
@@ -526,7 +560,8 @@ def with_retry(inp, body: Callable, split: Optional[Callable] = None,
                *, catalog: Optional[BufferCatalog] = None, name: str = "op",
                max_retries: Optional[int] = None, semaphore=None,
                close_input: bool = True,
-               cancelled: Optional[Callable[[], bool]] = None):
+               cancelled: Optional[Callable[[], bool]] = None,
+               presplit_rows: Optional[int] = None):
     """Generator: run ``body`` over ``inp`` and whatever ``split`` makes
     of it under OOM, yielding each result in input-row order.
 
@@ -544,7 +579,13 @@ def with_retry(inp, body: Callable, split: Optional[Callable] = None,
     storm must not ride out its whole backoff budget after the server
     already cancelled the query (stop()/watchdog during a lineage
     recompute) — the loop raises RetryCancelledError instead of
-    re-running the body."""
+    re-running the body.
+
+    ``presplit_rows`` (optional, the adaptive skew-join seam): an input
+    measuring over this many rows is split through the SAME machinery
+    BEFORE its first attempt, so a re-planned hot partition whose one
+    giant batch the shuffle statistics already measured never has to
+    OOM its way down to a workable size."""
     cat = catalog
     if cat is None:
         from .catalog import device_budget
@@ -557,7 +598,11 @@ def with_retry(inp, body: Callable, split: Optional[Callable] = None,
         # finish and free HBM (no-op for threads that hold nothing)
         from .semaphore import global_semaphore
         semaphore = global_semaphore()
-    work = deque([inp])
+    if presplit_rows is not None and presplit_rows > 0 and \
+            split is not None and _POLICY.enabled:
+        work = deque(presplit_inputs(inp, presplit_rows, split))
+    else:
+        work = deque([inp])
     try:
         while work:
             item = work.popleft()

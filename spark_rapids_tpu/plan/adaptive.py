@@ -1,0 +1,260 @@
+"""Adaptive query execution: the runtime re-planner that consumes the
+observed-cost store.
+
+PR 15 landed the measurement half — per-(shape-fingerprint, operator)
+wall/rows/bytes EWMAs in ``trace.ObservedCostStore``, fleet-merged over
+the ``trace`` wire op. This module is the consumption half, with two
+seams:
+
+**Cost-fed planning** (``advise``, called from ``Session.prepare``):
+when a fingerprint has measured whole-query wall times for the device
+path and/or the CPU path (the synthetic ``query:device`` /
+``query:cpu`` operators Session records at collect close), placement
+replays the *measured* winner instead of the modeled CBO scores. A
+conf-gated exploration floor (``adaptive.costFeedback.exploreEvery``)
+periodically re-runs the losing — or never-measured — path so the
+EWMAs keep tracking reality. Cost-fed plans BYPASS the planning cache
+in both directions: they are never replayed from a cached
+``PlanDecisions`` and never written into one, so a measured decision
+can never poison a cached fingerprint with a placement that was only
+right for last week's data (see docs/adaptive.md).
+
+**Runtime re-planning at exchange boundaries** (instrumentation +
+decisions in shuffle/exchange.py and exec/join.py): after a shuffle
+write materializes, real partition sizes drive (a) coalescing runs of
+tiny partitions, (b) splitting skewed partitions — piece-range reader
+specs plus the PR-7 split-and-retry pre-split for oversized single
+batches — and (c) switching a shuffled hash join to broadcast when the
+built side measures under ``adaptive.broadcastJoin.maxBuildRows``.
+
+Every decision flows through :func:`record_decision`, which emits a
+metric, a reason tag (the ``dictenc.fallback_reasons`` ring idiom) and
+a trace span — never silent. ``tools/lint_adaptive.py`` enforces that
+discipline over the AST.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# metrics (process-wide; sessions report deltas between snapshots — the
+# retry/net/cache counter idiom, rolled up by Session.metrics() under
+# the "adaptive" prefix and by serving_stats()'s adaptive block)
+# ---------------------------------------------------------------------------
+
+
+class AdaptiveMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cost_fed_plans = 0
+        self.exploration_runs = 0
+        self.replans = 0
+        self.coalesced_partitions = 0
+        self.skew_splits = 0
+        self.broadcast_switches = 0
+
+    def note(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "costFedPlanCount": self.cost_fed_plans,
+                "explorationRunCount": self.exploration_runs,
+                "replanCount": self.replans,
+                "coalescedPartitionCount": self.coalesced_partitions,
+                "skewSplitCount": self.skew_splits,
+                "broadcastSwitchCount": self.broadcast_switches,
+            }
+
+
+_METRICS = AdaptiveMetrics()
+
+
+def metrics() -> AdaptiveMetrics:
+    return _METRICS
+
+
+# ---------------------------------------------------------------------------
+# reason tags (the dictenc fallback-ring idiom: process-wide, bounded,
+# sessions watermark with reason_mark() and read back what THEIR query
+# decided with reasons(since=mark))
+# ---------------------------------------------------------------------------
+
+_REASON_LOCK = threading.Lock()
+_REASONS: Dict[str, int] = {}     # reason -> sequence number of last record
+_REASON_SEQ = 0
+_REASON_CAP = 256
+
+#: decision kind -> AdaptiveMetrics counter attribute. The planning-time
+#: kinds count plans; the runtime kinds additionally count one re-plan
+#: each (a runtime decision IS a deviation from the static plan).
+#: tools/lint_adaptive.py keeps this table, the record_decision call
+#: sites and AdaptiveMetrics.snapshot() consistent.
+DECISION_KINDS: Dict[str, str] = {
+    "costFed": "cost_fed_plans",
+    "explore": "exploration_runs",
+    "coalesce": "coalesced_partitions",
+    "skewSplit": "skew_splits",
+    "broadcastSwitch": "broadcast_switches",
+}
+
+#: runtime re-planning kinds — each occurrence also bumps replans
+_RUNTIME_KINDS = ("coalesce", "skewSplit", "broadcastSwitch")
+
+
+def record_decision(kind: str, reason: str, n: int = 1) -> None:
+    """The ONE way an adaptive decision is taken: counts the kind's
+    metric (``n`` = partitions coalesced / splits performed / 1), tags
+    the reason in the process ring, and lands a zero-width
+    ``adaptive.<kind>`` span on the active query trace. A decision that
+    skipped any of the three surfaces would be silent somewhere —
+    tools/lint_adaptive.py pins call sites to this helper."""
+    global _REASON_SEQ
+    _METRICS.note(DECISION_KINDS[kind], n)
+    if kind in _RUNTIME_KINDS:
+        _METRICS.note("replans")
+    with _REASON_LOCK:
+        _REASON_SEQ += 1
+        _REASONS[f"{kind}: {reason}"] = _REASON_SEQ
+        if len(_REASONS) > _REASON_CAP:
+            del _REASONS[min(_REASONS, key=_REASONS.get)]
+    from ..trace import span
+    with span(f"adaptive.{kind}", kind="adaptive", reason=reason, n=n):
+        pass
+
+
+def reason_mark() -> int:
+    """Sequence watermark: only decisions recorded AFTER the mark show
+    in reasons(since=mark). A repeat of an earlier reason re-sequences
+    it (latest wins), same contract as dictenc.fallback_mark."""
+    with _REASON_LOCK:
+        return _REASON_SEQ
+
+
+def reasons(since: int = 0) -> List[str]:
+    with _REASON_LOCK:
+        return sorted((r for r, s in _REASONS.items() if s > since),
+                      key=lambda r: _REASONS[r])
+
+
+def clear_reasons() -> None:
+    """Test support."""
+    global _REASON_SEQ
+    with _REASON_LOCK:
+        _REASONS.clear()
+        _REASON_SEQ = 0
+
+
+# ---------------------------------------------------------------------------
+# cost-fed planning
+# ---------------------------------------------------------------------------
+
+#: synthetic operator names Session records whole-query wall time under
+#: (apples-to-apples: per-op ``opTime`` EWMAs are iterator-inclusive and
+#: cannot be summed across a tree without double counting)
+QUERY_DEVICE_OP = "query:device"
+QUERY_CPU_OP = "query:cpu"
+
+_RUNS_LOCK = threading.Lock()
+_PLAN_RUNS: Dict[str, int] = {}       # fp -> cost-fed plans taken
+_PLAN_RUNS_CAP = 4096
+
+
+def _bump_runs(fp: str) -> int:
+    with _RUNS_LOCK:
+        n = _PLAN_RUNS.get(fp, 0) + 1
+        _PLAN_RUNS[fp] = n
+        while len(_PLAN_RUNS) > _PLAN_RUNS_CAP:
+            _PLAN_RUNS.pop(next(iter(_PLAN_RUNS)))
+        return n
+
+
+def clear_runs() -> None:
+    """Test support."""
+    with _RUNS_LOCK:
+        _PLAN_RUNS.clear()
+
+
+def advise(conf, fp: str) -> Optional[str]:
+    """Consult the observed-cost store for this fingerprint and return
+    the measured placement — ``"device"``, ``"cpu"`` — or None when
+    nothing is measured (the modeled pipeline decides as before).
+
+    Both paths measured: the lower whole-query EWMA wins. One path
+    measured: keep it — except every ``exploreEvery``-th cost-fed plan
+    of the fingerprint, which runs the unmeasured (or losing) path so
+    its EWMA exists / stays fresh. Every branch records a decision."""
+    from ..config import ADAPTIVE_COST_MIN_COUNT, ADAPTIVE_EXPLORE_EVERY
+    from ..trace import observed_costs
+    ops = observed_costs().get(fp)
+    if not ops:
+        return None
+    min_count = max(1, int(conf.get(ADAPTIVE_COST_MIN_COUNT.key)))
+    dev = ops.get(QUERY_DEVICE_OP)
+    cpu = ops.get(QUERY_CPU_OP)
+    dev_ok = dev is not None and dev["count"] >= min_count
+    cpu_ok = cpu is not None and cpu["count"] >= min_count
+    if not dev_ok and not cpu_ok:
+        return None
+    every = int(conf.get(ADAPTIVE_EXPLORE_EVERY.key))
+    runs = _bump_runs(fp)
+    short = fp[:12]
+    if dev_ok and cpu_ok:
+        choice = "cpu" if cpu["wallNs"] < dev["wallNs"] else "device"
+        loser = "device" if choice == "cpu" else "cpu"
+        if every > 0 and runs % every == 0:
+            record_decision(
+                "explore",
+                f"fingerprint {short} run {runs}: re-measuring the "
+                f"losing {loser} path (exploreEvery={every})")
+            return loser
+        record_decision(
+            "costFed",
+            f"fingerprint {short}: measured cpu "
+            f"{cpu['wallNs'] / 1e6:.2f}ms vs device "
+            f"{dev['wallNs'] / 1e6:.2f}ms -> {choice}")
+        return choice
+    measured, other = ("device", "cpu") if dev_ok else ("cpu", "device")
+    if every > 0 and runs % every == 0:
+        record_decision(
+            "explore",
+            f"fingerprint {short} run {runs}: {other} path never "
+            f"measured (exploreEvery={every}) -> trying it")
+        return other
+    wall = (dev if dev_ok else cpu)["wallNs"]
+    record_decision(
+        "costFed",
+        f"fingerprint {short}: only {measured} measured "
+        f"({wall / 1e6:.2f}ms) -> {measured}")
+    return measured
+
+
+def force_cpu(meta, reason: str) -> None:
+    """Tag every node of a PlanMeta tree back to the CPU — the whole
+    plan converts to (nested) CpuFallbackExec islands and
+    Session.prepare classifies it "fallback", i.e. the host interpreter
+    runs it and its wall time feeds ``query:cpu``."""
+    meta.will_not_work(reason)
+    for c in meta.children:
+        force_cpu(c, reason)
+
+
+def note_query_wall(conf, fp: Optional[str], path: str,
+                    wall_ns: int) -> None:
+    """Record one whole-query wall observation under the synthetic
+    ``query:device`` / ``query:cpu`` operator for this fingerprint —
+    the comparison feed ``advise`` consumes. Same gating as the
+    per-operator feed: a fingerprint to key on and costStore.enabled
+    (and the caller must never report cached serves — nothing ran)."""
+    from ..config import TRACE_COST_STORE_ALPHA, TRACE_COST_STORE_ENABLED
+    if fp is None or not conf.get(TRACE_COST_STORE_ENABLED.key):
+        return
+    from ..trace import observed_costs
+    op = QUERY_DEVICE_OP if path == "device" else QUERY_CPU_OP
+    observed_costs().observe(
+        fp, op, int(wall_ns),
+        alpha=float(conf.get(TRACE_COST_STORE_ALPHA.key)))

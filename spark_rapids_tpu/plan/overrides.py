@@ -865,16 +865,27 @@ def estimate_rows(node: L.LogicalPlan) -> Optional[int]:
 class Overrides:
     """applyWithContext analogue: tag, then convert."""
 
-    def __init__(self, conf: Optional[RapidsTpuConf] = None):
+    def __init__(self, conf: Optional[RapidsTpuConf] = None,
+                 adaptive_advice: Optional[str] = None):
         self.conf = conf or RapidsTpuConf()
+        # cost-fed placement from plan/adaptive.py: "cpu" forces the
+        # whole plan to the host interpreter, "device" suppresses the
+        # modeled CBO veto (a measured speedup beats an estimated one),
+        # None keeps the modeled pipeline
+        self.adaptive_advice = adaptive_advice
 
     def plan(self, logical: L.LogicalPlan) -> Exec:
         meta = PlanMeta(logical, self.conf)
         meta.tag()
         propagate_host_only_data(meta)
-        from .cbo import CBO_ENABLED, CostBasedOptimizer
-        if self.conf.get(CBO_ENABLED.key):
-            CostBasedOptimizer(self.conf).optimize(meta)
+        if self.adaptive_advice == "cpu":
+            from .adaptive import force_cpu
+            force_cpu(meta, "adaptive cost-fed: measured CPU wall time "
+                            "beats the device path for this fingerprint")
+        elif self.adaptive_advice != "device":
+            from .cbo import CBO_ENABLED, CostBasedOptimizer
+            if self.conf.get(CBO_ENABLED.key):
+                CostBasedOptimizer(self.conf).optimize(meta)
         self.last_meta = meta
         converted = self._convert(meta)
         from ..config import COALESCE_MAX_ROWS
@@ -1158,19 +1169,25 @@ class Overrides:
         else:
             # shuffled hash join: co-partition both sides on the join keys
             # (large or unknown-size build must NOT be replicated)
-            from ..config import (ADAPTIVE_ENABLED, SKEW_JOIN_ENABLED,
+            from ..config import (ADAPTIVE_BROADCAST_ENABLED,
+                                  ADAPTIVE_BROADCAST_MAX_BUILD_ROWS,
+                                  ADAPTIVE_ENABLED, SKEW_JOIN_ENABLED,
                                   SKEW_SPLIT_ROWS)
-            skew = None
-            if self.conf.get(ADAPTIVE_ENABLED.key) and \
-                    self.conf.get(SKEW_JOIN_ENABLED.key):
-                skew = self.conf.get(SKEW_SPLIT_ROWS.key)
+            skew = bswitch = None
+            if self.conf.get(ADAPTIVE_ENABLED.key):
+                if self.conf.get(SKEW_JOIN_ENABLED.key):
+                    skew = self.conf.get(SKEW_SPLIT_ROWS.key)
+                if self.conf.get(ADAPTIVE_BROADCAST_ENABLED.key):
+                    bswitch = int(self.conf.get(
+                        ADAPTIVE_BROADCAST_MAX_BUILD_ROWS.key))
             parts = self._shuffle_partitions()
             join = HashJoinExec(
                 left_keys, right_keys, n.join_type,
                 self._exchange(HashPartitioning(left_keys, parts), l),
                 self._exchange(HashPartitioning(right_keys, parts), r),
                 condition=n.condition, broadcast_build=False,
-                max_build_rows=max_build, skew_split_rows=skew)
+                max_build_rows=max_build, skew_split_rows=skew,
+                broadcast_switch_rows=bswitch)
         if swapped:
             # restore the user-facing column order (left cols, right cols)
             nl = len(ch[0].output_schema.fields)

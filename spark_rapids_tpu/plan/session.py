@@ -46,6 +46,8 @@ class Session:
         # watermark: dict_fallbacks() reports only reasons recorded on
         # THIS session's watch (the store itself is process-wide)
         self._dict_fb_mark = fallback_mark()
+        from . import adaptive
+        self._adaptive_mark0 = adaptive.reason_mark()
 
     def with_conf(self, **kv) -> "Session":
         settings = dict(self.conf._settings)
@@ -81,6 +83,22 @@ class Session:
                     self.last_cache["plan"] = f"uncacheable: {e.reason}"
                 self.last_fingerprint = fp
                 if fp is not None:
+                    from ..config import ADAPTIVE_COST_ENABLED
+                    if self.conf.get(ADAPTIVE_COST_ENABLED.key):
+                        from . import adaptive
+                        advice = adaptive.advise(self.conf, fp)
+                        if advice is not None:
+                            # measured placement: never replayed from —
+                            # and never written into — the planning
+                            # cache, so a cost-fed decision cannot
+                            # poison a cached fingerprint with a
+                            # placement the EWMAs have since outgrown
+                            self.last_cache["plan"] = \
+                                f"bypass: adaptive cost-fed ({advice})"
+                            if sp is not None:
+                                sp.attrs["planCache"] = "adaptive"
+                            return self._plan_fresh(df, fp, advice=advice,
+                                                    cache_put=False)
                     decisions = plancache.planning_cache().get(fp)
                     if decisions is not None:
                         prepared = self._plan_from_decisions(df, decisions)
@@ -95,11 +113,14 @@ class Session:
                     else "uncacheable"
             return self._plan_fresh(df, fp)
 
-    def _plan_fresh(self, df: DataFrame, fp: Optional[str]):
+    def _plan_fresh(self, df: DataFrame, fp: Optional[str],
+                    advice: Optional[str] = None, cache_put: bool = True):
         """The uncached planning pipeline; when ``fp`` is set, the
         tag/CBO outcome and the fusion/mesh eligibility land in the
-        process planning cache for the next same-shape query."""
-        ov = Overrides(self.conf)
+        process planning cache for the next same-shape query (cost-fed
+        plans pass cache_put=False: adaptive decisions stay as fresh as
+        the EWMAs that made them)."""
+        ov = Overrides(self.conf, adaptive_advice=advice)
         plan = ov.plan(df.plan)
         self.last_plan = plan
         from .overrides import CpuFallbackExec as _CFE
@@ -135,7 +156,7 @@ class Session:
                         plan = fused
                         self.last_plan = plan
                         fuse_eligible = True
-        if fp is not None:
+        if fp is not None and cache_put:
             from ..config import SERVER_PLAN_CACHE_MAX_ENTRIES
             from . import plancache
             plancache.metrics().note("plan_misses")
@@ -200,13 +221,15 @@ class Session:
         from ..memory.retry import metrics as _retry_metrics
         from ..shuffle.lineage import metrics as _lineage_metrics
         from ..shuffle.transport import transport_metrics
-        from . import plancache
+        from . import adaptive, plancache
         self._retry0 = _retry_metrics().snapshot()
         self._net0 = transport_metrics().snapshot()
         self._lineage0 = _lineage_metrics().snapshot()
         self._sem_wait0 = _python_semaphore.wait_time_ns
         self._cache0 = plancache.metrics().snapshot()
         self._trace0 = qtrace.metrics().snapshot()
+        self._adaptive0 = adaptive.metrics().snapshot()
+        self._adaptive_mark0 = adaptive.reason_mark()
 
     def try_cached_result(self, df: DataFrame) -> Optional[pa.Table]:
         """Serving-tier fast path: consult the result cache WITHOUT
@@ -347,12 +370,15 @@ class Session:
                 result = Interpreter(ansi=self.conf.ansi).execute(df.plan)
             return self._store_result(kd, result)
         if kind == "fallback":
+            import time as _time
+            t0 = _time.perf_counter_ns()
             with qtrace.span("cpuFallback", kind="execute"):
                 result = plan.interpret()
             # CPU-topped plans feed the cost store too: a measured
             # host-side operator cost is exactly the comparison point
             # an offload-decision CBO needs against the device path
             self._note_costs(plan)
+            self._note_query_wall("cpu", _time.perf_counter_ns() - t0)
             return self._store_result(kd, result)
         from ..exec.base import collect as collect_exec
         from ..memory.retry import apply_session_conf
@@ -361,9 +387,12 @@ class Session:
         # the metric watermarks were taken at query open in _watermark()
         apply_session_conf(self.conf)
         try:
+            import time as _time
+            t0 = _time.perf_counter_ns()
             with qtrace.span("execute", kind="execute"):
                 result = collect_exec(plan)
             self._note_costs(plan)
+            self._note_query_wall("device", _time.perf_counter_ns() - t0)
             return self._store_result(kd, result)
         finally:
             plan.close()    # free catalog-registered exchange/broadcast state
@@ -378,10 +407,26 @@ class Session:
         if self.last_fingerprint is None or \
                 not self.conf.get(TRACE_COST_STORE_ENABLED.key):
             return
+        if self._cached_serve is not None:
+            # result-cache hit: NOTHING executed, so there is no
+            # measurement — a verbatim cached reply must not drag the
+            # per-operator wall EWMAs toward zero for this fingerprint
+            return
         from .. import trace as qtrace
         qtrace.note_operator_costs(
             self.last_fingerprint, plan,
             alpha=float(self.conf.get(TRACE_COST_STORE_ALPHA.key)))
+
+    def _note_query_wall(self, path: str, wall_ns: int) -> None:
+        """Whole-query wall observation under the synthetic query:device
+        / query:cpu cost-store operator — the apples-to-apples feed
+        cost-fed planning (plan/adaptive.py) compares. Cached serves
+        never reach here (try_cached_result returns before execution)."""
+        if self._cached_serve is not None:
+            return
+        from . import adaptive
+        adaptive.note_query_wall(self.conf, self.last_fingerprint,
+                                 path, wall_ns)
 
     def _mesh(self):
         """1-axis data-parallel mesh over the visible devices."""
@@ -511,6 +556,11 @@ class Session:
         from .. import trace as qtrace
         emit_deltas("trace", qtrace.metrics().snapshot(),
                     getattr(self, "_trace0", None))
+        # adaptive-execution counters (cost-fed plans, exploration runs,
+        # runtime re-plans: coalesces / skew splits / broadcast switches)
+        from . import adaptive
+        emit_deltas("adaptive", adaptive.metrics().snapshot(),
+                    getattr(self, "_adaptive0", None))
         return out
 
     def executed_exec_names(self) -> List[str]:
@@ -537,6 +587,15 @@ class Session:
             return list(self._cached_serve[1])
         return [n for n in self.executed_exec_names()
                 if n.startswith("CpuFallback")]
+
+    def adaptive_decisions(self) -> List[str]:
+        """Reason tags of every adaptive decision taken since this
+        session's last query opened (cost-fed placement, exploration,
+        runtime coalesce/skew-split/broadcast-switch) — the never-silent
+        surface the plan server forwards in its reply. Same
+        process-ring-plus-watermark contract as dict_fallbacks()."""
+        from . import adaptive
+        return adaptive.reasons(since=getattr(self, "_adaptive_mark0", 0))
 
     def dict_fallbacks(self) -> List[str]:
         """willNotWork-style reason tags recorded when a dictionary-encoded

@@ -94,6 +94,9 @@ class PlanClient:
         #: fleet is the client's) + the client-side leg of its timeline
         self.last_query_id: str = ""
         self.last_fingerprint: str = ""
+        #: adaptive-decision reason tags of the last collect (cost-fed
+        #: placement / exploration / runtime re-plans, never silent)
+        self.last_adaptive: List[str] = []
         self._last_client_profile: Optional[dict] = None
         try:
             self._connect()
@@ -250,6 +253,7 @@ class PlanClient:
         self.last_cached = bool(reply.get("cached"))
         self.last_worker = str(reply.get("worker", ""))
         self.last_fingerprint = str(reply.get("fingerprint", ""))
+        self.last_adaptive = reply.get("adaptive", [])
         return protocol.ipc_to_table(body)
 
     def collect_catalyst(self, plan_json, tables: Optional[Dict[

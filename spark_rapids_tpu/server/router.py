@@ -65,7 +65,8 @@ from ..config import (FLEET_ADMISSION_TIMEOUT_MS, FLEET_DRAIN_TIMEOUT_MS,
                       FLEET_SPILLOVER_QUEUE_DEPTH, FLEET_TENANT_ID,
                       FLEET_TENANT_MAX_CONCURRENT, FLEET_TENANT_WEIGHTS,
                       FLEET_VNODES, FLEET_WORKER_RETRIES, FLEET_WORKERS,
-                      FLEET_RESULT_STORE_PATH, RapidsTpuConf,
+                      FLEET_RESULT_STORE_PATH, FLEET_COST_SYNC_PLANS,
+                      RapidsTpuConf,
                       SERVER_CONCURRENT_COLLECTS, SERVER_RESULT_CACHE_ENABLED,
                       SERVER_RETRY_AFTER_MS, SERVER_TRACE_RECORDER_ENTRIES,
                       SERVER_TRACE_SLOW_QUERY_MS, TRACE_ENABLED,
@@ -690,25 +691,7 @@ class _RouterSession:
         per operator)."""
         router = self.router
         if header.get("what") == "costs":
-            fp = header.get("fingerprint")
-            merged: Dict[str, Dict[str, dict]] = {}
-            for w in router.routable_workers():
-                try:
-                    reply = _admin_request(
-                        w.host, w.port,
-                        {"msg": "trace", "what": "costs",
-                         **({"fingerprint": fp} if fp else {})})
-                except (OSError, protocol.ProtocolError):
-                    continue    # net-ok: costs are best-effort reads
-                for fprint, ops in (reply.get("costs") or {}).items():
-                    if not ops:
-                        continue
-                    dst = merged.setdefault(fprint, {})
-                    for op, e in ops.items():
-                        if op not in dst or \
-                                e.get("count", 0) > \
-                                dst[op].get("count", 0):
-                            dst[op] = e
+            merged = router.merged_costs(header.get("fingerprint"))
             return {"msg": "trace_ack", "costs": merged}, b""
         qid = header.get("query_id") or None
         profiles = router.recorder.profiles(
@@ -1076,6 +1059,10 @@ class Router:
         self.fp_fallbacks = 0
         self.spillovers = 0
         self._overhead_ns = deque(maxlen=8192)
+        # --- adaptive cost sharing (0 = on-demand only) ---
+        self.cost_sync_plans = int(tconf.get(FLEET_COST_SYNC_PLANS.key))
+        self.cost_syncs = 0
+        self.cost_entries_adopted = 0
 
         # --- observability: the router's own flight recorder (its leg
         # of each traced query's timeline) + which worker served which
@@ -1170,6 +1157,65 @@ class Router:
         with self._lock:
             self.plans_routed += 1
             self._overhead_ns.append(overhead_ns)
+            due = (self.cost_sync_plans > 0
+                   and self.plans_routed % self.cost_sync_plans == 0)
+        if due:
+            # outside the lock: sync_costs fans out over the network
+            self.sync_costs()
+
+    # ---- adaptive cost sharing ----
+    def merged_costs(self, fp: Optional[str] = None
+                     ) -> Dict[str, Dict[str, dict]]:
+        """Pull every routable worker's observed-cost store over the
+        ``trace what=costs`` admin op and merge per operator — the
+        highest observation count wins, so the worker that has seen a
+        shape most often speaks for the fleet."""
+        merged: Dict[str, Dict[str, dict]] = {}
+        for w in self.routable_workers():
+            try:
+                reply = _admin_request(
+                    w.host, w.port,
+                    {"msg": "trace", "what": "costs",
+                     **({"fingerprint": fp} if fp else {})})
+            except (OSError, protocol.ProtocolError):
+                continue    # net-ok: costs are best-effort reads
+            for fprint, ops in (reply.get("costs") or {}).items():
+                if not ops:
+                    continue
+                dst = merged.setdefault(fprint, {})
+                for op, e in ops.items():
+                    if op not in dst or \
+                            e.get("count", 0) > \
+                            dst[op].get("count", 0):
+                        dst[op] = e
+        return merged
+
+    def sync_costs(self) -> dict:
+        """Fleet cost sync: merge the per-worker observed-cost stores
+        (merged_costs) and push the result back to every routable
+        worker over the ``costs_load`` op. Afterwards worker B plans
+        from costs worker A measured — the adaptive cost-fed path
+        works fleet-wide, not just per worker. Best-effort per worker;
+        returns {'workers': pushed, 'fingerprints': merged,
+        'adopted': total entries adopted across the fleet}."""
+        merged = self.merged_costs()
+        pushed = 0
+        adopted = 0
+        if merged:
+            for w in self.routable_workers():
+                try:
+                    reply = _admin_request(
+                        w.host, w.port,
+                        {"msg": "costs_load", "costs": merged})
+                except (OSError, protocol.ProtocolError):
+                    continue    # net-ok: the next sync catches it up
+                pushed += 1
+                adopted += int(reply.get("adopted", 0) or 0)
+        with self._lock:
+            self.cost_syncs += 1
+            self.cost_entries_adopted += adopted
+        return {"workers": pushed, "fingerprints": len(merged),
+                "adopted": adopted}
 
     def note_query_worker(self, query_id: str, wid: str) -> None:
         """Remember which worker served a query_id (bounded LRU) so the
@@ -1288,6 +1334,8 @@ class Router:
             plans = self.plans_routed
             failovers = self.failovers
             fallbacks = self.fp_fallbacks
+            cost_syncs = self.cost_syncs
+            cost_adopted = self.cost_entries_adopted
         per_worker = {}
         for w in self.routable_workers():
             try:
@@ -1301,7 +1349,15 @@ class Router:
             # v2: adds the `trace` block (the router's flight-recorder
             # occupancy/slow/dropped counters; each worker's own trace
             # block rides its per-worker stats below)
-            "schemaVersion": 2,
+            # v3: adds the `adaptive` block (fleet cost syncs; each
+            # worker's own adaptive decision counters ride its
+            # per-worker stats below)
+            "schemaVersion": 3,
+            "adaptive": {
+                "costSyncCount": cost_syncs,
+                "costEntriesAdopted": cost_adopted,
+                "costSyncEveryPlans": self.cost_sync_plans,
+            },
             "router": True,
             "trace": {
                 "recorder": self.recorder.stats(),

@@ -377,6 +377,16 @@ class _Handler(socketserver.BaseRequestHandler):
                         header.get("query_id") or None,
                         last=int(header.get("last", 0) or 0)),
                     "recorder": rec.stats()}, b""
+        if msg == "costs_load":
+            # fleet cost-sharing ingress: adopt a merged observed-cost
+            # snapshot the router fanned out (Router.sync_costs), so
+            # THIS worker's next prepare of a shape a sibling measured
+            # takes the cost-fed planning path. Per-entry highest
+            # observation count wins — same rule as the read-side merge.
+            from .. import trace as qtrace
+            adopted = qtrace.observed_costs().merge_snapshot(
+                header.get("costs") or {})
+            return {"msg": "costs_ack", "adopted": adopted}, b""
         if msg == "plan":
             from .. import trace as qtrace
             plan = plandoc.doc_to_plan(header["plan"], tables)
@@ -489,6 +499,12 @@ class _Handler(socketserver.BaseRequestHandler):
             # lets a client ask the observed-cost store about exactly
             # this query's shape (trace op, what="costs")
             reply["fingerprint"] = ses.last_fingerprint
+        decisions = ses.adaptive_decisions()
+        if decisions:
+            # never-silent surface of the adaptive re-planner: the
+            # reason tag of every cost-fed / exploration / runtime
+            # re-plan decision this query took rides the reply
+            reply["adaptive"] = decisions
         return reply, body_out
 
     @staticmethod
@@ -615,14 +631,18 @@ class PlanServer:
         stable (``schemaVersion`` guards it): the router aggregates
         these fleet-wide and ``readiness_line`` formats from the
         ``server`` block, so every field here is load-bearing."""
-        from ..plan import plancache
+        from ..plan import adaptive, plancache
         from ..shuffle.lineage import metrics as lineage_metrics
         from ..trace import observed_costs
         adm = self._server.query_admission
         return {
             # v2: adds the `trace` block (flight-recorder occupancy,
             # slow-query count, dropped spans, cost-store size)
-            "schemaVersion": 2,
+            # v3: adds the `adaptive` block (cost-fed plans,
+            # exploration runs, runtime re-plans: coalesces / skew
+            # splits / broadcast switches)
+            "schemaVersion": 3,
+            "adaptive": adaptive.metrics().snapshot(),
             "trace": {
                 "recorder": self._server.trace_recorder.stats(),
                 "costFingerprints": len(observed_costs()),

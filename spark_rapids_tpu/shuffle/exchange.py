@@ -148,6 +148,14 @@ class ShuffleExchangeExec(UnaryExec):
             if self.adaptive:
                 counts = [sum(rows for _, rows in pieces) for pieces in parts]
                 groups = _coalesce_groups(counts, self.target_rows)
+                if len(groups) < len(parts):
+                    from ..plan.adaptive import record_decision
+                    record_decision(
+                        "coalesce",
+                        f"solo exchange: {len(parts)} materialized "
+                        f"partitions -> {len(groups)} reader partitions "
+                        f"(targetRows={self.target_rows})",
+                        n=len(parts) - len(groups))
             else:
                 groups = [[p] for p in range(len(parts))]
             self.set_reader_specs(
@@ -441,11 +449,41 @@ def coordinate_join_reads(stream: "ShuffleExchangeExec",
     each paired with a full replica of the matching build partition
     (PartialReducerPartitionSpec semantics). Returns the number of skew
     splits performed."""
+    from ..plan.adaptive import record_decision
     sc = stream.partition_row_counts()
     bc = build.partition_row_counts()
     assert len(sc) == len(bc), (len(sc), len(bc))
     combined = [a + b for a, b in zip(sc, bc)]
-    groups = _coalesce_groups(combined, target_rows)
+    if skew_split_rows:
+        # skewed partitions are NOT coalesceable (OptimizeSkewedJoin
+        # runs before coalescing): each becomes its own singleton group
+        # so the split branch below sees it, and only the thin runs
+        # BETWEEN skewed partitions coalesce toward target_rows.
+        groups = []
+        run: List[int] = []
+        for p, c in enumerate(combined):
+            if sc[p] > skew_split_rows:
+                if run:
+                    groups += [[run[i] for i in g] for g in
+                               _coalesce_groups([combined[i] for i in run],
+                                                target_rows)]
+                    run = []
+                groups.append([p])
+            else:
+                run.append(p)
+        if run:
+            groups += [[run[i] for i in g] for g in
+                       _coalesce_groups([combined[i] for i in run],
+                                        target_rows)]
+    else:
+        groups = _coalesce_groups(combined, target_rows)
+    if len(groups) < len(combined):
+        record_decision(
+            "coalesce",
+            f"coordinated join exchanges: {len(combined)} materialized "
+            f"partitions -> {len(groups)} reader partitions "
+            f"(targetRows={target_rows})",
+            n=len(combined) - len(groups))
     s_specs: List[ReadSpec] = []
     b_specs: List[ReadSpec] = []
     n_splits = 0
@@ -464,6 +502,12 @@ def coordinate_join_reads(stream: "ShuffleExchangeExec",
             np_build = len(build.piece_row_counts(p))
             if len(chunks) > 1:
                 n_splits += len(chunks) - 1
+                record_decision(
+                    "skewSplit",
+                    f"partition {p}: {sc[p]} stream rows > "
+                    f"splitRows={skew_split_rows} -> {len(chunks)} "
+                    f"piece-range reader partitions (build replicated)",
+                    n=len(chunks) - 1)
             for c_lo, c_hi in chunks:
                 s_specs.append([(p, c_lo, c_hi)])
                 b_specs.append([(p, 0, np_build)])

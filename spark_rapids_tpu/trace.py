@@ -508,6 +508,38 @@ class ObservedCostStore:
             return {fp: {op: dict(e) for op, e in ops.items()}
                     for fp, ops in self._fps.items()}
 
+    def merge_snapshot(self, snap: Dict[str, Dict[str, dict]]) -> int:
+        """Fold another store's snapshot into this one — the fleet
+        cost-sharing op (router sync / costs_load wire op). Per (fp,
+        op), the entry with the HIGHER observation count wins (same
+        rule the router's trace-op merge applies): a better-measured
+        EWMA beats a fresher-but-thinner one, and re-merging the same
+        snapshot is idempotent. Returns entries adopted."""
+        adopted = 0
+        with self._lock:
+            for fp, ops in snap.items():
+                if not isinstance(ops, dict):
+                    continue
+                mine = self._fps.get(fp)
+                if mine is None:
+                    mine = self._fps[fp] = {}
+                self._fps.move_to_end(fp)
+                for op, e in ops.items():
+                    try:
+                        entry = {"wallNs": float(e["wallNs"]),
+                                 "rows": float(e.get("rows", 0)),
+                                 "bytes": float(e.get("bytes", 0)),
+                                 "count": int(e["count"])}
+                    except (KeyError, TypeError, ValueError):
+                        continue     # malformed peer entry: skip, not fail
+                    cur = mine.get(op)
+                    if cur is None or entry["count"] > cur["count"]:
+                        mine[op] = entry
+                        adopted += 1
+            while len(self._fps) > self.max_fingerprints:
+                self._fps.popitem(last=False)
+        return adopted
+
     def clear(self) -> None:
         with self._lock:
             self._fps.clear()

@@ -845,8 +845,14 @@ def test_stats_wire_op_and_stable_schema():
             c.collect(df)
             st = c.stats()
         # v2: the trace block (flight-recorder occupancy, slow-query
-        # count, dropped spans, cost-store size) joined the schema
-        assert st["schemaVersion"] == 2
+        # count, dropped spans, cost-store size) joined the schema;
+        # v3: the adaptive block (cost-fed plans + runtime re-plan
+        # counters) joined it
+        assert st["schemaVersion"] == 3
+        assert set(st["adaptive"]) == {
+            "costFedPlanCount", "explorationRunCount", "replanCount",
+            "coalescedPartitionCount", "skewSplitCount",
+            "broadcastSwitchCount"}
         tr = st["trace"]
         assert set(tr) == {"recorder", "costFingerprints"}
         assert set(tr["recorder"]) == {

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Repo lint: adaptive decisions cannot be silent and adaptive confs
+cannot be undocumented.
+
+The adaptive contract (plan/adaptive.py) is that EVERY cost-fed or
+runtime re-planning decision flows through ``record_decision(kind,
+reason, ...)`` — which bumps a metric, tags a reason and lands a trace
+span. This lint pins that contract over the AST (no imports of the
+checked code — the lint_retry/lint_metrics discipline), run in tier-1
+via tests/test_adaptive.py::test_lint_adaptive_clean:
+
+1. **Decision sites** — every ``record_decision(...)`` call in
+   ``spark_rapids_tpu/`` passes a LITERAL kind string registered in
+   ``DECISION_KINDS`` and a non-empty reason (literal, f-string or
+   expression — present, never omitted). An unregistered kind would
+   KeyError at runtime only on the path that takes it; a missing
+   reason is a silent decision.
+
+2. **Kind coverage** — every kind registered in ``DECISION_KINDS`` has
+   at least one ``record_decision`` call site in the package, its
+   counter attribute is initialized in ``AdaptiveMetrics.__init__``,
+   and every counter initialized there is read back in
+   ``snapshot()``. A kind nobody records is a stale table entry; a
+   counter snapshot() skips is invisible to Session.metrics(),
+   serving_stats() and the fleet.
+
+3. **Conf docs** — every registered conf key under the adaptive
+   surface (``spark.rapids.tpu.sql.adaptive.*`` and the fleet
+   ``...fleet.costSync.*`` keys) appears in docs/configs.md, and no
+   documented adaptive key has lost its registration. Missing and
+   stale both fail ("rerun tools/generate_docs.py and commit").
+
+Exit status 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "spark_rapids_tpu")
+ADAPTIVE = os.path.join(PKG, "plan", "adaptive.py")
+CONFIG = os.path.join(PKG, "config.py")
+CONFIGS_MD = os.path.join(ROOT, "docs", "configs.md")
+
+#: conf-key fragments that mark a key as part of the adaptive surface
+ADAPTIVE_KEY_MARKERS = (".sql.adaptive.", ".fleet.costSync.")
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, _, names in os.walk(root):
+        for n in names:
+            if n.endswith(".py"):
+                out.append(os.path.join(dirpath, n))
+    return sorted(out)
+
+
+def _parse(path: str) -> ast.Module:
+    with open(path, "r", encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the source of truth: DECISION_KINDS and AdaptiveMetrics, read from
+# plan/adaptive.py's AST
+# ---------------------------------------------------------------------------
+
+
+def _decision_kinds() -> Dict[str, str]:
+    """kind -> counter attribute, from the DECISION_KINDS literal."""
+    for node in ast.walk(_parse(ADAPTIVE)):
+        if isinstance(node, ast.AnnAssign) or isinstance(node, ast.Assign):
+            targets = [node.target] if isinstance(node, ast.AnnAssign) \
+                else node.targets
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if "DECISION_KINDS" in names and \
+                    isinstance(node.value, ast.Dict):
+                out: Dict[str, str] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(v, ast.Constant):
+                        out[str(k.value)] = str(v.value)
+                return out
+    return {}
+
+
+def _metrics_class() -> Optional[ast.ClassDef]:
+    for node in ast.walk(_parse(ADAPTIVE)):
+        if isinstance(node, ast.ClassDef) and \
+                node.name == "AdaptiveMetrics":
+            return node
+    return None
+
+
+def _counter_attrs(cls: ast.ClassDef) -> Set[str]:
+    """public ``self.x = <int literal>`` attributes of __init__."""
+    out: Set[str] = set()
+    for fn in cls.body:
+        if isinstance(fn, ast.FunctionDef) and fn.name == "__init__":
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self" and \
+                                not t.attr.startswith("_") and \
+                                isinstance(node.value, ast.Constant) and \
+                                isinstance(node.value.value, int):
+                            out.add(t.attr)
+    return out
+
+
+def _snapshot_reads(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for fn in cls.body:
+        if isinstance(fn, ast.FunctionDef) and fn.name == "snapshot":
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "self":
+                    out.add(node.attr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rules 1 + 2: decision sites and kind coverage
+# ---------------------------------------------------------------------------
+
+
+def lint_decision_sites() -> List[str]:
+    problems: List[str] = []
+    kinds = _decision_kinds()
+    if not kinds:
+        return ["plan/adaptive.py: DECISION_KINDS dict literal not "
+                "found (the lint's source of truth is gone)"]
+    recorded: Set[str] = set()
+    for path in _py_files(PKG):
+        rel = os.path.relpath(path, os.path.dirname(PKG)) \
+            .replace(os.sep, "/")
+        for node in ast.walk(_parse(path)):
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node) == "record_decision"):
+                continue
+            args = list(node.args)
+            if not args or not (isinstance(args[0], ast.Constant)
+                                and isinstance(args[0].value, str)):
+                problems.append(
+                    f"{rel}:{node.lineno}: record_decision kind must be "
+                    f"a literal string from DECISION_KINDS (the lint "
+                    f"cannot see through a variable)")
+                continue
+            kind = args[0].value
+            if kind not in kinds:
+                problems.append(
+                    f"{rel}:{node.lineno}: record_decision kind "
+                    f"{kind!r} is not registered in "
+                    f"plan/adaptive.py DECISION_KINDS")
+            else:
+                recorded.add(kind)
+            reason = args[1] if len(args) > 1 else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "reason"), None)
+            if reason is None or (isinstance(reason, ast.Constant)
+                                  and not str(reason.value).strip()):
+                problems.append(
+                    f"{rel}:{node.lineno}: record_decision({kind!r}) "
+                    f"carries no reason — adaptive decisions must "
+                    f"explain themselves")
+    for kind in sorted(set(kinds) - recorded):
+        problems.append(
+            f"plan/adaptive.py: DECISION_KINDS registers {kind!r} but "
+            f"no record_decision({kind!r}, ...) call site exists "
+            f"(stale table entry)")
+    return problems
+
+
+def lint_metric_surface() -> List[str]:
+    problems: List[str] = []
+    kinds = _decision_kinds()
+    cls = _metrics_class()
+    if cls is None:
+        return ["plan/adaptive.py: class AdaptiveMetrics not found"]
+    counters = _counter_attrs(cls)
+    reads = _snapshot_reads(cls)
+    for kind, attr in sorted(kinds.items()):
+        if attr not in counters:
+            problems.append(
+                f"plan/adaptive.py: DECISION_KINDS[{kind!r}] counts "
+                f"{attr!r} but AdaptiveMetrics.__init__ never "
+                f"initializes it")
+    for attr in sorted(counters - reads):
+        problems.append(
+            f"plan/adaptive.py: AdaptiveMetrics counter {attr!r} is "
+            f"never read in snapshot() — invisible to "
+            f"Session.metrics() and serving_stats()")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# rule 3: adaptive confs <-> docs/configs.md
+# ---------------------------------------------------------------------------
+
+
+def _registered_adaptive_confs() -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(_parse(CONFIG)):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "conf" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            key = node.args[0].value
+            if any(m in key for m in ADAPTIVE_KEY_MARKERS):
+                out.add(key)
+    return out
+
+
+def _documented_adaptive_confs() -> Set[str]:
+    keys: Set[str] = set()
+    with open(CONFIGS_MD, "r", encoding="utf-8") as f:
+        for line in f:
+            m = re.match(r"\|\s*(spark\.rapids\.tpu\.[\w.]+)\s*\|", line)
+            if m and any(mk in m.group(1)
+                         for mk in ADAPTIVE_KEY_MARKERS):
+                keys.add(m.group(1))
+    return keys
+
+
+def lint_conf_docs() -> List[str]:
+    problems: List[str] = []
+    registered = _registered_adaptive_confs()
+    documented = _documented_adaptive_confs()
+    if not registered:
+        problems.append(
+            "config.py: no adaptive confs registered at all — the "
+            "adaptive surface lost its configuration")
+    for k in sorted(registered - documented):
+        problems.append(
+            f"docs/configs.md: adaptive conf {k} is registered but "
+            f"undocumented — rerun tools/generate_docs.py and commit")
+    for k in sorted(documented - registered):
+        problems.append(
+            f"docs/configs.md: adaptive conf {k} is documented but no "
+            f"longer registered (stale docs) — rerun "
+            f"tools/generate_docs.py")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+
+
+def lint_all() -> List[str]:
+    return (lint_decision_sites() + lint_metric_surface()
+            + lint_conf_docs())
+
+
+def main() -> int:
+    problems = lint_all()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"\nlint_adaptive: {len(problems)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("lint_adaptive: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,7 @@ GROUPS: Dict[str, Tuple[str, str]] = {
     "shuffle/lineage.py": ("LineageMetrics", "lineage"),
     "plan/plancache.py": ("ServingMetrics", "cache"),
     "trace.py": ("TraceMetrics", "trace"),
+    "plan/adaptive.py": ("AdaptiveMetrics", "adaptive"),
 }
 
 SESSION = os.path.join(PKG, "plan", "session.py")

@@ -1,0 +1,233 @@
+"""Skewed-join bench: adaptive runtime re-planning vs the static plan.
+
+The adaptive subsystem (plan/adaptive.py + the exchange/join seams)
+claims that after a shuffle materializes, REAL partition sizes beat the
+planner's uniform assumption: runs of tiny partitions coalesce into few
+reader partitions, a skewed partition splits into piece ranges (build
+replicated), and a build side that measures small switches the shuffled
+join to broadcast. This bench puts a number on that claim over the
+worst realistic shape — one hot key owning ~half the fact table, the
+rest spread thin across many shuffle partitions.
+
+Data (seeded, reproducible): a fact table of SKEW_ROWS rows where key 0
+takes SKEW_HOT_FRAC (default 0.5) of the rows and the remainder is
+uniform over SKEW_KEYS keys; a dim table with one row per key. The
+query is the TPC-ish probe: fact JOIN dim on the key, group-by the
+fact's group column summing a measure from EACH side, order-by — so a
+wrong join or a dropped partition cannot produce the right answer.
+
+Legs (interleaved A/B/A/B reps, min per leg — the
+exchange_microbench timing discipline):
+
+  static            adaptive.enabled=false: one reader partition per
+                    shuffle partition, the hot partition probed as one
+                    giant batch.
+  adaptive          coalesce + skew split on (runtime broadcast switch
+                    off): tiny partitions coalesce toward targetRows,
+                    the hot partition splits at skewJoin.splitRows.
+  adaptive_bcast    the full re-planner: additionally the shuffled
+                    join switches to broadcast when the build side
+                    measures under broadcastJoin.maxBuildRows.
+
+autoBroadcastJoinThreshold is pinned to 0 in EVERY leg so the planner
+always emits the shuffled join — the bench measures runtime
+re-planning, not the planner's byte estimate. All legs must return
+bit-for-bit identical tables or the bench refuses to print numbers.
+
+Run: JAX_PLATFORMS=cpu python tools/skew_bench.py [--json-out BENCH_skew.json]
+Tune: SKEW_ROWS / SKEW_KEYS / SKEW_PARTS / SKEW_REPS env vars.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_ROWS = int(os.environ.get("SKEW_ROWS", 1 << 17))
+N_KEYS = int(os.environ.get("SKEW_KEYS", 1 << 12))
+N_PARTS = int(os.environ.get("SKEW_PARTS", 32))
+REPS = int(os.environ.get("SKEW_REPS", 3))
+HOT_FRAC = float(os.environ.get("SKEW_HOT_FRAC", 0.5))
+SPLIT_ROWS = int(os.environ.get("SKEW_SPLIT_ROWS", 1 << 14))
+SEED = int(os.environ.get("SKEW_SEED", 29))
+
+
+def make_skewed_tables(n_rows=N_ROWS, n_keys=N_KEYS,
+                       hot_frac=HOT_FRAC, seed=SEED):
+    """Seeded skewed fact + uniform dim. Key 0 is the hot key: it takes
+    ``hot_frac`` of the fact rows; the rest are uniform over the
+    remaining keys, so after hash partitioning exactly one shuffle
+    partition is ~hot_frac of the table and the others are thin."""
+    import pyarrow as pa
+    rng = np.random.default_rng(seed)
+    n_hot = int(n_rows * hot_frac)
+    keys = np.concatenate([
+        np.zeros(n_hot, dtype=np.int64),
+        rng.integers(1, n_keys, n_rows - n_hot).astype(np.int64)])
+    rng.shuffle(keys)
+    fact = pa.table({
+        "k": keys,
+        "g": rng.integers(0, 64, n_rows).astype(np.int32),
+        "v": rng.integers(-1000, 1000, n_rows).astype(np.int64),
+    })
+    dim = pa.table({
+        "dk": np.arange(n_keys, dtype=np.int64),
+        "w": rng.integers(0, 10, n_keys).astype(np.int64),
+    })
+    return fact, dim
+
+
+def _query(fact, dim):
+    from spark_rapids_tpu.exec.join import JoinType
+    from spark_rapids_tpu.expressions import col
+    from spark_rapids_tpu.expressions.aggregates import Count, Sum
+    from spark_rapids_tpu.plan import table
+    # num_slices models upstream map tasks: each slice writes one piece
+    # per shuffle partition, and piece boundaries are the granularity a
+    # skewed partition can split at (PartialReducerPartitionSpec)
+    slices = 16
+    return (table(fact, num_slices=slices,
+                  batch_rows=max(1, fact.num_rows // slices))
+            .join(table(dim), ["k"], ["dk"], JoinType.INNER)
+            .group_by("g")
+            .agg(Sum(col("v")).alias("sv"), Sum(col("w")).alias("sw"),
+                 Count().alias("c"))
+            .order_by("g"))
+
+
+#: every leg pins the planner to the shuffled join — the bench measures
+#: RUNTIME re-planning, never the planner's byte estimate
+_BASE = {
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": "0",
+    "spark.rapids.tpu.shuffle.partitions": str(N_PARTS),
+}
+
+LEGS = {
+    "static": {
+        **_BASE,
+        "spark.rapids.tpu.sql.adaptive.enabled": "false",
+    },
+    "adaptive": {
+        **_BASE,
+        "spark.rapids.tpu.sql.adaptive.enabled": "true",
+        "spark.rapids.tpu.sql.adaptive.skewJoin.splitRows":
+            str(SPLIT_ROWS),
+        "spark.rapids.tpu.sql.adaptive.broadcastJoin.enabled": "false",
+    },
+    "adaptive_bcast": {
+        **_BASE,
+        "spark.rapids.tpu.sql.adaptive.enabled": "true",
+        "spark.rapids.tpu.sql.adaptive.skewJoin.splitRows":
+            str(SPLIT_ROWS),
+        "spark.rapids.tpu.sql.adaptive.broadcastJoin.enabled": "true",
+        # dim has N_KEYS rows; measured <= this -> runtime broadcast
+        "spark.rapids.tpu.sql.adaptive.broadcastJoin.maxBuildRows":
+            str(max(N_KEYS, 1 << 16)),
+    },
+}
+
+
+def _time_group(fns, reps=REPS):
+    """Interleaved A/B/A/B timing, min per alternative — drift on a
+    loaded host hits every alternative equally."""
+    for fn in fns:
+        fn()                                 # warmup / compile
+    best = [float("inf")] * len(fns)
+    out = [None] * len(fns)
+    for _ in range(reps):
+        for i, fn in enumerate(fns):
+            t0 = time.perf_counter()
+            out[i] = fn()
+            best[i] = min(best[i], time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    argv = sys.argv[1:]
+    json_out = None
+    if "--json-out" in argv:
+        json_out = argv[argv.index("--json-out") + 1]
+
+    from spark_rapids_tpu.plan import Session
+    from spark_rapids_tpu.plan import adaptive
+
+    fact, dim = make_skewed_tables()
+    import jax
+    print(f"# skew bench: {N_ROWS} fact rows ({HOT_FRAC:.0%} on the hot "
+          f"key), {N_KEYS} dim rows, {N_PARTS} shuffle partitions, "
+          f"splitRows={SPLIT_ROWS}, {REPS} reps, "
+          f"platform={jax.devices()[0].platform}", flush=True)
+
+    sessions = {leg: Session(conf) for leg, conf in LEGS.items()}
+    decisions = {}
+
+    def run_leg(leg):
+        def run():
+            mark = adaptive.reason_mark()
+            out = sessions[leg].collect(_query(fact, dim))
+            decisions[leg] = adaptive.reasons(since=mark)
+            return out
+        return run
+
+    names = list(LEGS)
+    best, outs = _time_group([run_leg(n) for n in names])
+
+    # bit-for-bit or no numbers: every leg must agree with the static
+    # plan (the adaptive contract)
+    for name, out in zip(names[1:], outs[1:]):
+        if not out.equals(outs[0]):
+            print(f"FATAL: leg {name!r} diverged from the static result",
+                  file=sys.stderr)
+            return 1
+
+    rows = []
+    for name, dt in zip(names, best):
+        row = {"leg": name, "ms": round(dt * 1e3, 2),
+               "Mrows_per_s": round(N_ROWS / dt / 1e6, 2),
+               "speedup_vs_static": round(best[0] / dt, 3),
+               "decisions": decisions.get(name, [])}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    print("\n| leg | ms | Mrows/s | vs static |")
+    print("|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['leg']} | {r['ms']} | {r['Mrows_per_s']} | "
+              f"{r['speedup_vs_static']}x |")
+
+    if json_out:
+        payload = {
+            "description": (
+                "Skewed-join bench (adaptive runtime re-planning vs "
+                "the static plan): fact JOIN dim + group-by over a "
+                f"{N_ROWS}-row fact table with one hot key owning "
+                f"{HOT_FRAC:.0%} of the rows, {N_PARTS} shuffle "
+                "partitions, shuffled join forced in every leg "
+                "(autoBroadcastJoinThreshold=0). Legs are interleaved "
+                "A/B/A/B, min per leg; all legs verified bit-for-bit "
+                "equal before any number is reported."),
+            "command": ("JAX_PLATFORMS=cpu python tools/skew_bench.py "
+                        "--json-out BENCH_skew.json"),
+            "platform": jax.devices()[0].platform,
+            "params": {"rows": N_ROWS, "keys": N_KEYS,
+                       "partitions": N_PARTS, "hot_frac": HOT_FRAC,
+                       "split_rows": SPLIT_ROWS, "reps": REPS,
+                       "seed": SEED},
+            "legs": rows,
+        }
+        with open(json_out, "w") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        print(f"\nwrote {json_out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
