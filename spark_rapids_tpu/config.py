@@ -651,9 +651,10 @@ SERVER_RESULT_CACHE_ENABLED = conf(
     "spark.rapids.tpu.server.resultCache.enabled").doc(
     "Serve bit-for-bit repeated queries from an LRU over serialized "
     "results, keyed on (literal-inclusive plan fingerprint, per-table "
-    "content digests, conf); invalidated on drop_table/re-upload. Only "
-    "plans whose scans are in-memory tables are eligible "
-    "(docs/serving.md).").boolean(False)
+    "content digests, conf); invalidated on drop_table/re-upload. "
+    "In-memory scans key on content digests; file-backed scans key on "
+    "per-file (path, mtime_ns, size) stats, so a rewrite makes the "
+    "stale entry unreachable (docs/serving.md).").boolean(False)
 
 SERVER_RESULT_CACHE_MAX_BYTES = conf(
     "spark.rapids.tpu.server.resultCache.maxBytes").doc(
@@ -775,6 +776,59 @@ FLEET_COST_SYNC_PLANS = conf(
     "decisions for shapes only worker A measured. 0 = no automatic "
     "sync (Router.sync_costs() still works on demand)."
 ).integer(0)
+
+SHARING_ENABLED = conf(
+    "spark.rapids.tpu.server.sharing.enabled").doc(
+    "Master switch for cross-query work sharing (docs/serving.md "
+    "'Cross-query work sharing'): in-flight result dedup, subplan "
+    "result caching and shared scan uploads. Off, the engine behaves "
+    "byte-identically to a build without the feature — the sub-switches "
+    "below only apply when this is on.").boolean(False)
+
+SHARING_INFLIGHT_ENABLED = conf(
+    "spark.rapids.tpu.server.sharing.inflight.enabled").doc(
+    "Single-flight execution per RESULT key: a query whose result key "
+    "matches one already executing waits for the leader's serialized "
+    "bytes instead of executing (admission slots are NOT held while "
+    "waiting). On leader failure one waiter is promoted to leader and "
+    "re-executes; drop_table/re-upload invalidates parked waiters, who "
+    "then re-execute against post-drop state.").boolean(True)
+
+SHARING_WAIT_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.server.sharing.waitTimeoutMs").doc(
+    "Upper bound a deduplicated query waits on an in-flight leader "
+    "before giving up and executing on its own (a self-heal bound, not "
+    "a correctness gate — results are keyed bit-for-bit)."
+).integer(600000)
+
+SHARING_SUBPLAN_ENABLED = conf(
+    "spark.rapids.tpu.server.sharing.subplan.enabled").doc(
+    "Cache the serialized output of aggregate-boundary subtrees under "
+    "per-subtree result keys (plancache.subtree_result_key), so two "
+    "queries sharing a subtree — same scan+filter, different "
+    "aggregate — execute it once. Only single-partition subtrees with "
+    "at least one non-scan operator participate; entries invalidate "
+    "with drop_table/re-upload like full results.").boolean(True)
+
+SHARING_SUBPLAN_MAX_BYTES = conf(
+    "spark.rapids.tpu.server.sharing.subplan.maxBytes").doc(
+    "Byte budget of the subplan result cache (its own LRU, separate "
+    "from resultCache.maxBytes).").bytes_(128 << 20)
+
+SHARING_SCANSHARE_ENABLED = conf(
+    "spark.rapids.tpu.server.sharing.scanShare.enabled").doc(
+    "Publish each in-memory scan's device-resident batches in a "
+    "refcounted registry keyed on table content digest, so concurrent "
+    "(and closely following) queries over the same table ride one H2D "
+    "transfer; the admission layer prefers waiters whose scan digests "
+    "match in-flight queries so sharable queries overlap."
+).boolean(True)
+
+SHARING_SCANSHARE_MAX_BYTES = conf(
+    "spark.rapids.tpu.server.sharing.scanShare.maxBytes").doc(
+    "Byte budget of unreferenced device-resident scan entries kept "
+    "warm after their last query closes (refcounted entries never "
+    "evict).").bytes_(256 << 20)
 
 BRIDGE_ACCEPTED_SCHEMA_VERSIONS = conf(
     "spark.rapids.tpu.bridge.acceptedSchemaVersions").doc(

@@ -210,13 +210,29 @@ class BinaryExec(Exec):
         return self.children[1]
 
 
-def collect(plan: Exec) -> pa.Table:
-    """Run a plan and pull the result to the host as one Arrow table — the
-    test/collect boundary (reference: GpuColumnarToRowExec)."""
+def iter_subplan_tables(plan: Exec):
+    """The "subplan produced" side of the collect seam: run a plan and
+    yield one host Arrow table per output batch, in partition order.
+    Stage re-planning and subplan result sharing materialize interior
+    boundaries through this, so a captured subtree output is exactly
+    what assemble_result() would have consumed."""
     schema = plan.output_schema
-    tables = [to_arrow(b, schema) for b in plan.execute()]
+    for b in plan.execute():
+        yield to_arrow(b, schema)
+
+
+def assemble_result(tables, schema) -> pa.Table:
+    """The "query assembled" side of the collect seam: concatenate the
+    per-batch tables (empty input keeps the declared schema)."""
+    tables = list(tables)
     if not tables:
         from .. import types as T
         return pa.table({f.name: pa.array([], type=T.to_arrow(f.dtype))
                          for f in schema})
     return pa.concat_tables(tables)
+
+
+def collect(plan: Exec) -> pa.Table:
+    """Run a plan and pull the result to the host as one Arrow table — the
+    test/collect boundary (reference: GpuColumnarToRowExec)."""
+    return assemble_result(iter_subplan_tables(plan), plan.output_schema)

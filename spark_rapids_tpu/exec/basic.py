@@ -50,7 +50,8 @@ class InMemoryScanExec(LeafExec):
     def __init__(self, data, schema: Optional[Schema] = None,
                  batch_rows: Optional[int] = None, num_slices: int = 1,
                  ctx: EvalContext = EvalContext(),
-                 dict_conf: Optional[tuple] = None):
+                 dict_conf: Optional[tuple] = None,
+                 share: Optional[tuple] = None):
         super().__init__(ctx)
         self._num_slices = num_slices
         # (enabled, maxCardinality, maxCardinalityFraction) for the H2D
@@ -58,6 +59,13 @@ class InMemoryScanExec(LeafExec):
         # dictEncoding.enabled=false is honored off the file-scan path
         # too. None = registry defaults (direct test construction).
         self._dict_conf = dict_conf
+        # (ScanShareRegistry, key, digest, max_bytes) when cross-query
+        # scan sharing is on (plan/sharing.py; the planner threads it) —
+        # device batches are immutable, so concurrent queries over the
+        # same table content ride one refcounted H2D upload. None = the
+        # historical private-upload path, bit for bit.
+        self._share = share
+        self._share_entry = None
         if isinstance(data, pa.Table):
             self._tables = [data]
             self._batches = None
@@ -79,10 +87,7 @@ class InMemoryScanExec(LeafExec):
     def num_partitions(self) -> int:
         return self._num_slices
 
-    def _all_batches(self):
-        if self._batches is not None:
-            yield from self._batches
-            return
+    def _upload_batches(self):
         from ..memory.retry import maybe_inject, with_retry_no_split
 
         def h2d(chunk):
@@ -106,6 +111,44 @@ class InMemoryScanExec(LeafExec):
                                           name=self.name)
                 if n == 0:
                     break
+
+    def _all_batches(self):
+        if self._batches is not None:
+            yield from self._batches
+            return
+        if self._share is None:
+            yield from self._upload_batches()
+            return
+        yield from self._shared_batches()
+
+    def _shared_batches(self):
+        """Acquire (or perform) the one refcounted upload for this table
+        content; the pin is released in do_close()."""
+        if self._share_entry is not None:
+            return list(self._share_entry.batches)
+        from ..plan import sharing
+        registry, key, digest, max_bytes = self._share
+        entry, uploader = registry.acquire(key, digest,
+                                           max_bytes=max_bytes)
+        if uploader:
+            try:
+                batches = list(self._upload_batches())
+            except BaseException:
+                registry.abort(entry)   # a parked acquirer retries
+                raise
+            nbytes = sum(t.nbytes for t in self._tables)
+            registry.publish(entry, batches, nbytes)
+            sharing.metrics().note("scan_share_uploads")
+        else:
+            sharing.metrics().note("scan_share_hits")
+        self._share_entry = entry
+        return list(entry.batches)
+
+    def do_close(self) -> None:
+        entry = self._share_entry
+        if entry is not None:
+            self._share_entry = None
+            self._share[0].release(entry)
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
         for i, b in enumerate(self._all_batches()):

@@ -15,7 +15,8 @@ from .source import FileSource
 
 
 class FileSourceScanExec(LeafExec):
-    def __init__(self, source: FileSource, num_slices: int = 1):
+    def __init__(self, source: FileSource, num_slices: int = 1,
+                 share: Optional[tuple] = None):
         super().__init__()
         from ..exec.base import DEBUG, MODERATE, Metric
         # prefetch pipeline visibility (reference: the multi-file reader's
@@ -23,6 +24,12 @@ class FileSourceScanExec(LeafExec):
         # hidden behind this exec's device_put/compute
         self.metrics["overlapTime"] = Metric("overlapTime", MODERATE)
         self.metrics["prefetchWaitTime"] = Metric("prefetchWaitTime", DEBUG)
+        # (ScanShareRegistry, max_bytes) when cross-query scan sharing
+        # is on: single-partition file scans publish their decoded +
+        # uploaded device batches refcounted under the source's
+        # stat-keyed share_key, so repeat queries ride one decode+H2D
+        self._share = share
+        self._share_entry = None
         self.source = source
         #: per-PLAN file list: DPP prunes THIS copy, never the shared
         #: FileSource (a pruned source would corrupt later queries)
@@ -66,6 +73,43 @@ class FileSourceScanExec(LeafExec):
                 if i % self._num_slices == p]
 
     def do_execute_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        if self._share is not None and self._num_slices == 1:
+            yield from self._shared_batches()
+            return
+        yield from self._stream_batches(p)
+
+    def _shared_batches(self) -> Iterator[ColumnarBatch]:
+        """Single-partition path through the scan-share registry: the
+        first query decodes + uploads and publishes; concurrent and
+        following queries over unchanged files replay the refcounted
+        device batches (released in do_close)."""
+        from ..plan import sharing
+        registry, max_bytes = self._share
+        key, digest = self.source.share_key(self.files)
+        entry, uploader = registry.acquire(key, digest,
+                                           max_bytes=max_bytes)
+        if uploader:
+            try:
+                batches = list(self._stream_batches(0))
+            except BaseException:
+                registry.abort(entry)
+                raise
+            nbytes = sum(getattr(b, "nbytes", 0) or 0 for b in batches) \
+                or (self.source.estimated_bytes() or 0)
+            registry.publish(entry, batches, nbytes)
+            sharing.metrics().note("scan_share_uploads")
+        else:
+            sharing.metrics().note("scan_share_hits")
+        self._share_entry = entry
+        yield from list(entry.batches)
+
+    def do_close(self) -> None:
+        entry = self._share_entry
+        if entry is not None:
+            self._share_entry = None
+            self._share[0].release(entry)
+
+    def _stream_batches(self, p: int) -> Iterator[ColumnarBatch]:
         from ..pipeline import close_iterator
         it = self.source.read_split(self._files_for(p),
                                     metrics=self.metrics)

@@ -300,6 +300,32 @@ class FileSource:
         except OSError:
             return None
 
+    def share_key(self, files=None):
+        """(registry key, invalidation digest) identifying this source's
+        decoded + uploaded device batches for the cross-query scan-share
+        registry (plan/sharing.py): per-file (path, mtime_ns, size)
+        stats — a rewritten file changes its stats, so the stale entry
+        is unreachable and ages out of the byte budget — plus every knob
+        that changes what lands on the device (projection, predicate,
+        batch slicing, dict-encoding conf, decoration columns)."""
+        import hashlib
+        import json
+        stats = []
+        for p in (self.files if files is None else files):
+            try:
+                st = os.stat(p)
+                stats.append((str(p), st.st_mtime_ns, st.st_size))
+            except OSError:
+                stats.append((str(p), -1, -1))
+        payload = json.dumps(
+            [self.format_name, stats, self.columns,
+             str(self.predicate), self.batch_rows, self._dict_conf,
+             self._dict_scan, self.with_file_name,
+             self.partition_schema], default=str, sort_keys=True)
+        digest = hashlib.blake2b(payload.encode("utf-8"),
+                                 digest_size=16).hexdigest()
+        return ("file", digest), digest
+
     # ---- format hooks ----
     def infer_arrow_schema(self) -> pa.Schema:
         raise NotImplementedError

@@ -75,6 +75,15 @@ class AdmissionCancelledError(RuntimeError):
     """The caller's cancel flag fired while waiting for admission."""
 
 
+class _AdmitWaiter:
+    __slots__ = ("affinity", "enqueued")
+
+    def __init__(self, affinity: frozenset):
+        import time
+        self.affinity = affinity
+        self.enqueued = time.monotonic()
+
+
 class QueryAdmission:
     """Serving-tier per-query admission (plan server): a collect-slot
     semaphore (``spark.rapids.tpu.server.concurrentCollects``) plus a
@@ -89,14 +98,25 @@ class QueryAdmission:
     its backoff and re-runs, with this query's reservation already
     counted in the budget it retries against."""
 
+    #: a waiter with scan affinity may be admitted ahead of the queue
+    #: head only while the head has waited less than this (starvation
+    #: bound for the affinity preference)
+    HEAD_MAX_SKIP_S = 0.5
+
     def __init__(self, max_concurrent: int, catalog=None):
         self.max_concurrent = max(1, int(max_concurrent))
-        self._sem = threading.BoundedSemaphore(self.max_concurrent)
         self._catalog = catalog
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._slots = self.max_concurrent
+        self._waiters: list = []       # FIFO of _AdmitWaiter
+        self._active_digests: Dict[str, int] = {}
         self.wait_time_ns = 0          # slot + reservation wait, summed
         self.admitted_count = 0
         self.in_flight = 0
+        #: admissions granted while sharing ≥1 scan digest with an
+        #: already-admitted query (cross-query scan-share overlap)
+        self.affinity_batched = 0
 
     def _cat(self):
         if self._catalog is None:
@@ -104,15 +124,31 @@ class QueryAdmission:
             self._catalog = device_budget()
         return self._catalog
 
+    def _pick_locked(self):
+        """The next waiter a free slot goes to: the FIFO head, unless a
+        later waiter shares a scan digest with an in-flight query (it
+        rides the live upload — docs/serving.md scan-affinity batching)
+        AND the head has not waited past the starvation bound."""
+        import time
+        head = self._waiters[0]
+        if self._active_digests:
+            if time.monotonic() - head.enqueued < self.HEAD_MAX_SKIP_S:
+                for w in self._waiters:
+                    if w.affinity and not \
+                            w.affinity.isdisjoint(self._active_digests):
+                        return w
+        return head
+
     @contextmanager
     def admit(self, reserve_bytes: int = 0,
               cancelled: Optional[callable] = None,
-              poll_s: float = 0.01):
+              poll_s: float = 0.01, affinity=()):
         """Block until a slot AND the reservation are both held; a true
         ``cancelled()`` while waiting raises AdmissionCancelledError.
         Reservation failures (OutOfBudgetError after spilling) back off
         and retry — admission pressure queues, it does not fail the
-        query."""
+        query. ``affinity`` (scan content digests) batches waiters next
+        to in-flight queries over the same tables."""
         import time
 
         from ..trace import span as _trace_span
@@ -123,9 +159,6 @@ class QueryAdmission:
         # accounting, not a guarantee of exclusive HBM)
         reserve_bytes = min(int(reserve_bytes), self._cat().device_limit)
         t0 = time.perf_counter_ns()
-        # the admission wait is its own span: "where did this query's
-        # time go" must separate queueing behind other tenants from the
-        # query's own execution
         # the admission wait is its own span, closed the moment the
         # query is admitted: "where did this query's time go" must
         # separate queueing behind other tenants from execution
@@ -135,12 +168,31 @@ class QueryAdmission:
         wait_open = True
         reserved = 0
         acquired_slot = False
+        waiter = _AdmitWaiter(frozenset(affinity or ()))
         try:
-            while not self._sem.acquire(timeout=poll_s):
-                if cancelled is not None and cancelled():
-                    self._note_wait(t0)
-                    raise AdmissionCancelledError(
-                        "cancelled while waiting for a collect slot")
+            with self._cond:
+                self._waiters.append(waiter)
+                while not (self._slots > 0
+                           and self._pick_locked() is waiter):
+                    self._cond.wait(poll_s)
+                    if cancelled is not None and cancelled():
+                        self._waiters.remove(waiter)
+                        self._cond.notify_all()
+                        self.wait_time_ns += \
+                            time.perf_counter_ns() - t0
+                        raise AdmissionCancelledError(
+                            "cancelled while waiting for a collect slot")
+                self._slots -= 1
+                self._waiters.remove(waiter)
+                if waiter.affinity and not waiter.affinity.isdisjoint(
+                        self._active_digests):
+                    self.affinity_batched += 1
+                    from ..plan import sharing
+                    sharing.metrics().note("affinity_batched")
+                for d in waiter.affinity:
+                    self._active_digests[d] = \
+                        self._active_digests.get(d, 0) + 1
+                self._cond.notify_all()
             acquired_slot = True
             while reserve_bytes > 0:
                 if cancelled is not None and cancelled():
@@ -176,7 +228,15 @@ class QueryAdmission:
             if reserved:
                 self._cat().unreserve(reserved)
             if acquired_slot:
-                self._sem.release()
+                with self._cond:
+                    self._slots += 1
+                    for d in waiter.affinity:
+                        left = self._active_digests.get(d, 0) - 1
+                        if left > 0:
+                            self._active_digests[d] = left
+                        else:
+                            self._active_digests.pop(d, None)
+                    self._cond.notify_all()
 
     def _note_wait(self, t0: int) -> None:
         import time

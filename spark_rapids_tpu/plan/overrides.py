@@ -911,6 +911,37 @@ class Overrides:
         from ..expressions.base import EvalContext
         return EvalContext(ansi=self.conf.ansi)
 
+    def _scan_share(self, n) -> Optional[tuple]:
+        """Thread the cross-query scan-share registry into an in-memory
+        scan when sharing.scanShare is on: the share key folds in every
+        knob that changes the uploaded batches (content digest, batch
+        slicing, dict-encoding conf, declared schema), so a registry hit
+        is the SAME device data the private path would have built."""
+        from . import sharing
+        if not sharing.scan_share_on(self.conf):
+            return None
+        if not isinstance(n.data, pa.Table):
+            return None          # pre-built device batches: nothing to share
+        from ..config import SHARING_SCANSHARE_MAX_BYTES
+        from ..dictenc import dict_conf
+        from . import plancache
+        digest = plancache.content_digest(n.data)
+        schema = n._schema
+        key = (digest, n.batch_rows, dict_conf(self.conf),
+               str(schema) if schema is not None else None)
+        return (sharing.scan_share(), key, digest,
+                int(self.conf.get(SHARING_SCANSHARE_MAX_BYTES.key)))
+
+    def _file_scan_share(self) -> Optional[tuple]:
+        """File-scan flavor of _scan_share: the exec computes its own
+        stat-based share_key at execute time (post-DPP file list)."""
+        from . import sharing
+        if not sharing.scan_share_on(self.conf):
+            return None
+        from ..config import SHARING_SCANSHARE_MAX_BYTES
+        return (sharing.scan_share(),
+                int(self.conf.get(SHARING_SCANSHARE_MAX_BYTES.key)))
+
     def _shuffle_partitions(self) -> int:
         from ..config import SHUFFLE_PARTITIONS
         return self.conf.get(SHUFFLE_PARTITIONS.key)
@@ -929,12 +960,14 @@ class Overrides:
                 from ..io.scan import FileSourceScanExec
                 if hasattr(n.source, "apply_conf"):
                     n.source.apply_conf(self.conf)
-                return FileSourceScanExec(n.source, n.num_slices)
+                return FileSourceScanExec(n.source, n.num_slices,
+                                          share=self._file_scan_share())
             from ..dictenc import dict_conf
             return InMemoryScanExec(n.data, schema=n._schema,
                                     num_slices=n.num_slices,
                                     batch_rows=n.batch_rows,
-                                    dict_conf=dict_conf(self.conf))
+                                    dict_conf=dict_conf(self.conf),
+                                    share=self._scan_share(n))
         if isinstance(n, L.LogicalRange):
             return RangeExec(n.start, n.end, n.step)
         if isinstance(n, L.LogicalProject):

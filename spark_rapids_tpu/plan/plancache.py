@@ -44,8 +44,11 @@ Safety rules (documented in docs/serving.md):
   estimate against batchRowCapacity; the gate's boolean outcome is mixed
   into the shape fingerprint so bucketed row counts cannot smuggle an
   over-capacity input past a cached "fits on device" decision.
-- File-backed scans fingerprint (path, mtime_ns, size) per file for the
-  planning cache and are never result-cached (no content digest).
+- File-backed scans fingerprint (path, mtime_ns, size) per file in BOTH
+  key modes: a rewritten file changes its stats, which changes the
+  result key, so the old entry is unreachable — stat-change
+  invalidation. Sources without statable concrete paths stay loudly
+  result-uncacheable.
 - Plans the wire dialect cannot encode are uncacheable; the reason is
   recorded, never silent.
 """
@@ -266,11 +269,16 @@ def _walk_doc(doc, parent: Optional[str], tables, mode: str):
                     node["scan_digest"] = content_digest(t)
                 continue
             if k == "source":
-                if mode == "result":
+                stats = _file_stats(v.get("paths", ()))
+                if mode == "result" and (
+                        not stats or any(s[1] < 0 for s in stats)):
+                    # no concrete statable paths → no stand-in for a
+                    # content digest; stay loudly uncacheable rather
+                    # than risk serving a stale result
                     raise Uncacheable(
-                        "file-backed scan: no content digest for results")
+                        "file-backed scan without statable paths")
                 node["source"] = _walk_doc(v, None, tables, mode)
-                node["source_stat"] = _file_stats(v.get("paths", ()))
+                node["source_stat"] = stats
                 continue
             node[k] = _walk_doc(v, None, tables, mode)
         return node
@@ -377,13 +385,39 @@ def shape_fingerprint(plan: L.LogicalPlan, conf: RapidsTpuConf,
 
 def result_key(plan: L.LogicalPlan, conf: RapidsTpuConf,
                encoded=None) -> Tuple[str, Tuple[str, ...]]:
-    """(cache key, table digests the entry depends on). Raises
-    Uncacheable when any scan has no content digest (file sources).
-    ``encoded`` reuses a prior encode_plan(plan) result."""
+    """(cache key, table digests the entry depends on). In-memory scans
+    key on content digests; file-backed scans key on per-file
+    (path, mtime_ns, size) stats (raises Uncacheable only when a source
+    has no statable concrete paths). ``encoded`` reuses a prior
+    encode_plan(plan) result."""
     doc, tables = encoded if encoded is not None else encode_plan(plan)
+    return _result_key_parts(doc, tables, conf, "1")
+
+
+def result_key_doc(doc: dict, tables: Dict[str, pa.Table],
+                   conf: RapidsTpuConf) -> Tuple[str, Tuple[str, ...]]:
+    """The SAME result key ``result_key`` computes, taken straight from
+    a wire plandoc — the router's in-flight dedup keys on it without
+    building a Session, so duplicates collapse before any worker
+    dispatch regardless of ring placement."""
+    return _result_key_parts(doc, tables, conf, "1")
+
+
+def subtree_result_key(plan: L.LogicalPlan, conf: RapidsTpuConf
+                       ) -> Tuple[str, Tuple[str, ...]]:
+    """result_key for an interior subtree — the subplan-share key
+    (docs/serving.md "Cross-query work sharing"). Versioned under its
+    own namespace so a subtree's serialized output can never collide
+    with a whole-query result entry for an identical plan."""
+    doc, tables = encode_plan(plan)
+    return _result_key_parts(doc, tables, conf, "subplan1")
+
+
+def _result_key_parts(doc, tables, conf: RapidsTpuConf,
+                      version: str) -> Tuple[str, Tuple[str, ...]]:
     full = _walk_doc(doc, None, tables, "result")
     digests = tuple(sorted({content_digest(t) for t in tables.values()}))
-    key = _hash({"v": 1, "plan": full,
+    key = _hash({"v": version, "plan": full,
                  "conf": conf_fingerprint(conf, for_result=True)})
     return key, digests
 

@@ -42,6 +42,12 @@ class Session:
         #: (df, encode_plan result | Uncacheable) — ONE plandoc walk per
         #: query feeds both the result key and the shape fingerprint
         self._doc_memo = None
+        #: the single-flight Flight this query leads (None when not
+        #: leading) — settled by _store_result / abort_inflight
+        self._sf_flight = None
+        #: whether the result cache should be consulted/stored for the
+        #: current query (the key may be computed for dedup alone)
+        self._rc_lookup = False
         from ..dictenc import fallback_mark
         # watermark: dict_fallbacks() reports only reasons recorded on
         # THIS session's watch (the store itself is process-wide)
@@ -221,8 +227,9 @@ class Session:
         from ..memory.retry import metrics as _retry_metrics
         from ..shuffle.lineage import metrics as _lineage_metrics
         from ..shuffle.transport import transport_metrics
-        from . import adaptive, plancache
+        from . import adaptive, plancache, sharing
         self._retry0 = _retry_metrics().snapshot()
+        self._sharing0 = sharing.metrics().snapshot()
         self._net0 = transport_metrics().snapshot()
         self._lineage0 = _lineage_metrics().snapshot()
         self._sem_wait0 = _python_semaphore.wait_time_ns
@@ -231,17 +238,22 @@ class Session:
         self._adaptive0 = adaptive.metrics().snapshot()
         self._adaptive_mark0 = adaptive.reason_mark()
 
-    def try_cached_result(self, df: DataFrame) -> Optional[pa.Table]:
+    def try_cached_result(self, df: DataFrame,
+                          cancelled=None) -> Optional[pa.Table]:
         """Serving-tier fast path: consult the result cache WITHOUT
-        planning. Returns the cached table (bit-for-bit: the stored
-        Arrow IPC bytes of the original run) or None; the computed key
-        is kept so the collect() that follows stores under it."""
+        planning, then join (or lead) the in-flight single-flight table
+        when sharing is on. Returns the served table (bit-for-bit: the
+        stored/leader's Arrow IPC bytes) or None; the computed key is
+        kept so the collect() that follows stores under it.
+        ``cancelled`` (callable) lets the server's watchdog unpark a
+        deduplicated waiter early."""
         from .. import trace as qtrace
         from . import plancache
         self.last_cache = {}
         self._cached_serve = None
         self.last_result_ipc = b""
         self.last_query_id = qtrace.current_query_id()
+        self._sf_flight = None
         self._watermark()
         with qtrace.span("resultCache.lookup", kind="cache") as sp:
             kd = self._result_cache_key(df)
@@ -251,13 +263,18 @@ class Session:
                     sp.attrs["outcome"] = \
                         self.last_cache.get("result", "off")
                 return None
+            if not self._rc_lookup:
+                self.last_cache.setdefault("result", "off")
+                if sp is not None:
+                    sp.attrs["outcome"] = "off"
+                return self._join_inflight(kd, cancelled)
             entry = plancache.result_cache().get(kd[0])
             if entry is None:
                 plancache.metrics().note("result_misses")
                 self.last_cache["result"] = "miss"
                 if sp is not None:
                     sp.attrs["outcome"] = "miss"
-                return None
+                return self._join_inflight(kd, cancelled)
             plancache.metrics().note("result_hits")
             self.last_cache["result"] = "hit"
             if sp is not None:
@@ -270,6 +287,66 @@ class Session:
         self._rc_state = None
         from ..server import protocol
         return protocol.ipc_to_table(entry.ipc)
+
+    def _join_inflight(self, kd, cancelled=None) -> Optional[pa.Table]:
+        """In-flight dedup (docs/serving.md "Cross-query work sharing"):
+        lead the flight for this result key, or park on the executing
+        leader and serve its bytes verbatim. Returns the served table
+        for a waiter, None for a leader/solo query (the collect that
+        follows executes and settles the flight). Runs BEFORE prepare
+        and admission — a parked waiter holds no slot."""
+        from . import sharing
+        if not sharing.inflight_on(self.conf):
+            return None
+        from .. import trace as qtrace
+        sf = sharing.single_flight()
+        timeout_s = sharing.wait_timeout_s(self.conf)
+        while True:
+            role, flight = sf.begin(kd[0], kd[1])
+            if role == "leader":
+                sharing.metrics().note("inflight_leaders")
+                self._sf_flight = flight
+                return None
+            sharing.metrics().note("inflight_waits")
+            with qtrace.span("sharing.inflightWait", kind="cache") as sp:
+                out = sf.wait(flight, timeout_s, cancelled=cancelled)
+                if sp is not None:
+                    sp.attrs["outcome"] = out.state
+            if out.state == "result":
+                sharing.metrics().note("inflight_served")
+                self.last_cache["result"] = "inflight"
+                self.last_plan = None
+                self._cached_serve = (
+                    list(out.payload.get("execs", ())),
+                    list(out.payload.get("fell_back", ())))
+                self.last_result_ipc = out.ipc
+                self._rc_state = None
+                from ..server import protocol
+                return protocol.ipc_to_table(out.ipc)
+            if out.state == "promoted":
+                # the leader failed; this waiter re-executes as the new
+                # leader — an error is never served to a waiter verbatim
+                sharing.metrics().note("inflight_promoted")
+                self._sf_flight = flight
+                return None
+            if out.state in ("invalidated", "failed"):
+                # drop_table/re-upload outdated the flight (or it
+                # retired with no result): re-enter against the
+                # post-drop table — never serve the stale leader result
+                continue
+            sharing.metrics().note("inflight_timeouts")
+            return None     # execute solo, publish nothing
+
+    def abort_inflight(self, error=None) -> None:
+        """Settle an un-completed leader flight after a failure anywhere
+        between try_cached_result and _store_result (prepare, admission,
+        execution, cancellation): one parked waiter is promoted to
+        leader, the rest keep waiting on it. Idempotent."""
+        flight = self._sf_flight
+        self._sf_flight = None
+        if flight is not None:
+            from . import sharing
+            sharing.single_flight().fail(flight, error)
 
     def _encoded_plan(self, df: DataFrame):
         """Memoized plancache.encode_plan for the current query: one
@@ -291,13 +368,17 @@ class Session:
 
     def _result_cache_key(self, df: DataFrame):
         from ..config import SERVER_RESULT_CACHE_ENABLED
-        if not self.conf.get(SERVER_RESULT_CACHE_ENABLED.key):
+        from . import sharing
+        want_cache = bool(self.conf.get(SERVER_RESULT_CACHE_ENABLED.key))
+        self._rc_lookup = want_cache
+        if not want_cache and not sharing.inflight_on(self.conf):
             self.last_cache.setdefault("result", "off")
             return None
         from . import plancache
-        # attach the fleet's shared persistent tier when configured
-        # (idempotent per path; a read-through miss there is free)
-        plancache.configure_result_store(self.conf)
+        if want_cache:
+            # attach the fleet's shared persistent tier when configured
+            # (idempotent per path; a read-through miss there is free)
+            plancache.configure_result_store(self.conf)
         try:
             return plancache.result_key(df.plan, self.conf,
                                         encoded=self._encoded_plan(df))
@@ -320,14 +401,25 @@ class Session:
             # cacheable miss serializes once, not once to store and once
             # to reply
             self.last_result_ipc = ipc
-            plancache.result_cache().put(
-                plancache.ResultEntry(
-                    key=key, ipc=ipc, digests=digests,
-                    execs=tuple(self.executed_exec_names()),
-                    fell_back=tuple(self.fell_back()),
-                    rows=result.num_rows),
-                max_bytes=int(
-                    self.conf.get(SERVER_RESULT_CACHE_MAX_BYTES.key)))
+            execs = tuple(self.executed_exec_names())
+            fell_back = tuple(self.fell_back())
+            if self._rc_lookup:
+                plancache.result_cache().put(
+                    plancache.ResultEntry(
+                        key=key, ipc=ipc, digests=digests,
+                        execs=execs, fell_back=fell_back,
+                        rows=result.num_rows),
+                    max_bytes=int(
+                        self.conf.get(SERVER_RESULT_CACHE_MAX_BYTES.key)))
+            flight = self._sf_flight
+            if flight is not None:
+                # publish the same bytes to every parked duplicate
+                self._sf_flight = None
+                from . import sharing
+                sharing.single_flight().complete(
+                    flight, ipc, {"execs": list(execs),
+                                  "fell_back": list(fell_back),
+                                  "rows": result.num_rows})
         return result
 
     def collect(self, df: DataFrame, _prepared=None) -> pa.Table:
@@ -363,8 +455,28 @@ class Session:
             state = self._rc_state
         self._rc_state = None
         kd = state[1]
+        try:
+            return self._execute_collect(df, kd, _prepared)
+        except BaseException as e:
+            # leader unwind: promote one parked duplicate (it
+            # re-executes; the error is never served verbatim)
+            self.abort_inflight(e)
+            raise
+
+    def _execute_collect(self, df: DataFrame, kd,
+                         _prepared=None) -> pa.Table:
+        from .. import trace as qtrace
         kind, plan = _prepared if _prepared is not None \
             else self.prepare(df)
+        if kind == "exec":
+            from . import sharing
+            if sharing.subplan_on(self.conf):
+                shared = self._apply_subplan_sharing(df)
+                if shared is not None:
+                    # re-plan the substituted tree; the subtree's
+                    # serialized output now feeds a plain scan
+                    df = shared
+                    kind, plan = self.prepare(df)
         if kind == "interpret":
             with qtrace.span("interpret", kind="execute"):
                 result = Interpreter(ansi=self.conf.ansi).execute(df.plan)
@@ -396,6 +508,110 @@ class Session:
             return self._store_result(kd, result)
         finally:
             plan.close()    # free catalog-registered exchange/broadcast state
+
+    def _apply_subplan_sharing(self, df: DataFrame):
+        """Subplan-level result sharing (docs/serving.md): find the
+        first aggregate whose input is a linear project/filter chain
+        over a single-sliced in-memory scan and swap that subtree for
+        its (cached or freshly materialized) serialized output — two
+        queries sharing a scan+filter but diverging at the aggregate
+        execute the subtree once, across tenants. Conservatively
+        limited to subtrees whose output carries no floating-point
+        columns and whose default batching is one batch, so the
+        substitution is bit-for-bit by construction (exact arithmetic,
+        unchanged batch count feeding the aggregate). Returns the
+        substituted DataFrame, or None when nothing qualifies."""
+        import dataclasses
+        from .. import trace as qtrace
+        from ..types import TypeKind
+        from . import logical as L
+        from . import plancache, sharing
+
+        def chain_ok(n) -> bool:
+            hops = 0
+            while isinstance(n, (L.LogicalProject, L.LogicalFilter)):
+                hops += 1
+                n = n.children[0]
+            return hops > 0 and isinstance(n, L.LogicalScan) and \
+                n.data is not None and n.num_slices == 1 and \
+                n.batch_rows is None
+
+        target = None
+
+        def find(n):
+            nonlocal target
+            if target is not None:
+                return
+            if isinstance(n, L.LogicalAggregate) and \
+                    chain_ok(n.children[0]):
+                target = n
+                return
+            for c in n.children:
+                find(c)
+
+        find(df.plan)
+        if target is None:
+            return None
+        child = target.children[0]
+        try:
+            schema = child.schema()
+            if any(f.dtype.kind in (TypeKind.FLOAT32, TypeKind.FLOAT64)
+                   for f in schema.fields):
+                return None
+            key, digests = plancache.subtree_result_key(child, self.conf)
+        except Exception:
+            return None     # unbindable/unencodable subtree: no sharing
+        from ..config import SHARING_SUBPLAN_MAX_BYTES
+        from ..server import protocol
+        cache = sharing.subplan_cache()
+        with qtrace.span("sharing.subplan", kind="cache") as sp:
+            entry = cache.get(key)
+            if entry is not None:
+                sharing.metrics().note("subplan_hits")
+                self.last_cache["subplan"] = "hit"
+                ipc = entry.ipc
+            else:
+                # materialize the subtree once (inside the caller's
+                # already-admitted region) and publish its bytes
+                sub = self._materialize_subtree(child)
+                ipc = protocol.table_to_ipc(sub)
+                cache.put(key, ipc, digests, rows=sub.num_rows,
+                          max_bytes=int(self.conf.get(
+                              SHARING_SUBPLAN_MAX_BYTES.key)))
+                sharing.metrics().note("subplan_stores")
+                self.last_cache["subplan"] = "store"
+            if sp is not None:
+                sp.attrs["outcome"] = self.last_cache["subplan"]
+                sp.attrs["bytes"] = len(ipc)
+        # hit and store both re-decode the SAME bytes, so the scan the
+        # aggregate sees is identical on every query that shares the key
+        table = protocol.ipc_to_table(ipc)
+        plancache.register_digest(table, plancache.digest_ipc(ipc))
+        new_child = L.LogicalScan((), data=table, _schema=schema)
+
+        def swap(n):
+            if n is child:
+                return new_child
+            if not n.children:
+                return n
+            ch = tuple(swap(c) for c in n.children)
+            if all(a is b for a, b in zip(ch, n.children)):
+                return n
+            return dataclasses.replace(n, children=ch)
+
+        return DataFrame(swap(df.plan))
+
+    def _materialize_subtree(self, plan) -> pa.Table:
+        from ..exec.base import collect as collect_exec
+        from ..memory.retry import apply_session_conf
+        sub = Overrides(self.conf).plan(plan)
+        if isinstance(sub, CpuFallbackExec):
+            return sub.interpret()
+        apply_session_conf(self.conf)
+        try:
+            return collect_exec(sub)
+        finally:
+            sub.close()
 
     def _note_costs(self, plan) -> None:
         """Fold the executed plan's per-operator metrics into the
@@ -561,6 +777,11 @@ class Session:
         from . import adaptive
         emit_deltas("adaptive", adaptive.metrics().snapshot(),
                     getattr(self, "_adaptive0", None))
+        # cross-query work-sharing counters (in-flight dedup waits/
+        # serves/promotions, subplan hits, scan-share uploads ridden)
+        from . import sharing
+        emit_deltas("sharing", sharing.metrics().snapshot(),
+                    getattr(self, "_sharing0", None))
         return out
 
     def executed_exec_names(self) -> List[str]:

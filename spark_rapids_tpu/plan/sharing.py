@@ -1,0 +1,580 @@
+"""Cross-query work sharing: never compute the same thing twice.
+
+Three granularities, all keyed on the same digest-embedded identities the
+result cache already proves bit-for-bit safe (docs/serving.md
+"Cross-query work sharing"):
+
+1. **In-flight dedup** (``SingleFlight``) — a query whose RESULT key
+   matches one already executing parks on the leader's flight and is
+   served the leader's serialized bytes verbatim, instead of executing.
+   Admission slots are never held while parked (the worker joins before
+   prepare/admission; the router joins before its worker gate). On
+   leader failure exactly one waiter is promoted to leader — an error is
+   never served to a waiter verbatim, it re-executes. drop_table /
+   re-upload invalidates parked waiters, who then re-execute against
+   post-drop state instead of consuming a stale leader result.
+
+2. **Subplan result cache** (``SubplanCache``) — the serialized output
+   of an aggregate-boundary subtree under its per-subtree result key
+   (plancache.subtree_result_key), so two queries sharing a subtree —
+   same scan+filter, different aggregate — execute it once. Byte-
+   budgeted LRU with digest-indexed invalidation, exactly the result
+   cache's contract.
+
+3. **Scan sharing** (``ScanShareRegistry``) — refcounted device-resident
+   batch lists keyed on table content digest, so concurrent (and
+   closely following) queries over the same table ride one H2D
+   transfer. Uploads are themselves single-flighted: a second scan
+   arriving mid-upload waits for the first upload instead of doubling
+   it. Entries pin while referenced; unreferenced entries stay warm
+   under a byte budget.
+
+Everything here is conf-gated under ``spark.rapids.tpu.server.sharing.*``
+(master switch off = byte-identical behavior to a build without this
+module) and none of the confs perturb plan/result keys (the ``server.``
+prefix is excluded from every fingerprint by construction).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# metrics (process-wide; sessions report deltas between snapshots — the
+# plancache.ServingMetrics idiom, rolled up under the "sharing" prefix)
+# ---------------------------------------------------------------------------
+
+
+class SharingMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inflight_leaders = 0
+        self.inflight_waits = 0
+        self.inflight_served = 0
+        self.inflight_promoted = 0
+        self.inflight_invalidated = 0
+        self.inflight_timeouts = 0
+        self.subplan_hits = 0
+        self.subplan_stores = 0
+        self.subplan_evictions = 0
+        self.subplan_invalidations = 0
+        self.scan_share_hits = 0
+        self.scan_share_uploads = 0
+        self.scan_share_evictions = 0
+        self.scan_share_invalidations = 0
+        self.affinity_batched = 0
+
+    def note(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "inflightLeaderCount": self.inflight_leaders,
+                "inflightWaitCount": self.inflight_waits,
+                "inflightServedCount": self.inflight_served,
+                "inflightPromotedCount": self.inflight_promoted,
+                "inflightInvalidatedCount": self.inflight_invalidated,
+                "inflightTimeoutCount": self.inflight_timeouts,
+                "subplanHitCount": self.subplan_hits,
+                "subplanStoreCount": self.subplan_stores,
+                "subplanEvictionCount": self.subplan_evictions,
+                "subplanInvalidationCount": self.subplan_invalidations,
+                "scanShareHitCount": self.scan_share_hits,
+                "scanShareUploadCount": self.scan_share_uploads,
+                "scanShareEvictionCount": self.scan_share_evictions,
+                "scanShareInvalidationCount":
+                    self.scan_share_invalidations,
+                "admissionAffinityBatchedCount": self.affinity_batched,
+            }
+
+
+_METRICS = SharingMetrics()
+
+
+def metrics() -> SharingMetrics:
+    return _METRICS
+
+
+# ---------------------------------------------------------------------------
+# single-flight table
+# ---------------------------------------------------------------------------
+
+
+class Flight:
+    """One in-flight execution of a result key. States:
+
+    ``running``     leader executing; arrivals park as waiters
+    ``promote``     leader failed; the first waiter to wake claims
+                    leadership (state returns to ``running``), later
+                    waiters keep waiting — the error is NEVER served
+    ``done``        result published; waiters consume ipc+payload
+    ``invalidated`` a dependency digest was dropped; waiters re-execute
+    ``failed``      leader failed with no waiters (terminal bookkeeping)
+    """
+
+    __slots__ = ("key", "digests", "state", "ipc", "payload", "error",
+                 "waiters")
+
+    def __init__(self, key: str, digests: Tuple[str, ...]):
+        self.key = key
+        self.digests = tuple(digests)
+        self.state = "running"
+        self.ipc: bytes = b""
+        self.payload: dict = {}
+        self.error: Optional[BaseException] = None
+        self.waiters = 0
+
+
+class WaitOutcome:
+    __slots__ = ("state", "ipc", "payload", "error")
+
+    def __init__(self, state: str, ipc: bytes = b"",
+                 payload: Optional[dict] = None,
+                 error: Optional[BaseException] = None):
+        self.state = state          # result|promoted|invalidated|timeout
+        self.ipc = ipc
+        self.payload = payload or {}
+        self.error = error
+
+
+class SingleFlight:
+    """The dedup table. One instance per dedup domain: the worker
+    process keeps a singleton (``single_flight()``), each Router keeps
+    its own (embedded multi-router tests must not cross-talk).
+
+    A completed flight with parked waiters stays invalidatable (the
+    drop-after-complete-before-consume ordering) until the last waiter
+    consumes it; a NEW query for the key can lead a fresh flight
+    meanwhile — completion removes the flight from the live table."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._flights: Dict[str, Flight] = {}
+        #: done-with-pending-waiters flights, still invalidatable
+        self._pending_done: set = set()
+
+    def begin(self, key: str,
+              digests: Iterable[str] = ()) -> Tuple[str, Flight]:
+        """("leader", flight) — caller executes and must settle the
+        flight via complete()/fail(); ("wait", flight) — caller parks in
+        wait()."""
+        with self._cond:
+            f = self._flights.get(key)
+            if f is not None and f.state in ("running", "promote"):
+                f.waiters += 1
+                return "wait", f
+            f = Flight(key, tuple(digests))
+            self._flights[key] = f
+            return "leader", f
+
+    def complete(self, flight: Flight, ipc: bytes,
+                 payload: Optional[dict] = None) -> bool:
+        """Publish the leader's serialized result to every waiter.
+        False when the flight was invalidated while executing (nothing
+        is published; the waiters already left to re-execute)."""
+        with self._cond:
+            if flight.state != "running":
+                return False
+            flight.state = "done"
+            flight.ipc = ipc
+            flight.payload = dict(payload or {})
+            if self._flights.get(flight.key) is flight:
+                del self._flights[flight.key]
+            if flight.waiters > 0:
+                self._pending_done.add(flight)
+            self._cond.notify_all()
+            return True
+
+    def fail(self, flight: Flight,
+             error: Optional[BaseException] = None) -> None:
+        """Leader failed/cancelled: promote one waiter to leader (the
+        flight stays live; new arrivals keep waiting on the promoted
+        leader) or, with no waiters, retire the flight. Idempotent —
+        settling an already-settled flight is a no-op."""
+        with self._cond:
+            if flight.state != "running":
+                return
+            flight.error = error
+            if flight.waiters > 0:
+                flight.state = "promote"
+            else:
+                flight.state = "failed"
+                if self._flights.get(flight.key) is flight:
+                    del self._flights[flight.key]
+            self._cond.notify_all()
+
+    def wait(self, flight: Flight, timeout_s: float,
+             cancelled=None, poll_s: float = 0.05) -> WaitOutcome:
+        """Park on a flight joined via begin(). Exactly one waiter
+        claims a promotion; ``cancelled`` (callable) and ``timeout_s``
+        both resolve to a solo re-execution, never an error serve."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            while True:
+                if flight.state == "done":
+                    self._consume_locked(flight)
+                    return WaitOutcome("result", flight.ipc,
+                                       flight.payload)
+                if flight.state == "promote":
+                    # this waiter IS the new leader; the flight keeps
+                    # collecting arrivals while it re-executes
+                    flight.state = "running"
+                    flight.waiters -= 1
+                    return WaitOutcome("promoted", error=flight.error)
+                if flight.state in ("invalidated", "failed"):
+                    flight.waiters -= 1
+                    return WaitOutcome(flight.state, error=flight.error)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or \
+                        (cancelled is not None and cancelled()):
+                    flight.waiters -= 1
+                    return WaitOutcome("timeout")
+                self._cond.wait(min(poll_s, max(remaining, 0.001)))
+
+    def _consume_locked(self, flight: Flight) -> None:
+        flight.waiters -= 1
+        if flight.waiters <= 0:
+            self._pending_done.discard(flight)
+
+    def invalidate_digest(self, digest: str) -> int:
+        """Invalidate every flight depending on ``digest`` — running
+        (waiters wake and re-execute; the leader's eventual complete()
+        publishes nothing) and completed-but-unconsumed (a parked waiter
+        must never be served a result the drop outdated)."""
+        n = 0
+        with self._cond:
+            for f in list(self._flights.values()):
+                if digest in f.digests:
+                    f.state = "invalidated"
+                    del self._flights[f.key]
+                    n += 1
+            for f in list(self._pending_done):
+                if digest in f.digests:
+                    f.state = "invalidated"
+                    self._pending_done.discard(f)
+                    n += 1
+            if n:
+                self._cond.notify_all()
+        return n
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"inFlight": len(self._flights),
+                    "pendingDone": len(self._pending_done)}
+
+
+# ---------------------------------------------------------------------------
+# subplan result cache
+# ---------------------------------------------------------------------------
+
+
+class SubplanEntry:
+    __slots__ = ("key", "ipc", "digests", "rows", "hits")
+
+    def __init__(self, key: str, ipc: bytes, digests: Tuple[str, ...],
+                 rows: int):
+        self.key = key
+        self.ipc = ipc
+        self.digests = tuple(digests)
+        self.rows = rows
+        self.hits = 0
+
+
+class SubplanCache:
+    """Byte-budgeted LRU over serialized subtree outputs — the result
+    cache's shape with its own budget (a hot subtree must not evict
+    whole-query results and vice versa)."""
+
+    def __init__(self, max_bytes: int = 128 << 20):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, SubplanEntry]" = OrderedDict()
+        self.max_bytes = max_bytes
+        self.used_bytes = 0
+
+    def get(self, key: str) -> Optional[SubplanEntry]:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.hits += 1
+                self._entries.move_to_end(key)
+            return e
+
+    def put(self, key: str, ipc: bytes, digests: Iterable[str],
+            rows: int, max_bytes: Optional[int] = None) -> bool:
+        with self._lock:
+            if max_bytes is not None:
+                self.max_bytes = max_bytes
+            if len(ipc) > self.max_bytes:
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.used_bytes -= len(old.ipc)
+            e = SubplanEntry(key, ipc, tuple(digests), rows)
+            self._entries[key] = e
+            self.used_bytes += len(ipc)
+            while self.used_bytes > self.max_bytes and self._entries:
+                k, victim = self._entries.popitem(last=False)
+                if k == key:           # never evict what we just stored
+                    self._entries[k] = victim
+                    self._entries.move_to_end(k, last=False)
+                    break
+                self.used_bytes -= len(victim.ipc)
+                _METRICS.note("subplan_evictions")
+            return True
+
+    def invalidate_digest(self, digest: str) -> int:
+        with self._lock:
+            dead = [k for k, e in self._entries.items()
+                    if digest in e.digests]
+            for k in dead:
+                self.used_bytes -= len(self._entries.pop(k).ipc)
+            if dead:
+                _METRICS.note("subplan_invalidations", len(dead))
+            return len(dead)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.used_bytes = 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "usedBytes": self.used_bytes,
+                    "maxBytes": self.max_bytes}
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# scan-share registry (refcounted device-resident batches)
+# ---------------------------------------------------------------------------
+
+
+class ScanEntry:
+    __slots__ = ("key", "digest", "state", "batches", "nbytes", "refs")
+
+    def __init__(self, key, digest: str):
+        self.key = key
+        self.digest = digest
+        self.state = "uploading"       # uploading | ready
+        self.batches: Optional[List] = None
+        self.nbytes = 0
+        self.refs = 1                  # the acquirer's pin
+
+    @property
+    def pinned(self) -> bool:
+        return self.refs > 0
+
+
+class ScanShareRegistry:
+    """Device-resident batch lists keyed on (content digest, batch
+    layout knobs). Device arrays are immutable, so a published batch
+    list is safe to read from any number of concurrent queries.
+
+    ``acquire`` single-flights the upload itself: the first caller per
+    key uploads and publishes, callers arriving mid-upload park until
+    the publish — concurrent admitted queries over the same table ride
+    ONE H2D transfer. Refs pin entries against eviction; entries whose
+    refs drop to zero stay warm under ``max_bytes`` (LRU)."""
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._entries: "OrderedDict[object, ScanEntry]" = OrderedDict()
+        self.max_bytes = max_bytes
+        self.used_bytes = 0
+
+    def acquire(self, key, digest: str,
+                max_bytes: Optional[int] = None
+                ) -> Tuple[ScanEntry, bool]:
+        """(entry, is_uploader). Uploaders MUST publish() or abort();
+        everyone releases() when their query closes."""
+        with self._cond:
+            if max_bytes is not None:
+                self.max_bytes = max_bytes
+            while True:
+                e = self._entries.get(key)
+                if e is None:
+                    e = ScanEntry(key, digest)
+                    self._entries[key] = e
+                    return e, True
+                if e.state == "ready":
+                    e.refs += 1
+                    self._entries.move_to_end(key)
+                    return e, False
+                # mid-upload by another query: ride its H2D transfer
+                self._cond.wait(0.02)
+
+    def publish(self, entry: ScanEntry, batches: List,
+                nbytes: int) -> None:
+        with self._cond:
+            entry.batches = list(batches)
+            entry.nbytes = int(nbytes)
+            entry.state = "ready"
+            self.used_bytes += entry.nbytes
+            self._cond.notify_all()
+            self._evict_locked()
+
+    def abort(self, entry: ScanEntry) -> None:
+        """Upload failed: retire the placeholder so a parked acquirer
+        retries the upload itself."""
+        with self._cond:
+            if self._entries.get(entry.key) is entry:
+                del self._entries[entry.key]
+            self._cond.notify_all()
+
+    def release(self, entry: ScanEntry) -> None:
+        with self._cond:
+            entry.refs -= 1
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        while self.used_bytes > self.max_bytes:
+            victim_key = None
+            for k, e in self._entries.items():      # LRU order
+                if e.state == "ready" and not e.pinned:
+                    victim_key = k
+                    break
+            if victim_key is None:
+                return          # everything live is pinned: over-budget
+            e = self._entries.pop(victim_key)
+            self.used_bytes -= e.nbytes
+            _METRICS.note("scan_share_evictions")
+
+    def invalidate_digest(self, digest: str) -> int:
+        """Forget entries for a dropped/replaced table. A pinned entry's
+        batches stay alive through its holders' references (immutable
+        device data — in-flight queries over the pre-drop table finish
+        correctly); the registry just stops handing them out."""
+        with self._cond:
+            dead = [k for k, e in self._entries.items()
+                    if e.digest == digest and e.state == "ready"]
+            for k in dead:
+                self.used_bytes -= self._entries.pop(k).nbytes
+            if dead:
+                _METRICS.note("scan_share_invalidations", len(dead))
+            return len(dead)
+
+    def clear(self) -> None:
+        with self._cond:
+            self._entries.clear()
+            self.used_bytes = 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "usedBytes": self.used_bytes,
+                    "maxBytes": self.max_bytes,
+                    "pinnedRefs": sum(e.refs for e in
+                                      self._entries.values())}
+
+
+# ---------------------------------------------------------------------------
+# conf gates + plan helpers
+# ---------------------------------------------------------------------------
+
+
+def sharing_on(conf) -> bool:
+    from ..config import SHARING_ENABLED
+    return bool(conf.get(SHARING_ENABLED.key))
+
+
+def inflight_on(conf) -> bool:
+    from ..config import SHARING_INFLIGHT_ENABLED
+    return sharing_on(conf) and bool(conf.get(SHARING_INFLIGHT_ENABLED.key))
+
+
+def subplan_on(conf) -> bool:
+    from ..config import SHARING_SUBPLAN_ENABLED
+    return sharing_on(conf) and bool(conf.get(SHARING_SUBPLAN_ENABLED.key))
+
+
+def scan_share_on(conf) -> bool:
+    from ..config import SHARING_SCANSHARE_ENABLED
+    return sharing_on(conf) and \
+        bool(conf.get(SHARING_SCANSHARE_ENABLED.key))
+
+
+def wait_timeout_s(conf) -> float:
+    from ..config import SHARING_WAIT_TIMEOUT_MS
+    return max(0.0, int(conf.get(SHARING_WAIT_TIMEOUT_MS.key)) / 1000.0)
+
+
+def scan_affinity(plan, conf) -> frozenset:
+    """Content digests of the plan's in-memory scans — the admission
+    layer's affinity key: queries sharing a scan digest with an
+    in-flight query are admitted preferentially so their scans overlap
+    (and ride the scan-share registry). Empty when sharing is off."""
+    if not scan_share_on(conf):
+        return frozenset()
+    from . import logical as L
+    from . import plancache
+    out = set()
+
+    def walk(n):
+        if isinstance(n, L.LogicalScan) and n.data is not None:
+            out.add(plancache.content_digest(n.data))
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    return frozenset(out)
+
+
+# ---------------------------------------------------------------------------
+# process-wide singletons + combined invalidation
+# ---------------------------------------------------------------------------
+
+_SINGLE_FLIGHT: Optional[SingleFlight] = None
+_SUBPLAN_CACHE: Optional[SubplanCache] = None
+_SCAN_SHARE: Optional[ScanShareRegistry] = None
+_SINGLETON_LOCK = threading.Lock()
+
+
+def single_flight() -> SingleFlight:
+    global _SINGLE_FLIGHT
+    with _SINGLETON_LOCK:
+        if _SINGLE_FLIGHT is None:
+            _SINGLE_FLIGHT = SingleFlight()
+        return _SINGLE_FLIGHT
+
+
+def subplan_cache() -> SubplanCache:
+    global _SUBPLAN_CACHE
+    with _SINGLETON_LOCK:
+        if _SUBPLAN_CACHE is None:
+            _SUBPLAN_CACHE = SubplanCache()
+        return _SUBPLAN_CACHE
+
+
+def scan_share() -> ScanShareRegistry:
+    global _SCAN_SHARE
+    with _SINGLETON_LOCK:
+        if _SCAN_SHARE is None:
+            _SCAN_SHARE = ScanShareRegistry()
+        return _SCAN_SHARE
+
+
+def invalidate_digest(digest: str) -> int:
+    """drop_table/re-upload fan-in for every sharing structure: parked
+    in-flight waiters re-execute, subplan entries drop, scan-share
+    entries stop being handed out. The result cache's own invalidation
+    stays where it always was (server table handlers); this is additive
+    and returns the combined count for the ack."""
+    if not digest:
+        return 0
+    n = single_flight().invalidate_digest(digest)
+    if n:
+        _METRICS.note("inflight_invalidated", n)
+    n += subplan_cache().invalidate_digest(digest)
+    n += scan_share().invalidate_digest(digest)
+    return n

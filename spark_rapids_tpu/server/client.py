@@ -97,6 +97,10 @@ class PlanClient:
         #: adaptive-decision reason tags of the last collect (cost-fed
         #: placement / exploration / runtime re-plans, never silent)
         self.last_adaptive: List[str] = []
+        #: "inflight" when the last collect was served by router-tier
+        #: in-flight dedup (another client's identical query executed;
+        #: this one rode its result) — empty otherwise
+        self.last_sharing: str = ""
         self._last_client_profile: Optional[dict] = None
         try:
             self._connect()
@@ -254,6 +258,7 @@ class PlanClient:
         self.last_worker = str(reply.get("worker", ""))
         self.last_fingerprint = str(reply.get("fingerprint", ""))
         self.last_adaptive = reply.get("adaptive", [])
+        self.last_sharing = str(reply.get("sharing", ""))
         return protocol.ipc_to_table(body)
 
     def collect_catalyst(self, plan_json, tables: Optional[Dict[

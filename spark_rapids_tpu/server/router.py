@@ -717,6 +717,12 @@ class _RouterSession:
         name = header["name"]
         table = protocol.ipc_to_table(body)
         digest = plancache.digest_ipc(body)
+        old = self.tables.get(name)
+        if old is not None and old["digest"] != digest:
+            # re-upload with new content: router-tier flights parked on
+            # results over the old bytes must re-execute, not be served
+            # the pre-replace result
+            self.router.single_flight.invalidate_digest(old["digest"])
         # fan out FIRST, record after: a backend freshly created during
         # the fan-out replays the registry in its handshake, and with
         # the new table already recorded it would receive the same IPC
@@ -733,7 +739,11 @@ class _RouterSession:
 
     def serve_drop(self, header: dict):
         name = header["name"]
-        self.tables.pop(name, None)
+        rec = self.tables.pop(name, None)
+        if rec is not None:
+            # a duplicate parked on a flight over the dropped table
+            # re-executes against post-drop state
+            self.router.single_flight.invalidate_digest(rec["digest"])
         invalidated, acked = self._fan_out(
             {"msg": "drop_table", "name": name})
         return {"msg": "table_ack", "name": name,
@@ -824,9 +834,8 @@ class _RouterSession:
                 # (fingerprint, routing, framing), the number a "thin
                 # coordinator" must keep flat
                 spent_ns_box = [0]
-                reply, body = self._attempt_on_ring(
-                    header, fp, admission=True, t_open=t_open,
-                    spent_ns_box=spent_ns_box)
+                reply, body = self._dispatch_deduped(
+                    header, fp, conf, query_id, t_open, spent_ns_box)
                 if reply.get("msg") == "result":
                     overhead = (time.perf_counter_ns() - t_open
                                 - spent_ns_box[0])
@@ -843,6 +852,92 @@ class _RouterSession:
                 return reply, body
             finally:
                 router.admission.close_plan(self.tenant)
+
+    def _dispatch_deduped(self, header: dict, fp: str, conf,
+                          query_id: str, t_open: int,
+                          spent_ns_box: List[int]):
+        """Router-tier in-flight dedup: a plan whose RESULT key matches
+        one already dispatched parks on that flight and is served the
+        leader's reply bytes verbatim — duplicates coalesce at the
+        router regardless of which ring candidate each copy would have
+        landed on, and a parked duplicate holds NO worker slot (only
+        its tenant-quota ticket). Uncacheable or sharing-off plans
+        dispatch directly."""
+        from ..plan import plancache, sharing as _sharing
+        router = self.router
+        rkd = None
+        if _sharing.inflight_on(conf):
+            try:
+                rkd = plancache.result_key_doc(
+                    header.get("plan"),
+                    {n: r["table"] for n, r in self.tables.items()},
+                    conf)
+            except Exception:   # Uncacheable / malformed doc: the
+                rkd = None      # worker surfaces the real error
+        if rkd is None:
+            return self._attempt_on_ring(header, fp, admission=True,
+                                         t_open=t_open,
+                                         spent_ns_box=spent_ns_box)
+        sf = router.single_flight
+        timeout_s = _sharing.wait_timeout_s(conf)
+        while True:
+            role, flight = sf.begin(rkd[0], rkd[1])
+            if role == "leader":
+                router.sharing.note("inflight_leaders")
+                return self._lead_flight(flight, header, fp, t_open,
+                                         spent_ns_box)
+            router.sharing.note("inflight_waits")
+            t_wait = time.perf_counter_ns()
+            with qtrace.span("sharing.inflightWait",
+                             kind="cache") as sp:
+                out = sf.wait(flight, timeout_s)
+                if sp is not None:
+                    sp.attrs["outcome"] = out.state
+            # time parked on a sibling's flight is worker-side wait,
+            # not router CPU — keep it out of the overhead metric
+            spent_ns_box[0] += time.perf_counter_ns() - t_wait
+            if out.state == "result":
+                router.sharing.note("inflight_served")
+                reply = dict(out.payload)
+                reply["query_id"] = query_id
+                reply["sharing"] = "inflight"
+                return reply, out.ipc
+            if out.state == "promoted":
+                router.sharing.note("inflight_promoted")
+                return self._lead_flight(flight, header, fp, t_open,
+                                         spent_ns_box)
+            if out.state in ("invalidated", "failed"):
+                # a table drop/replace outdated the flight (or the
+                # leader retired with nothing): re-begin against
+                # post-drop state — never serve the stale result or
+                # the leader's error verbatim
+                router.sharing.note("inflight_invalidated")
+                continue
+            # timeout: go solo (no publish — the flight is not ours)
+            router.sharing.note("inflight_timeouts")
+            return self._attempt_on_ring(header, fp, admission=True,
+                                         t_open=t_open,
+                                         spent_ns_box=spent_ns_box)
+
+    def _lead_flight(self, flight, header: dict, fp: str, t_open: int,
+                     spent_ns_box: List[int]):
+        """Dispatch as the flight's leader and settle it: a result
+        reply publishes its payload + body to every parked duplicate;
+        anything else (error reply, transport failure) fails the
+        flight, promoting exactly one waiter to re-execute."""
+        router = self.router
+        try:
+            reply, body = self._attempt_on_ring(
+                header, fp, admission=True, t_open=t_open,
+                spent_ns_box=spent_ns_box)
+        except BaseException as e:
+            router.single_flight.fail(flight, e)
+            raise
+        if reply.get("msg") == "result":
+            router.single_flight.complete(flight, body, reply)
+        else:
+            router.single_flight.fail(flight)
+        return reply, body
 
     def _attempt_on_ring(self, header: dict, fp: str, admission: bool,
                          t_open: int, spent_ns_box: List[int]):
@@ -1059,6 +1154,14 @@ class Router:
         self.fp_fallbacks = 0
         self.spillovers = 0
         self._overhead_ns = deque(maxlen=8192)
+        # --- cross-query in-flight dedup (router tier) ---
+        # per-Router instance (embedded multi-router tests must not
+        # cross-talk), keyed on the same digest-embedded result key the
+        # workers dedup on — duplicates are coalesced HERE regardless of
+        # which ring candidate each copy would have hashed to
+        from ..plan import sharing as _sharing
+        self.single_flight = _sharing.SingleFlight()
+        self.sharing = _sharing.SharingMetrics()
         # --- adaptive cost sharing (0 = on-demand only) ---
         self.cost_sync_plans = int(tconf.get(FLEET_COST_SYNC_PLANS.key))
         self.cost_syncs = 0
@@ -1352,12 +1455,17 @@ class Router:
             # v3: adds the `adaptive` block (fleet cost syncs; each
             # worker's own adaptive decision counters ride its
             # per-worker stats below)
-            "schemaVersion": 3,
+            # v4: adds the `sharing` block (router-tier in-flight
+            # dedup; each worker's full sharing block — subplan cache,
+            # scan-share registry — rides its per-worker stats below)
+            "schemaVersion": 4,
             "adaptive": {
                 "costSyncCount": cost_syncs,
                 "costEntriesAdopted": cost_adopted,
                 "costSyncEveryPlans": self.cost_sync_plans,
             },
+            "sharing": dict(self.sharing.snapshot(),
+                            inflight=self.single_flight.stats()),
             "router": True,
             "trace": {
                 "recorder": self.recorder.stats(),

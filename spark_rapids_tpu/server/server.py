@@ -334,16 +334,19 @@ class _Handler(socketserver.BaseRequestHandler):
                              name="server-shutdown").start()
             return {"msg": "shutdown_ack", "fatal": True}, b""
         if msg == "table":
-            from ..plan import plancache
+            from ..plan import plancache, sharing
             name = header["name"]
             digest = plancache.digest_ipc(body)
             invalidated = 0
             old = tables.digests.get(name)
             if old is not None and old != digest:
                 # re-upload with NEW content: results derived from the
-                # replaced table must never be served again
+                # replaced table must never be served again — neither
+                # from the result cache nor from a flight/subplan/scan
+                # entry still in motion over the old bytes
                 invalidated = plancache.result_cache() \
                     .invalidate_digest(old)
+                invalidated += sharing.invalidate_digest(old)
             tables[name] = protocol.ipc_to_table(body)
             # prime the digest memo from the wire bytes we already hold,
             # so result keys never re-hash the table
@@ -353,12 +356,17 @@ class _Handler(socketserver.BaseRequestHandler):
                     "rows": tables[name].num_rows,
                     "digest": digest, "invalidated": invalidated}, b""
         if msg == "drop_table":
-            from ..plan import plancache
+            from ..plan import plancache, sharing
             name = header["name"]
             tables.pop(name, None)
             digest = tables.digests.pop(name, None)
             invalidated = plancache.result_cache() \
                 .invalidate_digest(digest) if digest else 0
+            if digest:
+                # a parked duplicate waiting on a flight over the
+                # dropped table must re-execute against post-drop
+                # state, never be served the pre-drop result
+                invalidated += sharing.invalidate_digest(digest)
             return {"msg": "table_ack", "name": name,
                     "invalidated": invalidated}, b""
         if msg == "trace":
@@ -421,56 +429,21 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _collect_plan(self, header, srv, ses, df,
                       cancelled: Callable[[], bool], query_id: str):
-        # result-set cache first: a hit serves the stored IPC bytes
-        # verbatim — no planning, no admission, no device work
-        result = ses.try_cached_result(df)
+        # result-set cache first, then the in-flight single-flight
+        # table: a hit/dedup-serve forwards IPC bytes verbatim — no
+        # planning, no admission, no device work (a parked duplicate
+        # holds NO collect slot while it waits)
+        result = ses.try_cached_result(df, cancelled=cancelled)
         cached = result is not None
         if not cached:
-            # plan/bind, untagged: binding errors echo client-chosen
-            # names (a column literally called "...halted...") and
-            # must never reach the breaker's substring classifier
-            prepared = ses.prepare(df)
-            from ..memory.semaphore import AdmissionCancelledError
-            # interpret/fallback queries never touch the device:
-            # admit them through the slot (they still consume CPU)
-            # but reserve no HBM — a CPU-query stream must not spill
-            # device-resident state of concurrent device tenants
-            reserve = srv.query_reserve_for(df) \
-                if prepared[0] == "exec" else 0
-            from ..shuffle import lineage
             try:
-                with srv.query_admission.admit(
-                        reserve, cancelled=cancelled), \
-                        lineage.cancel_scope(
-                            cancelled, exc=QueryCancelledError):
-                    # the test-only collect delay runs INSIDE the
-                    # admitted region so collectDelayMs holds a real
-                    # collect slot — deterministic admission
-                    # contention for the watchdog/serialization
-                    # tests (cancellation semantics are unchanged:
-                    # the delay loop polls the same cancel flag).
-                    # The lineage cancel scope makes stop()/watchdog
-                    # cancellation observable INSIDE a collect whose
-                    # exchange read is recomputing lost partitions:
-                    # the recompute loop polls the flag between
-                    # recoveries (and between retry attempts),
-                    # raises QueryCancelledError, and this admit
-                    # context releases the slot on unwind.
-                    self._check_cancel(cancelled, ses)
-                    try:
-                        result = ses.collect(df, _prepared=prepared)
-                    except Exception as e:
-                        if prepared[0] == "exec":
-                            # planning succeeded and the plan ran on
-                            # DEVICE — only these failures may reach
-                            # the breaker's fatal-marker
-                            # classification (interpreter/fallback
-                            # paths never touch the device)
-                            e._rtpu_exec_phase = True
-                        raise
-            except AdmissionCancelledError:
-                raise QueryCancelledError(
-                    "query cancelled while waiting for admission")
+                result = self._execute_plan(srv, ses, df, cancelled)
+            except BaseException as e:
+                # leader unwind for failures anywhere before the
+                # session settles the flight itself (prepare errors,
+                # admission cancellation): promote a parked duplicate
+                ses.abort_inflight(e)
+                raise
         # cached serves AND cacheable misses publish their IPC bytes
         # on the session (one serialization per result, verbatim)
         from ..trace import span as _trace_span
@@ -506,6 +479,60 @@ class _Handler(socketserver.BaseRequestHandler):
             # re-plan decision this query took rides the reply
             reply["adaptive"] = decisions
         return reply, body_out
+
+    def _execute_plan(self, srv, ses, df, cancelled):
+        # plan/bind, untagged: binding errors echo client-chosen
+        # names (a column literally called "...halted...") and
+        # must never reach the breaker's substring classifier
+        prepared = ses.prepare(df)
+        from ..memory.semaphore import AdmissionCancelledError
+        # interpret/fallback queries never touch the device:
+        # admit them through the slot (they still consume CPU)
+        # but reserve no HBM — a CPU-query stream must not spill
+        # device-resident state of concurrent device tenants
+        reserve = srv.query_reserve_for(df) \
+            if prepared[0] == "exec" else 0
+        # scan-digest affinity: the admission queue seats waiters
+        # next to in-flight queries over the same tables so their
+        # uploads overlap in the scan-share registry
+        from ..plan import sharing as _sharing
+        affinity = _sharing.scan_affinity(df.plan, ses.conf) \
+            if prepared[0] == "exec" else frozenset()
+        from ..shuffle import lineage
+        try:
+            with srv.query_admission.admit(
+                    reserve, cancelled=cancelled,
+                    affinity=affinity), \
+                    lineage.cancel_scope(
+                        cancelled, exc=QueryCancelledError):
+                # the test-only collect delay runs INSIDE the
+                # admitted region so collectDelayMs holds a real
+                # collect slot — deterministic admission
+                # contention for the watchdog/serialization
+                # tests (cancellation semantics are unchanged:
+                # the delay loop polls the same cancel flag).
+                # The lineage cancel scope makes stop()/watchdog
+                # cancellation observable INSIDE a collect whose
+                # exchange read is recomputing lost partitions:
+                # the recompute loop polls the flag between
+                # recoveries (and between retry attempts),
+                # raises QueryCancelledError, and this admit
+                # context releases the slot on unwind.
+                self._check_cancel(cancelled, ses)
+                try:
+                    return ses.collect(df, _prepared=prepared)
+                except Exception as e:
+                    if prepared[0] == "exec":
+                        # planning succeeded and the plan ran on
+                        # DEVICE — only these failures may reach
+                        # the breaker's fatal-marker
+                        # classification (interpreter/fallback
+                        # paths never touch the device)
+                        e._rtpu_exec_phase = True
+                    raise
+        except AdmissionCancelledError:
+            raise QueryCancelledError(
+                "query cancelled while waiting for admission")
 
     @staticmethod
     def _check_cancel(cancelled: Callable[[], bool], ses: Session) -> None:
@@ -631,7 +658,7 @@ class PlanServer:
         stable (``schemaVersion`` guards it): the router aggregates
         these fleet-wide and ``readiness_line`` formats from the
         ``server`` block, so every field here is load-bearing."""
-        from ..plan import adaptive, plancache
+        from ..plan import adaptive, plancache, sharing
         from ..shuffle.lineage import metrics as lineage_metrics
         from ..trace import observed_costs
         adm = self._server.query_admission
@@ -641,8 +668,17 @@ class PlanServer:
             # v3: adds the `adaptive` block (cost-fed plans,
             # exploration runs, runtime re-plans: coalesces / skew
             # splits / broadcast switches)
-            "schemaVersion": 3,
+            # v4: adds the `sharing` block (in-flight dedup, subplan
+            # cache, scan-share registry, admission affinity batching)
+            "schemaVersion": 4,
             "adaptive": adaptive.metrics().snapshot(),
+            "sharing": dict(
+                sharing.metrics().snapshot(),
+                inflight=sharing.single_flight().stats(),
+                subplanCache=sharing.subplan_cache().stats(),
+                scanShare=sharing.scan_share().stats(),
+                affinityBatched=adm.affinity_batched,
+            ),
             "trace": {
                 "recorder": self._server.trace_recorder.stats(),
                 "costFingerprints": len(observed_costs()),

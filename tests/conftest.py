@@ -67,6 +67,11 @@ def pytest_configure(config):
                    "robustness job alongside oom_inject (the full "
                    "kind/schedule matrix is nightly)")
     config.addinivalue_line(
+        "markers", "sharing: cross-query work sharing (in-flight dedup, "
+                   "subplan result cache, scan-share registry); the "
+                   "sharing-marked smoke job rides the `-m 'serving and "
+                   "smoke'` mini load gate (docs/serving.md)")
+    config.addinivalue_line(
         "markers", "chaos: long-running chaos soak jobs "
                    "(tools/chaos_soak.py wrappers) — excluded from "
                    "tier-1 and smoke exactly like `slow` (the conftest "

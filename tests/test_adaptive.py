@@ -564,11 +564,11 @@ class TestAdaptiveFleet:
                            for r in direct.last_adaptive), \
                     direct.last_adaptive
                 st = direct.stats()
-                assert st["schemaVersion"] == 3
+                assert st["schemaVersion"] == 4
                 assert st["adaptive"]["costFedPlanCount"] >= 1
 
             rst = router.serving_stats()
-            assert rst["schemaVersion"] == 3
+            assert rst["schemaVersion"] == 4
             assert rst["adaptive"]["costSyncCount"] == 1
             assert rst["adaptive"]["costEntriesAdopted"] >= 1
         finally:

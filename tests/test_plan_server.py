@@ -847,12 +847,23 @@ def test_stats_wire_op_and_stable_schema():
         # v2: the trace block (flight-recorder occupancy, slow-query
         # count, dropped spans, cost-store size) joined the schema;
         # v3: the adaptive block (cost-fed plans + runtime re-plan
-        # counters) joined it
-        assert st["schemaVersion"] == 3
+        # counters) joined it; v4: the sharing block (in-flight dedup,
+        # subplan cache, scan-share registry, affinity batching)
+        assert st["schemaVersion"] == 4
         assert set(st["adaptive"]) == {
             "costFedPlanCount", "explorationRunCount", "replanCount",
             "coalescedPartitionCount", "skewSplitCount",
             "broadcastSwitchCount"}
+        sh = st["sharing"]
+        for k in ("inflightLeaderCount", "inflightServedCount",
+                  "subplanHitCount", "scanShareHitCount",
+                  "admissionAffinityBatchedCount"):
+            assert k in sh, k
+        assert set(sh["inflight"]) == {"inFlight", "pendingDone"}
+        assert set(sh["subplanCache"]) == {"entries", "usedBytes",
+                                           "maxBytes"}
+        assert set(sh["scanShare"]) == {"entries", "usedBytes",
+                                        "maxBytes", "pinnedRefs"}
         tr = st["trace"]
         assert set(tr) == {"recorder", "costFingerprints"}
         assert set(tr["recorder"]) == {

@@ -148,7 +148,8 @@ def test_result_key_keeps_literal_values_and_digests():
     assert c_key == a_key and c_dig == a_dig
 
 
-def test_result_key_file_source_uncacheable(tmp_path):
+def test_result_key_file_source_stat_keyed(tmp_path):
+    import os
     import pyarrow.parquet as pq
     from spark_rapids_tpu.io.parquet import ParquetSource
     from spark_rapids_tpu.plan.logical import DataFrame, LogicalScan
@@ -159,13 +160,20 @@ def test_result_key_file_source_uncacheable(tmp_path):
     # plan-cacheable (with file stats in the fingerprint)...
     fp1 = plancache.shape_fingerprint(df.plan, RapidsTpuConf())
     assert fp1
-    # ...but never result-cacheable: no content digest for files
-    with pytest.raises(Uncacheable):
-        plancache.result_key(df.plan, RapidsTpuConf())
-    # touching the file changes the planning fingerprint
-    import os
+    # ...and result-cacheable: the key embeds per-file
+    # (path, mtime_ns, size) stats instead of a content digest
+    k1, _ = plancache.result_key(df.plan, RapidsTpuConf())
+    assert k1
+    # touching the file changes BOTH the planning fingerprint and the
+    # result key (the stale result entry becomes unreachable)
     os.utime(str(p), ns=(1, 1))
     assert plancache.shape_fingerprint(df.plan, RapidsTpuConf()) != fp1
+    k2, _ = plancache.result_key(df.plan, RapidsTpuConf())
+    assert k2 != k1
+    # a missing file is still loudly uncacheable, not silently stale
+    os.unlink(str(p))
+    with pytest.raises(Uncacheable):
+        plancache.result_key(df.plan, RapidsTpuConf())
 
 
 # ---------------------------------------------------------------------------

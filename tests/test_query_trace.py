@@ -410,7 +410,7 @@ def test_fleet_stitched_trace_bit_for_bit(tabs):
             assert ops and all(e["wallNs"] > 0 for e in ops.values())
             # fleet stats carry the router's recorder occupancy
             st = c.stats()
-            assert st["schemaVersion"] == 3
+            assert st["schemaVersion"] == 4
             assert st["trace"]["recorder"]["entries"] >= 1
             assert "costSyncCount" in st["adaptive"]
     finally:

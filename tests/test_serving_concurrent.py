@@ -173,21 +173,18 @@ def test_concurrent_differential_cache_on_vs_off(tabs):
         assert t.equals(oracle), \
             f"client {ci} shape {name} round {r} diverged under caching"
 
-    # 2) repeats hit: plan cache counters moved, and every digest-keyed
-    #    shape (all but the file-backed scan) served repeats from the
-    #    result cache
+    # 2) repeats hit: plan cache counters moved, and EVERY shape —
+    #    file-backed scans included, stat-keyed on (path, mtime_ns,
+    #    size) since ISSUE 18 — served repeats from the result cache
     counters = stats["counters"]
     assert counters["planCacheHitCount"] > 0
     assert counters["resultCacheHitCount"] > 0
     served = {name for (name, info, cached) in caches if cached}
     assert {"q1_stage", "hash_agg", "join_sort",
-            "exchange"} <= served
-    # the file-backed scan must be loudly result-uncacheable, never
-    # silently wrong
-    pq_infos = [info for (name, info, _) in caches
-                if name == "parquet_scan"]
-    assert all(str(i.get("result", "")).startswith("uncacheable")
-               for i in pq_infos)
+            "exchange", "parquet_scan"} <= served
+    # no shape ever answers from the loud-refusal path anymore
+    assert not any(str(i.get("result", "")).startswith("uncacheable")
+                   for (_, i, _) in caches)
 
     # 3) zero leaks: no admitted sessions, no catalog pins beyond the
     #    suite's pre-existing ones

@@ -1,0 +1,663 @@
+"""Cross-query work sharing suite (ISSUE 18 acceptance).
+
+Three granularities of "never compute the same thing twice", each
+tested for both the speedup AND the correctness unwind:
+
+  1. in-flight dedup — SingleFlight state machine (leader / waiter /
+     promotion on leader failure / invalidation in both orderings),
+     worker-session dedup, and router-tier dedup through a real
+     2-worker fleet where N identical concurrent clients execute
+     exactly once;
+  2. subplan result cache — two queries sharing a scan+filter subtree
+     under different aggregates execute the subtree once, bit-for-bit
+     vs the sharing-off oracle;
+  3. scan sharing — refcounted device-resident batches: hit counters
+     move, pins drain to zero at close, invalidation stops handing
+     entries out;
+
+plus the satellite regressions: file-backed scans result-key on per-file
+(path, mtime_ns, size) stats (a rewrite invalidates), and sharing OFF is
+byte-identical to a build without the subsystem.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expressions import col, lit
+from spark_rapids_tpu.expressions.aggregates import Count, Max, Sum
+from spark_rapids_tpu.plan import plancache, table
+from spark_rapids_tpu.plan import sharing
+from spark_rapids_tpu.plan.session import Session
+from spark_rapids_tpu.server import PlanClient, protocol
+from spark_rapids_tpu.server.router import Router
+
+pytestmark = [pytest.mark.serving, pytest.mark.sharing]
+
+SHARING_ON = {"spark.rapids.tpu.server.sharing.enabled": "true"}
+NO_CACHES = {
+    "spark.rapids.tpu.server.planCache.enabled": "false",
+    "spark.rapids.tpu.server.resultCache.enabled": "false",
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sharing_state():
+    """Process singletons must not leak state (or counters' baselines)
+    across tests: every test starts with empty sharing structures."""
+    with sharing._SINGLETON_LOCK:
+        sharing._SINGLE_FLIGHT = sharing.SingleFlight()
+        sharing._SUBPLAN_CACHE = sharing.SubplanCache()
+        sharing._SCAN_SHARE = sharing.ScanShareRegistry()
+        sharing._METRICS = sharing.SharingMetrics()
+    yield
+
+
+def _ints(n=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 64, n).astype(np.int64),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+    })
+
+
+def _sum_query(tab, v=10):
+    return (table(tab).where(col("v") > lit(int(v)))
+            .group_by("k").agg(Sum(col("v")).alias("s")))
+
+
+# ---------------------------------------------------------------------------
+# 1a. SingleFlight state machine (deterministic unit coverage)
+# ---------------------------------------------------------------------------
+
+
+class TestSingleFlight:
+    def test_leader_waiter_result(self):
+        sf = sharing.SingleFlight()
+        role, f = sf.begin("k1", ("d1",))
+        assert role == "leader"
+        role2, f2 = sf.begin("k1", ("d1",))
+        assert role2 == "wait" and f2 is f
+        out = []
+        th = threading.Thread(
+            target=lambda: out.append(sf.wait(f2, 5.0)), daemon=True)
+        th.start()
+        time.sleep(0.05)
+        assert sf.complete(f, b"bytes", {"rows": 3})
+        th.join(timeout=5)
+        assert out and out[0].state == "result"
+        assert out[0].ipc == b"bytes" and out[0].payload["rows"] == 3
+        # settled flights leave the live table: a NEW arrival leads
+        role3, f3 = sf.begin("k1", ("d1",))
+        assert role3 == "leader" and f3 is not f
+        assert sf.stats() == {"inFlight": 1, "pendingDone": 0}
+
+    def test_leader_failure_promotes_exactly_one(self):
+        """Two waiters park; the leader fails; EXACTLY one waiter is
+        promoted (re-executes), the other keeps waiting and is served
+        the promoted leader's result — the error reaches nobody."""
+        sf = sharing.SingleFlight()
+        _, leader = sf.begin("k", ("d",))
+        waits = [sf.begin("k", ("d",))[1] for _ in range(2)]
+        outcomes = []
+        lock = threading.Lock()
+
+        def waiter(f):
+            out = sf.wait(f, 10.0)
+            if out.state == "promoted":
+                # the promoted waiter IS the new leader: execute + publish
+                time.sleep(0.05)
+                sf.complete(f, b"good", {"rows": 1})
+            with lock:
+                outcomes.append(out)
+
+        ths = [threading.Thread(target=waiter, args=(f,), daemon=True)
+               for f in waits]
+        for t in ths:
+            t.start()
+        time.sleep(0.05)
+        sf.fail(leader, RuntimeError("leader died"))
+        for t in ths:
+            t.join(timeout=5)
+        states = sorted(o.state for o in outcomes)
+        assert states == ["promoted", "result"], states
+        served = next(o for o in outcomes if o.state == "result")
+        assert served.ipc == b"good"     # never the leader's error
+
+    def test_invalidate_while_leader_running(self):
+        """Ordering (a): drop_table lands while the leader executes —
+        the parked waiter re-executes (against post-drop state) and the
+        leader's eventual complete() publishes nothing."""
+        sf = sharing.SingleFlight()
+        _, leader = sf.begin("k", ("dig-a", "dig-b"))
+        _, wf = sf.begin("k", ())
+        out = []
+        th = threading.Thread(target=lambda: out.append(sf.wait(wf, 5.0)),
+                              daemon=True)
+        th.start()
+        time.sleep(0.05)
+        assert sf.invalidate_digest("dig-b") == 1
+        th.join(timeout=5)
+        assert out[0].state == "invalidated"
+        assert not sf.complete(leader, b"stale")    # nothing published
+        # the key is free again
+        assert sf.begin("k", ())[0] == "leader"
+
+    def test_invalidate_after_complete_before_consume(self):
+        """Ordering (b): the leader completed but the waiter has not
+        consumed yet when the drop lands — the done-with-waiters flight
+        is STILL invalidatable, and the waiter re-executes rather than
+        consuming the pre-drop result."""
+        sf = sharing.SingleFlight()
+        _, leader = sf.begin("k", ("dig",))
+        _, wf = sf.begin("k", ())
+        assert sf.complete(leader, b"pre-drop", {})
+        assert sf.stats()["pendingDone"] == 1
+        # the drop beats the waiter's wakeup
+        assert sf.invalidate_digest("dig") == 1
+        out = sf.wait(wf, 5.0)
+        assert out.state == "invalidated"
+        assert sf.stats() == {"inFlight": 0, "pendingDone": 0}
+
+
+# ---------------------------------------------------------------------------
+# 1b. worker-session in-flight dedup (threads over process singletons)
+# ---------------------------------------------------------------------------
+
+
+class TestSessionInflight:
+    def test_waiter_served_leader_bytes(self):
+        tab = _ints()
+        df = _sum_query(tab)
+        conf = dict(NO_CACHES, **SHARING_ON)
+        ses1, ses2 = Session(dict(conf)), Session(dict(conf))
+        assert ses1.try_cached_result(df) is None     # leader
+        got = []
+        err = []
+
+        def dup():
+            try:
+                t = ses2.try_cached_result(_sum_query(tab))
+                got.append(t)
+            except BaseException as e:   # surfaced below
+                err.append(e)
+
+        th = threading.Thread(target=dup, daemon=True)
+        th.start()
+        time.sleep(0.1)                               # B parks
+        expected = ses1.collect(df)                   # leader executes
+        th.join(timeout=10)
+        assert not err and got and got[0] is not None
+        assert got[0].equals(expected)
+        assert ses2.last_cache["result"] == "inflight"
+        snap = sharing.metrics().snapshot()
+        assert snap["inflightLeaderCount"] >= 1
+        assert snap["inflightServedCount"] == 1
+
+    def test_leader_failure_promotes_waiter(self):
+        """The leader aborts (exec failure / cancel): one parked
+        duplicate is promoted and re-executes; every duplicate still
+        gets the CORRECT result, never the leader's error."""
+        tab = _ints()
+        conf = dict(NO_CACHES, **SHARING_ON)
+        ses1 = Session(dict(conf))
+        assert ses1.try_cached_result(_sum_query(tab)) is None
+        results, errs = [], []
+        lock = threading.Lock()
+
+        def dup():
+            ses = Session(dict(conf))
+            try:
+                df = _sum_query(tab)
+                t = ses.try_cached_result(df)
+                if t is None:                # promoted to leader
+                    t = ses.collect(df)
+                with lock:
+                    results.append(t)
+            except BaseException as e:
+                with lock:
+                    errs.append(e)
+
+        ths = [threading.Thread(target=dup, daemon=True)
+               for _ in range(2)]
+        for t in ths:
+            t.start()
+        time.sleep(0.15)                     # both park on the flight
+        ses1.abort_inflight(RuntimeError("leader blew up"))
+        for t in ths:
+            t.join(timeout=30)
+        assert errs == []
+        oracle = Session(dict(NO_CACHES)).collect(_sum_query(tab))
+        assert len(results) == 2
+        for t in results:
+            assert t.equals(oracle)
+        snap = sharing.metrics().snapshot()
+        assert snap["inflightPromotedCount"] == 1
+        assert snap["inflightServedCount"] == 1
+
+    def test_drop_while_waiter_parked_reexecutes(self):
+        """Ordering (a) end-to-end at the session layer: the table is
+        invalidated while a duplicate is parked — the waiter re-leads
+        and re-executes instead of consuming a result the drop
+        outdated."""
+        tab = _ints()
+        conf = dict(NO_CACHES, **SHARING_ON)
+        ses1 = Session(dict(conf))
+        assert ses1.try_cached_result(_sum_query(tab)) is None
+        results, errs = [], []
+
+        def dup():
+            ses = Session(dict(conf))
+            try:
+                df = _sum_query(tab)
+                t = ses.try_cached_result(df)
+                if t is None:
+                    t = ses.collect(df)
+                results.append((t, dict(ses.last_cache)))
+            except BaseException as e:
+                errs.append(e)
+
+        th = threading.Thread(target=dup, daemon=True)
+        th.start()
+        time.sleep(0.1)
+        n = sharing.invalidate_digest(plancache.content_digest(tab))
+        assert n >= 1
+        th.join(timeout=30)
+        assert errs == []
+        oracle = Session(dict(NO_CACHES)).collect(_sum_query(tab))
+        assert results and results[0][0].equals(oracle)
+        # the waiter re-executed: it was NOT served the parked flight
+        assert results[0][1].get("result") != "inflight"
+        assert sharing.metrics().snapshot()[
+            "inflightInvalidatedCount"] >= 1
+        # the original leader's own collect still succeeds (its
+        # complete() just publishes to nobody)
+        assert ses1.collect(_sum_query(tab)).equals(oracle)
+
+
+# ---------------------------------------------------------------------------
+# 2. subplan result cache: shared scan+filter subtree, divergent aggs
+# ---------------------------------------------------------------------------
+
+
+class TestSubplanShare:
+    def test_divergent_aggregates_share_subtree(self):
+        tab = _ints()
+        conf = dict(NO_CACHES, **SHARING_ON)
+
+        def q_sum():
+            return (table(tab).where(col("v") > lit(10))
+                    .group_by("k").agg(Sum(col("v")).alias("s")))
+
+        def q_max():
+            return (table(tab).where(col("v") > lit(10))
+                    .group_by("k").agg(Max(col("v")).alias("m"),
+                                       Count().alias("n")))
+
+        ses1 = Session(dict(conf))
+        r_sum = ses1.collect(q_sum())
+        assert ses1.last_cache.get("subplan") == "store"
+        ses2 = Session(dict(conf))
+        r_max = ses2.collect(q_max())
+        assert ses2.last_cache.get("subplan") == "hit"
+        snap = sharing.metrics().snapshot()
+        assert snap["subplanStoreCount"] >= 1
+        assert snap["subplanHitCount"] == 1
+        # bit-for-bit against the sharing-off oracle for BOTH queries
+        off = Session(dict(NO_CACHES))
+        assert r_sum.equals(off.collect(q_sum()))
+        assert r_max.equals(off.collect(q_max()))
+
+    def test_float_subtrees_stay_unshared(self):
+        """FLOAT64 columns in the subtree output are excluded (exact
+        arithmetic is the bit-for-bit guarantee; float reductions may
+        differ across padding shapes) — no store, no hit."""
+        rng = np.random.default_rng(3)
+        tab = pa.table({
+            "k": rng.integers(0, 8, 500).astype(np.int64),
+            "x": rng.uniform(0, 1, 500),
+        })
+        conf = dict(NO_CACHES, **SHARING_ON)
+        ses = Session(dict(conf))
+        ses.collect(table(tab).where(col("x") > lit(0.25))
+                    .group_by("k").agg(Count().alias("n")))
+        assert "subplan" not in ses.last_cache
+        assert sharing.metrics().snapshot()["subplanStoreCount"] == 0
+
+    def test_drop_invalidates_subplan_entries(self):
+        tab = _ints()
+        conf = dict(NO_CACHES, **SHARING_ON)
+        ses = Session(dict(conf))
+        ses.collect(_sum_query(tab))
+        assert len(sharing.subplan_cache()) == 1
+        assert sharing.invalidate_digest(
+            plancache.content_digest(tab)) >= 1
+        assert len(sharing.subplan_cache()) == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. scan sharing: one upload, refcount hygiene, invalidation
+# ---------------------------------------------------------------------------
+
+
+class TestScanShare:
+    def test_repeat_scan_rides_one_upload_and_unpins(self):
+        tab = _ints()
+        conf = dict(NO_CACHES, **SHARING_ON)
+        ses1 = Session(dict(conf))
+        r1 = ses1.collect(_sum_query(tab))
+        snap = sharing.metrics().snapshot()
+        assert snap["scanShareUploadCount"] >= 1
+        st = sharing.scan_share().stats()
+        assert st["entries"] >= 1 and st["usedBytes"] > 0
+        # every pin released at close — the leak check
+        assert st["pinnedRefs"] == 0, st
+        uploads0 = snap["scanShareUploadCount"]
+        ses2 = Session(dict(conf))
+        r2 = ses2.collect(_sum_query(tab))
+        snap2 = sharing.metrics().snapshot()
+        assert snap2["scanShareHitCount"] >= 1
+        assert snap2["scanShareUploadCount"] == uploads0  # no re-upload
+        assert r2.equals(r1)
+        assert sharing.scan_share().stats()["pinnedRefs"] == 0
+
+    def test_invalidation_stops_handing_out_entries(self):
+        tab = _ints()
+        conf = dict(NO_CACHES, **SHARING_ON)
+        Session(dict(conf)).collect(_sum_query(tab))
+        assert sharing.scan_share().stats()["entries"] >= 1
+        dig = plancache.content_digest(tab)
+        assert sharing.invalidate_digest(dig) >= 1
+        # no entry for the dropped table's content remains reachable
+        # (subplan-materialized intermediates keyed on OTHER digests
+        # may stay warm — they can only be hit by identical content)
+        reg = sharing.scan_share()
+        with reg._lock:
+            assert all(e.digest != dig for e in reg._entries.values())
+        # post-drop queries re-upload and still answer correctly
+        ses = Session(dict(conf))
+        got = ses.collect(_sum_query(tab))
+        assert got.equals(Session(dict(NO_CACHES))
+                          .collect(_sum_query(tab)))
+        assert sharing.metrics().snapshot()[
+            "scanShareInvalidationCount"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# 4. satellite: file-backed scans are result-cacheable on file stats
+# ---------------------------------------------------------------------------
+
+
+class TestFileScanResultKey:
+    def _write(self, path, seed):
+        import pyarrow.parquet as pq
+        rng = np.random.default_rng(seed)
+        pq.write_table(pa.table({
+            "k": rng.integers(0, 16, 1000).astype(np.int64),
+            "v": rng.integers(0, 100, 1000).astype(np.int64),
+        }), str(path))
+
+    def test_stat_keyed_result_cache_and_rewrite_invalidation(
+            self, tmp_path):
+        import os
+        from spark_rapids_tpu.io.scan import read_parquet
+        p = tmp_path / "t.parquet"
+        self._write(p, seed=1)
+        conf = {"spark.rapids.tpu.server.resultCache.enabled": "true"}
+
+        def q():
+            return (read_parquet([str(p)])
+                    .group_by("k").agg(Sum(col("v")).alias("s")))
+
+        # the old behavior raised Uncacheable for ANY file scan; now
+        # the key embeds per-file (path, mtime_ns, size)
+        key1, digs = plancache.result_key(q().plan,
+                                          Session(conf).conf)
+        assert key1 and isinstance(digs, tuple)
+        ses = Session(dict(conf))
+        r1 = ses.collect(q())
+        assert ses.try_cached_result(q()) is not None   # cache hit
+        # rewrite with NEW data (and force an mtime step for coarse
+        # filesystem clocks): the stat changes, so the key changes —
+        # the stale entry is unreachable, the query recomputes
+        self._write(p, seed=2)
+        st = os.stat(str(p))
+        os.utime(str(p), ns=(st.st_atime_ns, st.st_mtime_ns + 10**7))
+        key2, _ = plancache.result_key(q().plan, Session(conf).conf)
+        assert key2 != key1
+        assert ses.try_cached_result(q()) is None       # miss
+        r2 = ses.collect(q())
+        assert not r2.equals(r1)       # really recomputed on new bytes
+
+    def test_statless_source_stays_loudly_uncacheable(self):
+        from spark_rapids_tpu.io.parquet import ParquetSource
+        from spark_rapids_tpu.plan.logical import DataFrame, LogicalScan
+        src = ParquetSource(["/nonexistent/never-there.parquet"])
+        df = DataFrame(LogicalScan((), source=src, _schema=None))
+        with pytest.raises(plancache.Uncacheable):
+            plancache.result_key(df.plan, Session({}).conf)
+
+
+# ---------------------------------------------------------------------------
+# 5. sharing OFF is byte-identical (the conf-gate differential)
+# ---------------------------------------------------------------------------
+
+
+def test_sharing_off_is_byte_identical():
+    tab = _ints()
+    df_on = _sum_query(tab)
+    on = Session(dict(NO_CACHES, **SHARING_ON))
+    off = Session(dict(NO_CACHES))
+    b_on = protocol.table_to_ipc(on.collect(df_on))
+    before = sharing.metrics().snapshot()
+    b_off = protocol.table_to_ipc(off.collect(_sum_query(tab)))
+    after = sharing.metrics().snapshot()
+    assert b_on == b_off
+    # the off session never touched a sharing structure
+    assert before == after
+    assert len(sharing.subplan_cache()) >= 0  # structures exist, idle
+
+
+# ---------------------------------------------------------------------------
+# 6. fleet: N identical concurrent clients execute exactly once
+# ---------------------------------------------------------------------------
+
+
+N_DUP = 6
+
+
+def test_fleet_inflight_dedup_executes_exactly_once():
+    tab = _ints(seed=23)
+    router = Router(
+        workers=2,
+        conf=dict(SHARING_ON),
+        worker_conf={
+            "spark.rapids.tpu.server.resultCache.enabled": "false",
+            # holds the leader in its collect slot long enough that
+            # every duplicate is provably parked, not racing
+            "spark.rapids.tpu.server.test.collectDelayMs": "900",
+        }).start()
+    barrier = threading.Barrier(N_DUP)
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def client(ci):
+        try:
+            with PlanClient("127.0.0.1", router.port,
+                            unavailable_retries=4) as c:
+                barrier.wait(timeout=60)
+                t = c.collect(_sum_query(tab))
+                with lock:
+                    results.append((t, c.last_sharing,
+                                    dict(c.last_cache)))
+        except Exception as e:
+            barrier.abort()
+            with lock:
+                errors.append(f"client {ci}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(N_DUP)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert errors == [], errors
+        assert len(results) == N_DUP
+        oracle = Session(dict(NO_CACHES)).collect(_sum_query(tab))
+        for t, _, _ in results:
+            assert t.equals(oracle)
+        # exactly ONE worker dispatch for N identical queries
+        st = router.serving_stats()
+        assert sum(st["routing"]["perWorkerPlans"].values()) == 1, st
+        sh = st["sharing"]
+        assert sh["inflightLeaderCount"] == 1, sh
+        assert sh["inflightServedCount"] == N_DUP - 1, sh
+        served = sum(1 for _, s, _ in results if s == "inflight")
+        assert served == N_DUP - 1
+    finally:
+        router.stop(grace_s=5)
+
+
+@pytest.mark.slow
+def test_fleet_sharing_bit_for_bit_vs_oracle():
+    """Threaded clients x the five bench shapes through a sharing-ON
+    2-worker fleet: every result equals the sharing-off in-process
+    oracle — dedup/subplan/scan sharing may change WHAT executes,
+    never what is answered. Multi-minute: full (nightly) tier, like
+    the adaptive differentials."""
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(11)
+    n = 1500
+    tabs = {
+        "lineitem": pa.table({
+            "k": rng.integers(0, 3, n).astype(np.int32),
+            "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+            "l_extendedprice": rng.uniform(1.0, 1e5, n),
+        }),
+        "facts": pa.table({
+            "k": rng.integers(0, 64, n).astype(np.int64),
+            "v": rng.integers(-1000, 1000, n).astype(np.int64),
+        }),
+        "dims": pa.table({
+            "k": np.arange(64, dtype=np.int64),
+            "w": (np.arange(64) % 10).astype(np.int64),
+        }),
+    }
+
+    def shapes(tmpdir):
+        from spark_rapids_tpu.exec.sort import asc
+        from spark_rapids_tpu.io.scan import read_parquet
+        ppath = str(tmpdir / "ws.parquet")
+        pq.write_table(tabs["facts"], ppath)
+
+        def q1(v):
+            return (table(tabs["lineitem"])
+                    .where(col("l_quantity") > lit(int(v)))
+                    .group_by("k")
+                    .agg(Sum(col("l_extendedprice")).alias("rev"),
+                         Count().alias("c")))
+
+        def agg_sum(v):
+            return _sum_query(tabs["facts"], v)
+
+        def join_sort(v):
+            return (table(tabs["facts"])
+                    .where(col("v") > lit(int(v)))
+                    .join(table(tabs["dims"]), ["k"], ["k"])
+                    .group_by("w").agg(Sum(col("v")).alias("s"))
+                    .order_by(asc(col("w"))))
+
+        def parquet_scan(v):
+            return (read_parquet([ppath])
+                    .where(col("v") > lit(int(v)))
+                    .group_by("k").agg(Count().alias("c")))
+
+        def exchange(v):
+            return (table(tabs["facts"], num_slices=4)
+                    .where(col("v") > lit(int(v)))
+                    .group_by("k").agg(Sum(col("v")).alias("s")))
+
+        return [("q1", q1), ("agg", agg_sum), ("join_sort", join_sort),
+                ("parquet", parquet_scan), ("exchange", exchange)]
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        from pathlib import Path
+        sh = shapes(Path(td))
+        router = Router(workers=2, conf=dict(SHARING_ON)).start()
+        results, errors = {}, []
+        lock = threading.Lock()
+
+        def client(ci):
+            try:
+                with PlanClient("127.0.0.1", router.port,
+                                unavailable_retries=4) as c:
+                    for r in range(2):
+                        for name, build in sh:
+                            t = c.collect(build(10 + r * 3))
+                            with lock:
+                                results[(ci, name, r)] = t
+            except Exception as e:
+                with lock:
+                    errors.append(
+                        f"client {ci}: {type(e).__name__}: {e}")
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True) for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert errors == [], errors
+            oracle = Session(dict(NO_CACHES))
+            for r in range(2):
+                for name, build in sh:
+                    want = oracle.collect(build(10 + r * 3))
+                    for ci in range(3):
+                        got = results[(ci, name, r)]
+                        assert got.equals(want), \
+                            f"client {ci} {name} round {r} diverged " \
+                            f"with sharing on"
+        finally:
+            router.stop(grace_s=5)
+
+
+# ---------------------------------------------------------------------------
+# 7. smoke-tier sharing loadbench job (~20s): rides the
+#    `pytest -m "serving and smoke"` mini load gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_sharing_loadbench_smoke():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import server_loadbench
+    finally:
+        sys.path.pop(0)
+    book = {}
+    rep = server_loadbench.run_fleet_load(
+        clients=8, rounds=2, rows=1000, fleet=2, shapes_per_client=2,
+        duplicate_fraction=0.5, sharing=True, digest_book=book)
+    assert rep["errors"] == 0, rep["error_samples"]
+    assert rep["queries"] == 8 * 2 * 2
+    assert rep["leaked_sessions"] == 0
+    assert rep["dup"]["n"] == 4 * 2 * 2       # 4 duplicator clients
+    # duplicates were actually deduped in flight somewhere in the stack
+    # (router tier and/or a worker), and the counters say so loudly
+    r_sh = rep["sharing_counters"]["router"] or {}
+    w_sh = rep["sharing_counters"]["workers"] or {}
+    served = rep["dedup_served"] + \
+        w_sh.get("inflightServedCount", 0)
+    assert served >= 1, rep["sharing_counters"]
+    assert r_sh.get("inflightLeaderCount", 0) >= 1
+    # bit-for-bit book: every (shape, literal) answered identically
+    assert len(book) >= 2

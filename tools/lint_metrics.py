@@ -53,6 +53,7 @@ GROUPS: Dict[str, Tuple[str, str]] = {
     "plan/plancache.py": ("ServingMetrics", "cache"),
     "trace.py": ("TraceMetrics", "trace"),
     "plan/adaptive.py": ("AdaptiveMetrics", "adaptive"),
+    "plan/sharing.py": ("SharingMetrics", "sharing"),
 }
 
 SESSION = os.path.join(PKG, "plan", "session.py")

@@ -228,6 +228,9 @@ def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
                    shape_variants: int = 0,
                    shapes_per_client: int = 0,
                    cpus_per_worker: int = 0,
+                   duplicate_fraction: float = 0.0,
+                   sharing: bool = False,
+                   digest_book: dict = None,
                    host: str = "127.0.0.1",
                    client_timeout: float = 900.0) -> dict:
     """Drive ``clients`` threads through a Router over ``fleet`` worker
@@ -247,7 +250,18 @@ def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
     which measures ring imbalance, not fleet throughput.
     ``shapes_per_client`` > 0 gives each client a deterministic subset
     (variants stay shared ACROSS clients, so repeats still hit warm
-    caches) to bound total query count at high client counts."""
+    caches) to bound total query count at high client counts.
+
+    ``duplicate_fraction`` > 0 turns that fraction of clients into
+    *duplicators*: each round they all submit the SAME query (same
+    shape, same literal, synchronized at a round barrier), the
+    duplicate-heavy leg of the cross-query work-sharing acceptance
+    (ISSUE 18). ``sharing`` enables
+    ``spark.rapids.tpu.server.sharing.*`` router- and worker-side; the
+    report then carries per-leg dedup / subplan / scan-share counters.
+    ``digest_book`` (a shared dict) bit-for-bit-gates results: every
+    (shape, literal) result's content digest must match across clients,
+    rounds, and LEGS (pass the same dict to the sharing-off leg)."""
     from spark_rapids_tpu.server import PlanClient
     from spark_rapids_tpu.server.router import Router
 
@@ -275,11 +289,17 @@ def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
                 lambda v, _b=build, _j=j: _b(v).limit(10**9 - _j)))
     else:
         shapes = base
+    base_conf = {"spark.rapids.tpu.server.fleet.tenant.weights":
+                 ",".join(f"t{i}={1 + i % 2}" for i in range(tenants))}
+    if sharing:
+        # conf feeds router AND workers: the router dedups in-flight
+        # duplicates before they reach a worker; a worker dedups the
+        # ones that slip through (and runs subplan/scan sharing)
+        base_conf["spark.rapids.tpu.server.sharing.enabled"] = "true"
     router = Router(
         workers=fleet,
         worker_cpusets=cpusets,
-        conf={"spark.rapids.tpu.server.fleet.tenant.weights":
-              ",".join(f"t{i}={1 + i % 2}" for i in range(tenants))},
+        conf=base_conf,
         worker_conf={
             "spark.rapids.tpu.server.resultCache.enabled":
                 str(result_cache),
@@ -288,19 +308,40 @@ def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
             "spark.rapids.tpu.server.maxSessions":
                 str(max(64, clients + 8)),
         }).start()
-    samples = []          # (shape, kind, ms, tenant, worker, cached)
+    samples = []    # (shape, kind, ms, tenant, worker, cached, sharing)
     lock = threading.Lock()
     errors = []
     finished_clients = [0]
     restart_report = {}
     restart_done = threading.Event()
+    # duplicate-heavy legs synchronize each round so the duplicators'
+    # queries actually overlap in flight (what in-flight dedup dedups);
+    # a broken barrier (an errored client) degrades to free-running
+    barrier = threading.Barrier(clients) \
+        if duplicate_fraction > 0 and clients > 1 \
+        and not rolling_restart else None
+
+    def _round_sync():
+        if barrier is None:
+            return
+        try:
+            barrier.wait(timeout=client_timeout)
+        except threading.BrokenBarrierError:
+            pass
 
     def worker(ci: int):
         tenant = f"t{ci % tenants}"
+        duplicator = duplicate_fraction > 0 and \
+            ci < int(clients * duplicate_fraction + 0.5)
         my_shapes = list(enumerate(shapes))
         if shapes_per_client and shapes_per_client < len(shapes):
             my_shapes = [my_shapes[(ci * 7 + m * 13) % len(shapes)]
                          for m in range(shapes_per_client)]
+        if duplicator:
+            # every duplicator drives the SAME deterministic shape list
+            my_shapes = list(enumerate(shapes))
+            if shapes_per_client and shapes_per_client < len(shapes):
+                my_shapes = my_shapes[:shapes_per_client]
         try:
             with PlanClient(
                     host, router.port, timeout=client_timeout,
@@ -314,29 +355,55 @@ def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
                 # rehydration); bounded in case the roll wedges
                 r, extra = 0, 0
                 while True:
+                    _round_sync()
                     for si, (name, build) in my_shapes:
-                        unique = r > 0 and \
-                            ((ci * 31 + r * 7 + si) % 100) < \
-                            unique_fraction * 100
-                        lit_v = 25 if (repeat_literals or r == 0) else \
-                            1 + (ci * 131 + r * 17 + si * 7) % 900
-                        df = build(lit_v)
-                        if unique:
-                            # a distinct limit bound = a distinct plan
-                            # SHAPE (plan fields stay in the
-                            # fingerprint): cold planning, same rows
-                            df = df.limit(
-                                10**9 - (ci * 997 + r * 131 + si))
-                            kind = "unique"
+                        if duplicator:
+                            # IDENTICAL to every other duplicator this
+                            # round: same shape, same literal — the
+                            # in-flight dedup leg
+                            lit_v = 25 if r == 0 else \
+                                1 + (r * 17 + si * 7) % 900
+                            df = build(lit_v)
+                            kind = "dup"
+                            qkey = (name, lit_v, 0)
                         else:
-                            kind = "first" if r == 0 else "repeat"
+                            unique = r > 0 and \
+                                ((ci * 31 + r * 7 + si) % 100) < \
+                                unique_fraction * 100
+                            lit_v = 25 if (repeat_literals or r == 0) \
+                                else 1 + (ci * 131 + r * 17 + si * 7) \
+                                % 900
+                            df = build(lit_v)
+                            qkey = (name, lit_v, 0)
+                            if unique:
+                                # a distinct limit bound = a distinct
+                                # plan SHAPE (plan fields stay in the
+                                # fingerprint): cold planning, same rows
+                                bound = 10**9 - (ci * 997 + r * 131 + si)
+                                df = df.limit(bound)
+                                kind = "unique"
+                                qkey = (name, lit_v, bound)
+                            else:
+                                kind = "first" if r == 0 else "repeat"
                         t0 = time.perf_counter()
-                        c.collect(df)
+                        out = c.collect(df)
                         ms = (time.perf_counter() - t0) * 1e3
+                        if digest_book is not None:
+                            # bit-for-bit gate, within AND across legs
+                            from spark_rapids_tpu.plan.plancache import \
+                                content_digest
+                            d = content_digest(out)
+                            with lock:
+                                seen = digest_book.setdefault(qkey, d)
+                            if seen != d:
+                                raise AssertionError(
+                                    f"result diverged for {qkey}: "
+                                    f"{d} != {seen}")
                         with lock:
                             samples.append(
                                 (name, kind, ms, tenant,
-                                 c.last_worker, c.last_cached))
+                                 c.last_worker, c.last_cached,
+                                 c.last_sharing))
                     r += 1
                     if r < rounds:
                         continue
@@ -347,6 +414,8 @@ def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
                             break
                         extra += 1      # the proving post-restart round
         except Exception as e:    # surfaced in the report
+            if barrier is not None:
+                barrier.abort()   # never strand the healthy clients
             with lock:
                 errors.append(f"client {ci}: {type(e).__name__}: {e}")
         finally:
@@ -408,12 +477,22 @@ def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
     rehydration = sum(
         (ws or {}).get("counters", {}).get("resultStoreHitCount", 0)
         for ws in stats["workers"].values())
+    # per-leg work-sharing counters: the router's own dedup block plus
+    # every worker's sharing block summed (a worker that died mid-run
+    # reports null and is skipped)
+    worker_sharing = {}
+    for ws in stats["workers"].values():
+        for k, v in ((ws or {}).get("sharing") or {}).items():
+            if isinstance(v, int):
+                worker_sharing[k] = worker_sharing.get(k, 0) + v
     return {
         "fleet": fleet, "clients": clients, "rounds": rounds,
         "rows": rows, "tenants_n": tenants,
         "result_cache": result_cache,
         "repeat_literals": repeat_literals,
         "concurrent_collects": concurrent_collects,
+        "sharing": sharing,
+        "duplicate_fraction": duplicate_fraction,
         "wall_s": round(wall, 3),
         "qps": round(len(samples) / wall, 1) if wall else 0.0,
         "queries": len(samples),
@@ -423,6 +502,12 @@ def run_fleet_load(clients: int, rounds: int, rows: int, fleet: int,
         "repeat": agg(lambda s: s[1] == "repeat"),
         "unique": agg(lambda s: s[1] == "unique"),
         "first": agg(lambda s: s[1] == "first"),
+        "dup": agg(lambda s: s[1] == "dup"),
+        "dedup_served": sum(1 for s in samples if s[6] == "inflight"),
+        "sharing_counters": {
+            "router": stats.get("sharing"),
+            "workers": worker_sharing or None,
+        },
         "result_cache_served": sum(1 for s in samples if s[5]),
         "per_worker_qps": {
             "plans": per_worker_plans,
@@ -480,6 +565,16 @@ def main(argv=None) -> int:
                    help="taskset-pin each worker to this many cores so "
                         "a single-host 1-vs-N comparison holds "
                         "per-worker compute constant across legs")
+    p.add_argument("--duplicate-fraction", type=float, default=0.0,
+                   help="fleet mode: this fraction of clients submit "
+                        "the SAME query each round (synchronized) — "
+                        "the in-flight-dedup duplicate-heavy leg")
+    p.add_argument("--sharing-compare", action="store_true",
+                   help="fleet mode: run the identical duplicate-heavy "
+                        "workload twice — sharing.* ON then OFF — "
+                        "bit-for-bit gated through a shared digest "
+                        "book, and report the QPS ratio (the ISSUE 18 "
+                        "acceptance leg)")
     p.add_argument("--restart-under-load", action="store_true",
                    help="fleet mode: add a leg that rolls the whole "
                         "fleet mid-run (result cache ON, repeated "
@@ -492,7 +587,37 @@ def main(argv=None) -> int:
                         "of tracing vs the untraced legs")
     args = p.parse_args(argv)
 
-    if args.fleet > 0:
+    if args.fleet > 0 and args.sharing_compare:
+        # the ISSUE 18 acceptance instrument: identical duplicate-heavy
+        # workload, sharing ON vs OFF, one shared digest book so every
+        # result is bit-for-bit gated across clients, rounds, AND legs
+        book: dict = {}
+        on = run_fleet_load(
+            args.clients, args.rounds, args.rows, fleet=args.fleet,
+            tenants=args.tenants,
+            unique_fraction=args.unique_fraction,
+            concurrent_collects=args.concurrent_collects,
+            duplicate_fraction=args.duplicate_fraction,
+            sharing=True, digest_book=book,
+            client_timeout=args.client_timeout)
+        off = run_fleet_load(
+            args.clients, args.rounds, args.rows, fleet=args.fleet,
+            tenants=args.tenants,
+            unique_fraction=args.unique_fraction,
+            concurrent_collects=args.concurrent_collects,
+            duplicate_fraction=args.duplicate_fraction,
+            sharing=False, digest_book=book,
+            client_timeout=args.client_timeout)
+        report = {
+            "sharing_on": on, "sharing_off": off,
+            "bit_for_bit_queries": len(book),
+            "qps_speedup": round(on["qps"] / off["qps"], 3)
+            if off["qps"] else None,
+            "dup_qps_speedup": round(
+                on["dup"]["qps"] / off["dup"]["qps"], 3)
+            if off["dup"]["qps"] else None,
+        }
+    elif args.fleet > 0:
         report = {"fleet_loadbench": run_fleet_load(
             args.clients, args.rounds, args.rows, fleet=args.fleet,
             tenants=args.tenants,
@@ -501,6 +626,7 @@ def main(argv=None) -> int:
             shape_variants=args.shape_variants,
             shapes_per_client=args.shapes_per_client,
             cpus_per_worker=args.cpus_per_worker,
+            duplicate_fraction=args.duplicate_fraction,
             client_timeout=args.client_timeout)}
         if args.compare:
             cc = args.compare_clients or args.clients
